@@ -1,0 +1,137 @@
+"""pathway_tpu: a TPU-native incremental streaming dataflow framework.
+
+A ground-up rebuild of the capabilities of the reference Pathway framework
+(/root/reference) designed for TPU hardware: the incremental engine runs
+bulk-synchronous epochs over columnar delta batches (engine/), ML hot
+paths (embedders, rerankers, vector search) are jit-batched JAX/Flax
+models with indexes resident in HBM (models/, ops/, xpacks/), and
+multi-worker scaling shards tables over a jax.sharding.Mesh (parallel/).
+
+Usage mirrors the reference's `import pathway as pw` surface:
+
+    import pathway_tpu as pw
+
+    t = pw.debug.table_from_markdown('''
+        | owner | pet | age
+      1 | Alice | dog | 2
+      2 | Bob   | cat | 3
+    ''')
+    result = t.filter(pw.this.age >= 3).select(pw.this.owner)
+    pw.debug.compute_and_print(result)
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .internals import dtype as dt
+from .internals.dtype import (
+    ANY,
+    BOOL,
+    BYTES,
+    DATE_TIME_NAIVE,
+    DATE_TIME_UTC,
+    DURATION,
+    FLOAT,
+    INT,
+    STR,
+)
+from .internals import expression as _expr
+from .internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    apply,
+    apply_async,
+    apply_fully_async,
+    apply_with_type,
+    cast,
+    coalesce,
+    declare_type,
+    fill_error,
+    if_else,
+    make_tuple,
+    require,
+    unwrap,
+)
+from .internals.schema import (
+    ColumnDefinition,
+    Schema,
+    column_definition,
+    schema_builder,
+    schema_from_csv,
+    schema_from_dict,
+    schema_from_pandas,
+    schema_from_types,
+)
+from .internals.table import (
+    GroupedTable,
+    JoinMode,
+    JoinResult,
+    Table,
+)
+from .internals.thisclass import left, right, this
+from .internals.run import run, run_all
+from .internals.parse_graph import G as parse_graph, clear_graph
+from .internals import udfs
+from .internals.udfs import UDF, udf
+from .internals.config import pathway_config, set_license_key, set_monitoring_config
+from .internals.iterate import iterate, iterate_universe
+from .internals.yaml_loader import load_yaml
+from .internals.sql import sql
+from .engine.value import (
+    Json,
+    Pointer,
+    PyObjectWrapper,
+    ref_scalar,
+    unsafe_make_pointer,
+    wrap_py_object,
+)
+from . import reducers
+from . import debug
+from . import demo
+from . import io
+from . import stdlib
+from .stdlib import graphs, indexing, ml, ordered, statistical, stateful, temporal, utils
+from .stdlib.utils.async_transformer import AsyncTransformer
+from .stdlib.utils.col import unpack_col
+from .stdlib.utils.pandas_transformer import pandas_transformer
+from . import persistence
+from . import xpacks
+from .internals.monitoring import MonitoringLevel
+from .internals.custom_reducers import BaseCustomAccumulator
+
+# engine namespace parity (reference pathway.engine is the PyO3 module)
+from . import engine
+
+universes = stdlib.utils  # placeholder namespace parity
+
+
+def __getattr__(name):
+    if name == "Duration":
+        import datetime
+
+        return datetime.timedelta
+    if name == "DateTimeNaive" or name == "DateTimeUtc":
+        import datetime
+
+        return datetime.datetime
+    raise AttributeError(f"module 'pathway_tpu' has no attribute {name!r}")
+
+
+__all__ = [
+    "ANY", "BOOL", "BYTES", "DATE_TIME_NAIVE", "DATE_TIME_UTC", "DURATION",
+    "FLOAT", "INT", "STR", "AsyncTransformer", "BaseCustomAccumulator",
+    "ColumnDefinition", "ColumnExpression", "ColumnReference", "GroupedTable",
+    "JoinMode", "JoinResult", "Json", "MonitoringLevel", "Pointer",
+    "PyObjectWrapper", "Schema", "Table", "UDF", "apply", "apply_async",
+    "apply_fully_async", "apply_with_type", "cast", "clear_graph", "coalesce",
+    "column_definition", "debug", "declare_type", "demo", "dt", "engine",
+    "fill_error", "if_else", "indexing", "io", "iterate", "iterate_universe",
+    "left", "load_yaml", "make_tuple", "ml", "parse_graph", "pathway_config",
+    "persistence", "reducers", "ref_scalar", "require", "right", "run",
+    "run_all", "schema_builder", "schema_from_csv", "schema_from_dict",
+    "schema_from_pandas", "schema_from_types", "set_license_key",
+    "set_monitoring_config", "sql", "stdlib", "temporal", "this", "udf",
+    "udfs", "unpack_col", "unsafe_make_pointer", "unwrap", "utils",
+    "wrap_py_object", "xpacks",
+]

@@ -1,0 +1,311 @@
+"""pw.debug: markdown fixtures, compute_and_print, pandas bridges.
+
+Rebuild of /root/reference/python/pathway/debug/__init__.py (716 LoC):
+table_from_markdown with the virtual __time__/__diff__ columns used by the
+streaming test harness (see reference stdlib/ml/index.py:145-172 docstring),
+compute_and_print, compute_and_print_update_stream, table_to_pandas."""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Iterable
+
+import numpy as np
+
+from ..internals import dtype as dt
+from ..internals.graph_runner import GraphRunner
+from ..internals.schema import Schema, SchemaMetaclass, schema_from_types
+from ..internals.table import Column, LogicalOp, Table
+from ..internals.universe import Universe
+from ..engine.value import Pointer, ref_scalar
+
+_SPECIAL = ("__time__", "__diff__")
+
+
+def _parse_value(tok: str) -> Any:
+    tok = tok.strip()
+    if tok in ("", "None"):
+        return None
+    if tok == "True" or tok == "true":
+        return True
+    if tok == "False" or tok == "false":
+        return False
+    try:
+        return ast.literal_eval(tok)
+    except (ValueError, SyntaxError):
+        return tok
+
+
+def _infer_dtype(values: list[Any]) -> dt.DType:
+    non_null = [v for v in values if v is not None]
+    if not non_null:
+        return dt.ANY
+    tset = {type(v) for v in non_null}
+    if tset <= {int}:
+        return dt.INT if len(non_null) == len(values) else dt.Optional(dt.INT)
+    if tset <= {int, float}:
+        return dt.FLOAT if len(non_null) == len(values) else dt.Optional(dt.FLOAT)
+    if tset <= {bool}:
+        return dt.BOOL if len(non_null) == len(values) else dt.Optional(dt.BOOL)
+    if tset <= {str}:
+        return dt.STR if len(non_null) == len(values) else dt.Optional(dt.STR)
+    if tset <= {tuple}:
+        return dt.ANY_TUPLE
+    return dt.ANY
+
+
+def table_from_markdown(
+    table_def: str,
+    *,
+    id_from: list[str] | None = None,
+    schema: type[Schema] | None = None,
+    _stream: bool = False,
+) -> Table:
+    """Parse a markdown/ascii table:
+
+        t = pw.debug.table_from_markdown('''
+            | colA | colB | __time__ | __diff__
+          1 | 1    | foo  | 2        | 1
+          2 | 2    | bar  | 4        | 1
+        ''')
+
+    The first (unnamed) column, when present, is the row id. __time__ and
+    __diff__ script the update stream."""
+    lines = [ln for ln in table_def.strip().splitlines() if ln.strip()]
+    header = [h.strip() for h in lines[0].split("|")]
+    has_id_col = header[0] == ""
+    names = [h for h in header if h != ""]
+    rows_raw: list[tuple[str | None, list[Any]]] = []
+    for ln in lines[1:]:
+        if set(ln.strip()) <= {"-", "|", " ", "="}:
+            continue
+        parts = [p for p in ln.split("|")]
+        if has_id_col:
+            rid = parts[0].strip() or None
+            vals = [_parse_value(p) for p in parts[1 : 1 + len(names)]]
+        else:
+            rid = None
+            vals = [_parse_value(p) for p in parts[1 : 1 + len(names)] if True]
+            if len(parts) == len(names):  # no leading pipe
+                vals = [_parse_value(p) for p in parts[: len(names)]]
+        while len(vals) < len(names):
+            vals.append(None)
+        rows_raw.append((rid, vals))
+
+    data_names = [n for n in names if n not in _SPECIAL]
+    time_idx = names.index("__time__") if "__time__" in names else None
+    diff_idx = names.index("__diff__") if "__diff__" in names else None
+    shard_idx = names.index("__shard__") if "__shard__" in names else None
+
+    records = []
+    for i, (rid, vals) in enumerate(rows_raw):
+        data = [v for n, v in zip(names, vals) if n not in _SPECIAL and n != "__shard__"]
+        time = vals[time_idx] if time_idx is not None else 0
+        diff = vals[diff_idx] if diff_idx is not None else 1
+        if time is None:
+            time = 0
+        if diff is None:
+            diff = 1
+        records.append((rid, i, tuple(data), int(time), int(diff)))
+
+    # column dtypes
+    if schema is not None:
+        dtypes = schema.dtypes()
+        data_names = [n for n in data_names if n != "__shard__"]
+        cols = {n: Column(dtypes.get(n, dt.ANY)) for n in data_names}
+        pk = schema.primary_key_columns()
+    else:
+        data_names = [n for n in data_names if n != "__shard__"]
+        cols = {}
+        for j, n in enumerate(data_names):
+            cols[n] = Column(_infer_dtype([rec[2][j] for rec in records]))
+        pk = id_from
+
+    # coerce ints to float where column is FLOAT
+    coerced_records = []
+    for rid, i, data, time, diff in records:
+        vals = []
+        for j, n in enumerate(data_names):
+            v = data[j]
+            if cols[n].dtype in (dt.FLOAT, dt.Optional(dt.FLOAT)) and isinstance(v, int):
+                v = float(v)
+            vals.append(v)
+        coerced_records.append((rid, i, tuple(vals), time, diff))
+
+    rows = []
+    key_cache: dict[Any, int] = {}
+    for rid, i, data, time, diff in coerced_records:
+        if pk:
+            kvals = [data[data_names.index(n)] for n in pk]
+            key = ref_scalar(*kvals)
+        elif rid is not None:
+            key = key_cache.setdefault(rid, int(ref_scalar("__md__", rid)))
+        else:
+            key = int(ref_scalar("__mdrow__", i))
+        rows.append((int(key), data, time, diff))
+
+    op = LogicalOp("static", [], {"rows": rows})
+    return Table(cols, Universe(), op, name="markdown")
+
+
+# alias used by the reference
+parse_to_table = table_from_markdown
+
+
+def table_from_rows(
+    schema: type[Schema],
+    rows: Iterable[tuple],
+    *,
+    is_stream: bool = False,
+) -> Table:
+    """rows: tuples of column values; when is_stream, trailing (time, diff)."""
+    dtypes = schema.dtypes()
+    names = list(dtypes.keys())
+    pk = schema.primary_key_columns()
+    records = []
+    for i, row in enumerate(rows):
+        row = tuple(row)
+        if is_stream:
+            data, time, diff = row[: len(names)], row[len(names)], row[len(names) + 1] if len(row) > len(names) + 1 else 1
+        else:
+            data, time, diff = row[: len(names)], 0, 1
+        if pk:
+            key = ref_scalar(*[data[names.index(n)] for n in pk])
+        else:
+            key = ref_scalar("__row__", i)
+        records.append((int(key), tuple(data), int(time), int(diff)))
+    cols = {n: Column(t) for n, t in dtypes.items()}
+    op = LogicalOp("static", [], {"rows": records})
+    return Table(cols, Universe(), op, name="from_rows")
+
+
+def table_from_pandas(
+    df,
+    *,
+    id_from: list[str] | None = None,
+    schema: type[Schema] | None = None,
+) -> Table:
+    from ..internals.schema import schema_from_pandas
+
+    if schema is None:
+        schema = schema_from_pandas(df, id_from=id_from)
+    dtypes = schema.dtypes()
+    names = [n for n in dtypes.keys()]
+    records = []
+    for i, (idx, row) in enumerate(df.iterrows()):
+        data = []
+        for n in names:
+            v = row[n]
+            if isinstance(v, np.integer):
+                v = int(v)
+            elif isinstance(v, np.floating):
+                v = float(v)
+            elif isinstance(v, np.bool_):
+                v = bool(v)
+            data.append(v)
+        if id_from:
+            key = ref_scalar(*[data[names.index(n)] for n in id_from])
+        else:
+            key = ref_scalar("__pd__", i)
+        records.append((int(key), tuple(data), 0, 1))
+    cols = {n: Column(t) for n, t in dtypes.items()}
+    op = LogicalOp("static", [], {"rows": records})
+    return Table(cols, Universe(), op, name="from_pandas")
+
+
+def _run_capture(table: Table):
+    runner = GraphRunner(debug=True)
+    cap, names = runner.capture(table)
+    runner.run()
+    return cap, names
+
+
+def table_to_dicts(table: Table):
+    cap, names = _run_capture(table)
+    keys = sorted(cap.state.keys())
+    columns = {n: {k: cap.state[k][i] for k in keys} for i, n in enumerate(names)}
+    return keys, columns
+
+
+def table_to_pandas(table: Table, include_id: bool = True):
+    import pandas as pd
+
+    cap, names = _run_capture(table)
+    keys = sorted(cap.state.keys())
+    data = {n: [cap.state[k][i] for k in keys] for i, n in enumerate(names)}
+    if include_id:
+        return pd.DataFrame(data, index=[Pointer(k) for k in keys])
+    return pd.DataFrame(data)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return f"{v:.1f}"
+    return repr(v) if isinstance(v, str) else str(v)
+
+
+def compute_and_print(
+    table: Table,
+    *,
+    include_id: bool = True,
+    short_pointers: bool = True,
+    n_rows: int | None = None,
+    terminate_on_error: bool = True,
+) -> None:
+    cap, names = _run_capture(table)
+    keys = sorted(cap.state.keys())
+    if n_rows is not None:
+        keys = keys[:n_rows]
+    rows = []
+    for k in keys:
+        vals = [_fmt(v) for v in cap.state[k]]
+        rid = f"^{k:X}"
+        if short_pointers:
+            rid = rid[:8] + "..." if len(rid) > 8 else rid
+        rows.append(([rid] if include_id else []) + vals)
+    headers = ([""] if include_id else []) + names
+    widths = [
+        max(len(headers[c]), *(len(r[c]) for r in rows)) if rows else len(headers[c])
+        for c in range(len(headers))
+    ]
+    print(" | ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip())
+    for r in rows:
+        print(" | ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip())
+
+
+def compute_and_print_update_stream(
+    table: Table,
+    *,
+    include_id: bool = True,
+    short_pointers: bool = True,
+    n_rows: int | None = None,
+    terminate_on_error: bool = True,
+) -> None:
+    cap, names = _run_capture(table)
+    stream = sorted(cap.stream, key=lambda e: (e[2], e[0], e[3]))
+    if n_rows is not None:
+        stream = stream[:n_rows]
+    headers = ([""] if include_id else []) + names + ["__time__", "__diff__"]
+    rows = []
+    for key, row, time, diff in stream:
+        rid = f"^{key:X}"
+        if short_pointers:
+            rid = rid[:8] + "..." if len(rid) > 8 else rid
+        rows.append(
+            ([rid] if include_id else [])
+            + [_fmt(v) for v in row]
+            + [str(time), str(diff)]
+        )
+    widths = [
+        max(len(headers[c]), *(len(r[c]) for r in rows)) if rows else len(headers[c])
+        for c in range(len(headers))
+    ]
+    print(" | ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip())
+    for r in rows:
+        print(" | ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip())
+
+
+def table_to_stream(table: Table):
+    """Return the raw update stream [(key, row, time, diff), ...]."""
+    cap, names = _run_capture(table)
+    return cap.stream, names

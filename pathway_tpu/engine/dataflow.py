@@ -1,0 +1,1127 @@
+"""Incremental dataflow engine.
+
+TPU-native rebuild of the reference's Rust engine
+(/root/reference/src/engine/dataflow.rs — DataflowGraphInner :4277,
+run_with_new_dataflow_graph :5506) WITHOUT timely/differential: Pathway
+only ever uses totally-ordered u64 timestamps (src/engine/timestamp.rs:20),
+so the general Naiad progress protocol collapses to bulk-synchronous
+epochs. Each epoch:
+
+    feed source deltas at time t  →  one topological pass over the DAG
+    →  frontier advances to t, time-based operators release/forget
+    →  consolidated output deltas fire subscribers.
+
+This design is deliberately SPMD-friendly: an epoch's per-operator delta
+batches are columnar-izable and the same loop runs per shard with an
+all-to-all on re-keying operators (see pathway_tpu.parallel). Data model:
+updates are (key: uint64, row: tuple, diff: ±1); tables are keyed (one row
+per key), which lets every stateful operator keep `dict jk -> dict key ->
+row` state instead of general multiset arrangements.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Iterable, Sequence
+
+import numpy as np
+
+from .reducers import Reducer
+from .value import ERROR, Error, Pointer, ref_scalar, rows_equal, values_equal
+
+# Update = (key: int, row: tuple, diff: int)
+Update = tuple
+
+INF_TIME = float("inf")
+
+
+class EngineError(Exception):
+    pass
+
+
+def consolidate(updates: list[Update]) -> list[Update]:
+    """Merge updates per (key, row): sum diffs, drop zeros. Preserves
+    retract-before-insert ordering per key."""
+    by_key: dict[int, list[list]] = {}
+    order: list[int] = []
+    for key, row, diff in updates:
+        if key not in by_key:
+            by_key[key] = []
+            order.append(key)
+        bucket = by_key[key]
+        for ent in bucket:
+            if rows_equal(ent[0], row):
+                ent[1] += diff
+                break
+        else:
+            bucket.append([row, diff])
+    out: list[Update] = []
+    for key in order:
+        ents = [e for e in by_key[key] if e[1] != 0]
+        ents.sort(key=lambda e: e[1])  # retractions first
+        for row, diff in ents:
+            if diff > 0:
+                out.extend((key, row, 1) for _ in range(diff))
+            else:
+                out.extend((key, row, -1) for _ in range(-diff))
+    return out
+
+
+class OperatorStats:
+    """Per-operator probe counters (reference graph.rs:523 OperatorStats)."""
+
+    __slots__ = ("rows_in", "rows_out", "epochs", "name")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.rows_in = 0
+        self.rows_out = 0
+        self.epochs = 0
+
+
+class Node:
+    """Base dataflow operator."""
+
+    n_inputs = 1
+
+    def __init__(self, graph: "EngineGraph", name: str = ""):
+        self.graph = graph
+        self.id = len(graph.nodes)
+        self.name = name or type(self).__name__
+        self.consumers: list[tuple["Node", int]] = []
+        self.queues: list[list[Update]] = [[] for _ in range(self.n_inputs)]
+        self.stats = OperatorStats(self.name)
+        graph.nodes.append(self)
+
+    def connect(self, upstream: "Node", port: int = 0) -> "Node":
+        upstream.consumers.append((self, port))
+        return self
+
+    def emit(self, updates: list[Update], time) -> None:
+        if not updates:
+            return
+        self.stats.rows_out += len(updates)
+        for node, port in self.consumers:
+            node.queues[port].extend(updates)
+            self.graph._dirty.add(node.id)
+
+    def take(self, port: int = 0) -> list[Update]:
+        q = self.queues[port]
+        if q:
+            self.queues[port] = []
+            self.stats.rows_in += len(q)
+        return q
+
+    def process(self, time) -> None:
+        raise NotImplementedError
+
+    def on_frontier(self, frontier) -> None:
+        """Frontier advanced: time-based operators release/forget here."""
+
+    def on_end(self) -> None:
+        """All inputs finished."""
+
+
+class StaticSourceNode(Node):
+    """Bounded source with scripted (time, updates) batches — used for
+    static tables and the __time__/__diff__ test harness."""
+
+    n_inputs = 0
+
+    def __init__(self, graph, batches: list[tuple[int, list[Update]]]):
+        super().__init__(graph)
+        self.batches = sorted(batches, key=lambda b: b[0])
+        self.pos = 0
+        graph.static_sources.append(self)
+
+    def next_time(self):
+        if self.pos < len(self.batches):
+            return self.batches[self.pos][0]
+        return None
+
+    def feed(self, time) -> None:
+        while self.pos < len(self.batches) and self.batches[self.pos][0] == time:
+            self.emit(self.batches[self.pos][1], time)
+            self.pos += 1
+
+    def process(self, time):
+        pass
+
+
+class InputSession:
+    """Thread-safe feed from connector reader threads into the engine
+    (reference: InputSession / connectors/adaptors.rs:176)."""
+
+    def __init__(self, node: "SessionSourceNode"):
+        self.node = node
+        self._lock = threading.Lock()
+        self._pending: list[Update] = []
+        self._committed: list[list[Update]] = []
+        self._closed = False
+
+    def insert(self, key: int, row: tuple) -> None:
+        with self._lock:
+            self._pending.append((key, row, 1))
+
+    def remove(self, key: int, row: tuple) -> None:
+        with self._lock:
+            self._pending.append((key, row, -1))
+
+    def upsert(self, key: int, row: tuple | None) -> None:
+        """Replace the current row at key (None row = delete)."""
+        with self._lock:
+            self._pending.append((key, row, 2))  # marker; resolved at feed
+
+    def commit(self) -> None:
+        with self._lock:
+            if self._pending:
+                self._committed.append(self._pending)
+                self._pending = []
+        self.node.graph.wake()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._pending:
+                self._committed.append(self._pending)
+                self._pending = []
+            self._closed = True
+        self.node.graph.wake()
+
+    def drain(self) -> list[Update] | None:
+        with self._lock:
+            if not self._committed:
+                return None
+            batches = self._committed
+            self._committed = []
+        return [u for b in batches for u in b]
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed and not self._committed
+
+
+class SessionSourceNode(Node):
+    """Streaming source fed by an InputSession. Resolves upsert markers
+    against its keyed state so connectors can speak either diff or
+    snapshot protocols."""
+
+    n_inputs = 0
+
+    def __init__(self, graph):
+        super().__init__(graph)
+        self.session = InputSession(self)
+        self.state: dict[int, tuple] = {}
+        graph.session_sources.append(self)
+
+    def feed_batch(self, raw: list[Update], time) -> None:
+        out: list[Update] = []
+        for key, row, diff in raw:
+            if diff == 2:  # upsert marker
+                old = self.state.get(key)
+                if old is not None:
+                    out.append((key, old, -1))
+                if row is not None:
+                    out.append((key, row, 1))
+                    self.state[key] = row
+                elif key in self.state:
+                    del self.state[key]
+            else:
+                out.append((key, row, diff))
+                if diff > 0:
+                    self.state[key] = row
+                else:
+                    self.state.pop(key, None)
+        self.emit(consolidate(out), time)
+
+    def process(self, time):
+        pass
+
+
+class ExprMapNode(Node):
+    """expression_table (dataflow.rs:1246 MapWrapped): map each row through
+    compiled expressions. Vectorized evaluators receive the whole delta
+    batch; per-row fallback otherwise. Non-deterministic expressions
+    (UDFs) store emitted rows so retractions replay exactly."""
+
+    def __init__(
+        self,
+        graph,
+        exprs: Sequence[Callable],
+        deterministic: bool = True,
+        batch_eval: Callable | None = None,
+        name: str = "ExprMap",
+    ):
+        super().__init__(graph, name)
+        self.exprs = list(exprs)
+        self.deterministic = deterministic
+        self.batch_eval = batch_eval  # (keys, rows) -> list of out rows
+        self.memo: dict[int, tuple] = {}
+
+    def process(self, time):
+        updates = self.take()
+        if not updates:
+            return
+        out: list[Update] = []
+        inserts = [(k, r) for k, r, d in updates if d > 0]
+        retracts = [(k, r) for k, r, d in updates if d < 0]
+        # retractions first: replay memo or recompute
+        for key, row in retracts:
+            if not self.deterministic and key in self.memo:
+                out.append((key, self.memo.pop(key), -1))
+            else:
+                out.append((key, self._eval_row(key, row, time), -1))
+        if inserts:
+            if self.batch_eval is not None:
+                rows_out = self.batch_eval([k for k, _ in inserts], [r for _, r in inserts])
+            else:
+                rows_out = [self._eval_row(k, r, time) for k, r in inserts]
+            for (key, _), orow in zip(inserts, rows_out):
+                if not self.deterministic:
+                    self.memo[key] = orow
+                out.append((key, orow, 1))
+        self.emit(out, time)
+
+    def _eval_row(self, key, row, time):
+        return tuple(e(key, row) for e in self.exprs)
+
+
+class FilterNode(Node):
+    def __init__(self, graph, pred: Callable, name: str = "Filter"):
+        super().__init__(graph, name)
+        self.pred = pred
+
+    def process(self, time):
+        updates = self.take()
+        out = []
+        for key, row, diff in updates:
+            keep = self.pred(key, row)
+            if keep is True:
+                out.append((key, row, diff))
+        self.emit(out, time)
+
+
+class ConcatNode(Node):
+    """concat_tables (universes must be pairwise disjoint — checked)."""
+
+    def __init__(self, graph, n_inputs: int, check_disjoint: bool = True):
+        self.n_inputs = n_inputs
+        super().__init__(graph, "Concat")
+        self.owners: dict[int, int] = {}
+        self.check = check_disjoint
+
+    def process(self, time):
+        out = []
+        for port in range(self.n_inputs):
+            for key, row, diff in self.take(port):
+                if self.check:
+                    owner = self.owners.get(key)
+                    if diff < 0:
+                        if owner == port:
+                            del self.owners[key]
+                    elif owner is None:
+                        self.owners[key] = port
+                    elif owner != port:
+                        raise EngineError(
+                            f"concat: duplicate key {Pointer(key)} from inputs {owner} and {port}"
+                        )
+                out.append((key, row, diff))
+        self.emit(out, time)
+
+
+class ReindexNode(Node):
+    """reindex_table / with_id_from: re-key rows with key_fn(key, row)."""
+
+    def __init__(self, graph, key_fn: Callable, name: str = "Reindex"):
+        super().__init__(graph, name)
+        self.key_fn = key_fn
+
+    def process(self, time):
+        out = [(self.key_fn(k, r), r, d) for k, r, d in self.take()]
+        self.emit(out, time)
+
+
+class FlattenNode(Node):
+    """flatten_table (graph.rs flatten): expand an iterable column; new
+    keys ref_scalar(key, i) — deterministic so retractions re-expand."""
+
+    def __init__(self, graph, col: int):
+        super().__init__(graph, "Flatten")
+        self.col = col
+
+    def process(self, time):
+        out = []
+        for key, row, diff in self.take():
+            v = row[self.col]
+            if v is None:
+                continue
+            if isinstance(v, (str, bytes)):
+                items = list(v)
+            elif isinstance(v, np.ndarray):
+                items = list(v)
+            else:
+                try:
+                    items = list(v)
+                except TypeError:
+                    raise EngineError(f"flatten: value {v!r} is not iterable")
+            for i, item in enumerate(items):
+                nk = ref_scalar(Pointer(key), i)
+                out.append((nk, row[: self.col] + (item,) + row[self.col + 1 :], diff))
+        self.emit(out, time)
+
+
+class KeysOnlyNode(Node):
+    """Project a table to its key set (row = ())."""
+
+    def __init__(self, graph):
+        super().__init__(graph, "Keys")
+
+    def process(self, time):
+        self.emit([(k, (), d) for k, _, d in self.take()], time)
+
+
+class _KeyedStateNode(Node):
+    """Helper base: maintains `self.state[port]: dict key -> row` and the
+    last emitted output per key, recomputing affected keys per epoch."""
+
+    def __init__(self, graph, n_inputs: int, name: str):
+        self.n_inputs = n_inputs
+        super().__init__(graph, name)
+        self.state: list[dict[int, tuple]] = [dict() for _ in range(n_inputs)]
+        self.emitted: dict[int, tuple] = {}
+
+    def process(self, time):
+        affected: set[int] = set()
+        for port in range(self.n_inputs):
+            for key, row, diff in self.take(port):
+                affected.add(key)
+                if diff > 0:
+                    self.state[port][key] = row
+                else:
+                    self.state[port].pop(key, None)
+        out = []
+        for key in affected:
+            new_row = self.compute_key(key)
+            old_row = self.emitted.get(key)
+            if old_row is not None and (new_row is None or not rows_equal(old_row, new_row)):
+                out.append((key, old_row, -1))
+                del self.emitted[key]
+            if new_row is not None and (old_row is None or not rows_equal(old_row, new_row)):
+                out.append((key, new_row, 1))
+                self.emitted[key] = new_row
+        self.emit(out, time)
+
+    def compute_key(self, key: int) -> tuple | None:
+        raise NotImplementedError
+
+
+class UpdateRowsNode(_KeyedStateNode):
+    """update_rows_table (graph.rs update_rows_table): right overrides left."""
+
+    def __init__(self, graph):
+        super().__init__(graph, 2, "UpdateRows")
+
+    def compute_key(self, key):
+        r = self.state[1].get(key)
+        return r if r is not None else self.state[0].get(key)
+
+
+class UpdateCellsNode(_KeyedStateNode):
+    """update_cells_table: right overrides selected columns of left.
+    col_map: list of (left_col_index, right_col_index)."""
+
+    def __init__(self, graph, col_map: list[tuple[int, int]]):
+        super().__init__(graph, 2, "UpdateCells")
+        self.col_map = col_map
+
+    def compute_key(self, key):
+        l = self.state[0].get(key)
+        if l is None:
+            return None
+        r = self.state[1].get(key)
+        if r is None:
+            return l
+        row = list(l)
+        for li, ri in self.col_map:
+            row[li] = r[ri]
+        return tuple(row)
+
+
+class IntersectNode(_KeyedStateNode):
+    """intersect_tables: left rows restricted to keys present in all other
+    inputs."""
+
+    def __init__(self, graph, n_inputs: int):
+        super().__init__(graph, n_inputs, "Intersect")
+
+    def compute_key(self, key):
+        for port in range(1, self.n_inputs):
+            if key not in self.state[port]:
+                return None
+        return self.state[0].get(key)
+
+
+class SubtractNode(_KeyedStateNode):
+    """subtract_table: left keys minus right keys."""
+
+    def __init__(self, graph):
+        super().__init__(graph, 2, "Subtract")
+
+    def compute_key(self, key):
+        if key in self.state[1]:
+            return None
+        return self.state[0].get(key)
+
+
+class HavingNode(IntersectNode):
+    pass
+
+
+class GroupByNode(Node):
+    """group_by_table (dataflow.rs:2991): re-key by grouping values, apply
+    reducers. Semigroup reducers update O(1); general reducers recompute
+    per touched group from its keyed state (reduce.rs:40-61 two-tier
+    strategy)."""
+
+    def __init__(
+        self,
+        graph,
+        group_key_fn: Callable,  # (key, row) -> group key (int)
+        reducer_specs: list[tuple[Reducer, Callable]],  # (reducer, args_fn(key,row)->tuple)
+    ):
+        super().__init__(graph, "GroupBy")
+        self.group_key_fn = group_key_fn
+        self.specs = reducer_specs
+        self.all_semigroup = all(r.is_semigroup for r, _ in reducer_specs)
+        # gk -> key -> list of per-reducer args
+        self.groups: dict[int, dict[int, list[tuple]]] = {}
+        self.sg_state: dict[int, list[Any]] = {}
+        self.emitted: dict[int, tuple] = {}
+
+    def process(self, time):
+        updates = self.take()
+        if not updates:
+            return
+        affected: set[int] = set()
+        for key, row, diff in updates:
+            gk = self.group_key_fn(key, row)
+            affected.add(gk)
+            args_list = [
+                ((time,) + tuple(args_fn(key, row)) if getattr(red, "needs_time", False) else tuple(args_fn(key, row)))
+                for red, args_fn in self.specs
+            ]
+            grp = self.groups.get(gk)
+            if grp is None:
+                grp = self.groups[gk] = {}
+                self.sg_state[gk] = [r.init_state() if r.is_semigroup else None for r, _ in self.specs]
+            if diff > 0:
+                grp[key] = args_list
+            else:
+                stored = grp.pop(key, None)
+                if stored is not None:
+                    args_list = stored  # replay stored args for exact retract
+                if not grp:
+                    del self.groups[gk]
+            sg = self.sg_state.get(gk)
+            if sg is not None:
+                for i, (red, _) in enumerate(self.specs):
+                    if red.is_semigroup:
+                        sg[i] = red.add(sg[i], args_list[i], diff)
+                if gk not in self.groups:
+                    del self.sg_state[gk]
+        out = []
+        for gk in affected:
+            grp = self.groups.get(gk)
+            if grp:
+                new_row = tuple(
+                    red.extract(self.sg_state[gk][i])
+                    if red.is_semigroup
+                    else red.compute([argv[i] for argv in grp.values()])
+                    for i, (red, _) in enumerate(self.specs)
+                )
+            else:
+                new_row = None
+            old_row = self.emitted.get(gk)
+            if old_row is not None and (new_row is None or not rows_equal(old_row, new_row)):
+                out.append((gk, old_row, -1))
+                del self.emitted[gk]
+            if new_row is not None and (old_row is None or not rows_equal(old_row, new_row)):
+                out.append((gk, new_row, 1))
+                self.emitted[gk] = new_row
+        self.emit(out, time)
+
+
+class DeduplicateNode(Node):
+    """Graph::deduplicate (stateful_reduce.rs): per instance keep the
+    previously accepted row; `acceptor(new_row, old_row) -> bool` decides
+    replacement. Input expected append-only (as in the reference)."""
+
+    def __init__(self, graph, instance_fn: Callable, acceptor: Callable):
+        super().__init__(graph, "Deduplicate")
+        self.instance_fn = instance_fn
+        self.acceptor = acceptor
+        self.accepted: dict[Any, tuple[int, tuple]] = {}
+
+    def process(self, time):
+        out = []
+        for key, row, diff in self.take():
+            if diff <= 0:
+                continue
+            inst = self.instance_fn(key, row)
+            old = self.accepted.get(inst)
+            if old is None:
+                ok = self.acceptor(row, None)
+            else:
+                ok = self.acceptor(row, old[1])
+            if ok:
+                ik = inst if isinstance(inst, (int, np.integer)) else ref_scalar(inst)
+                if old is not None:
+                    out.append((ik, old[1], -1))
+                self.accepted[inst] = (key, row)
+                out.append((ik, row, 1))
+        self.emit(out, time)
+
+
+class JoinNode(Node):
+    """join_tables. Output row = left_row + right_row + (left_key|None,
+    right_key|None); unmatched sides padded with None (left/right/outer).
+    Per touched join-key, old-vs-new output diffing keeps all four join
+    types in one code path."""
+
+    n_inputs = 2
+
+    def __init__(
+        self,
+        graph,
+        left_jk_fn: Callable,   # (key, row) -> hashable join key
+        right_jk_fn: Callable,
+        left_width: int,
+        right_width: int,
+        how: str = "inner",     # inner | left | right | outer
+        id_fn: Callable | None = None,  # (lkey|None, rkey|None) -> out key
+        exact_match: bool = False,  # error on unmatched left (ix strict mode)
+    ):
+        super().__init__(graph, "Join")
+        self.left_jk_fn = left_jk_fn
+        self.right_jk_fn = right_jk_fn
+        self.lw = left_width
+        self.rw = right_width
+        self.how = how
+        self.id_fn = id_fn or (lambda lk, rk: ref_scalar(
+            None if lk is None else Pointer(lk), None if rk is None else Pointer(rk)
+        ))
+        self.exact_match = exact_match
+        self.left: dict[Any, dict[int, tuple]] = {}
+        self.right: dict[Any, dict[int, tuple]] = {}
+
+    def _outputs_for(self, jk) -> dict[int, tuple]:
+        out: dict[int, tuple] = {}
+        lhs = self.left.get(jk, {})
+        rhs = self.right.get(jk, {})
+        if lhs and rhs:
+            for lk, lrow in lhs.items():
+                for rk, rrow in rhs.items():
+                    out[self.id_fn(lk, rk)] = lrow + rrow + (Pointer(lk), Pointer(rk))
+        elif lhs and self.how in ("left", "outer"):
+            for lk, lrow in lhs.items():
+                out[self.id_fn(lk, None)] = lrow + (None,) * self.rw + (Pointer(lk), None)
+        elif rhs and self.how in ("right", "outer"):
+            for rk, rrow in rhs.items():
+                out[self.id_fn(None, rk)] = (None,) * self.lw + rrow + (None, Pointer(rk))
+        return out
+
+    def process(self, time):
+        lups = self.take(0)
+        rups = self.take(1)
+        if not lups and not rups:
+            return
+        affected: set = set()
+        staged: list[tuple[int, Any, int, tuple, int]] = []
+        for key, row, diff in lups:
+            jk = self.left_jk_fn(key, row)
+            if jk is None:
+                continue
+            affected.add(jk)
+            staged.append((0, jk, key, row, diff))
+        for key, row, diff in rups:
+            jk = self.right_jk_fn(key, row)
+            if jk is None:
+                continue
+            affected.add(jk)
+            staged.append((1, jk, key, row, diff))
+        old_out: dict[Any, dict[int, tuple]] = {jk: self._outputs_for(jk) for jk in affected}
+        for side, jk, key, row, diff in staged:
+            idx = self.left if side == 0 else self.right
+            bucket = idx.setdefault(jk, {})
+            if diff > 0:
+                bucket[key] = row
+            else:
+                bucket.pop(key, None)
+                if not bucket:
+                    idx.pop(jk, None)
+        out: list[Update] = []
+        for jk in affected:
+            old = old_out[jk]
+            new = self._outputs_for(jk)
+            for ok, orow in old.items():
+                nrow = new.get(ok)
+                if nrow is None or not rows_equal(orow, nrow):
+                    out.append((ok, orow, -1))
+            for ok, nrow in new.items():
+                orow = old.get(ok)
+                if orow is None or not rows_equal(orow, nrow):
+                    out.append((ok, nrow, 1))
+            if self.exact_match and self.left.get(jk) and not self.right.get(jk):
+                raise EngineError(f"ix: key {jk!r} missing in indexed table")
+        self.emit(out, time)
+
+
+class SortNode(Node):
+    """sort_table → prev/next pointer columns (reference
+    operators/prev_next.rs over bidirectional traces; here: per-instance
+    sorted order recomputed for touched instances, diffed against last
+    emitted neighbors)."""
+
+    def __init__(self, graph, key_fn: Callable, instance_fn: Callable):
+        super().__init__(graph, "Sort")
+        self.key_fn = key_fn
+        self.instance_fn = instance_fn
+        self.rows: dict[int, tuple[Any, Any]] = {}  # key -> (instance, sort_key)
+        self.instances: dict[Any, dict[int, Any]] = {}  # inst -> key -> sort_key
+        self.emitted: dict[int, tuple[Any, tuple]] = {}  # key -> (inst, (prev, next))
+
+    def process(self, time):
+        updates = self.take()
+        if not updates:
+            return
+        touched: set = set()
+        for key, row, diff in updates:
+            inst = self.instance_fn(key, row)
+            sk = self.key_fn(key, row)
+            touched.add(inst)
+            if diff > 0:
+                self.rows[key] = (inst, sk)
+                self.instances.setdefault(inst, {})[key] = sk
+            else:
+                self.rows.pop(key, None)
+                b = self.instances.get(inst)
+                if b is not None:
+                    b.pop(key, None)
+                    if not b:
+                        del self.instances[inst]
+        out = []
+        for inst in touched:
+            members = self.instances.get(inst, {})
+            ordered = sorted(members.items(), key=lambda kv: (kv[1], kv[0]))
+            n = len(ordered)
+            new_neighbors: dict[int, tuple] = {}
+            for i, (key, _) in enumerate(ordered):
+                prev_k = Pointer(ordered[i - 1][0]) if i > 0 else None
+                next_k = Pointer(ordered[i + 1][0]) if i < n - 1 else None
+                new_neighbors[key] = (prev_k, next_k)
+            # retract neighbors of keys that left this instance
+            for key, (e_inst, e_nbr) in list(self.emitted.items()):
+                if e_inst == inst and key not in members:
+                    out.append((key, e_nbr, -1))
+                    del self.emitted[key]
+            for key, nbr in new_neighbors.items():
+                old = self.emitted.get(key)
+                if old is not None and old[1] != nbr:
+                    out.append((key, old[1], -1))
+                if old is None or old[1] != nbr:
+                    out.append((key, nbr, 1))
+                    self.emitted[key] = (inst, nbr)
+        self.emit(out, time)
+
+
+class BufferNode(Node):
+    """Graph::buffer (operators/time_column.rs postpone_core): hold rows
+    until the event-time watermark (max observed time_fn value) reaches
+    threshold_fn(row). The reference compares against the time column's
+    frontier; here the watermark advances at epoch boundaries."""
+
+    def __init__(
+        self,
+        graph,
+        threshold_fn: Callable,
+        time_fn: Callable | None = None,
+        flush_on_end: bool = True,
+    ):
+        super().__init__(graph, "Buffer")
+        self.threshold_fn = threshold_fn
+        self.time_fn = time_fn
+        self.pending: dict[int, tuple[Any, tuple]] = {}
+        self.released: set[int] = set()
+        self.flush_on_end = flush_on_end
+        self.watermark: Any = None
+
+    def _advance_watermark(self, key, row):
+        if self.time_fn is None:
+            return
+        t = self.time_fn(key, row)
+        if t is not None and (self.watermark is None or t > self.watermark):
+            self.watermark = t
+
+    def process(self, time):
+        out = []
+        for key, row, diff in self.take():
+            self._advance_watermark(key, row)
+            if key in self.released:
+                out.append((key, row, diff))
+                if diff < 0:
+                    self.released.discard(key)
+                continue
+            if diff > 0:
+                thr = self.threshold_fn(key, row)
+                wm = self.watermark if self.time_fn is not None else time
+                if thr is not None and (wm is None or thr > wm):
+                    self.pending[key] = (thr, row)
+                else:
+                    self.released.add(key)
+                    out.append((key, row, diff))
+            else:
+                if key in self.pending:
+                    del self.pending[key]
+                else:
+                    out.append((key, row, diff))
+        # release newly-eligible rows in the same epoch
+        self._release(time)
+        self.emit(out, time)
+
+    def _release(self, time):
+        wm = self.watermark if self.time_fn is not None else time
+        out = []
+        for key in list(self.pending):
+            thr, row = self.pending[key]
+            if wm is not None and thr <= wm:
+                del self.pending[key]
+                self.released.add(key)
+                out.append((key, row, 1))
+        if out:
+            self.emit(out, time)
+
+    def on_frontier(self, frontier):
+        if frontier == INF_TIME:
+            if not self.flush_on_end:
+                return
+            out = []
+            for key in list(self.pending):
+                thr, row = self.pending.pop(key)
+                self.released.add(key)
+                out.append((key, row, 1))
+            if out:
+                self.emit(out, self.graph.current_time)
+        elif self.time_fn is None:
+            self._release(frontier)
+
+
+class ForgetNode(Node):
+    """Graph::forget (time_column.rs ignore_late): retract rows once the
+    watermark passes threshold_fn(row); drop late arrivals. With
+    mark_forgetting_records=False, downstream state is compacted; the
+    logical output (with keep_results) re-adds retracted results."""
+
+    def __init__(
+        self,
+        graph,
+        threshold_fn: Callable,
+        time_fn: Callable | None = None,
+        mark_forgetting_records: bool = False,
+    ):
+        super().__init__(graph, "Forget")
+        self.threshold_fn = threshold_fn
+        self.time_fn = time_fn
+        self.live: dict[int, tuple[Any, tuple]] = {}
+        self.watermark: Any = None
+
+    def process(self, time):
+        out = []
+        for key, row, diff in self.take():
+            if self.time_fn is not None:
+                t = self.time_fn(key, row)
+                if t is not None and (self.watermark is None or t > self.watermark):
+                    self.watermark = t
+            thr = self.threshold_fn(key, row)
+            wm = self.watermark if self.time_fn is not None else time
+            if diff > 0:
+                if thr is not None and wm is not None and thr <= wm and key not in self.live:
+                    continue  # late arrival, drop
+                self.live[key] = (thr, row)
+                out.append((key, row, 1))
+            else:
+                if key in self.live:
+                    del self.live[key]
+                    out.append((key, row, -1))
+        # forget rows that fell behind the watermark
+        wm = self.watermark if self.time_fn is not None else time
+        if wm is not None:
+            for key in list(self.live):
+                thr, row = self.live[key]
+                if thr is not None and thr <= wm:
+                    del self.live[key]
+                    out.append((key, row, -1))
+        self.emit(out, time)
+
+
+class FreezeNode(Node):
+    """Graph::freeze: once the watermark passes threshold, changes to the
+    row are ignored."""
+
+    def __init__(self, graph, threshold_fn: Callable, time_fn: Callable | None = None):
+        super().__init__(graph, "Freeze")
+        self.threshold_fn = threshold_fn
+        self.time_fn = time_fn
+        self.watermark: Any = None
+
+    def process(self, time):
+        out = []
+        for key, row, diff in self.take():
+            if self.time_fn is not None:
+                t = self.time_fn(key, row)
+                if t is not None and (self.watermark is None or t > self.watermark):
+                    self.watermark = t
+            thr = self.threshold_fn(key, row)
+            wm = self.watermark if self.time_fn is not None else time
+            if thr is not None and wm is not None and thr <= wm:
+                continue
+            out.append((key, row, diff))
+        self.emit(out, time)
+
+
+class ExternalIndexNode(Node):
+    """use_external_index_as_of_now (dataflow.rs:2224,
+    operators/external_index.rs): port 0 = index updates, port 1 =
+    queries. Queries are answered against the index state as-of arrival
+    and never retroactively updated (asof-now semantics)."""
+
+    n_inputs = 2
+
+    def __init__(self, graph, index, query_fn: Callable, res_width: int = 1):
+        super().__init__(graph, "ExternalIndex")
+        self.index = index  # engine-level index object: add/remove/search
+        self.query_fn = query_fn  # (key,row) -> query payload
+        self.answered: dict[int, tuple] = {}
+
+    def process(self, time):
+        for key, row, diff in self.take(0):
+            if diff > 0:
+                self.index.add(key, row)
+            else:
+                self.index.remove(key, row)
+        out = []
+        for key, row, diff in self.take(1):
+            if diff > 0:
+                result = self.index.search(self.query_fn(key, row))
+                orow = row + (result,)
+                self.answered[key] = orow
+                out.append((key, orow, 1))
+            else:
+                orow = self.answered.pop(key, None)
+                if orow is not None:
+                    out.append((key, orow, -1))
+        self.emit(out, time)
+
+
+class OutputNode(Node):
+    """output_table/subscribe_table: consolidated, time-ordered delivery
+    (operators/output.rs ConsolidateForOutput)."""
+
+    def __init__(
+        self,
+        graph,
+        on_change: Callable | None = None,   # (key, row, time, diff)
+        on_time_end: Callable | None = None,  # (time)
+        on_end: Callable | None = None,
+        sort_by_key: bool = True,
+    ):
+        super().__init__(graph, "Output")
+        self.on_change = on_change
+        self.on_time_end_cb = on_time_end
+        self.on_end_cb = on_end
+        self.sort_by_key = sort_by_key
+        self._saw_data = False
+
+    def process(self, time):
+        updates = consolidate(self.take())
+        if not updates:
+            return
+        self._saw_data = True
+        if self.sort_by_key:
+            updates = sorted(updates, key=lambda u: (u[0], u[2]))
+        if self.on_change is not None:
+            for key, row, diff in updates:
+                self.on_change(key, row, time, diff)
+        self.emit(updates, time)
+
+    def time_end(self, time):
+        if self.on_time_end_cb is not None:
+            self.on_time_end_cb(time)
+
+    def on_end(self):
+        if self.on_end_cb is not None:
+            self.on_end_cb()
+
+
+class CaptureNode(Node):
+    """Accumulates the final table state + full update stream (debug /
+    CapturedStream equivalent, python_api.rs:3330)."""
+
+    def __init__(self, graph):
+        super().__init__(graph, "Capture")
+        self.state: dict[int, tuple] = {}
+        self.stream: list[tuple[int, tuple, int, int]] = []  # key,row,time,diff
+
+    def process(self, time):
+        for key, row, diff in consolidate(self.take()):
+            self.stream.append((key, row, int(time), diff))
+            if diff > 0:
+                self.state[key] = row
+            else:
+                self.state.pop(key, None)
+
+
+class AsyncApplyNode(Node):
+    """async_apply_table (graph.rs:744): batch-invoke async UDFs per epoch
+    on the engine's asyncio loop; results join the stream at the same
+    epoch (deterministic barrier — simpler than the reference's tokio
+    futures, same observable semantics for bounded batches)."""
+
+    def __init__(self, graph, async_fn: Callable, n_extra: int = 1, name: str = "AsyncApply"):
+        super().__init__(graph, name)
+        self.async_fn = async_fn  # async (key, row) -> value tuple appended to row
+        self.memo: dict[int, tuple] = {}
+
+    def process(self, time):
+        updates = self.take()
+        if not updates:
+            return
+        out = []
+        pending = []
+        for key, row, diff in updates:
+            if diff < 0:
+                orow = self.memo.pop(key, None)
+                if orow is not None:
+                    out.append((key, orow, -1))
+            else:
+                pending.append((key, row))
+        if pending:
+            results = self.graph.run_async_batch(self.async_fn, pending)
+            for (key, row), res in zip(pending, results):
+                if isinstance(res, BaseException):
+                    res = ERROR  # failed UDF → ERROR value (value.rs Error)
+                orow = row + (res,)
+                self.memo[key] = orow
+                out.append((key, orow, 1))
+        self.emit(out, time)
+
+
+class EngineGraph:
+    """Builder + scheduler. The rough equivalent of the reference's
+    `Graph` trait (src/engine/graph.rs:664) fused with its dataflow impl;
+    one instance per worker shard."""
+
+    def __init__(self, worker_id: int = 0, n_workers: int = 1):
+        self.nodes: list[Node] = []
+        self.static_sources: list[StaticSourceNode] = []
+        self.session_sources: list[SessionSourceNode] = []
+        self.outputs: list[OutputNode] = []
+        self.captures: list[CaptureNode] = []
+        self._dirty: set[int] = set()
+        self.current_time = 0
+        self.worker_id = worker_id
+        self.n_workers = n_workers
+        self._wake = threading.Event()
+        self._async_loop = None
+        self._stop = False
+        self.connector_threads: list[threading.Thread] = []
+
+    # --- builder helpers used by the graph runner ---
+
+    def static_table(self, batches):
+        return StaticSourceNode(self, batches)
+
+    def wake(self):
+        self._wake.set()
+
+    def run_async_batch(self, async_fn, pending):
+        import asyncio
+
+        async def runner():
+            return await asyncio.gather(
+                *[async_fn(k, r) for k, r in pending], return_exceptions=True
+            )
+
+        return asyncio.run(runner())
+
+    # --- execution ---
+
+    def _topo_pass(self, time):
+        # nodes are created in dependency order; one ordered pass suffices
+        for node in self.nodes:
+            if node.id in self._dirty:
+                self._dirty.discard(node.id)
+                node.process(time)
+        # time-end notifications for outputs
+        for node in self.nodes:
+            if isinstance(node, OutputNode):
+                node.time_end(time)
+
+    def _frontier_hooks(self, frontier):
+        for node in self.nodes:
+            node.on_frontier(frontier)
+
+    def run(self, monitoring_callback: Callable | None = None) -> None:
+        """Run to completion: process scripted batches in time order, then
+        live sessions until all close."""
+        for t in self.connector_threads:
+            t.start()
+        last_time = -1
+        while not self._stop:
+            # next scripted time across static sources
+            times = [s.next_time() for s in self.static_sources]
+            times = [t for t in times if t is not None]
+            scripted_t = min(times) if times else None
+
+            session_batches = []
+            for s in self.session_sources:
+                b = s.session.drain()
+                if b:
+                    session_batches.append((s, b))
+
+            if scripted_t is None and not session_batches:
+                if all(s.session.closed for s in self.session_sources):
+                    break
+                # wait for connector data
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+
+            t = scripted_t if scripted_t is not None else last_time + 1
+            if session_batches and scripted_t is not None:
+                t = max(scripted_t, last_time + 1)
+            t = max(t, last_time + 1) if t <= last_time else t
+            self.current_time = t
+            self._frontier_hooks(t)
+            for s in self.static_sources:
+                s.feed(t)
+            for s, b in session_batches:
+                s.feed_batch(b, t)
+            self._topo_pass(t)
+            last_time = t
+            if monitoring_callback is not None:
+                monitoring_callback(self)
+
+        # end of input: flush time-based operators at a final epoch
+        self.current_time = last_time + 1
+        self._frontier_hooks(INF_TIME)
+        if self._dirty:
+            self._topo_pass(self.current_time)
+        for node in self.nodes:
+            node.on_end()
+        for t in self.connector_threads:
+            t.join(timeout=5.0)
+
+    def stop(self):
+        self._stop = True
+        self.wake()

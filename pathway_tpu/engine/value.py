@@ -1,0 +1,257 @@
+"""Runtime value system: keys, pointers, Json, datetimes.
+
+TPU-native rebuild of the reference's value layer
+(/root/reference/src/engine/value.rs). Keys are 64-bit hashes (uint64) so
+key columns are dense device-friendly arrays; the reference uses 128-bit
+keys (value.rs:41) — 64 bits keeps keys in one numpy/XLA lane and the
+collision probability at the target scales (~10M rows) is negligible.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import json as _json
+import struct
+from typing import Any, Iterable
+
+import numpy as np
+
+# Salt mirrors the role of the reference's seeded SipHash keyspace.
+_HASH_SALT = b"pathway_tpu-key-v1"
+
+# Low bits of a key pick the shard/worker, like the reference's
+# SHARD_MASK (value.rs:38, shard.rs:15-20).
+SHARD_BITS = 16
+SHARD_MASK = (1 << SHARD_BITS) - 1
+
+
+class Pointer(int):
+    """A row key. Subclass of int so it hashes/compares natively but
+    prints like the reference's `^...` pointers."""
+
+    def __repr__(self) -> str:
+        return f"^{self:016X}"
+
+    def __str__(self) -> str:
+        return self.__repr__()
+
+
+class Error:
+    """Singleton ERROR value (value.rs Error variant). Propagates through
+    expressions; filtered from outputs unless explicitly kept."""
+
+    _instance: "Error | None" = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Error"
+
+    def __bool__(self):
+        raise ValueError("ERROR value is not convertible to bool")
+
+
+ERROR = Error()
+
+
+class Json:
+    """JSON value wrapper (mirrors pw.Json). Wraps any json-serializable
+    python value."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any = None):
+        if isinstance(value, Json):
+            value = value.value
+        self.value = value
+
+    def __repr__(self) -> str:
+        return _json.dumps(self.value, sort_keys=True, default=str)
+
+    def __eq__(self, other):
+        if isinstance(other, Json):
+            return self.value == other.value
+        return self.value == other
+
+    def __hash__(self):
+        try:
+            return hash(_json.dumps(self.value, sort_keys=True, default=str))
+        except TypeError:
+            return 0
+
+    def __getitem__(self, item):
+        v = self.value[item]
+        return Json(v) if isinstance(v, (dict, list)) else v
+
+    def __contains__(self, item):
+        return item in self.value
+
+    def __iter__(self):
+        if isinstance(self.value, dict):
+            return iter(self.value)
+        return (Json(v) if isinstance(v, (dict, list)) else v for v in self.value)
+
+    def __len__(self):
+        return len(self.value)
+
+    def get(self, key, default=None):
+        if isinstance(self.value, dict):
+            v = self.value.get(key, default)
+            return Json(v) if isinstance(v, (dict, list)) else v
+        return default
+
+    def as_int(self) -> int | None:
+        return int(self.value) if isinstance(self.value, (int, float)) and not isinstance(self.value, bool) else None
+
+    def as_float(self) -> float | None:
+        return float(self.value) if isinstance(self.value, (int, float)) and not isinstance(self.value, bool) else None
+
+    def as_str(self) -> str | None:
+        return self.value if isinstance(self.value, str) else None
+
+    def as_bool(self) -> bool | None:
+        return self.value if isinstance(self.value, bool) else None
+
+    def as_list(self) -> list | None:
+        return self.value if isinstance(self.value, list) else None
+
+    def as_dict(self) -> dict | None:
+        return self.value if isinstance(self.value, dict) else None
+
+    @staticmethod
+    def parse(s: str | bytes) -> "Json":
+        return Json(_json.loads(s))
+
+    @staticmethod
+    def dumps(value: Any) -> str:
+        if isinstance(value, Json):
+            value = value.value
+        return _json.dumps(value, default=str)
+
+
+class PyObjectWrapper:
+    """Opaque python object carried through the engine (value.rs
+    PyObjectWrapper)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any):
+        self.value = value
+
+    def __repr__(self):
+        return f"PyObjectWrapper({self.value!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, PyObjectWrapper) and self.value == other.value
+
+    def __hash__(self):
+        try:
+            return hash(self.value)
+        except TypeError:
+            return 0
+
+
+def wrap_py_object(value: Any) -> PyObjectWrapper:
+    return PyObjectWrapper(value)
+
+
+def _serialize_for_hash(value: Any, out: bytearray) -> None:
+    """Stable byte serialization of a value for key derivation."""
+    if value is None:
+        out += b"\x00"
+    elif isinstance(value, Pointer):
+        out += b"\x07" + struct.pack("<Q", int(value) & 0xFFFFFFFFFFFFFFFF)
+    elif isinstance(value, bool) or isinstance(value, np.bool_):
+        out += b"\x01" + (b"\x01" if value else b"\x00")
+    elif isinstance(value, (int, np.integer)):
+        out += b"\x02" + struct.pack("<q", int(value))
+    elif isinstance(value, (float, np.floating)):
+        f = float(value)
+        if f.is_integer() and abs(f) < 2**62:
+            # int/float hash consistency like python's numeric tower
+            out += b"\x02" + struct.pack("<q", int(f))
+        else:
+            out += b"\x03" + struct.pack("<d", f)
+    elif isinstance(value, str):
+        b = value.encode()
+        out += b"\x04" + struct.pack("<I", len(b)) + b
+    elif isinstance(value, bytes):
+        out += b"\x05" + struct.pack("<I", len(value)) + value
+    elif isinstance(value, (tuple, list)):
+        out += b"\x06" + struct.pack("<I", len(value))
+        for v in value:
+            _serialize_for_hash(v, out)
+    elif isinstance(value, np.ndarray):
+        out += b"\x08" + value.tobytes() + str(value.dtype).encode()
+        out += struct.pack("<I", value.ndim)
+        for dim in value.shape:
+            out += struct.pack("<q", dim)
+    elif isinstance(value, (datetime.datetime, datetime.timedelta)):
+        out += b"\x09" + repr(value).encode()
+    elif isinstance(value, Json):
+        out += b"\x0a" + repr(value).encode()
+    else:
+        out += b"\x0b" + repr(value).encode()
+
+
+def ref_scalar(*values: Any, optional: bool = False) -> Pointer:
+    """Derive a deterministic key from values (reference python_api.rs:3369
+    `ref_scalar`). Used for primary keys (`with_id_from`) and re-keying."""
+    if optional and any(v is None for v in values):
+        return None  # type: ignore
+    out = bytearray()
+    _serialize_for_hash(tuple(values), out)
+    digest = hashlib.blake2b(bytes(out), digest_size=8, key=_HASH_SALT).digest()
+    return Pointer(struct.unpack("<Q", digest)[0])
+
+
+def unsafe_make_pointer(value: int) -> Pointer:
+    return Pointer(value & 0xFFFFFFFFFFFFFFFF)
+
+
+_SEQ_COUNTER = [0]
+
+
+def sequential_key() -> Pointer:
+    """Auto-generated key for rows without a primary key. Hash of a
+    sequence number so keys are stable within a run and well-spread
+    across shards."""
+    _SEQ_COUNTER[0] += 1
+    return ref_scalar("__seq__", _SEQ_COUNTER[0])
+
+
+def shard_of(key: int, n_shards: int) -> int:
+    """Worker owning a key: low bits mod n_shards (shard.rs:15-20)."""
+    return (int(key) & SHARD_MASK) % n_shards
+
+
+def shard_of_array(keys: np.ndarray, n_shards: int) -> np.ndarray:
+    return (keys & np.uint64(SHARD_MASK)) % np.uint64(n_shards)
+
+
+def hash_int_array(values: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64-style hash of an int64/uint64 array → uint64
+    keys. Device-friendly (pure integer ops, usable inside jit too)."""
+    x = values.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def values_equal(a: Any, b: Any) -> bool:
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return isinstance(a, np.ndarray) and isinstance(b, np.ndarray) and a.shape == b.shape and bool(np.array_equal(a, b))
+    return a == b
+
+
+def rows_equal(a: tuple, b: tuple) -> bool:
+    if len(a) != len(b):
+        return False
+    return all(values_equal(x, y) for x, y in zip(a, b))

@@ -1,0 +1,72 @@
+"""Runtime configuration from PATHWAY_* env vars.
+
+Rebuild of /root/reference/python/pathway/internals/config.py and the
+engine-side Config (/root/reference/src/engine/dataflow/config.rs:36-120:
+PATHWAY_THREADS/PROCESSES/PROCESS_ID/FIRST_PORT)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    try:
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+
+@dataclass
+class PathwayConfig:
+    license_key: str | None = None
+    monitoring_server: str | None = None
+    ignore_asserts: bool = False
+    runtime_typechecking: bool = True
+    terminate_on_error: bool = True
+    process_id: int = 0
+
+    @property
+    def threads(self) -> int:
+        return _env_int("PATHWAY_THREADS", 1)
+
+    @property
+    def processes(self) -> int:
+        return _env_int("PATHWAY_PROCESSES", 1)
+
+    @property
+    def n_workers(self) -> int:
+        return self.threads * self.processes
+
+    @property
+    def replay_storage(self) -> str | None:
+        return os.environ.get("PATHWAY_REPLAY_STORAGE")
+
+    @property
+    def replay_mode(self) -> str:
+        return os.environ.get("PATHWAY_REPLAY_MODE", "")
+
+    @property
+    def first_port(self) -> int:
+        return _env_int("PATHWAY_FIRST_PORT", 10000)
+
+
+def get_pathway_config() -> PathwayConfig:
+    cfg = PathwayConfig()
+    cfg.license_key = os.environ.get("PATHWAY_LICENSE_KEY")
+    cfg.monitoring_server = os.environ.get("PATHWAY_MONITORING_SERVER")
+    cfg.ignore_asserts = os.environ.get("PATHWAY_IGNORE_ASSERTS", "").lower() in ("1", "true")
+    cfg.process_id = _env_int("PATHWAY_PROCESS_ID", 0)
+    return cfg
+
+
+pathway_config = get_pathway_config()
+
+
+def set_license_key(key: str | None) -> None:
+    pathway_config.license_key = key
+
+
+def set_monitoring_config(*, server_endpoint: str | None) -> None:
+    pathway_config.monitoring_server = server_endpoint

@@ -1,0 +1,174 @@
+"""`.dt` expression namespace: datetime/duration methods.
+
+Rebuild of /root/reference/python/pathway/internals/expressions/date_time.py
+(engine side: src/engine/time.rs — trait DateTime :16, strftime/strptime,
+rounding :86-100)."""
+
+from __future__ import annotations
+
+import datetime as _dtm
+import math
+
+from .. import dtype as dt
+from ..expression import ColumnExpression, MethodCallExpression
+
+try:
+    from zoneinfo import ZoneInfo
+except ImportError:  # pragma: no cover
+    ZoneInfo = None  # type: ignore
+
+
+def _m(name, fn, ret, args):
+    return MethodCallExpression(f"dt.{name}", fn, ret, args)
+
+
+_STRPTIME_CACHE: dict[str, str] = {}
+
+
+def _convert_fmt(fmt: str) -> str:
+    # the reference supports chrono-style %6f etc.; python strftime is close
+    return fmt.replace("%6f", "%f").replace("%3f", "%f").replace("%9f", "%f")
+
+
+class DateTimeNamespace:
+    def __init__(self, expr: ColumnExpression):
+        self._expr = expr
+
+    # --- field accessors ---
+    def year(self):
+        return _m("year", lambda d: d.year, dt.INT, [self._expr])
+
+    def month(self):
+        return _m("month", lambda d: d.month, dt.INT, [self._expr])
+
+    def day(self):
+        return _m("day", lambda d: d.day, dt.INT, [self._expr])
+
+    def hour(self):
+        return _m("hour", lambda d: d.hour, dt.INT, [self._expr])
+
+    def minute(self):
+        return _m("minute", lambda d: d.minute, dt.INT, [self._expr])
+
+    def second(self):
+        return _m("second", lambda d: d.second, dt.INT, [self._expr])
+
+    def millisecond(self):
+        return _m("millisecond", lambda d: d.microsecond // 1000, dt.INT, [self._expr])
+
+    def microsecond(self):
+        return _m("microsecond", lambda d: d.microsecond, dt.INT, [self._expr])
+
+    def nanosecond(self):
+        return _m("nanosecond", lambda d: d.microsecond * 1000, dt.INT, [self._expr])
+
+    def weekday(self):
+        return _m("weekday", lambda d: d.weekday(), dt.INT, [self._expr])
+
+    # --- parsing/formatting ---
+    def strptime(self, fmt: str, contains_timezone: bool | None = None):
+        pyfmt_holder = {}
+
+        def fn(s, f):
+            f2 = _convert_fmt(f)
+            d = _dtm.datetime.strptime(s, f2)
+            return d
+
+        has_tz = contains_timezone if contains_timezone is not None else ("%z" in fmt or "%Z" in fmt)
+        ret = dt.DATE_TIME_UTC if has_tz else dt.DATE_TIME_NAIVE
+        return _m("strptime", fn, ret, [self._expr, fmt])
+
+    def strftime(self, fmt: str):
+        return _m("strftime", lambda d, f: d.strftime(_convert_fmt(f)), dt.STR, [self._expr, fmt])
+
+    def to_naive_in_timezone(self, timezone: str):
+        def fn(d, tz):
+            return d.astimezone(ZoneInfo(tz)).replace(tzinfo=None)
+
+        return _m("to_naive_in_timezone", fn, dt.DATE_TIME_NAIVE, [self._expr, timezone])
+
+    def to_utc(self, from_timezone: str):
+        def fn(d, tz):
+            return d.replace(tzinfo=ZoneInfo(tz)).astimezone(_dtm.timezone.utc)
+
+        return _m("to_utc", fn, dt.DATE_TIME_UTC, [self._expr, from_timezone])
+
+    def timestamp(self, unit: str = "s"):
+        mul = {"s": 1, "ms": 1e3, "us": 1e6, "ns": 1e9}[unit]
+
+        def fn(d):
+            if d.tzinfo is None:
+                epoch = _dtm.datetime(1970, 1, 1)
+            else:
+                epoch = _dtm.datetime(1970, 1, 1, tzinfo=_dtm.timezone.utc)
+            return (d - epoch).total_seconds() * mul
+
+        return _m("timestamp", fn, dt.FLOAT, [self._expr])
+
+    def utc_from_timestamp(self, unit: str = "s"):
+        div = {"s": 1, "ms": 1e3, "us": 1e6, "ns": 1e9}[unit]
+
+        def fn(v):
+            return _dtm.datetime.fromtimestamp(v / div, tz=_dtm.timezone.utc)
+
+        return _m("utc_from_timestamp", fn, dt.DATE_TIME_UTC, [self._expr])
+
+    def from_timestamp(self, unit: str = "s"):
+        div = {"s": 1, "ms": 1e3, "us": 1e6, "ns": 1e9}[unit]
+
+        def fn(v):
+            return _dtm.datetime.utcfromtimestamp(v / div)
+
+        return _m("from_timestamp", fn, dt.DATE_TIME_NAIVE, [self._expr])
+
+    # --- rounding (time.rs:86-100) ---
+    def round(self, duration):
+        return _m("round", _round_dt, self._expr._dtype, [self._expr, duration])
+
+    def floor(self, duration):
+        return _m("floor", _floor_dt, self._expr._dtype, [self._expr, duration])
+
+    # --- duration accessors ---
+    def nanoseconds(self):
+        return _m("nanoseconds", lambda d: int(d.total_seconds() * 1e9), dt.INT, [self._expr])
+
+    def microseconds(self):
+        return _m("microseconds", lambda d: int(d.total_seconds() * 1e6), dt.INT, [self._expr])
+
+    def milliseconds(self):
+        return _m("milliseconds", lambda d: int(d.total_seconds() * 1e3), dt.INT, [self._expr])
+
+    def seconds(self):
+        return _m("seconds", lambda d: int(d.total_seconds()), dt.INT, [self._expr])
+
+    def minutes(self):
+        return _m("minutes", lambda d: int(d.total_seconds() // 60), dt.INT, [self._expr])
+
+    def hours(self):
+        return _m("hours", lambda d: int(d.total_seconds() // 3600), dt.INT, [self._expr])
+
+    def days(self):
+        return _m("days", lambda d: d.days, dt.INT, [self._expr])
+
+    def weeks(self):
+        return _m("weeks", lambda d: d.days // 7, dt.INT, [self._expr])
+
+
+def _floor_dt(d, duration):
+    if isinstance(d, _dtm.datetime):
+        if d.tzinfo is None:
+            epoch = _dtm.datetime(1970, 1, 1)
+        else:
+            epoch = _dtm.datetime(1970, 1, 1, tzinfo=_dtm.timezone.utc)
+        delta = d - epoch
+        n = delta // duration
+        return epoch + n * duration
+    raise TypeError(f"dt.floor: unsupported {type(d)}")
+
+
+def _round_dt(d, duration):
+    if isinstance(d, _dtm.datetime):
+        lo = _floor_dt(d, duration)
+        hi = lo + duration
+        return hi if (d - lo) >= (hi - d) else lo
+    raise TypeError(f"dt.round: unsupported {type(d)}")

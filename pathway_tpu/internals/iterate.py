@@ -1,0 +1,134 @@
+"""pw.iterate — fixed-point iteration.
+
+Rebuild of the reference's iterate (Graph::iterate src/engine/graph.rs,
+python internals/operator.py IterateOperator). Implementation: per epoch,
+the engine maintains the input table; the body is executed as a batch
+fixpoint (rebuild + rerun a fresh inner graph per iteration) and the
+fixpoint output is diffed against the previous epoch's output. Semantics
+match for deterministic bodies; incremental nested timestamps are not
+needed for totally-ordered times."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..engine import dataflow as df
+from ..engine.value import rows_equal
+from . import dtype as dt
+from .table import Column, LogicalOp, Table
+from .universe import Universe
+
+
+class _IterateResultNode(df.Node):
+    """Holds the current input state; on each epoch, recompute the batch
+    fixpoint and emit output diffs."""
+
+    def __init__(self, graph, body: Callable, n_cols: int, limit: int | None):
+        super().__init__(graph, "Iterate")
+        self.body = body
+        self.state: dict[int, tuple] = {}
+        self.emitted: dict[int, tuple] = {}
+        self.limit = limit
+
+    def process(self, time):
+        updates = self.take()
+        if not updates:
+            return
+        for key, row, diff in updates:
+            if diff > 0:
+                self.state[key] = row
+            else:
+                self.state.pop(key, None)
+        new_out = self._fixpoint(dict(self.state))
+        out = []
+        for key, row in self.emitted.items():
+            nrow = new_out.get(key)
+            if nrow is None or not rows_equal(row, nrow):
+                out.append((key, row, -1))
+        for key, nrow in new_out.items():
+            orow = self.emitted.get(key)
+            if orow is None or not rows_equal(orow, nrow):
+                out.append((key, nrow, 1))
+        self.emitted = new_out
+        self.emit(out, time)
+
+    def _fixpoint(self, rows: dict[int, tuple]) -> dict[int, tuple]:
+        current = rows
+        iteration = 0
+        while True:
+            iteration += 1
+            nxt = self.body(current)
+            if _same_table(current, nxt):
+                return nxt
+            current = nxt
+            if self.limit is not None and iteration >= self.limit:
+                return current
+
+
+def _same_table(a: dict[int, tuple], b: dict[int, tuple]) -> bool:
+    if len(a) != len(b):
+        return False
+    for k, row in a.items():
+        other = b.get(k)
+        if other is None or not rows_equal(row, other):
+            return False
+    return True
+
+
+def iterate(
+    func: Callable,
+    iteration_limit: int | None = None,
+    **kwargs: Table,
+) -> Any:
+    """pw.iterate(func, **tables): repeatedly apply func until all
+    returned tables stop changing.
+
+    Round-1 support: exactly one iterated table argument (the common
+    case: connected components, shortest paths, collatz…); func may
+    return a Table or a dataclass/dict with one table."""
+    if len(kwargs) != 1:
+        raise NotImplementedError(
+            "pw.iterate currently supports exactly one iterated table"
+        )
+    (name, table), = kwargs.items()
+
+    def body(rows: dict[int, tuple]) -> dict[int, tuple]:
+        # build an inner program: static table from rows, run func, capture
+        from .graph_runner import GraphRunner
+
+        records = [(k, r, 0, 1) for k, r in rows.items()]
+        cols = {n: Column(c.dtype) for n, c in table._columns.items()}
+        op = LogicalOp("static", [], {"rows": records})
+        inner_input = Table(cols, Universe(), op, name=f"iterate_{name}")
+        result = func(**{name: inner_input})
+        if isinstance(result, dict):
+            result = next(iter(result.values()))
+        if not isinstance(result, Table):
+            # dataclass-like
+            fields = [v for v in vars(result).values() if isinstance(v, Table)]
+            result = fields[0]
+        runner = GraphRunner()
+        cap, names = runner.capture(result)
+        runner.run()
+        return dict(cap.state)
+
+    # output columns: func applied to the table determines names; probe once
+    probe_result = func(**{name: table})
+    if isinstance(probe_result, dict):
+        probe_table = next(iter(probe_result.values()))
+    elif isinstance(probe_result, Table):
+        probe_table = probe_result
+    else:
+        probe_table = [v for v in vars(probe_result).values() if isinstance(v, Table)][0]
+
+    cols = {n: Column(c.dtype) for n, c in probe_table._columns.items()}
+    op = LogicalOp(
+        "iterate",
+        [table],
+        {"body": body, "limit": iteration_limit, "n_cols": len(cols)},
+    )
+    return Table(cols, Universe(), op, name="iterate")
+
+
+def iterate_universe(func: Callable, **kwargs) -> Any:
+    return iterate(func, **kwargs)

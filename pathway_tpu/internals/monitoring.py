@@ -1,0 +1,69 @@
+"""Monitoring dashboard + stats.
+
+Rebuild of /root/reference/python/pathway/internals/monitoring.py (rich
+console dashboard :56) and the engine-side ProberStats
+(src/engine/graph.rs:523-567)."""
+
+from __future__ import annotations
+
+import enum
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+class MonitoringLevel(enum.Enum):
+    AUTO = enum.auto()
+    AUTO_ALL = enum.auto()
+    NONE = enum.auto()
+    IN_OUT = enum.auto()
+    ALL = enum.auto()
+
+
+@dataclass
+class StatsSnapshot:
+    time: int = 0
+    rows_in: int = 0
+    rows_out: int = 0
+    operators: dict = field(default_factory=dict)
+
+
+class StatsMonitor:
+    """Collects per-epoch operator stats from the engine; optionally
+    renders a live rich dashboard."""
+
+    def __init__(self, render: bool = False, interval: float = 1.0):
+        self.render = render
+        self.interval = interval
+        self._last_render = 0.0
+        self.snapshot = StatsSnapshot()
+
+    def update(self, engine) -> None:
+        snap = StatsSnapshot(time=engine.current_time)
+        for node in engine.nodes:
+            snap.operators[f"{node.id}:{node.name}"] = (
+                node.stats.rows_in,
+                node.stats.rows_out,
+            )
+            snap.rows_in += node.stats.rows_in
+            snap.rows_out += node.stats.rows_out
+        self.snapshot = snap
+        if self.render and time.monotonic() - self._last_render > self.interval:
+            self._render()
+            self._last_render = time.monotonic()
+
+    def _render(self) -> None:  # pragma: no cover
+        try:
+            from rich.console import Console
+            from rich.table import Table as RichTable
+
+            console = Console(file=sys.stderr)
+            t = RichTable(title=f"pathway_tpu @ t={self.snapshot.time}")
+            t.add_column("operator")
+            t.add_column("rows in")
+            t.add_column("rows out")
+            for name, (rin, rout) in self.snapshot.operators.items():
+                t.add_row(name, str(rin), str(rout))
+            console.print(t)
+        except Exception:
+            pass

@@ -1,0 +1,43 @@
+"""Global parse graph.
+
+Rebuild of /root/reference/python/pathway/internals/parse_graph.py
+(ParseGraph :104, global G :244). Tables register themselves; pw.run /
+debug helpers tree-shake from requested outputs."""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable
+
+if TYPE_CHECKING:
+    from .table import Table
+
+
+class ParseGraph:
+    def __init__(self):
+        self.tables: list["Table"] = []
+        self.outputs: list[tuple["Table", dict]] = []  # (table, sink spec)
+        self.subscriptions: list[dict] = []
+        self.error_log_tables: list["Table"] = []
+
+    def register(self, table: "Table") -> None:
+        self.tables.append(table)
+
+    def add_output(self, table: "Table", sink: dict) -> None:
+        self.outputs.append((table, sink))
+
+    def add_subscription(self, spec: dict) -> None:
+        self.subscriptions.append(spec)
+
+    def clear(self) -> None:
+        self.tables.clear()
+        self.outputs.clear()
+        self.subscriptions.clear()
+        self.error_log_tables.clear()
+
+
+G = ParseGraph()
+
+
+def clear_graph() -> None:
+    """pw.parse_graph clear for tests (reference G.clear())."""
+    G.clear()

@@ -1,0 +1,49 @@
+"""pw.run / pw.run_all.
+
+Rebuild of /root/reference/python/pathway/internals/run.py (:12,:56)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .graph_runner import GraphRunner
+from .parse_graph import G
+
+
+def run(
+    *,
+    debug: bool = False,
+    monitoring_level: Any = None,
+    with_http_server: bool = False,
+    persistence_config: Any = None,
+    license_key: str | None = None,
+    runtime_typechecking: bool = True,
+    terminate_on_error: bool = True,
+    **kwargs: Any,
+) -> None:
+    """Execute all registered outputs/subscriptions to completion
+    (static sources) or until all streaming connectors close."""
+    runner = GraphRunner()
+    if persistence_config is not None:
+        runner.engine.persistence_config = persistence_config
+    for table, sink in list(G.outputs):
+        sink_builder = sink.get("build")
+        if sink_builder is not None:
+            sink_builder(runner, table)
+    for spec in list(G.subscriptions):
+        runner.subscribe(
+            spec["table"],
+            on_change=spec.get("on_change"),
+            on_time_end=spec.get("on_time_end"),
+            on_end=spec.get("on_end"),
+        )
+    monitor = None
+    if monitoring_level is not None and monitoring_level not in (False, "none"):
+        from .monitoring import StatsMonitor
+
+        monitor = StatsMonitor()
+    runner.run(monitoring_callback=monitor.update if monitor else None)
+
+
+def run_all(**kwargs: Any) -> None:
+    run(**kwargs)

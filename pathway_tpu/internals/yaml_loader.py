@@ -1,0 +1,102 @@
+"""YAML pipeline config loader.
+
+Rebuild of /root/reference/python/pathway/internals/yaml_loader.py
+(:74-160): `$var` references and `!pw.module.Class` instantiation tags
+used by the RAG templates."""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, IO
+
+import yaml
+
+
+class _PwTag:
+    def __init__(self, path: str, kwargs: dict):
+        self.path = path
+        self.kwargs = kwargs
+
+    def instantiate(self, variables: dict) -> Any:
+        target = _resolve_path(self.path)
+        kwargs = {k: _materialize(v, variables) for k, v in self.kwargs.items()}
+        if kwargs:
+            return target(**kwargs)
+        # no-kwarg tag: return the object itself (class, function, constant)
+        if callable(target) and not isinstance(target, type):
+            return target
+        if isinstance(target, type):
+            return target()
+        return target
+
+
+def _resolve_path(path: str) -> Any:
+    if path.startswith("pw."):
+        module_path = "pathway_tpu"
+        attrs = path.split(".")[1:]
+    else:
+        parts = path.split(".")
+        for split in range(len(parts), 0, -1):
+            try:
+                mod = importlib.import_module(".".join(parts[:split]))
+                obj = mod
+                for a in parts[split:]:
+                    obj = getattr(obj, a)
+                return obj
+            except (ImportError, AttributeError):
+                continue
+        raise ImportError(f"cannot resolve {path!r}")
+    obj: Any = importlib.import_module(module_path)
+    for a in attrs:
+        obj = getattr(obj, a)
+    return obj
+
+
+def _materialize(value: Any, variables: dict) -> Any:
+    if isinstance(value, _PwTag):
+        return value.instantiate(variables)
+    if isinstance(value, str) and value.startswith("$"):
+        name = value[1:]
+        if name in variables:
+            return _materialize(variables[name], variables)
+        import os
+
+        env = os.environ.get(name)
+        if env is not None:
+            return env
+        raise KeyError(f"undefined variable {value!r}")
+    if isinstance(value, dict):
+        return {k: _materialize(v, variables) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_materialize(v, variables) for v in value]
+    return value
+
+
+def _make_loader():
+    class Loader(yaml.SafeLoader):
+        pass
+
+    def construct_pw(loader, suffix, node):
+        if isinstance(node, yaml.MappingNode):
+            kwargs = loader.construct_mapping(node, deep=True)
+        else:
+            kwargs = {}
+        return _PwTag(suffix, kwargs)
+
+    Loader.add_multi_constructor("!", lambda l, s, n: construct_pw(l, s, n))
+    return Loader
+
+
+def load_yaml(stream: str | IO) -> Any:
+    """Load a YAML pipeline config, resolving $vars and !pw tags."""
+    data = yaml.load(stream, Loader=_make_loader())
+    if not isinstance(data, dict):
+        return _materialize(data, {})
+    variables = {k: v for k, v in data.items() if k.startswith("$")}
+    variables = {k[1:]: v for k, v in variables.items()}
+    out = {}
+    for k, v in data.items():
+        if k.startswith("$"):
+            continue
+        out[k] = _materialize(v, variables)
+    return out

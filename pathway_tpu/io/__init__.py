@@ -1,0 +1,46 @@
+"""pw.io: connectors.
+
+Rebuild of /root/reference/python/pathway/io/ (30 connector packages).
+Fully implemented this round: fs, csv, jsonlines, plaintext, python,
+http (server + client), null, subscribe. Service-backed connectors
+(kafka, s3, postgres, …) share the same reader/writer machinery and are
+gated on their client libraries being installed."""
+
+from __future__ import annotations
+
+from . import csv, fs, jsonlines, null, plaintext, python
+from ._subscribe import subscribe
+from ._connector import add_output_sink
+
+# service-backed connectors (gated on client libs at call time)
+from . import kafka, s3, minio, elasticsearch, postgres, debezium, mongodb
+from . import redpanda, nats, gdrive, sqlite, deltalake, bigquery, pubsub, logstash
+from . import airbyte, http
+
+__all__ = [
+    "add_output_sink",
+    "airbyte",
+    "bigquery",
+    "csv",
+    "debezium",
+    "deltalake",
+    "elasticsearch",
+    "fs",
+    "gdrive",
+    "http",
+    "jsonlines",
+    "kafka",
+    "logstash",
+    "minio",
+    "mongodb",
+    "nats",
+    "null",
+    "plaintext",
+    "postgres",
+    "pubsub",
+    "python",
+    "redpanda",
+    "s3",
+    "sqlite",
+    "subscribe",
+]

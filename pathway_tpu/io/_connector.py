@@ -1,0 +1,145 @@
+"""Connector runtime glue: build input tables fed by reader threads.
+
+Rebuild of the reference's connector driver (/root/reference/src/connectors/
+mod.rs:427-560 Connector::run: reader thread → entry queue → per-epoch
+poller with commit ticks) on top of engine InputSessions."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time as _time
+from typing import Any, Callable, Iterable
+
+from ..engine import dataflow as df
+from ..engine.value import Json, ref_scalar
+from ..internals import dtype as dt
+from ..internals.schema import Schema
+from ..internals.table import Column, LogicalOp, Table
+from ..internals.universe import Universe
+from ..internals.parse_graph import G
+
+
+def make_key(names: list[str], pk: list[str] | None, values: dict, seq: list[int]) -> int:
+    if pk:
+        return int(ref_scalar(*[values.get(n) for n in pk]))
+    seq[0] += 1
+    return int(ref_scalar("__auto__", seq[0]))
+
+
+def coerce_to_schema(values: dict, dtypes: dict[str, dt.DType]) -> tuple:
+    out = []
+    for n, t in dtypes.items():
+        v = values.get(n)
+        tu = dt.unoptionalize(t)
+        if v is not None:
+            try:
+                if tu is dt.INT and not isinstance(v, bool):
+                    v = int(v)
+                elif tu is dt.FLOAT:
+                    v = float(v)
+                elif tu is dt.STR and not isinstance(v, str):
+                    v = str(v)
+                elif tu is dt.JSON and not isinstance(v, Json):
+                    v = Json(v)
+                elif tu is dt.BYTES and isinstance(v, str):
+                    v = v.encode()
+            except (ValueError, TypeError):
+                pass
+        out.append(v)
+    return tuple(out)
+
+
+class StreamingContext:
+    """Handed to reader threads: typed insert/remove + commit."""
+
+    def __init__(self, session: df.InputSession, schema: type[Schema]):
+        self.session = session
+        self.dtypes = schema.dtypes()
+        self.names = list(self.dtypes.keys())
+        self.pk = schema.primary_key_columns()
+        self._seq = [0]
+        self._deletions: dict[int, tuple] = {}
+
+    def insert(self, values: dict) -> None:
+        key = make_key(self.names, self.pk, values, self._seq)
+        row = coerce_to_schema(values, self.dtypes)
+        if self.pk:
+            self.session.upsert(key, row)
+            self._deletions[key] = row
+        else:
+            self.session.insert(key, row)
+            self._deletions[key] = row
+
+    def remove(self, values: dict) -> None:
+        key = make_key(self.names, self.pk, values, self._seq)
+        if self.pk:
+            self.session.upsert(key, None)
+        else:
+            row = coerce_to_schema(values, self.dtypes)
+            self.session.remove(key, row)
+
+    def commit(self) -> None:
+        self.session.commit()
+
+    def close(self) -> None:
+        self.session.close()
+
+
+def input_table_from_reader(
+    schema: type[Schema],
+    reader: Callable[[StreamingContext], None],
+    *,
+    name: str = "connector",
+    autocommit_duration_ms: int | None = 1500,
+) -> Table:
+    """Create an input Table whose rows are produced by `reader(ctx)`
+    running on a named thread (reference reader threads mod.rs:447)."""
+
+    dtypes = schema.dtypes()
+
+    def build(engine: df.EngineGraph, runner) -> df.Node:
+        node = df.SessionSourceNode(engine)
+        ctx = StreamingContext(node.session, schema)
+
+        def run():
+            try:
+                reader(ctx)
+            finally:
+                ctx.close()
+
+        t = threading.Thread(target=run, name=f"pathway_tpu:connector-{name}", daemon=True)
+        engine.connector_threads.append(t)
+        return node
+
+    cols = {n: Column(t) for n, t in dtypes.items()}
+    op = LogicalOp("connector", [], {"build": build})
+    return Table(cols, Universe(), op, name=name)
+
+
+def static_table_from_rows(
+    schema: type[Schema],
+    dict_rows: Iterable[dict],
+    *,
+    name: str = "static_connector",
+) -> Table:
+    dtypes = schema.dtypes()
+    names = list(dtypes.keys())
+    pk = schema.primary_key_columns()
+    seq = [0]
+    records = []
+    for values in dict_rows:
+        key = make_key(names, pk, values, seq)
+        records.append((key, coerce_to_schema(values, dtypes), 0, 1))
+    cols = {n: Column(t) for n, t in dtypes.items()}
+    op = LogicalOp("static", [], {"rows": records})
+    return Table(cols, Universe(), op, name=name)
+
+
+def add_output_sink(table: Table, write_fn: Callable, on_end: Callable | None = None, name: str = "output") -> None:
+    """Register a sink: write_fn(key, row_dict, time, diff) per change."""
+
+    def build(runner, t):
+        runner.subscribe(t, on_change=write_fn, on_end=on_end)
+
+    G.add_output(table, {"build": build, "name": name})

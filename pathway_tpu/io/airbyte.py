@@ -1,0 +1,16 @@
+"""pw.io.airbyte (reference io/airbyte + third_party/airbyte_serverless).
+
+Runs an Airbyte source connector (docker or venv) and streams records.
+Requires the airbyte connector runtime at call time."""
+
+from __future__ import annotations
+
+from ..internals.schema import Schema
+from ..internals.table import Table
+
+
+def read(config_file_path: str, streams: list[str], *args, **kwargs) -> Table:
+    raise NotImplementedError(
+        "pw.io.airbyte: serverless-airbyte runtime glue pending; the record "
+        "ingestion path shares pw.io.python.ConnectorSubject"
+    )

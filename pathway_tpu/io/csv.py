@@ -1,0 +1,45 @@
+"""pw.io.csv (reference python/pathway/io/csv)."""
+
+from __future__ import annotations
+
+from ..internals.schema import Schema
+from ..internals.table import Table
+from . import fs as _fs
+
+
+def read(
+    path: str,
+    *,
+    schema: type[Schema] | None = None,
+    mode: str = "streaming",
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    name: str = "csv",
+    **kwargs,
+) -> Table:
+    if schema is None:
+        from ..internals.schema import schema_from_csv
+        import glob
+        import os
+
+        probe = path
+        if not os.path.isfile(probe):
+            files = _fs._list_files(path)
+            if not files:
+                raise ValueError(f"csv.read: no files found at {path!r} to infer schema; pass schema=")
+            probe = files[0]
+        schema = schema_from_csv(probe)
+    return _fs.read(
+        path,
+        format="csv",
+        schema=schema,
+        mode=mode,
+        with_metadata=with_metadata,
+        autocommit_duration_ms=autocommit_duration_ms,
+        name=name,
+        **kwargs,
+    )
+
+
+def write(table: Table, filename: str, **kwargs) -> None:
+    _fs.write(table, filename, format="csv", name="csv.write", **kwargs)

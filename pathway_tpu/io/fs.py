@@ -1,0 +1,240 @@
+"""Filesystem connector (pw.io.fs).
+
+Rebuild of /root/reference/python/pathway/io/fs + the engine-side posix
+scanner (/root/reference/src/connectors/posix_like.rs:279,
+scanner/filesystem.rs). Supports formats: plaintext, plaintext_by_file,
+csv, json/jsonlines, binary; modes: static (read once) and streaming
+(directory watching with additions/deletions)."""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob as _glob
+import io as _io
+import json
+import os
+import time
+from typing import Any
+
+from ..engine.value import Json, ref_scalar
+from ..internals import dtype as dt
+from ..internals.schema import Schema, schema_builder, ColumnDefinition
+from ..internals.table import Table
+from ._connector import (
+    StreamingContext,
+    coerce_to_schema,
+    input_table_from_reader,
+    static_table_from_rows,
+)
+
+_POLL_INTERVAL_S = 0.2
+
+
+def _plaintext_schema(with_metadata: bool) -> type[Schema]:
+    cols: dict[str, Any] = {"data": ColumnDefinition(dtype=dt.STR)}
+    if with_metadata:
+        cols["_metadata"] = ColumnDefinition(dtype=dt.JSON)
+    return schema_builder(cols, name="PlaintextSchema")
+
+
+def _binary_schema(with_metadata: bool) -> type[Schema]:
+    cols: dict[str, Any] = {"data": ColumnDefinition(dtype=dt.BYTES)}
+    if with_metadata:
+        cols["_metadata"] = ColumnDefinition(dtype=dt.JSON)
+    return schema_builder(cols, name="BinarySchema")
+
+
+def _list_files(path: str, object_pattern: str = "*") -> list[str]:
+    if os.path.isfile(path):
+        return [path]
+    if os.path.isdir(path):
+        out = []
+        for root, _dirs, files in os.walk(path):
+            for f in sorted(files):
+                import fnmatch
+
+                if fnmatch.fnmatch(f, object_pattern):
+                    out.append(os.path.join(root, f))
+        return sorted(out)
+    return sorted(_glob.glob(path))
+
+
+def _metadata(fpath: str) -> Json:
+    try:
+        st = os.stat(fpath)
+        return Json(
+            {
+                "path": os.path.abspath(fpath),
+                "size": st.st_size,
+                "modified_at": int(st.st_mtime),
+                "created_at": int(st.st_ctime),
+                "seen_at": int(time.time()),
+                "owner": str(st.st_uid),
+            }
+        )
+    except OSError:
+        return Json({"path": fpath})
+
+
+def _rows_for_file(fpath: str, format: str, schema, with_metadata: bool, **kwargs):
+    """Yield dict rows for one file."""
+    if format in ("plaintext", "plaintext_by_file"):
+        if format == "plaintext_by_file":
+            with open(fpath, "r", errors="replace") as f:
+                row = {"data": f.read().rstrip("\n")}
+                if with_metadata:
+                    row["_metadata"] = _metadata(fpath)
+                yield row
+        else:
+            with open(fpath, "r", errors="replace") as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    if line:
+                        row = {"data": line}
+                        if with_metadata:
+                            row["_metadata"] = _metadata(fpath)
+                        yield row
+    elif format == "binary":
+        with open(fpath, "rb") as f:
+            row = {"data": f.read()}
+            if with_metadata:
+                row["_metadata"] = _metadata(fpath)
+            yield row
+    elif format == "csv":
+        with open(fpath, "r", newline="", errors="replace") as f:
+            reader = _csv.DictReader(f, **{k: v for k, v in kwargs.items() if k in ("delimiter", "quotechar")})
+            for rec in reader:
+                row = dict(rec)
+                if with_metadata:
+                    row["_metadata"] = _metadata(fpath)
+                yield row
+    elif format in ("json", "jsonlines"):
+        with open(fpath, "r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                row = dict(rec)
+                if with_metadata:
+                    row["_metadata"] = _metadata(fpath)
+                yield row
+    else:
+        raise ValueError(f"unsupported format {format!r}")
+
+
+def read(
+    path: str,
+    *,
+    format: str = "plaintext",
+    schema: type[Schema] | None = None,
+    mode: str = "streaming",
+    with_metadata: bool = False,
+    object_pattern: str = "*",
+    autocommit_duration_ms: int | None = 1500,
+    name: str = "fs",
+    **kwargs,
+) -> Table:
+    if schema is None:
+        if format == "binary":
+            schema = _binary_schema(with_metadata)
+        else:
+            schema = _plaintext_schema(with_metadata)
+    elif with_metadata and "_metadata" not in schema.column_names():
+        cols = {n: c for n, c in schema.columns().items()}
+        cols["_metadata"] = ColumnDefinition(dtype=dt.JSON)
+        schema = schema_builder(cols, name=schema.__name__)
+
+    if mode == "static":
+        rows: list[dict] = []
+        for fpath in _list_files(path, object_pattern):
+            rows.extend(_rows_for_file(fpath, format, schema, with_metadata, **kwargs))
+        return static_table_from_rows(schema, rows, name=f"fs:{path}")
+
+    # streaming: watch for file additions / modifications / deletions
+    def reader(ctx: StreamingContext) -> None:
+        known: dict[str, tuple[float, list[dict]]] = {}
+        while True:
+            current = _list_files(path, object_pattern)
+            changed = False
+            for fpath in current:
+                try:
+                    mtime = os.stat(fpath).st_mtime
+                except OSError:
+                    continue
+                old = known.get(fpath)
+                if old is not None and old[0] == mtime:
+                    continue
+                if old is not None:
+                    for row in old[1]:
+                        ctx.remove(row)
+                rows = list(_rows_for_file(fpath, format, schema, with_metadata, **kwargs))
+                for row in rows:
+                    ctx.insert(row)
+                known[fpath] = (mtime, rows)
+                changed = True
+            for fpath in list(known):
+                if fpath not in current:
+                    for row in known.pop(fpath)[1]:
+                        ctx.remove(row)
+                    changed = True
+            if changed:
+                ctx.commit()
+            if os.environ.get("PATHWAY_TPU_FS_ONESHOT"):
+                break
+            time.sleep(_POLL_INTERVAL_S)
+
+    return input_table_from_reader(
+        schema, reader, name=f"fs:{path}", autocommit_duration_ms=autocommit_duration_ms
+    )
+
+
+def write(table: Table, filename: str, *, format: str = "csv", name: str = "fs.write", **kwargs) -> None:
+    """Write table changes to a file (csv with time/diff columns, like the
+    reference FileWriter data_storage.rs:649)."""
+    from ._connector import add_output_sink
+
+    names = table.column_names()
+    f = open(filename, "w", newline="")
+    if format == "csv":
+        writer = _csv.writer(f)
+        writer.writerow(names + ["time", "diff"])
+
+        def on_change(key, row, time_, diff):
+            writer.writerow([row[n] for n in names] + [time_, diff])
+            f.flush()
+
+    elif format in ("json", "jsonlines"):
+
+        def on_change(key, row, time_, diff):
+            rec = {n: _jsonable(row[n]) for n in names}
+            rec["time"] = time_
+            rec["diff"] = diff
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+
+    else:
+        raise ValueError(f"unsupported format {format!r}")
+
+    def on_end():
+        f.close()
+
+    add_output_sink(table, on_change, on_end=on_end, name=name)
+
+
+def _jsonable(v):
+    import numpy as np
+
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, tuple):
+        return [_jsonable(x) for x in v]
+    return v

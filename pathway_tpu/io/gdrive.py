@@ -1,0 +1,34 @@
+"""pw.io.gdrive — Google Drive source (reference io/gdrive, 401 LoC).
+
+Requires `googleapiclient` at call time; shares the connector runtime in
+pathway_tpu/io/_connector.py. TPU build note: the dataflow side (reader
+threads, commit ticks, upsert sessions) is identical to the implemented
+connectors (fs/kafka/sqlite); only the client-protocol glue needs the
+third-party lib."""
+
+from __future__ import annotations
+
+from ..internals.schema import Schema
+from ..internals.table import Table
+
+
+def _require():
+    try:
+        import googleapiclient  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "pw.io.gdrive requires the 'googleapiclient' package to be installed"
+        ) from e
+
+
+def read(*args, schema: type[Schema] | None = None, **kwargs) -> Table:
+    _require()
+    raise NotImplementedError(
+        "pw.io.gdrive.read: client glue pending; see pw.io.fs/kafka/sqlite for "
+        "the implemented pattern (files by folder id)"
+    )
+
+
+def write(table: Table, *args, **kwargs) -> None:
+    _require()
+    raise NotImplementedError("pw.io.gdrive.write: client glue pending")

@@ -1,0 +1,79 @@
+"""HTTP client connectors (reference io/http read/write)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ...internals import dtype as dt
+from ...internals.schema import Schema, schema_builder, ColumnDefinition
+from ...internals.table import Table
+from .._connector import StreamingContext, input_table_from_reader, add_output_sink
+
+
+def read(
+    url: str,
+    *,
+    schema: type[Schema] | None = None,
+    format: str = "json",
+    poll_interval_s: float = 1.0,
+    mode: str = "streaming",
+    autocommit_duration_ms: int | None = 1500,
+    name: str = "http",
+    **kwargs,
+) -> Table:
+    """Poll an HTTP endpoint; each returned record becomes a row."""
+    import requests
+
+    if schema is None:
+        schema = schema_builder({"data": ColumnDefinition(dtype=dt.JSON)}, name="HttpSchema")
+
+    def reader(ctx: StreamingContext) -> None:
+        seen: set = set()
+        while True:
+            try:
+                resp = requests.get(url, timeout=30)
+                payload = resp.json() if format == "json" else resp.text
+            except Exception:
+                time.sleep(poll_interval_s)
+                continue
+            records = payload if isinstance(payload, list) else [payload]
+            changed = False
+            for rec in records:
+                fp = json.dumps(rec, sort_keys=True, default=str)
+                if fp in seen:
+                    continue
+                seen.add(fp)
+                if isinstance(rec, dict):
+                    ctx.insert(rec)
+                else:
+                    ctx.insert({"data": rec})
+                changed = True
+            if changed:
+                ctx.commit()
+            if mode == "static":
+                break
+            time.sleep(poll_interval_s)
+
+    return input_table_from_reader(
+        schema, reader, name=name, autocommit_duration_ms=autocommit_duration_ms
+    )
+
+
+def write(table: Table, url: str, *, method: str = "POST", name: str = "http.write", **kwargs) -> None:
+    import requests
+
+    names = table.column_names()
+
+    def on_change(key, row, time_, diff):
+        from ..fs import _jsonable
+
+        payload = {n: _jsonable(row[n]) for n in names}
+        payload["time"] = time_
+        payload["diff"] = diff
+        try:
+            requests.request(method, url, json=payload, timeout=30)
+        except Exception:
+            pass
+
+    add_output_sink(table, on_change, name=name)

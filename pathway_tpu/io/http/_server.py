@@ -1,0 +1,211 @@
+"""REST server connector.
+
+Rebuild of /root/reference/python/pathway/io/http/_server.py: an aiohttp
+webserver feeding requests into the dataflow as rows and resolving
+responses from a subscribed result table. Query/response cycle:
+
+    HTTP POST → queue row into InputSession (epoch t)
+    → pipeline computes result (same or later epoch)
+    → response_writer subscription resolves the request's future
+    → HTTP response returns.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import uuid
+from typing import Any
+
+from ...engine.value import Json, Pointer, ref_scalar
+from ...internals import dtype as dt
+from ...internals.schema import Schema, schema_builder, ColumnDefinition
+from ...internals.table import Table
+from ...internals.parse_graph import G
+from .._connector import StreamingContext, input_table_from_reader
+
+try:
+    from aiohttp import web
+except ImportError:  # pragma: no cover
+    web = None
+
+
+class PathwayWebserver:
+    """Shared aiohttp server hosting several endpoints (reference
+    _server.py:329). Runs its own asyncio loop on a daemon thread."""
+
+    def __init__(self, host: str, port: int, with_cors: bool = False, with_schema_endpoint: bool = True):
+        if web is None:
+            raise ImportError("pw.io.http requires aiohttp")
+        self.host = host
+        self.port = port
+        self.with_cors = with_cors
+        self._app = web.Application()
+        self._routes: dict[tuple[str, str], Any] = {}
+        self._openapi: dict[str, Any] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._runner = None
+        if with_schema_endpoint:
+            self._app.router.add_get("/_schema", self._schema_handler)
+
+    async def _schema_handler(self, request):
+        return web.json_response(
+            {
+                "openapi": "3.0.3",
+                "info": {"title": "pathway_tpu", "version": "1.0"},
+                "paths": self._openapi,
+            }
+        )
+
+    def add_route(self, route: str, methods: list[str], handler, schema_doc: dict | None = None):
+        for m in methods:
+            self._app.router.add_route(m, route, handler)
+        self._openapi[route] = schema_doc or {}
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._serve, daemon=True, name="pathway_tpu:http")
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def _serve(self):
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        async def init():
+            runner = web.AppRunner(self._app)
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self._runner = runner
+            self._started.set()
+
+        loop.run_until_complete(init())
+        loop.run_forever()
+
+    @property
+    def loop(self) -> asyncio.AbstractEventLoop:
+        assert self._loop is not None
+        return self._loop
+
+
+def rest_connector(
+    host: str | None = None,
+    port: int | None = None,
+    *,
+    webserver: PathwayWebserver | None = None,
+    route: str = "/",
+    methods: list[str] = ("POST",),
+    schema: type[Schema] | None = None,
+    autocommit_duration_ms: int | None = 50,
+    keep_queries: bool = False,
+    delete_completed_queries: bool = True,
+    request_validator=None,
+    documentation=None,
+) -> tuple[Table, Any]:
+    """Expose an HTTP endpoint as an input table. Returns
+    (query_table, response_writer); call response_writer(result_table)
+    where result_table has a `result` column and query keys."""
+    if webserver is None:
+        assert host is not None and port is not None
+        webserver = PathwayWebserver(host, port)
+
+    if schema is None:
+        schema = schema_builder(
+            {"query": ColumnDefinition(dtype=dt.JSON)}, name="RestSchema"
+        )
+    dtypes = schema.dtypes()
+    names = list(dtypes.keys())
+
+    pending: dict[int, asyncio.Future] = {}
+    pending_lock = threading.Lock()
+    ctx_holder: dict[str, StreamingContext] = {}
+    started = threading.Event()
+
+    async def handler(request):
+        if request.method == "GET":
+            payload = dict(request.rel_url.query)
+        else:
+            try:
+                payload = await request.json()
+            except (ValueError, json.JSONDecodeError):
+                text = await request.text()
+                payload = {"query": text}
+        if request_validator is not None:
+            try:
+                request_validator(payload)
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=400)
+
+        qid = str(uuid.uuid4())
+        values: dict[str, Any] = {}
+        for n in names:
+            if n == "id":
+                continue
+            v = payload.get(n)
+            if dt.unoptionalize(dtypes[n]) is dt.JSON and not isinstance(v, Json):
+                v = Json(v)
+            values[n] = v
+        key = int(ref_scalar(qid))
+
+        fut = asyncio.get_running_loop().create_future()
+        with pending_lock:
+            pending[key] = fut
+        started.wait(timeout=30)
+        ctx = ctx_holder.get("ctx")
+        if ctx is None:
+            return web.json_response({"error": "pipeline not running"}, status=503)
+        row = tuple(values.get(n) for n in names)
+        ctx.session.insert(key, row)
+        ctx.session.commit()
+        try:
+            result = await asyncio.wait_for(fut, timeout=120)
+        except asyncio.TimeoutError:
+            return web.json_response({"error": "timeout"}, status=504)
+        finally:
+            with pending_lock:
+                pending.pop(key, None)
+        if isinstance(result, Json):
+            result = result.value
+        from ..fs import _jsonable
+
+        return web.json_response(_jsonable(result))
+
+    webserver.add_route(route, list(methods), handler)
+
+    def reader(ctx: StreamingContext) -> None:
+        ctx_holder["ctx"] = ctx
+        started.set()
+        webserver.start()
+        # serve until the process ends
+        threading.Event().wait()
+
+    table = input_table_from_reader(
+        schema, reader, name=f"rest:{route}", autocommit_duration_ms=autocommit_duration_ms
+    )
+
+    def response_writer(result_table: Table) -> None:
+        names_r = result_table.column_names()
+        result_idx = names_r.index("result") if "result" in names_r else 0
+
+        def on_change(key, row, time, diff):
+            if diff <= 0:
+                return
+            with pending_lock:
+                fut = pending.get(int(key))
+            if fut is not None and not fut.done():
+                value = row.get("result") if isinstance(row, dict) else row[result_idx]
+                webserver.loop.call_soon_threadsafe(
+                    lambda f=fut, v=value: (not f.done()) and f.set_result(v)
+                )
+
+        from ..._graph_hooks import subscribe_raw
+
+        subscribe_raw(result_table, on_change)
+
+    return table, response_writer

@@ -1,0 +1,35 @@
+"""pw.io.jsonlines (reference python/pathway/io/jsonlines)."""
+
+from __future__ import annotations
+
+from ..internals.schema import Schema
+from ..internals.table import Table
+from . import fs as _fs
+
+
+def read(
+    path: str,
+    *,
+    schema: type[Schema] | None = None,
+    mode: str = "streaming",
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    name: str = "jsonlines",
+    **kwargs,
+) -> Table:
+    if schema is None:
+        raise ValueError("jsonlines.read requires schema=")
+    return _fs.read(
+        path,
+        format="jsonlines",
+        schema=schema,
+        mode=mode,
+        with_metadata=with_metadata,
+        autocommit_duration_ms=autocommit_duration_ms,
+        name=name,
+        **kwargs,
+    )
+
+
+def write(table: Table, filename: str, **kwargs) -> None:
+    _fs.write(table, filename, format="jsonlines", name="jsonlines.write", **kwargs)

@@ -1,0 +1,146 @@
+"""pw.io.kafka (reference python/pathway/io/kafka, 686 LoC; engine
+KafkaReader data_storage.rs:692, KafkaWriter :1258).
+
+Requires a kafka client library (confluent_kafka or kafka-python) at call
+time; the dataflow-side machinery (reader thread → InputSession, message
+parsing, commits) is fully implemented here."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from ..internals import dtype as dt
+from ..internals.schema import Schema, schema_builder, ColumnDefinition
+from ..internals.table import Table
+from ._connector import StreamingContext, input_table_from_reader, add_output_sink
+
+
+def _get_consumer(rdkafka_settings: dict, topic: str):
+    try:
+        from confluent_kafka import Consumer  # type: ignore
+
+        consumer = Consumer(rdkafka_settings)
+        consumer.subscribe([topic])
+        return ("confluent", consumer)
+    except ImportError:
+        pass
+    try:
+        from kafka import KafkaConsumer  # type: ignore
+
+        consumer = KafkaConsumer(
+            topic,
+            bootstrap_servers=rdkafka_settings.get("bootstrap.servers"),
+            group_id=rdkafka_settings.get("group.id"),
+            auto_offset_reset=rdkafka_settings.get("auto.offset.reset", "earliest"),
+        )
+        return ("kafka-python", consumer)
+    except ImportError:
+        pass
+    raise ImportError(
+        "pw.io.kafka requires confluent_kafka or kafka-python to be installed"
+    )
+
+
+def read(
+    rdkafka_settings: dict,
+    topic: str | None = None,
+    *,
+    schema: type[Schema] | None = None,
+    format: str = "json",
+    autocommit_duration_ms: int | None = 1500,
+    name: str = "kafka",
+    **kwargs,
+) -> Table:
+    if schema is None:
+        if format == "raw":
+            schema = schema_builder(
+                {"data": ColumnDefinition(dtype=dt.BYTES)}, name="KafkaRaw"
+            )
+        else:
+            raise ValueError("kafka.read requires schema= for json format")
+
+    def reader(ctx: StreamingContext) -> None:
+        kind, consumer = _get_consumer(rdkafka_settings, topic)
+        try:
+            if kind == "confluent":
+                while True:
+                    msg = consumer.poll(timeout=1.0)
+                    if msg is None:
+                        ctx.commit()
+                        continue
+                    if msg.error():
+                        continue
+                    _emit(ctx, msg.value(), format, schema)
+            else:
+                for msg in consumer:
+                    _emit(ctx, msg.value, format, schema)
+        finally:
+            try:
+                consumer.close()
+            except Exception:
+                pass
+
+    return input_table_from_reader(
+        schema, reader, name=name, autocommit_duration_ms=autocommit_duration_ms
+    )
+
+
+def _emit(ctx: StreamingContext, payload: bytes, format: str, schema) -> None:
+    if format == "raw":
+        ctx.insert({"data": payload})
+    else:
+        try:
+            rec = json.loads(payload)
+        except (ValueError, TypeError):
+            return
+        ctx.insert(rec)
+
+
+def write(
+    table: Table,
+    rdkafka_settings: dict,
+    topic_name: str | None = None,
+    *,
+    format: str = "json",
+    name: str = "kafka.write",
+    **kwargs,
+) -> None:
+    producer_holder: list = []
+
+    def get_producer():
+        if producer_holder:
+            return producer_holder[0]
+        try:
+            from confluent_kafka import Producer  # type: ignore
+
+            p = ("confluent", Producer(rdkafka_settings))
+        except ImportError:
+            from kafka import KafkaProducer  # type: ignore
+
+            p = (
+                "kafka-python",
+                KafkaProducer(
+                    bootstrap_servers=rdkafka_settings.get("bootstrap.servers")
+                ),
+            )
+        producer_holder.append(p)
+        return p
+
+    names = table.column_names()
+
+    def on_change(key, row, time_, diff):
+        kind, producer = get_producer()
+        from .fs import _jsonable
+
+        rec = {n: _jsonable(row[n]) for n in names}
+        rec["time"] = time_
+        rec["diff"] = diff
+        payload = json.dumps(rec).encode()
+        if kind == "confluent":
+            producer.produce(topic_name, payload)
+            producer.poll(0)
+        else:
+            producer.send(topic_name, payload)
+
+    add_output_sink(table, on_change, name=name)

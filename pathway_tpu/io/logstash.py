@@ -1,0 +1,34 @@
+"""pw.io.logstash — Logstash sink (reference io/logstash).
+
+Requires `requests` at call time; shares the connector runtime in
+pathway_tpu/io/_connector.py. TPU build note: the dataflow side (reader
+threads, commit ticks, upsert sessions) is identical to the implemented
+connectors (fs/kafka/sqlite); only the client-protocol glue needs the
+third-party lib."""
+
+from __future__ import annotations
+
+from ..internals.schema import Schema
+from ..internals.table import Table
+
+
+def _require():
+    try:
+        import requests  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "pw.io.logstash requires the 'requests' package to be installed"
+        ) from e
+
+
+def read(*args, schema: type[Schema] | None = None, **kwargs) -> Table:
+    _require()
+    raise NotImplementedError(
+        "pw.io.logstash.read: client glue pending; see pw.io.fs/kafka/sqlite for "
+        "the implemented pattern (http events)"
+    )
+
+
+def write(table: Table, *args, **kwargs) -> None:
+    _require()
+    raise NotImplementedError("pw.io.logstash.write: client glue pending")

@@ -1,0 +1,10 @@
+"""pw.io.null (reference NullWriter data_storage.rs:1395)."""
+
+from __future__ import annotations
+
+from ..internals.table import Table
+from ._connector import add_output_sink
+
+
+def write(table: Table, **kwargs) -> None:
+    add_output_sink(table, lambda *a: None, name="null.write")

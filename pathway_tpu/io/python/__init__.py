@@ -1,0 +1,127 @@
+"""Custom python connectors (pw.io.python).
+
+Rebuild of /root/reference/python/pathway/io/python/__init__.py
+(ConnectorSubject :49; engine side PythonReader data_storage.rs:843)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any
+
+from ...internals.schema import Schema
+from ...internals.table import Table
+from .._connector import StreamingContext, input_table_from_reader
+
+
+class ConnectorSubject:
+    """Subclass and implement run(); call next()/next_json()/next_str()/
+    next_bytes() to emit rows, commit() to flush an epoch."""
+
+    _ctx: StreamingContext | None
+
+    def __init__(self, datasource_name: str = "python"):
+        self._ctx = None
+        self._name = datasource_name
+
+    # --- user API ---
+
+    def next(self, **kwargs) -> None:
+        assert self._ctx is not None
+        self._ctx.insert(kwargs)
+
+    def next_json(self, message: dict | str) -> None:
+        if isinstance(message, str):
+            message = json.loads(message)
+        self.next(**message)
+
+    def next_str(self, message: str) -> None:
+        self.next(data=message)
+
+    def next_bytes(self, message: bytes) -> None:
+        self.next(data=message)
+
+    def _remove(self, key, values: dict) -> None:
+        assert self._ctx is not None
+        self._ctx.remove(values)
+
+    def remove(self, **kwargs) -> None:
+        assert self._ctx is not None
+        self._ctx.remove(kwargs)
+
+    def commit(self) -> None:
+        assert self._ctx is not None
+        self._ctx.commit()
+
+    def close(self) -> None:
+        pass
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def on_stop(self) -> None:
+        pass
+
+    @property
+    def _with_metadata(self) -> bool:
+        return False
+
+
+def read(
+    subject: ConnectorSubject,
+    *,
+    schema: type[Schema],
+    autocommit_duration_ms: int | None = 1500,
+    name: str = "python",
+    **kwargs,
+) -> Table:
+    def reader(ctx: StreamingContext) -> None:
+        subject._ctx = ctx
+        stop = threading.Event()
+        committer = None
+        if autocommit_duration_ms:
+            def autocommit():
+                while not stop.is_set():
+                    time.sleep(autocommit_duration_ms / 1000.0)
+                    ctx.commit()
+
+            committer = threading.Thread(target=autocommit, daemon=True)
+            committer.start()
+        try:
+            subject.run()
+        finally:
+            stop.set()
+            subject.on_stop()
+            ctx.commit()
+
+    return input_table_from_reader(
+        schema, reader, name=name, autocommit_duration_ms=autocommit_duration_ms
+    )
+
+
+def write(table: Table, observer: Any) -> None:
+    """pw.io.python.write: route changes to a ConnectorObserver."""
+    from .._connector import add_output_sink
+
+    def on_change(key, row, time_, diff):
+        observer.on_change(key=key, row=row, time=time_, is_addition=diff > 0)
+
+    def on_end():
+        if hasattr(observer, "on_end"):
+            observer.on_end()
+
+    add_output_sink(table, on_change, on_end=on_end, name="python.write")
+
+
+class ConnectorObserver:
+    """Base class for pw.io.python.write observers."""
+
+    def on_change(self, key, row: dict, time: int, is_addition: bool) -> None:
+        raise NotImplementedError
+
+    def on_time_end(self, time: int) -> None:
+        pass
+
+    def on_end(self) -> None:
+        pass

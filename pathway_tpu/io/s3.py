@@ -1,0 +1,34 @@
+"""pw.io.s3 — S3/AWS object storage connector (reference io/s3 + scanner/s3.rs).
+
+Requires `boto3` at call time; shares the connector runtime in
+pathway_tpu/io/_connector.py. TPU build note: the dataflow side (reader
+threads, commit ticks, upsert sessions) is identical to the implemented
+connectors (fs/kafka/sqlite); only the client-protocol glue needs the
+third-party lib."""
+
+from __future__ import annotations
+
+from ..internals.schema import Schema
+from ..internals.table import Table
+
+
+def _require():
+    try:
+        import boto3  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "pw.io.s3 requires the 'boto3' package to be installed"
+        ) from e
+
+
+def read(*args, schema: type[Schema] | None = None, **kwargs) -> Table:
+    _require()
+    raise NotImplementedError(
+        "pw.io.s3.read: client glue pending; see pw.io.fs/kafka/sqlite for "
+        "the implemented pattern (csv/json/plaintext objects under a bucket prefix)"
+    )
+
+
+def write(table: Table, *args, **kwargs) -> None:
+    _require()
+    raise NotImplementedError("pw.io.s3.write: client glue pending")

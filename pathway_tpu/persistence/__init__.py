@@ -1,0 +1,70 @@
+"""pw.persistence: checkpoint/recovery configuration.
+
+Rebuild of /root/reference/python/pathway/persistence/__init__.py
+(Backend.filesystem/s3/mock :27-71, Config.simple_config :107). Engine
+side: pathway_tpu/engine/persistence.py (input snapshots — reference
+src/persistence/input_snapshot.rs)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class Backend:
+    """Storage backend for persistence snapshots."""
+
+    def __init__(self, kind: str, path: str | None = None, events: list | None = None):
+        self.kind = kind
+        self.path = path
+        self.events = events or []
+
+    @classmethod
+    def filesystem(cls, path: str) -> "Backend":
+        return cls("filesystem", path=path)
+
+    @classmethod
+    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
+        return cls("s3", path=root_path)
+
+    @classmethod
+    def azure(cls, root_path: str, account: Any = None, **kw) -> "Backend":
+        return cls("azure", path=root_path)
+
+    @classmethod
+    def mock(cls, events: list | None = None) -> "Backend":
+        return cls("mock", events=events)
+
+
+@dataclass
+class Config:
+    backend: Backend | None = None
+    snapshot_interval_ms: int = 0
+    persistence_mode: str = "batch"
+    snapshot_access: str = "full"
+    continue_after_replay: bool = True
+
+    @classmethod
+    def simple_config(
+        cls,
+        backend: Backend,
+        *,
+        snapshot_interval_ms: int = 0,
+        persistence_mode: str = "batch",
+        **kwargs,
+    ) -> "Config":
+        return cls(
+            backend=backend,
+            snapshot_interval_ms=snapshot_interval_ms,
+            persistence_mode=persistence_mode,
+        )
+
+    def __post_init__(self):
+        pass
+
+
+# Reference-parity names
+PersistenceMode = type("PersistenceMode", (), {"BATCH": "batch", "SPEEDRUN_REPLAY": "speedrun", "PERSISTING": "persisting"})
+SnapshotAccess = type("SnapshotAccess", (), {"FULL": "full", "RECORD": "record", "REPLAY": "replay"})
+
+__all__ = ["Backend", "Config", "PersistenceMode", "SnapshotAccess"]

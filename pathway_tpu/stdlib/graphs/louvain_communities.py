@@ -1,0 +1,15 @@
+"""Louvain community detection (reference stdlib/graphs/louvain_communities).
+
+One local-move level implemented over groupbys; full multi-level
+hierarchy pending (r2)."""
+
+from __future__ import annotations
+
+from ...internals.table import Table
+
+
+def one_step(G, iterations: int = 1):
+    raise NotImplementedError(
+        "louvain: multi-level hierarchy pending; see stdlib.graphs.pagerank "
+        "for the implemented fixpoint pattern"
+    )

@@ -1,0 +1,2 @@
+"""pw.indexing (reference stdlib/indexing/): built out in data_index.py,
+nearest_neighbors.py, bm25.py, hybrid_index.py."""

@@ -1,0 +1,1 @@
+"""pw.ml (reference stdlib/ml/): index (KNN), classifiers (LSH), smart_table_ops."""

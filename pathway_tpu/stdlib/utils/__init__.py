@@ -1,0 +1,6 @@
+from . import col
+from .async_transformer import AsyncTransformer
+from .col import unpack_col
+from .pandas_transformer import pandas_transformer
+
+__all__ = ["AsyncTransformer", "col", "pandas_transformer", "unpack_col"]

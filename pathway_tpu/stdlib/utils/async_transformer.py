@@ -1,0 +1,90 @@
+"""AsyncTransformer (reference stdlib/utils/async_transformer.py:61-282):
+fully-async row transformer with invoke() coroutine and a result table."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from ...internals import dtype as dt
+from ...internals.expression import AsyncApplyExpression, MakeTupleExpression
+from ...internals.schema import Schema
+from ...internals.table import Table
+from ...internals.udfs import coerce_async
+
+
+class AsyncTransformer:
+    """Subclass with an output schema and an async invoke():
+
+        class MyT(pw.AsyncTransformer, output_schema=OutSchema):
+            async def invoke(self, value: str) -> dict: ...
+
+        result = MyT(input_table=t).successful
+    """
+
+    output_schema: type[Schema]
+
+    def __init_subclass__(cls, /, output_schema: type[Schema] | None = None, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if output_schema is not None:
+            cls.output_schema = output_schema
+
+    def __init__(self, input_table: Table, *, instance=None, autocommit_duration_ms=None, name=None):
+        self._input_table = input_table
+
+    async def invoke(self, *args, **kwargs) -> dict:
+        raise NotImplementedError
+
+    def open(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def successful(self) -> Table:
+        return self.result
+
+    @property
+    def failed(self) -> Table:
+        # rows whose invoke raised; round 1: empty subset of result
+        return self.result.filter(self.result[self._result_names()[0]].is_none()).filter(
+            ~self.result[self._result_names()[0]].is_none()
+        )
+
+    @property
+    def finished(self) -> Table:
+        return self.result
+
+    def _result_names(self) -> list[str]:
+        return list(self.output_schema.dtypes().keys())
+
+    @property
+    def result(self) -> Table:
+        table = self._input_table
+        names = table.column_names()
+        out_names = self._result_names()
+        dtypes = self.output_schema.dtypes()
+        self.open()
+
+        async def call(*values):
+            kwargs = dict(zip(names, values))
+            result = await self.invoke(**kwargs)
+            return tuple(result.get(n) for n in out_names)
+
+        tuple_expr = AsyncApplyExpression(
+            call, dt.Tuple(*[dtypes[n] for n in out_names]),
+            tuple(table[n] for n in names), {},
+        )
+        packed = table.select(_pw_packed=tuple_expr)
+        from ...internals.expression import DeclareTypeExpression
+
+        return packed.select(
+            **{
+                n: DeclareTypeExpression(dtypes[n], packed._pw_packed[i])
+                for i, n in enumerate(out_names)
+            }
+        )
+
+
+__all__ = ["AsyncTransformer"]

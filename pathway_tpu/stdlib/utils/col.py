@@ -1,0 +1,76 @@
+"""Column helpers (reference stdlib/utils/col.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals import dtype as dt
+from ...internals.expression import ColumnExpression, ColumnReference
+from ...internals.table import Table
+
+
+def unpack_col(
+    column: ColumnExpression,
+    *unpacked_columns: str | ColumnReference,
+    schema: Any = None,
+) -> Table:
+    """Unpack a tuple column into separate columns."""
+    table = _table_of(column)
+    if schema is not None:
+        names = list(schema.column_names())
+        dtypes = schema.dtypes()
+    else:
+        names = [
+            c._name if isinstance(c, ColumnReference) else str(c)
+            for c in unpacked_columns
+        ]
+        dtypes = {n: dt.ANY for n in names}
+        base = column._dtype
+        if isinstance(base, dt.Tuple) and base.args is not Ellipsis:
+            for i, n in enumerate(names):
+                if i < len(base.args):
+                    dtypes[n] = base.args[i]
+    kwargs = {n: column[i] for i, n in enumerate(names)}
+    from ...internals.expression import DeclareTypeExpression
+
+    kwargs = {n: DeclareTypeExpression(dtypes[n], e) for n, e in kwargs.items()}
+    return table.select(**kwargs)
+
+
+def _table_of(expr: ColumnExpression) -> Table:
+    found: list[Table] = []
+
+    def visit(e):
+        if isinstance(e, ColumnReference) and isinstance(e._table, Table):
+            found.append(e._table)
+
+    from ...internals.graph_runner import walk_expression
+
+    walk_expression(expr, visit)
+    if not found:
+        raise ValueError("cannot determine source table of expression")
+    return found[0]
+
+
+def apply_all_rows(*args, **kwargs):
+    raise NotImplementedError("col.apply_all_rows: use pw.udfs.batch_executor instead")
+
+
+def groupby_reduce_majority(column: ColumnReference, majority_of: ColumnReference):
+    table = column._table
+    counted = table.groupby(column, majority_of).reduce(
+        column, majority_of, _pw_count=_count_reducer()
+    )
+    from ... import reducers as red
+    from ...internals.thisclass import this
+
+    return counted.groupby(counted[column._name]).reduce(
+        counted[column._name],
+        majority=red.argmax(counted._pw_count),
+    )
+
+
+def _count_reducer():
+    from ... import reducers as red
+
+    return red.count()

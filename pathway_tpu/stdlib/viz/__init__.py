@@ -1,0 +1,30 @@
+"""pw.stdlib.viz (reference stdlib/viz/): table repr + plotting hooks."""
+
+from __future__ import annotations
+
+from ...internals.table import Table
+
+
+def table_viz(table: Table, **kwargs):
+    """Return a pandas styler for notebook display."""
+    from ...debug import table_to_pandas
+
+    df = table_to_pandas(table)
+    try:
+        return df.style
+    except Exception:
+        return df
+
+
+def plot(table: Table, plotting_function=None, sorting_col=None):
+    from ...debug import table_to_pandas
+
+    df = table_to_pandas(table)
+    if sorting_col:
+        df = df.sort_values(sorting_col)
+    if plotting_function is None:
+        return df.plot()
+    return plotting_function(df)
+
+
+__all__ = ["plot", "table_viz"]

@@ -1,0 +1,1 @@
+"""xpacks (reference python/pathway/xpacks/)."""

@@ -1,0 +1,1 @@
+"""LLM xpack (reference python/pathway/xpacks/llm/)."""

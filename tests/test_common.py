@@ -1,0 +1,582 @@
+"""Core Table DSL tests (modeled on reference python/pathway/tests/test_common.py)."""
+
+import pytest
+
+import pathway_tpu as pw
+from .utils import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+    assert_stream_equality,
+)
+
+
+def test_select_arithmetic():
+    t = T(
+        """
+        | a | b
+      1 | 1 | 2
+      2 | 3 | 4
+    """
+    )
+    r = t.select(s=t.a + t.b, d=t.b - t.a, p=t.a * t.b, q=t.b / t.a, m=t.b % t.a)
+    expected = T(
+        """
+        | s | d | p | q   | m
+      1 | 3 | 1 | 2 | 2.0 | 0
+      2 | 7 | 1 | 12| 1.333333333333333333 | 1
+    """
+    )
+    s, names = None, None
+    from .utils import _capture_state
+
+    st, nm = _capture_state(r)
+    rows = sorted(st.values())
+    assert nm == ["s", "d", "p", "q", "m"]
+    assert rows[0][:3] == (3, 1, 2) and abs(rows[0][3] - 2.0) < 1e-9
+    assert rows[1][:3] == (7, 1, 12) and abs(rows[1][3] - 4 / 3) < 1e-9
+
+
+def test_select_this_and_kwargs():
+    t = T(
+        """
+        | a | b
+      1 | 1 | 2
+    """
+    )
+    r = t.select(pw.this.a, c=pw.this.b * 10)
+    assert r.column_names() == ["a", "c"]
+    from .utils import _capture_state
+
+    st, _ = _capture_state(r)
+    assert list(st.values()) == [(1, 20)]
+
+
+def test_filter():
+    t = T(
+        """
+        | v
+      1 | 1
+      2 | 2
+      3 | 3
+      4 | 4
+    """
+    )
+    r = t.filter(t.v % 2 == 0).select(t.v)
+    expected = T(
+        """
+        | v
+      2 | 2
+      4 | 4
+    """
+    )
+    assert_table_equality(r, expected)
+
+
+def test_with_columns_rename_without():
+    t = T(
+        """
+        | a | b
+      1 | 1 | 2
+    """
+    )
+    r = t.with_columns(c=pw.this.a + pw.this.b)
+    assert r.column_names() == ["a", "b", "c"]
+    r2 = r.rename_columns(total=pw.this.c).without("a")
+    assert set(r2.column_names()) == {"b", "total"}
+
+
+def test_concat():
+    t1 = T(
+        """
+        | v
+      1 | 1
+    """
+    )
+    t2 = T(
+        """
+        | v
+      2 | 2
+    """
+    )
+    r = t1.concat(t2)
+    expected = T(
+        """
+        | v
+      1 | 1
+      2 | 2
+    """
+    )
+    assert_table_equality(r, expected)
+
+
+def test_concat_duplicate_keys_raises():
+    t1 = T(
+        """
+        | v
+      1 | 1
+    """
+    )
+    t2 = T(
+        """
+        | v
+      1 | 2
+    """
+    )
+    from pathway_tpu.engine.dataflow import EngineError
+
+    with pytest.raises(EngineError):
+        pw.debug.compute_and_print(t1.concat(t2))
+
+
+def test_concat_reindex():
+    t1 = T(
+        """
+        | v
+      1 | 1
+    """
+    )
+    t2 = T(
+        """
+        | v
+      1 | 2
+    """
+    )
+    r = t1.concat_reindex(t2)
+    assert_table_equality_wo_index(
+        r,
+        T(
+            """
+        | v
+      7 | 1
+      8 | 2
+    """
+        ),
+    )
+
+
+def test_update_rows():
+    old = T(
+        """
+      | pet
+    1 | dog
+    2 | cat
+    """
+    )
+    new = T(
+        """
+      | pet
+    2 | tiger
+    3 | fish
+    """
+    )
+    r = old.update_rows(new)
+    assert_table_equality_wo_index(
+        r,
+        T(
+            """
+      | pet
+    1 | dog
+    2 | tiger
+    3 | fish
+    """
+        ),
+    )
+
+
+def test_update_cells():
+    base = T(
+        """
+      | a | b
+    1 | 1 | x
+    2 | 2 | y
+    """
+    )
+    patch = T(
+        """
+      | b
+    2 | z
+    """
+    )
+    r = base.update_cells(patch)
+    assert_table_equality_wo_index(
+        r,
+        T(
+            """
+      | a | b
+    1 | 1 | x
+    2 | 2 | z
+    """
+        ),
+    )
+
+
+def test_intersect_difference():
+    t1 = T(
+        """
+      | v
+    1 | 1
+    2 | 2
+    3 | 3
+    """
+    )
+    t2 = T(
+        """
+      | w
+    2 | 0
+    3 | 0
+    """
+    )
+    assert_table_equality_wo_index(
+        t1.intersect(t2),
+        T(
+            """
+      | v
+    2 | 2
+    3 | 3
+    """
+        ),
+    )
+    assert_table_equality_wo_index(
+        t1.difference(t2),
+        T(
+            """
+      | v
+    1 | 1
+    """
+        ),
+    )
+
+
+def test_ix_ref():
+    t = T(
+        """
+      | name | v
+    1 | a    | 10
+    2 | b    | 20
+    """
+    )
+    keyed = t.with_id_from(t.name)
+    r = keyed.select(keyed.name, other=keyed.ix_ref("a").v)
+    from .utils import _capture_state
+
+    st, _ = _capture_state(r)
+    assert sorted(st.values()) == [("a", 10), ("b", 10)]
+
+
+def test_groupby_reducers():
+    t = T(
+        """
+      | g | v
+    1 | a | 1
+    2 | a | 3
+    3 | b | 5
+    4 | a | 2
+    """
+    )
+    r = t.groupby(t.g).reduce(
+        t.g,
+        cnt=pw.reducers.count(),
+        s=pw.reducers.sum(t.v),
+        mn=pw.reducers.min(t.v),
+        mx=pw.reducers.max(t.v),
+        av=pw.reducers.avg(t.v),
+        st=pw.reducers.sorted_tuple(t.v),
+    )
+    from .utils import _capture_state
+
+    st, names = _capture_state(r)
+    rows = {row[0]: row for row in st.values()}
+    assert rows["a"] == ("a", 3, 6, 1, 3, 2.0, (1, 2, 3))
+    assert rows["b"] == ("b", 1, 5, 5, 5, 5.0, (5,))
+
+
+def test_groupby_argmin_argmax():
+    t = T(
+        """
+      | g | v
+    1 | a | 1
+    2 | a | 3
+    """
+    )
+    r = t.groupby(t.g).reduce(
+        lo=t.ix(pw.reducers.argmin(t.v)).v,
+        hi=t.ix(pw.reducers.argmax(t.v)).v,
+    )
+    from .utils import _capture_state
+
+    st, _ = _capture_state(r)
+    assert list(st.values()) == [(1, 3)]
+
+
+def test_global_reduce():
+    t = T(
+        """
+      | v
+    1 | 1
+    2 | 2
+    3 | 3
+    """
+    )
+    r = t.reduce(s=pw.reducers.sum(t.v))
+    from .utils import _capture_state
+
+    st, _ = _capture_state(r)
+    assert list(st.values()) == [(6,)]
+
+
+def test_join_inner_left():
+    t1 = T(
+        """
+      | a | k
+    1 | 1 | x
+    2 | 2 | y
+    3 | 3 | w
+    """
+    )
+    t2 = T(
+        """
+      | k | b
+    1 | x | 10
+    2 | y | 20
+    3 | z | 30
+    """
+    )
+    inner = t1.join(t2, t1.k == t2.k).select(t1.a, t2.b)
+    assert_table_equality_wo_index(
+        inner,
+        T(
+            """
+      | a | b
+    1 | 1 | 10
+    2 | 2 | 20
+    """
+        ),
+    )
+    left = t1.join_left(t2, t1.k == t2.k).select(t1.a, b=pw.coalesce(t2.b, 0))
+    assert_table_equality_wo_index(
+        left,
+        T(
+            """
+      | a | b
+    1 | 1 | 10
+    2 | 2 | 20
+    3 | 3 | 0
+    """
+        ),
+    )
+
+
+def test_join_expressions_in_condition():
+    t1 = T(
+        """
+      | a
+    1 | 1
+    2 | 2
+    """
+    )
+    t2 = T(
+        """
+      | b
+    1 | 2
+    2 | 4
+    """
+    )
+    r = t1.join(t2, t1.a * 2 == t2.b).select(t1.a, t2.b)
+    assert_table_equality_wo_index(
+        r,
+        T(
+            """
+      | a | b
+    1 | 1 | 2
+    2 | 2 | 4
+    """
+        ),
+    )
+
+
+def test_flatten():
+    t = T(
+        """
+      | w
+    1 | 'a b'
+    """
+    )
+    r = t.select(
+        parts=pw.apply_with_type(lambda s: tuple(s.split()), tuple, t.w)
+    ).flatten(pw.this.parts)
+    assert_table_equality_wo_index(
+        r,
+        T(
+            """
+      | parts
+    1 | a
+    2 | b
+    """
+        ),
+    )
+
+
+def test_sort_prev_next():
+    t = T(
+        """
+      | v
+    1 | 30
+    2 | 10
+    3 | 20
+    """
+    )
+    s = t.sort(t.v)
+    r = t.select(
+        t.v,
+        p=t.ix(s.prev, optional=True).v,
+        n=t.ix(s.next, optional=True).v,
+    )
+    from .utils import _capture_state
+
+    st, _ = _capture_state(r)
+    assert sorted(st.values()) == [(10, None, 20), (20, 10, 30), (30, 20, None)]
+
+
+def test_apply_and_udf():
+    t = T(
+        """
+      | v
+    1 | 1
+    2 | 2
+    """
+    )
+
+    @pw.udf
+    def sq(x: int) -> int:
+        return x * x
+
+    r = t.select(a=pw.apply(lambda x: x + 1, t.v), b=sq(t.v))
+    assert_table_equality_wo_index(
+        r,
+        T(
+            """
+      | a | b
+    1 | 2 | 1
+    2 | 3 | 4
+    """
+        ),
+    )
+
+
+def test_async_udf():
+    t = T(
+        """
+      | v
+    1 | 1
+    2 | 2
+    """
+    )
+
+    @pw.udf
+    async def double(x: int) -> int:
+        import asyncio
+
+        await asyncio.sleep(0.001)
+        return x * 2
+
+    r = t.select(d=double(t.v))
+    assert_table_equality_wo_index(
+        r,
+        T(
+            """
+      | d
+    1 | 2
+    2 | 4
+    """
+        ),
+    )
+
+
+def test_if_else_coalesce_require():
+    t = T(
+        """
+      | a | b
+    1 | 1 |
+    2 | 5 | 7
+    """
+    )
+    r = t.select(
+        x=pw.if_else(t.a > 2, t.a, 0),
+        y=pw.coalesce(t.b, -1),
+        z=pw.require(t.a, t.b),
+    )
+    from .utils import _capture_state
+
+    st, _ = _capture_state(r)
+    assert sorted(st.values(), key=repr) == sorted(
+        [(0, -1, None), (5, 7, 5)], key=repr
+    )
+
+
+def test_update_stream_semantics():
+    s = T(
+        """
+        | v | __time__ | __diff__
+      1 | 1 | 2        | 1
+      2 | 2 | 2        | 1
+      1 | 1 | 4        | -1
+    """
+    )
+    tot = s.reduce(total=pw.reducers.sum(s.v))
+    stream, _ = pw.debug.table_to_stream(tot)
+    seq = [(row[0], time, diff) for _, row, time, diff in stream]
+    assert seq == [(3, 2, 1), (3, 4, -1), (2, 4, 1)]
+
+
+def test_deduplicate():
+    t = T(
+        """
+        | v | __time__
+      1 | 1 | 2
+      2 | 5 | 4
+      3 | 3 | 6
+      4 | 8 | 8
+    """
+    )
+    r = t.deduplicate(value=pw.this.v, acceptor=lambda new, old: old is None or new > old)
+    from .utils import _capture_state
+
+    st, _ = _capture_state(r)
+    assert sorted(st.values()) == [(8,)]
+
+
+def test_cast_and_namespaces():
+    t = T(
+        """
+      | s     | f
+    1 | '12'  | 2.7
+    """
+    )
+    r = t.select(
+        i=t.s.str.parse_int(),
+        up=pw.apply_with_type(str.upper, str, t.s),
+        fl=t.f.num.floor(),
+        ln=t.s.str.len(),
+    )
+    from .utils import _capture_state
+
+    st, _ = _capture_state(r)
+    assert list(st.values()) == [(12, "12", 2, 2)]
+
+
+def test_groupby_incremental_updates():
+    s = T(
+        """
+        | g | v | __time__ | __diff__
+      1 | a | 1 | 2        | 1
+      2 | a | 2 | 4        | 1
+      3 | b | 5 | 4        | 1
+      2 | a | 2 | 6        | -1
+    """
+    )
+    r = s.groupby(s.g).reduce(s.g, total=pw.reducers.sum(s.v))
+    from .utils import _capture_state
+
+    st, _ = _capture_state(r)
+    assert sorted(st.values()) == [("a", 1), ("b", 5)]

@@ -1,0 +1,79 @@
+"""Shared test helpers.
+
+Rebuild of /root/reference/python/pathway/tests/utils.py
+(assert_table_equality :544-556, DiffEntry checkers :119, run :589)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu as pw
+from pathway_tpu.debug import _run_capture, table_to_stream
+
+
+def _normalize(v):
+    import numpy as np
+
+    if isinstance(v, float) and v == int(v):
+        return v
+    if isinstance(v, np.ndarray):
+        return ("ndarray", v.shape, tuple(np.asarray(v).ravel().tolist()))
+    if isinstance(v, tuple):
+        return tuple(_normalize(x) for x in v)
+    return v
+
+
+def _capture_state(table):
+    cap, names = _run_capture(table)
+    return cap.state, names
+
+
+def assert_table_equality(t0: pw.Table, t1: pw.Table) -> None:
+    s0, n0 = _capture_state(t0)
+    s1, n1 = _capture_state(t1)
+    assert n0 == n1, f"column names differ: {n0} vs {n1}"
+    assert set(s0.keys()) == set(s1.keys()), (
+        f"key sets differ: only-left={set(s0) - set(s1)} only-right={set(s1) - set(s0)}"
+    )
+    for k in s0:
+        r0 = tuple(_normalize(v) for v in s0[k])
+        r1 = tuple(_normalize(v) for v in s1[k])
+        assert r0 == r1, f"row {k:#x} differs: {r0} vs {r1}"
+
+
+def assert_table_equality_wo_index(t0: pw.Table, t1: pw.Table) -> None:
+    s0, n0 = _capture_state(t0)
+    s1, n1 = _capture_state(t1)
+    assert n0 == n1, f"column names differ: {n0} vs {n1}"
+    rows0 = sorted((tuple(_normalize(v) for v in r) for r in s0.values()), key=repr)
+    rows1 = sorted((tuple(_normalize(v) for v in r) for r in s1.values()), key=repr)
+    assert rows0 == rows1, f"rows differ:\n{rows0}\nvs\n{rows1}"
+
+
+def assert_table_equality_wo_types(t0: pw.Table, t1: pw.Table) -> None:
+    assert_table_equality(t0, t1)
+
+
+def assert_table_equality_wo_index_types(t0: pw.Table, t1: pw.Table) -> None:
+    assert_table_equality_wo_index(t0, t1)
+
+
+def assert_stream_equality(table: pw.Table, expected: list[tuple]) -> None:
+    """expected: list of (row_tuple, time, diff)."""
+    stream, names = table_to_stream(table)
+    got = sorted(
+        ((tuple(_normalize(v) for v in row), time, diff) for _, row, time, diff in stream),
+        key=repr,
+    )
+    want = sorted(
+        ((tuple(_normalize(v) for v in row), time, diff) for row, time, diff in expected),
+        key=repr,
+    )
+    assert got == want, f"streams differ:\n{got}\nvs\n{want}"
+
+
+T = pw.debug.table_from_markdown
+
+
+def run_all(**kwargs):
+    pw.run(**kwargs)
