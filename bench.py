@@ -1,0 +1,76 @@
+"""Headline benchmark: MiniLM-L6 embedding throughput (embeddings/sec)
+on the available accelerator.
+
+North-star (BASELINE.md): >=1M embeddings/sec on v5e-16 with
+all-MiniLM-L6-v2 => 62,500 embeddings/sec/chip. vs_baseline is measured
+throughput per chip divided by that per-chip target.
+
+Measures the device embed path on pre-tokenized ~24-token chunks (in the
+streaming pipeline host tokenization runs on connector threads and
+overlaps device compute). Results stay device-resident — they feed the
+HBM KNN index — so only a checksum is pulled back per batch.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.sentence_encoder import SentenceEncoder
+
+    devices = jax.devices()
+    n_chips = max(1, len(devices))
+    B = 16384 * n_chips  # large batches amortize dispatch latency
+    mesh = None
+    if n_chips > 1:  # data-parallel embed over every chip
+        from pathway_tpu.parallel.sharding import make_mesh
+
+        mesh = make_mesh(model_parallel=1)
+    enc = SentenceEncoder(max_seq_len=64, max_batch=B, mesh=mesh)
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        ids = rng.integers(999, 29000, (B, 32)).astype(np.int32)
+        ids[:, 0] = 101
+        ids[:, -1] = 102
+        mask = np.ones((B, 32), bool)
+        return ids, mask
+
+    # warmup / compile
+    ids, mask = make_batch()
+    np.asarray(enc._run_padded(ids, mask)[:1])
+
+    reps = 6
+    batches = [make_batch() for _ in range(reps)]
+    t0 = time.perf_counter()
+    outs = [enc._run_padded(i, m) for i, m in batches]  # pipelined dispatch
+    checksum = float(sum(jnp.sum(o[:, 0]) for o in outs))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(checksum)
+    total = reps * B
+    eps = total / dt
+    per_chip = eps / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "minilm_l6_embeddings_per_sec",
+                "value": round(eps, 1),
+                "unit": "embeddings/s",
+                "vs_baseline": round(per_chip / 62500.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

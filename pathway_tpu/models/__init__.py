@@ -1,0 +1,36 @@
+"""JAX/flax model zoo — the TPU replacements for the reference's torch
+hot paths (SentenceTransformer embedders.py:270, CrossEncoder
+rerankers.py:186) plus CLIP for multimodal RAG and a contrastive
+fine-tuning trainer.
+"""
+
+from .encoder import CrossEncoderHead, EncoderConfig, TextEncoder, init_params
+from .sentence_encoder import CrossEncoderScorer, SentenceEncoder
+from .tokenizer import WordPieceTokenizer, default_tokenizer
+from .batching import pad_token_batch, bucket, chunks
+
+__all__ = [
+    "EncoderConfig",
+    "TextEncoder",
+    "CrossEncoderHead",
+    "init_params",
+    "SentenceEncoder",
+    "CrossEncoderScorer",
+    "WordPieceTokenizer",
+    "default_tokenizer",
+    "pad_token_batch",
+    "bucket",
+    "chunks",
+]
+
+
+def __getattr__(name):  # lazy: CLIP/training pull in optax etc.
+    if name in ("CLIPEncoder", "CLIPConfig"):
+        from . import clip
+
+        return getattr(clip, name)
+    if name in ("ContrastiveTrainer", "info_nce_loss"):
+        from . import training
+
+        return getattr(training, name)
+    raise AttributeError(name)

@@ -1,0 +1,67 @@
+"""Bucketed batching for jit-compiled models.
+
+Stream delta batches have ragged sizes/lengths; XLA wants static shapes.
+Strategy (SURVEY.md §7 hard part 3): round batch and sequence dims up to
+a small set of power-of-two buckets so the jit cache stays tiny, pad
+with masked rows, and slice the padding off on the host. The same
+discipline the reference gets implicitly from torch dynamic shapes —
+but here every unique bucket compiles once and then runs from cache.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_SEQ_BUCKETS = (16, 32, 64, 128, 256, 512)
+DEFAULT_BATCH_BUCKETS = (1, 8, 32, 128, 256, 512, 1024)
+
+
+def bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def pad_token_batch(
+    token_lists: list[list[int]],
+    pad_id: int = 0,
+    seq_buckets=DEFAULT_SEQ_BUCKETS,
+    batch_buckets=DEFAULT_BATCH_BUCKETS,
+    max_batch: int | None = None,
+    token_type_lists: list[list[int]] | None = None,
+):
+    """-> (ids[B,S] int32, mask[B,S] bool, token_types[B,S] or None, n_real).
+
+    B and S are bucketed; rows past ``n_real`` are padding. If the input
+    exceeds ``max_batch`` (or the largest batch bucket) the caller should
+    chunk first — see :func:`chunks`.
+    """
+    n = len(token_lists)
+    max_len = max((len(t) for t in token_lists), default=1)
+    S = bucket(max_len, seq_buckets)
+    if max_batch is not None:
+        bb = tuple(b for b in batch_buckets if b < max_batch) + (max_batch,)
+    else:
+        bb = batch_buckets
+    B = max(bucket(n, bb), n)
+    ids = np.full((B, S), pad_id, dtype=np.int32)
+    mask = np.zeros((B, S), dtype=bool)
+    tts = None
+    if token_type_lists is not None:
+        tts = np.zeros((B, S), dtype=np.int32)
+    for i, toks in enumerate(token_lists):
+        L = min(len(toks), S)
+        ids[i, :L] = toks[:L]
+        mask[i, :L] = True
+        if tts is not None:
+            tt = token_type_lists[i]
+            tts[i, :L] = tt[:L]
+    # padding rows are all-masked; the mean-pool divide is guarded by
+    # jnp.maximum(count, 1) in the encoder
+    return ids, mask, tts, n
+
+
+def chunks(seq, size: int):
+    for i in range(0, len(seq), size):
+        yield seq[i : i + size]

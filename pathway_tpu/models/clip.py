@@ -1,0 +1,179 @@
+"""CLIP ViT-B/32 image+text dual encoder (flax, bf16) for multimodal RAG.
+
+Reference uses OpenAI/clip via LiteLLM APIs; BASELINE.md config 4 calls
+for CLIP-ViT-B/32 on TPU. Vision tower = ViT-B/32 (patchify via one
+conv-as-matmul, 12 layers, width 768); text tower = causal transformer
+(width 512, 12 heads, vocab 49408, context 77); joint 512-d embedding
+space, L2-normalized.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .batching import bucket, chunks
+from .tokenizer import WordPieceTokenizer
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    image_size: int = 224
+    patch_size: int = 32
+    vision_width: int = 768
+    vision_layers: int = 12
+    vision_heads: int = 12
+    text_width: int = 512
+    text_layers: int = 12
+    text_heads: int = 8
+    vocab_size: int = 49408
+    context_length: int = 77
+    embed_dim: int = 512
+    dtype: Any = jnp.bfloat16
+
+
+class _Block(nn.Module):
+    width: int
+    heads: int
+    dtype: Any
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        d, h = self.width, self.heads
+        hd = d // h
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_1")(x)
+        qkv = nn.Dense(3 * d, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads_(t):
+            return t.reshape(t.shape[0], t.shape[1], h, hd)
+
+        q, k, v = heads_(q), heads_(k), heads_(v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+        if self.causal:
+            n = x.shape[1]
+            cmask = jnp.tril(jnp.ones((n, n), bool))
+            scores = jnp.where(cmask[None, None], scores, jnp.finfo(scores.dtype).min)
+        if mask is not None:
+            scores = jnp.where(mask[:, None, None, :], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(self.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(x.shape[0], x.shape[1], d)
+        x = x + nn.Dense(d, dtype=self.dtype, name="attn_out")(ctx)
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_2")(x)
+        y = nn.Dense(4 * d, dtype=self.dtype, name="mlp_in")(y)
+        y = y * jax.nn.sigmoid(1.702 * y)  # quick-gelu (CLIP)
+        x = x + nn.Dense(d, dtype=self.dtype, name="mlp_out")(y)
+        return x
+
+
+class VisionTower(nn.Module):
+    cfg: CLIPConfig
+
+    @nn.compact
+    def __call__(self, images):  # [B, H, W, 3] float32 in [0,1]
+        cfg = self.cfg
+        p = cfg.patch_size
+        B, H, W, _ = images.shape
+        n = (H // p) * (W // p)
+        # patchify -> one big [B, n, p*p*3] @ [p*p*3, width] matmul (MXU)
+        x = images.reshape(B, H // p, p, W // p, p, 3)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, n, p * p * 3).astype(cfg.dtype)
+        x = nn.Dense(cfg.vision_width, use_bias=False, dtype=cfg.dtype, name="patch_proj")(x)
+        cls = self.param(
+            "cls", nn.initializers.normal(0.02), (1, 1, cfg.vision_width), cfg.dtype
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, cfg.vision_width)), x], axis=1)
+        pos = self.param(
+            "pos", nn.initializers.normal(0.01), (1, n + 1, cfg.vision_width), cfg.dtype
+        )
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_pre")(x + pos)
+        for i in range(cfg.vision_layers):
+            x = _Block(cfg.vision_width, cfg.vision_heads, cfg.dtype, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_post")(x[:, 0])
+        return nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype, name="proj")(x)
+
+
+class CLIPTextTower(nn.Module):
+    cfg: CLIPConfig
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.text_width, dtype=cfg.dtype, name="tok")(ids)
+        pos = self.param(
+            "pos", nn.initializers.normal(0.01), (1, ids.shape[1], cfg.text_width), cfg.dtype
+        )
+        x = x + pos
+        for i in range(cfg.text_layers):
+            x = _Block(cfg.text_width, cfg.text_heads, cfg.dtype, causal=True, name=f"block_{i}")(x, mask)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_final")(x)
+        # pool at the last real token (CLIP takes the EOT position)
+        last = jnp.maximum(mask.sum(axis=1) - 1, 0)
+        x = x[jnp.arange(x.shape[0]), last]
+        return nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype, name="proj")(x)
+
+
+def _normalize(x):
+    x = x.astype(jnp.float32)
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+
+
+class CLIPEncoder:
+    """Host-facing wrapper: encode_text(list[str]) / encode_image(ndarray)."""
+
+    def __init__(self, config: CLIPConfig | None = None, seed: int = 0, max_batch: int = 64):
+        self.cfg = config or CLIPConfig()
+        self.max_batch = max_batch
+        self.vision = VisionTower(self.cfg)
+        self.text = CLIPTextTower(self.cfg)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        img = jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3), jnp.float32)
+        ids = jnp.zeros((1, self.cfg.context_length), jnp.int32)
+        msk = jnp.ones((1, self.cfg.context_length), bool)
+        self.vparams = self.vision.init(k1, img)
+        self.tparams = self.text.init(k2, ids, msk)
+        self.tokenizer = WordPieceTokenizer(vocab_size=self.cfg.vocab_size)
+        self._vfwd = jax.jit(lambda p, im: _normalize(self.vision.apply(p, im)))
+        self._tfwd = jax.jit(lambda p, i, m: _normalize(self.text.apply(p, i, m)))
+
+    @property
+    def dim(self):
+        return self.cfg.embed_dim
+
+    def encode_image(self, images: np.ndarray) -> np.ndarray:
+        """images: [n, H, W, 3] float in [0,1] (host resizes/crops)."""
+        outs = []
+        for lo in range(0, len(images), self.max_batch):
+            batch = np.asarray(images[lo : lo + self.max_batch], np.float32)
+            B = bucket(len(batch), (1, 8, 16, 32, 64))
+            if B > len(batch):
+                batch = np.concatenate(
+                    [batch, np.zeros((B - len(batch),) + batch.shape[1:], np.float32)]
+                )
+            outs.append(np.asarray(self._vfwd(self.vparams, batch))[: min(self.max_batch, len(images) - lo)])
+        return np.concatenate(outs) if outs else np.zeros((0, self.dim), np.float32)
+
+    def encode_text(self, texts: Sequence[str]) -> np.ndarray:
+        L = self.cfg.context_length
+        out = np.empty((len(texts), self.dim), np.float32)
+        for group in chunks(list(range(len(texts))), self.max_batch):
+            ids = np.zeros((len(group), L), np.int32)
+            mask = np.zeros((len(group), L), bool)
+            for j, i in enumerate(group):
+                toks = self.tokenizer.encode(texts[i] or "", L)
+                ids[j, : len(toks)] = toks
+                mask[j, : len(toks)] = True
+            B = bucket(len(group), (1, 8, 16, 32, 64, 128))
+            if B > len(group):
+                ids = np.concatenate([ids, np.zeros((B - len(group), L), np.int32)])
+                mask = np.concatenate([mask, np.zeros((B - len(group), L), bool)])
+                mask[len(group):, 0] = True
+            out[np.asarray(group)] = np.asarray(self._tfwd(self.tparams, ids, mask))[: len(group)]
+        return out

@@ -1,0 +1,176 @@
+"""SentenceEncoder / CrossEncoder — the TPU replacements for the
+reference's torch hot paths.
+
+Reference: sentence-transformers ``model.encode`` per row inside a sync
+UDF (/root/reference/python/pathway/xpacks/llm/embedders.py:270-329) and
+``CrossEncoder.predict`` (rerankers.py:186). Here: tokenize on host,
+pad to bucketed static shapes, run one jit-compiled bf16 forward per
+bucket (cached), optionally pjit over a device mesh for data-parallel
+embedding.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .batching import chunks, pad_token_batch
+from .encoder import (
+    CrossEncoderHead,
+    EncoderConfig,
+    TextEncoder,
+    init_params,
+    load_hf_weights,
+)
+from .tokenizer import default_tokenizer
+
+
+class SentenceEncoder:
+    """Batched text -> L2-normalized embeddings [n, hidden]."""
+
+    def __init__(
+        self,
+        model: str = "all-MiniLM-L6-v2",
+        *,
+        config: EncoderConfig | None = None,
+        checkpoint_dir: str | None = None,
+        max_seq_len: int = 256,
+        max_batch: int = 1024,
+        seed: int = 0,
+        mesh=None,
+        data_axis: str = "data",
+    ):
+        if config is None:
+            if "L12" in model or "l12" in model:
+                config = EncoderConfig.minilm_l12()
+            else:
+                config = EncoderConfig.minilm_l6()
+        self.cfg = config
+        self.model_name = model
+        self.max_seq_len = max_seq_len
+        self.max_batch = max_batch
+        self.module = TextEncoder(config)
+        self.params = init_params(self.module, config, seed=seed)
+        checkpoint_dir = checkpoint_dir or os.environ.get("PATHWAY_TPU_CKPT")
+        if checkpoint_dir and os.path.isdir(checkpoint_dir):
+            try:
+                self.params = load_hf_weights(self.params, checkpoint_dir)
+            except (FileNotFoundError, KeyError):
+                pass
+        self.tokenizer = default_tokenizer(checkpoint_dir)
+        self.mesh = mesh
+        self.data_axis = data_axis
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.params = jax.device_put(
+                self.params, NamedSharding(mesh, P())
+            )
+            self._data_sharding = NamedSharding(mesh, P(data_axis))
+        else:
+            self._data_sharding = None
+        self._fwd = jax.jit(self.module.apply)
+
+    @property
+    def dim(self) -> int:
+        return self.cfg.hidden_size
+
+    def _run_padded(self, ids, mask):
+        if self._data_sharding is not None:
+            ndata = self.mesh.shape[self.data_axis]
+            pad = (-ids.shape[0]) % ndata
+            if pad:
+                ids = np.concatenate([ids, np.zeros((pad, ids.shape[1]), ids.dtype)])
+                mask = np.concatenate([mask, np.zeros((pad, mask.shape[1]), bool)])
+            ids = jax.device_put(ids, self._data_sharding)
+            mask = jax.device_put(mask, self._data_sharding)
+        return self._fwd(self.params, ids, mask)
+
+    def encode_tokens(self, toks: Sequence[list[int]], as_numpy: bool = True):
+        """Embed pre-tokenized sequences. Dispatch is async: all buckets
+        are enqueued before the first result is pulled, so host padding
+        overlaps device compute."""
+        if not len(toks):
+            return np.zeros((0, self.dim), np.float32)
+        # order by length so buckets stay dense, then restore order
+        order = sorted(range(len(toks)), key=lambda i: len(toks[i]))
+        batch = self.max_batch
+        if self.mesh is not None:
+            ndata = self.mesh.shape[self.data_axis]
+            batch = max(batch - batch % ndata, ndata)
+        pending = []
+        for group in chunks(order, batch):
+            ids, mask, _, n = pad_token_batch(
+                [toks[i] for i in group], pad_id=self.tokenizer.pad_id,
+                max_batch=batch,
+            )
+            pending.append((group, n, self._run_padded(ids, mask)))
+        out = np.empty((len(toks), self.dim), np.float32)
+        for group, n, emb in pending:
+            out[np.asarray(group)] = np.asarray(emb)[:n]
+        return out
+
+    def encode(self, texts: Sequence[str]) -> np.ndarray:
+        if not len(texts):
+            return np.zeros((0, self.dim), np.float32)
+        toks = [self.tokenizer.encode(t or "", self.max_seq_len) for t in texts]
+        return self.encode_tokens(toks)
+
+    def __call__(self, texts: Sequence[str]) -> np.ndarray:
+        return self.encode(texts)
+
+
+class CrossEncoderScorer:
+    """Batched (query, doc) pairs -> relevance scores [n]."""
+
+    def __init__(
+        self,
+        model: str = "ms-marco-MiniLM-L-6-v2",
+        *,
+        config: EncoderConfig | None = None,
+        checkpoint_dir: str | None = None,
+        max_seq_len: int = 256,
+        max_batch: int = 256,
+        seed: int = 0,
+    ):
+        self.cfg = config or EncoderConfig.cross_encoder_l6()
+        self.model_name = model
+        self.max_seq_len = max_seq_len
+        self.max_batch = max_batch
+        self.module = CrossEncoderHead(self.cfg)
+        self.params = init_params(self.module, self.cfg, seed=seed)
+        checkpoint_dir = checkpoint_dir or os.environ.get("PATHWAY_TPU_XENC_CKPT")
+        if checkpoint_dir and os.path.isdir(checkpoint_dir):
+            try:
+                self.params = load_hf_weights(self.params, checkpoint_dir)
+            except (FileNotFoundError, KeyError):
+                pass
+        self.tokenizer = default_tokenizer(checkpoint_dir)
+        self._fwd = jax.jit(self.module.apply)
+
+    def score(self, pairs: Sequence[tuple[str, str]]) -> np.ndarray:
+        if not len(pairs):
+            return np.zeros((0,), np.float32)
+        enc = [self.tokenizer.encode_pair(a or "", b or "", self.max_seq_len) for a, b in pairs]
+        toks = [e[0] for e in enc]
+        tts = [e[1] for e in enc]
+        order = sorted(range(len(toks)), key=lambda i: len(toks[i]))
+        out = np.empty((len(toks),), np.float32)
+        for group in chunks(order, self.max_batch):
+            ids, mask, tt, n = pad_token_batch(
+                [toks[i] for i in group],
+                pad_id=self.tokenizer.pad_id,
+                max_batch=self.max_batch,
+                token_type_lists=[tts[i] for i in group],
+            )
+            scores = np.asarray(self._fwd(self.params, ids, mask, tt))[:n]
+            out[np.asarray(group)] = scores
+        return out
+
+    def __call__(self, pairs):
+        return self.score(pairs)

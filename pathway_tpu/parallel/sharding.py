@@ -1,0 +1,85 @@
+"""Mesh + sharding helpers (the framework's scaling substrate).
+
+Replaces the reference's worker topology (threads × processes over TCP,
+/root/reference/src/engine/dataflow/config.rs:36-120) with a
+``jax.sharding.Mesh``: the "data" axis plays the role of key-sharded
+workers (R7 shard.rs — hash(key) → shard), the "model" axis shards
+embedder/reranker weights tensor-parallel. Collectives ride ICI; multi-
+host extends the same mesh over DCN via ``jax.distributed.initialize``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+# flax logical axis -> mesh axis (see models/encoder.py annotations)
+LOGICAL_RULES = (
+    ("embed", None),
+    ("heads", MODEL_AXIS),
+    ("mlp", MODEL_AXIS),
+    ("vocab", None),
+    ("batch", DATA_AXIS),
+)
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    model_parallel: int | None = None,
+    devices: Sequence | None = None,
+) -> Mesh:
+    """Build a (data, model) mesh. ``model_parallel`` must divide the
+    device count; defaults to the largest of {4, 2, 1} that divides both
+    the device count and the MiniLM head count (12)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    n = len(devices)
+    if model_parallel is None:
+        model_parallel = next(tp for tp in (4, 2, 1) if n % tp == 0)
+    assert n % model_parallel == 0
+    arr = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def param_sharding(mesh: Mesh, logical_axes):
+    """Map a flax logical-axis pytree (from ``nn.get_partition_spec``)
+    to NamedShardings on ``mesh``."""
+    from flax import linen as nn
+
+    return nn.logical_to_mesh_sharding(logical_axes, mesh, rules=list(LOGICAL_RULES))
+
+
+def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Batch-dim sharding for activations/inputs."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def host_mesh_from_env() -> Mesh | None:
+    """Multi-host init: when PATHWAY_PROCESSES/PROCESS_ID are set (same
+    env contract as the reference's config.rs:88-120), join the cluster
+    via jax.distributed and return the global mesh."""
+    n_proc = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+    if n_proc <= 1:
+        return None
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    coord = os.environ.get(
+        "PATHWAY_COORDINATOR",
+        f"127.0.0.1:{int(os.environ.get('PATHWAY_FIRST_PORT', '10000'))}",
+    )
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=n_proc, process_id=pid
+    )
+    return make_mesh()

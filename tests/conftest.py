@@ -1,14 +1,22 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so
-sharding tests run without TPU hardware (SURVEY.md §4 implication)."""
+sharding tests run without TPU hardware (SURVEY.md §4 implication).
+
+The container's sitecustomize pre-imports jax and registers the 'axon'
+TPU platform, so the JAX_PLATFORMS env var alone is not enough — we
+must override via jax.config before first backend use.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
