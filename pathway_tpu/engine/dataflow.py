@@ -890,36 +890,146 @@ class FreezeNode(Node):
 
 
 class ExternalIndexNode(Node):
-    """use_external_index_as_of_now (dataflow.rs:2224,
-    operators/external_index.rs): port 0 = index updates, port 1 =
-    queries. Queries are answered against the index state as-of arrival
-    and never retroactively updated (asof-now semantics)."""
+    """use_external_index_as_of_now / incremental index queries
+    (dataflow.rs:2224, operators/external_index.rs): port 0 = index
+    updates, port 1 = queries.
+
+    asof_now=True: queries are answered against the index state as of
+    arrival and never retroactively updated. asof_now=False (the
+    reference's fully-incremental ``InnerIndex.query``): stored queries
+    are re-answered whenever the index changes — on TPU that re-answer
+    is one batched matmul+top-k over all live queries, so incremental
+    correctness costs a single fused device call per epoch.
+
+    The index object implements add(key, payload, metadata) /
+    remove(key) / search_batch(payloads, k, filter_fns). Optional
+    data_embed/query_embed callables batch-map raw payloads (texts) to
+    vectors once per epoch — this is where jit-batched encoders plug in.
+    ``result_fn(matches, data_rows)`` shapes the reply columns (matched
+    data values come from the node's own data-row mirror — the repack
+    join the reference does in Python, done here in-operator)."""
 
     n_inputs = 2
 
-    def __init__(self, graph, index, query_fn: Callable, res_width: int = 1):
-        super().__init__(graph, "ExternalIndex")
-        self.index = index  # engine-level index object: add/remove/search
-        self.query_fn = query_fn  # (key,row) -> query payload
+    def __init__(
+        self,
+        graph,
+        index,
+        data_fn: Callable,    # (key, row) -> (payload, metadata)
+        query_fn: Callable,   # (key, row) -> (payload, k, filter_str)
+        result_fn: Callable,  # (list[(key, score)], data_rows: dict) -> tuple of column values
+        filter_compiler: Callable | None = None,
+        query_proj: Callable | None = None,  # (key, row) -> output row prefix
+        data_embed: Callable | None = None,   # list[payload] -> list[vector]
+        query_embed: Callable | None = None,
+        asof_now: bool = True,
+        name: str = "ExternalIndex",
+    ):
+        super().__init__(graph, name)
+        self.index = index
+        self.data_fn = data_fn
+        self.query_fn = query_fn
+        self.result_fn = result_fn
+        self.query_proj = query_proj
+        self.data_embed = data_embed
+        self.query_embed = query_embed
+        self.asof_now = asof_now
+        self.filter_compiler = filter_compiler
+        self._filter_cache: dict[str, Callable | None] = {}
+        self.data_rows: dict[int, tuple] = {}
         self.answered: dict[int, tuple] = {}
+        # incremental mode: live query store key -> (prefix, payload, k, flt)
+        self.queries: dict[int, tuple] = {}
+
+    def _compile_filter(self, flt):
+        if flt is None or self.filter_compiler is None:
+            return None
+        if flt not in self._filter_cache:
+            if len(self._filter_cache) >= 4096:  # bound per-query filter churn
+                self._filter_cache.clear()
+            self._filter_cache[flt] = self.filter_compiler(flt)
+        return self._filter_cache[flt]
 
     def process(self, time):
+        index_changed = False
+        adds: list[tuple[int, Any, Any]] = []
         for key, row, diff in self.take(0):
             if diff > 0:
-                self.index.add(key, row)
+                payload, metadata = self.data_fn(key, row)
+                adds.append((key, payload, metadata))
+                self.data_rows[key] = row
             else:
-                self.index.remove(key, row)
-        out = []
+                self.index.remove(key)
+                self.data_rows.pop(key, None)
+                index_changed = True
+        if adds:
+            payloads = [p for _, p, _ in adds]
+            if self.data_embed is not None:
+                payloads = self.data_embed(payloads)
+            items = [
+                (key, payload, metadata)
+                for (key, _, metadata), payload in zip(adds, payloads)
+                if payload is not None
+            ]
+            if hasattr(self.index, "add_batch"):
+                self.index.add_batch(items)
+            else:
+                for key, payload, metadata in items:
+                    self.index.add(key, payload, metadata)
+            index_changed = True
+
+        out: list[Update] = []
+        new_queries: list[tuple[int, tuple, Any, int, Any]] = []
         for key, row, diff in self.take(1):
             if diff > 0:
-                result = self.index.search(self.query_fn(key, row))
-                orow = row + (result,)
-                self.answered[key] = orow
-                out.append((key, orow, 1))
+                payload, k, flt = self.query_fn(key, row)
+                prefix = self.query_proj(key, row) if self.query_proj else row
+                new_queries.append((key, prefix, payload, int(k), flt))
             else:
+                self.queries.pop(key, None)
                 orow = self.answered.pop(key, None)
                 if orow is not None:
                     out.append((key, orow, -1))
+        if new_queries and self.query_embed is not None:
+            embedded = self.query_embed([q[2] for q in new_queries])
+            new_queries = [
+                (k, pre, emb, kk, flt)
+                for (k, pre, _, kk, flt), emb in zip(new_queries, embedded)
+            ]
+
+        if self.asof_now:
+            to_answer = new_queries
+        else:
+            for key, prefix, payload, k, flt in new_queries:
+                self.queries[key] = (prefix, payload, k, flt)
+            if index_changed:
+                to_answer = [
+                    (key, pre, pay, k, flt)
+                    for key, (pre, pay, k, flt) in self.queries.items()
+                ]
+            else:
+                to_answer = new_queries
+
+        # batch queries by k so each group is one device top-k call
+        by_k: dict[int, list[int]] = {}
+        for i, (_, _, _, k, _) in enumerate(to_answer):
+            by_k.setdefault(k, []).append(i)
+        replies: list[Any] = [None] * len(to_answer)
+        for k, idxs in by_k.items():
+            payloads = [to_answer[i][2] for i in idxs]
+            filter_fns = [self._compile_filter(to_answer[i][4]) for i in idxs]
+            matches = self.index.search_batch(payloads, k, filter_fns)
+            for i, m in zip(idxs, matches):
+                replies[i] = m
+        for (key, prefix, _, _, _), matches in zip(to_answer, replies):
+            orow = prefix + self.result_fn(matches or [], self.data_rows)
+            old = self.answered.get(key)
+            if old is not None:
+                if rows_equal(old, orow):
+                    continue
+                out.append((key, old, -1))
+            self.answered[key] = orow
+            out.append((key, orow, 1))
         self.emit(out, time)
 
 

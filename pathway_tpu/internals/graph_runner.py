@@ -392,6 +392,94 @@ class GraphRunner:
         out.connect(node)
         return out
 
+    def _lower_external_index(self, table: Table, op: LogicalOp) -> Lowered:
+        """use_external_index_as_of_now (reference dataflow.rs:2224 /
+        operators/external_index.rs): port 0 = data table diffs feed the
+        index (device KNN / BM25), port 1 = queries, answered asof-now.
+        Matched data values are pulled in-operator from the node's data
+        row mirror — no separate repack join."""
+        query_table, data_table = op.inputs
+        p = op.params
+        index = p["index_factory"]()
+
+        # data side: payload + metadata expressions over the data table
+        data_exprs = [p["data_payload"]] + ([p["data_metadata"]] if p.get("data_metadata") is not None else [])
+        dnode, dlayout = self._zip_context(data_table, data_exprs)
+        payload_fn = self.compile(p["data_payload"], dlayout)
+        meta_fn = (
+            self.compile(p["data_metadata"], dlayout)
+            if p.get("data_metadata") is not None
+            else None
+        )
+
+        def data_fn(key, row):
+            return payload_fn(key, row), (meta_fn(key, row) if meta_fn else None)
+
+        # query side: payload, k, filter expressions
+        query_exprs = [p["query_payload"], p["query_k"]]
+        if p.get("query_filter") is not None:
+            query_exprs.append(p["query_filter"])
+        qnode, qlayout = self._zip_context(query_table, query_exprs)
+        qpayload_fn = self.compile(p["query_payload"], qlayout)
+        k_fn = self.compile(p["query_k"], qlayout)
+        flt_fn = (
+            self.compile(p["query_filter"], qlayout)
+            if p.get("query_filter") is not None
+            else None
+        )
+
+        def query_fn(key, row):
+            return (
+                qpayload_fn(key, row),
+                k_fn(key, row),
+                flt_fn(key, row) if flt_fn else None,
+            )
+
+        # project query context down to the query table's own columns
+        qnames = list(query_table._columns.keys())
+        data_names = p.get("data_cols") or []
+        data_slots = [dlayout.slots[(data_table._id, n)] for n in data_names]
+
+        from ..engine.value import Pointer
+
+        def result_fn(matches, data_rows):
+            reply = tuple((Pointer(k), s) for k, s in matches)
+            scores = tuple(s for _, s in matches)
+            cols = []
+            for slot in data_slots:
+                vals = []
+                for k, _ in matches:
+                    drow = data_rows.get(k)
+                    vals.append(drow[slot] if drow is not None else None)
+                cols.append(tuple(vals))
+            return (reply, scores, *cols)
+
+        from ..utils.jmespath_lite import compile_filter
+
+        qslots = [qlayout.slots[(query_table._id, n)] for n in qnames]
+
+        def query_proj(key, row):
+            return tuple(row[i] for i in qslots)
+
+        node = df.ExternalIndexNode(
+            self.engine,
+            index,
+            data_fn=data_fn,
+            query_fn=query_fn,
+            result_fn=result_fn,
+            filter_compiler=compile_filter,
+            query_proj=query_proj,
+            data_embed=p.get("data_embed"),
+            query_embed=p.get("query_embed"),
+            asof_now=p.get("asof_now", True),
+        )
+        node.connect(dnode, 0)
+        node.connect(qnode, 1)
+        out_names = qnames + ["_pw_index_reply", "_pw_index_reply_score"] + [
+            f"_pw_data_{n}" for n in data_names
+        ]
+        return Lowered(node, out_names)
+
     def _lower_filter(self, table: Table, op: LogicalOp) -> Lowered:
         base = op.inputs[0]
         pred_expr = op.params["expr"]

@@ -1,0 +1,231 @@
+"""Device-resident brute-force KNN index.
+
+The TPU-native replacement for the reference's native vector indexes
+(USearch HNSW, /root/reference/src/external_integration/usearch_integration.rs:20,
+and the ndarray brute-force KNN, brute_force_knn_integration.rs:22).
+On TPU, an exhaustive scored scan of an HBM-resident ``[capacity, dim]``
+matrix is one fused matmul + top-k on the MXU — at the scale targets
+(10M x 384 sharded over a v5e-16) this beats host-side HNSW graph walks
+and needs no incremental graph maintenance under retractions: remove is
+O(1) slot invalidation.
+
+Retraction-aware (add/remove driven by engine diffs, reference
+operators/external_index.rs:24). Capacity grows by doubling; each
+capacity bucket compiles once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+_NEG = -3.0e38
+
+# jax imports deferred so `import pathway_tpu` stays jax-free for pure
+# ETL pipelines; kernels compile lazily on first search
+_JIT: dict[str, Callable] = {}
+
+
+def _topk_fn(metric: str) -> Callable:
+    if metric not in _JIT:
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("k",))
+        def topk_dot(matrix, valid, queries, k):
+            # cos: rows pre-normalized so cosine == dot; ip: raw dot
+            scores = queries @ matrix.T  # [q, cap] — the MXU hot loop
+            scores = jnp.where(valid[None, :], scores, _NEG)
+            return jax.lax.top_k(scores, k)
+
+        @partial(jax.jit, static_argnames=("k",))
+        def topk_l2(matrix, valid, queries, k):
+            # -||q - x||^2 = 2 q.x - ||x||^2 - ||q||^2
+            sq = jnp.sum(matrix * matrix, axis=1)
+            scores = 2.0 * (queries @ matrix.T) - sq[None, :]
+            scores = jnp.where(valid[None, :], scores, _NEG)
+            neg_d2, idx = jax.lax.top_k(scores, k)
+            qq = jnp.sum(queries * queries, axis=1, keepdims=True)
+            return neg_d2 - qq, idx
+
+        _JIT["cos"] = topk_dot
+        _JIT["ip"] = topk_dot
+        _JIT["l2"] = topk_l2
+    return _JIT[metric]
+
+
+def _k_bucket(k: int) -> int:
+    b = 8
+    while b < k:
+        b *= 2
+    return b
+
+
+class DeviceKnnIndex:
+    """Growable device matrix + host-side key/metadata mirror.
+
+    add/remove mutate a host staging buffer; the device matrix syncs
+    lazily before the next search (streams batch many updates between
+    queries — one transfer amortizes them all).
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        metric: str = "cos",  # "cos" | "l2" | "ip"
+        reserved_space: int = 1024,
+        dtype=np.float32,
+        mesh=None,
+        auxiliary_space: int = 0,  # reference-parity arg (usearch), unused
+    ):
+        self.dim = dim
+        self.metric = metric
+        self.dtype = dtype
+        self.capacity = max(64, int(reserved_space))
+        self.mesh = mesh
+        self._host = np.zeros((self.capacity, dim), np.float32)
+        self._valid_host = np.zeros((self.capacity,), bool)
+        self._keys: list[Any] = [None] * self.capacity
+        self._slot_of: dict[Any, int] = {}
+        self._meta: dict[Any, Any] = {}
+        self._free: list[int] = list(range(self.capacity - 1, -1, -1))
+        self._dirty = True
+        self._dev_matrix = None
+        self._dev_valid = None
+
+    def __len__(self) -> int:
+        return len(self._slot_of)
+
+    # --- updates (engine diff protocol) ---
+
+    def add(self, key, vector, metadata=None) -> None:
+        vec = np.asarray(vector, np.float32).reshape(-1)
+        if vec.shape[0] != self.dim:
+            raise ValueError(f"index dim {self.dim}, got vector dim {vec.shape[0]}")
+        if key in self._slot_of:
+            self.remove(key)
+        if not self._free:
+            self._grow()
+        slot = self._free.pop()
+        if self.metric == "cos":
+            n = np.linalg.norm(vec)
+            if n > 0:
+                vec = vec / n
+        self._host[slot] = vec
+        self._valid_host[slot] = True
+        self._keys[slot] = key
+        self._slot_of[key] = slot
+        if metadata is not None:
+            self._meta[key] = metadata
+        self._dirty = True
+
+    def remove(self, key) -> None:
+        slot = self._slot_of.pop(key, None)
+        if slot is None:
+            return
+        self._valid_host[slot] = False
+        self._keys[slot] = None
+        self._meta.pop(key, None)
+        self._free.append(slot)
+        self._dirty = True
+
+    def _grow(self) -> None:
+        old = self.capacity
+        self.capacity *= 2
+        self._host = np.concatenate(
+            [self._host, np.zeros((old, self.dim), np.float32)]
+        )
+        self._valid_host = np.concatenate([self._valid_host, np.zeros((old,), bool)])
+        self._keys.extend([None] * old)
+        self._free.extend(range(self.capacity - 1, old - 1, -1))
+        self._dev_matrix = None
+
+    def _sync(self) -> None:
+        if not self._dirty and self._dev_matrix is not None:
+            return
+        import jax
+
+        mat = self._host.astype(np.float32)
+        val = self._valid_host
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            ndata = self.mesh.shape["data"]
+            pad = (-mat.shape[0]) % ndata
+            if pad:
+                mat = np.concatenate([mat, np.zeros((pad, self.dim), np.float32)])
+                val = np.concatenate([val, np.zeros((pad,), bool)])
+            self._dev_matrix = jax.device_put(mat, NamedSharding(self.mesh, P("data", None)))
+            self._dev_valid = jax.device_put(val, NamedSharding(self.mesh, P("data")))
+        else:
+            self._dev_matrix = jax.device_put(mat)
+            self._dev_valid = jax.device_put(val)
+        self._dirty = False
+
+    # --- search ---
+
+    def search_batch(
+        self,
+        queries: np.ndarray,
+        k: int,
+        filter_fns: list[Callable | None] | None = None,
+    ) -> list[list[tuple[Any, float]]]:
+        """queries [q, dim] -> per query a list of (key, score), best
+        first (score: cosine similarity, or negative squared L2).
+        ``filter_fns[i]`` filters candidate metadata; over-fetch + host
+        filter with exponential refill (usearch filtered-search style)."""
+        if len(self._slot_of) == 0 or len(queries) == 0:
+            return [[] for _ in range(len(queries))]
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        if self.metric == "cos":
+            norms = np.linalg.norm(q, axis=1, keepdims=True)
+            q = q / np.maximum(norms, 1e-12)
+        self._sync()
+        need_filter = filter_fns is not None and any(f is not None for f in filter_fns)
+        fetch = min(_k_bucket(4 * k if need_filter else k), self.capacity)
+        fn = _topk_fn(self.metric)
+        results: list[list[tuple[Any, float]] | None] = [None] * len(q)
+        todo = list(range(len(q)))
+        while todo:
+            scores, idx = fn(self._dev_matrix, self._dev_valid, q[todo], fetch)
+            scores = np.asarray(scores)
+            idx = np.asarray(idx)
+            next_todo = []
+            for row, qi in enumerate(todo):
+                flt = filter_fns[qi] if filter_fns is not None else None
+                out: list[tuple[Any, float]] = []
+                for s, slot in zip(scores[row], idx[row]):
+                    if s <= _NEG / 2:
+                        break
+                    key = self._keys[slot]
+                    if key is None:
+                        continue
+                    if flt is not None and not _apply_filter(flt, self._meta.get(key)):
+                        continue
+                    out.append((key, float(s)))
+                    if len(out) == k:
+                        break
+                results[qi] = out
+                if len(out) < min(k, len(self._slot_of)) and fetch < self.capacity:
+                    # filters ate too many candidates — refetch deeper
+                    next_todo.append(qi)
+            if next_todo:
+                fetch = min(fetch * 4, self.capacity)
+                todo = next_todo
+            else:
+                todo = []
+        return [r if r is not None else [] for r in results]
+
+    def search_one(self, query, k: int, filter_fn: Callable | None = None):
+        return self.search_batch(np.asarray(query)[None, :], k, [filter_fn])[0]
+
+
+def _apply_filter(flt: Callable, metadata) -> bool:
+    try:
+        return bool(flt(metadata))
+    except Exception:
+        return False

@@ -34,17 +34,21 @@ def make_mesh(
     n_devices: int | None = None,
     model_parallel: int | None = None,
     devices: Sequence | None = None,
+    heads: int | None = None,
 ) -> Mesh:
     """Build a (data, model) mesh. ``model_parallel`` must divide the
-    device count; defaults to the largest of {4, 2, 1} that divides both
-    the device count and the MiniLM head count (12)."""
+    device count; the default picks the largest of {4, 2, 1} dividing
+    the device count — and ``heads`` too when given, so attention
+    weights shard on head boundaries."""
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
             devices = devices[:n_devices]
     n = len(devices)
     if model_parallel is None:
-        model_parallel = next(tp for tp in (4, 2, 1) if n % tp == 0)
+        model_parallel = next(
+            tp for tp in (4, 2, 1) if n % tp == 0 and (heads is None or heads % tp == 0)
+        )
     assert n % model_parallel == 0
     arr = np.asarray(devices).reshape(n // model_parallel, model_parallel)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))

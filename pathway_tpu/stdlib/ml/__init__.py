@@ -1,1 +1,7 @@
-"""pw.ml (reference stdlib/ml/): index (KNN), classifiers (LSH), smart_table_ops."""
+"""pw.ml (reference stdlib/ml/): index (KNN), classifiers (LSH),
+smart_table_ops (fuzzy join), hmm, datasets."""
+
+from . import classifiers, index
+from .index import KNNIndex, DistanceTypes
+
+__all__ = ["classifiers", "index", "KNNIndex", "DistanceTypes"]

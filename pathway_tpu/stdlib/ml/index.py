@@ -1,0 +1,116 @@
+"""KNNIndex — the classic KNN retrieval API.
+
+Rebuild of /root/reference/python/pathway/stdlib/ml/index.py (KNNIndex
+:9). The reference implements it with LSH bucketing + per-bucket numpy
+top-k UDFs (classifiers/_knn_lsh.py:135-290); here it rides the
+device-resident brute-force index (pathway_tpu.ops.knn) — exact top-k
+as one matmul on the MXU, retraction-aware, with the LSH tuning args
+accepted for API compatibility.
+
+Distance conventions match the reference: "euclidean" -> squared L2
+distance, "cosine" -> 1 - cosine similarity.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+from ...internals.expression import ColumnExpression, ColumnReference
+from ...internals.table import Table
+from ..indexing.colnames import _INDEX_REPLY, _SCORE
+from ..indexing.nearest_neighbors import BruteForceKnn
+
+DistanceTypes = Literal["euclidean", "cosine"]
+
+
+class KNNIndex:
+    def __init__(
+        self,
+        data_embedding: ColumnReference,
+        data: Table,
+        n_dimensions: int,
+        n_or: int = 20,
+        n_and: int = 10,
+        bucket_length: float = 10.0,
+        distance_type: DistanceTypes = "euclidean",
+        metadata: ColumnExpression | None = None,
+    ):
+        self.data = data
+        self.distance_type = distance_type
+        metric = "l2" if distance_type == "euclidean" else "cos"
+        self.inner = BruteForceKnn(
+            data_embedding,
+            metadata,
+            dimensions=n_dimensions,
+            reserved_space=1024,
+            metric=metric,
+        )
+
+    def _get(
+        self,
+        query_embedding: ColumnReference,
+        k,
+        collapse_rows: bool,
+        with_distances: bool,
+        metadata_filter,
+        as_of_now: bool,
+    ) -> Table:
+        data_cols = list(self.data._columns.keys())
+        raw = self.inner._build_query(
+            query_embedding,
+            number_of_matches=k,
+            metadata_filter=metadata_filter,
+            data_cols=data_cols,
+            as_of_now=as_of_now,
+        )
+        if self.distance_type == "euclidean":
+            to_dist = lambda scores: tuple(-s for s in scores)
+        else:
+            to_dist = lambda scores: tuple(1.0 - s for s in scores)
+        from ... import apply_with_type
+        from ...internals import dtype as dt
+
+        if collapse_rows:
+            sel = {n: raw[f"_pw_data_{n}"] for n in data_cols}
+            if with_distances:
+                sel["dist"] = apply_with_type(to_dist, dt.ANY, raw[_SCORE])
+            return raw.select(**sel)
+        # flat format: one row per match, query_id column
+        tmp = raw.select(query_id=raw.id, match=raw[_INDEX_REPLY])
+        flat = tmp.flatten(tmp.match)
+        match = flat.match
+        ixed = self.data.ix(match.get(0), optional=True)
+        sel = {n: ixed[n] for n in data_cols}
+        if with_distances:
+            if self.distance_type == "euclidean":
+                sel["dist"] = apply_with_type(lambda m: -m[1], dt.FLOAT, match)
+            else:
+                sel["dist"] = apply_with_type(lambda m: 1.0 - m[1], dt.FLOAT, match)
+        sel["query_id"] = flat.query_id
+        return flat.select(**sel)
+
+    def get_nearest_items(
+        self,
+        query_embedding: ColumnReference,
+        k: ColumnExpression | int = 3,
+        collapse_rows: bool = True,
+        with_distances: bool = False,
+        metadata_filter: ColumnExpression | None = None,
+    ) -> Table:
+        """Incremental: results update as better documents arrive."""
+        return self._get(
+            query_embedding, k, collapse_rows, with_distances, metadata_filter, False
+        )
+
+    def get_nearest_items_asof_now(
+        self,
+        query_embedding: ColumnReference,
+        k: ColumnExpression | int = 3,
+        collapse_rows: bool = True,
+        with_distances: bool = False,
+        metadata_filter: ColumnExpression | None = None,
+    ) -> Table:
+        """Answers reflect the index as of query arrival; never updated."""
+        return self._get(
+            query_embedding, k, collapse_rows, with_distances, metadata_filter, True
+        )

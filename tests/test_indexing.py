@@ -1,0 +1,211 @@
+"""Indexing stdlib tests (reference test model:
+python/pathway/tests/test_external_index*.py, ml/test_index.py)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing import (
+    BruteForceKnnFactory,
+    HybridIndexFactory,
+    LshKnnFactory,
+    TantivyBM25Factory,
+)
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+from .utils import run_table
+
+
+def one_hot_embed(texts):
+    """Deterministic fake embedder: 8-dim one-hot by hash."""
+    out = []
+    for t in texts:
+        v = np.zeros(8)
+        v[sum(map(ord, t)) % 8] = 1.0
+        out.append(v)
+    return np.stack(out)
+
+
+def _docs():
+    return pw.debug.table_from_markdown(
+        """
+      | text | path
+    1 | aaa  | /docs/x/1.txt
+    2 | bbb  | /docs/y/2.txt
+    3 | ccc  | /docs/x/3.txt
+    """
+    )
+
+
+def test_brute_force_knn_as_of_now():
+    docs = _docs()
+    index = BruteForceKnnFactory(dimensions=8, embedder=one_hot_embed).build_index(
+        docs.text, docs
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+      | query
+    9 | aaa
+    """
+    )
+    res = index.query_as_of_now(queries.query, number_of_matches=1)
+    rows = run_table(res.select(text=res.text))
+    assert list(rows.values())[0] == (("aaa",),)
+
+
+def test_knn_per_query_k():
+    docs = _docs()
+    index = BruteForceKnnFactory(dimensions=8, embedder=one_hot_embed).build_index(
+        docs.text, docs
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+      | query | k
+    8 | aaa   | 1
+    9 | bbb   | 3
+    """
+    )
+    res = index.query_as_of_now(queries.query, number_of_matches=queries.k)
+    rows = run_table(res.select(text=res.text))
+    lens = sorted(len(v[0]) for v in rows.values())
+    assert lens == [1, 3]
+
+
+def test_knn_metadata_filter():
+    docs = _docs()
+    meta = docs.select(
+        docs.text,
+        meta=pw.apply_with_type(lambda p: {"path": p}, pw.ANY, docs.path),
+    )
+    index = BruteForceKnnFactory(dimensions=8, embedder=one_hot_embed).build_index(
+        meta.text, meta, metadata_column=meta.meta
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+      | query | flt
+    9 | aaa   | globmatch('/docs/x/**', path)
+    """
+    )
+    res = index.query_as_of_now(
+        queries.query, number_of_matches=5, metadata_filter=queries.flt
+    )
+    rows = run_table(res.select(text=res.text))
+    texts = list(rows.values())[0][0]
+    assert set(texts) == {"aaa", "ccc"}
+
+
+def test_incremental_query_updates():
+    docs = pw.debug.table_from_markdown(
+        """
+      | text | __time__
+    1 | aaa  | 2
+    2 | bbb  | 4
+    """
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+      | query | __time__
+    9 | aaa   | 0
+    """
+    )
+    index = BruteForceKnnFactory(dimensions=8, embedder=one_hot_embed).build_index(
+        docs.text, docs
+    )
+    res = index.query(queries.query, number_of_matches=2)
+    rows = run_table(res.select(text=res.text))
+    # final state reflects both docs even though the query arrived first
+    assert len(list(rows.values())[0][0]) == 2
+
+
+def test_bm25():
+    docs = _docs()
+    index = TantivyBM25Factory().build_index(docs.text, docs)
+    queries = pw.debug.table_from_markdown(
+        """
+      | query
+    9 | bbb
+    """
+    )
+    res = index.query_as_of_now(queries.query, number_of_matches=2)
+    rows = run_table(res.select(text=res.text))
+    assert list(rows.values())[0] == (("bbb",),)
+
+
+def test_hybrid_index():
+    docs = _docs()
+    factory = HybridIndexFactory(
+        [
+            BruteForceKnnFactory(dimensions=8, embedder=one_hot_embed),
+            TantivyBM25Factory(),
+        ]
+    )
+    index = factory.build_index(docs.text, docs)
+    queries = pw.debug.table_from_markdown(
+        """
+      | query
+    9 | ccc
+    """
+    )
+    res = index.query_as_of_now(queries.query, number_of_matches=2)
+    rows = run_table(res.select(text=res.text))
+    assert "ccc" in list(rows.values())[0][0]
+
+
+def test_lsh_knn():
+    docs = _docs()
+    index = LshKnnFactory(dimensions=8, embedder=one_hot_embed).build_index(
+        docs.text, docs
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+      | query
+    9 | aaa
+    """
+    )
+    res = index.query_as_of_now(queries.query, number_of_matches=1)
+    rows = run_table(res.select(text=res.text))
+    # LSH is approximate but identical vectors share every bucket
+    assert list(rows.values())[0] == (("aaa",),)
+
+
+def _embedded(table, col):
+    return table.select(
+        table.name,
+        emb=pw.apply_with_type(lambda *a: tuple(map(float, a)), pw.ANY, *col),
+    )
+
+
+def test_knnindex_collapsed_and_flat():
+    docs = pw.debug.table_from_markdown(
+        """
+      | name    | x | y
+    1 | bluejay | 4 | 3
+    2 | cat     | 3 | 3
+    3 | eagle   | 2 | 3
+    """
+    )
+    docs = _embedded(docs, (docs.x, docs.y))
+    queries = pw.debug.table_from_markdown(
+        """
+      | x | y
+    9 | 3 | 3
+    """
+    )
+    queries = queries.select(
+        emb=pw.apply_with_type(lambda x, y: (float(x), float(y)), pw.ANY, queries.x, queries.y)
+    )
+    idx = KNNIndex(docs.emb, docs, n_dimensions=2)
+    collapsed = run_table(
+        idx.get_nearest_items(queries.emb, k=2, with_distances=True).select(
+            name=pw.this.name, dist=pw.this.dist
+        )
+    )
+    (names, dists) = list(collapsed.values())[0]
+    assert names == ("cat", "bluejay") and tuple(dists) == (0.0, 1.0)
+
+    flat = run_table(
+        idx.get_nearest_items_asof_now(
+            queries.emb, k=2, collapse_rows=False
+        ).select(name=pw.this.name)
+    )
+    assert sorted(v[0] for v in flat.values()) == ["bluejay", "cat"]

@@ -77,3 +77,9 @@ T = pw.debug.table_from_markdown
 
 def run_all(**kwargs):
     pw.run(**kwargs)
+
+
+def run_table(table: pw.Table) -> dict:
+    """Run to completion; return {key: row_tuple} of the final state."""
+    state, _names = _capture_state(table)
+    return state
