@@ -1167,11 +1167,15 @@ class EngineGraph:
     # --- execution ---
 
     def _topo_pass(self, time):
-        # nodes are created in dependency order; one ordered pass suffices
-        for node in self.nodes:
-            if node.id in self._dirty:
-                self._dirty.discard(node.id)
-                node.process(time)
+        # nodes are created in dependency order, so one ordered pass covers
+        # forward edges; operators that emit "later" than their position
+        # (external index answering as-of-now, ix pre-joins) create
+        # back-edges — keep sweeping until quiescent.
+        while self._dirty:
+            for node in self.nodes:
+                if node.id in self._dirty:
+                    self._dirty.discard(node.id)
+                    node.process(time)
         # time-end notifications for outputs
         for node in self.nodes:
             if isinstance(node, OutputNode):

@@ -341,6 +341,9 @@ class GraphRunner:
         node = self._apply_exprs(node, layout, list(exprs.values()))
         return Lowered(node, list(exprs.keys()))
 
+    # Table.__add__: select over the zipped pair of same-universe tables
+    _lower_concat_columns = _lower_select
+
     def _apply_exprs(self, node, layout, out_exprs: list[ColumnExpression]) -> df.Node:
         """Attach pending ix joins, chain AsyncApplyNodes for async
         sub-expressions, then a final ExprMap for the sync projection."""

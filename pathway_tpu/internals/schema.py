@@ -21,6 +21,8 @@ class ColumnDefinition:
     default_value: Any = ...
     name: str | None = None
     append_only: bool | None = None
+    description: str | None = None
+    example: Any = None
 
     @property
     def has_default_value(self) -> bool:
@@ -34,6 +36,8 @@ def column_definition(
     dtype: Any = None,
     name: str | None = None,
     append_only: bool | None = None,
+    description: str | None = None,
+    example: Any = None,
 ) -> Any:
     return ColumnDefinition(
         dtype=dt.wrap(dtype) if dtype is not None else dt.ANY,
@@ -41,6 +45,8 @@ def column_definition(
         default_value=default_value,
         name=name,
         append_only=append_only,
+        description=description,
+        example=example,
     )
 
 

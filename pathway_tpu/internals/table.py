@@ -130,6 +130,24 @@ class Table:
         op = LogicalOp("select", [self], {"exprs": all_exprs})
         return Table(cols, self._universe, op, name=f"{self._name}.with_columns")
 
+    def __add__(self, other: "Table") -> "Table":
+        """Concatenate columns of two same-universe tables (reference
+        table.py `Table.__add__`); columns of `other` take precedence."""
+        if not isinstance(other, Table):
+            return NotImplemented
+        if not universe_solver.query_are_equal(self._universe, other._universe):
+            raise ValueError(
+                "Table.__add__ requires tables with the same universe; "
+                "use .with_universe_of() or a join for unrelated tables"
+            )
+        exprs: dict[str, ColumnExpression] = {
+            n: ColumnReference(self, n) for n in self._columns
+        }
+        exprs.update({n: ColumnReference(other, n) for n in other._columns})
+        cols = {n: Column(e._dtype) for n, e in exprs.items()}
+        op = LogicalOp("concat_columns", [self, other], {"exprs": exprs})
+        return Table(cols, self._universe, op, name=f"{self._name}+")
+
     def filter(self, filter_expression: ColumnExpression) -> "Table":
         expr = _resolve_this(smart_wrap(filter_expression), self)
         cols = {n: Column(c.dtype) for n, c in self._columns.items()}

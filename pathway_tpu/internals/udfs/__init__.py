@@ -279,6 +279,61 @@ def with_timeout(fn: Callable, timeout: float) -> Callable:
     return wrapper
 
 
+def unwrap_udf(fn: Any) -> Callable:
+    """The plain callable behind a UDF (or the callable itself)."""
+    if isinstance(fn, UDF):
+        return fn.func if fn.func is not None else fn.__wrapped__
+    return fn
+
+
+def as_batch_callable(embedder: Any) -> Callable:
+    """Adapt a UDF or plain callable into `texts -> results` for host-side
+    batch use (index builds, dimension probing).
+
+    Preserves the UDF's executor policies: async UDFs keep their retry /
+    timeout / capacity wrappers and cache strategy, and the whole batch
+    runs under one event loop via asyncio.gather instead of one
+    asyncio.run per item. BatchExecutor UDFs call their function once
+    with the full list. Plain callables are assumed batch-capable."""
+    if not isinstance(embedder, UDF):
+        return embedder
+    inner = unwrap_udf(embedder)
+    ex = embedder.executor
+
+    if isinstance(ex, BatchExecutor):
+        def run_batch(items):
+            return inner(list(items))
+
+        return run_batch
+
+    if asyncio.iscoroutinefunction(inner) or isinstance(ex, AsyncExecutor):
+        wrapped = coerce_async(inner)
+        if isinstance(ex, AsyncExecutor):
+            if ex.retry_strategy is not None:
+                wrapped = with_retry_strategy(wrapped, ex.retry_strategy)
+            if ex.timeout is not None:
+                wrapped = with_timeout(wrapped, ex.timeout)
+            if ex.capacity is not None:
+                wrapped = with_capacity(wrapped, ex.capacity)
+        if embedder.cache_strategy is not None:
+            wrapped = with_cache_strategy(wrapped, embedder.cache_strategy)
+
+        def run_gathered(items):
+            async def run_all():
+                return list(
+                    await asyncio.gather(*[wrapped(item) for item in items])
+                )
+
+            return asyncio.run(run_all())
+
+        return run_gathered
+
+    def run_items(items):
+        return [inner(item) for item in items]
+
+    return run_items
+
+
 class _DynamicBatcher:
     """Collects concurrent calls into one batch invocation of the
     underlying columnar function. All calls gathered within an epoch's
@@ -352,8 +407,9 @@ class UDF:
         self.executor = executor or AutoExecutor()
         self.cache_strategy = cache_strategy
         self.max_batch_size = max_batch_size
-        self.__wrapped__ = func
         if func is not None:
+            # update_wrapper sets self.__wrapped__ = func; guarded so a
+            # subclass-defined __wrapped__ method is not shadowed by None
             functools.update_wrapper(self, func)
 
     # subclasses may override instead of passing func

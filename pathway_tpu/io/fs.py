@@ -226,7 +226,7 @@ def _jsonable(v):
     import numpy as np
 
     if isinstance(v, Json):
-        return v.value
+        return _jsonable(v.value)
     if isinstance(v, bytes):
         return v.decode(errors="replace")
     if isinstance(v, np.ndarray):
@@ -235,6 +235,8 @@ def _jsonable(v):
         return int(v)
     if isinstance(v, (np.floating,)):
         return float(v)
-    if isinstance(v, tuple):
+    if isinstance(v, (tuple, list)):
         return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
     return v

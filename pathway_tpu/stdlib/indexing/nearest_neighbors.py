@@ -41,6 +41,16 @@ def _as_vector(payload) -> np.ndarray:
     return np.asarray(list(payload), np.float32)
 
 
+def normalize_embedder(embedder: Callable | None) -> Callable | None:
+    """Adapt an embedder (pw UDF or plain batch callable) into a batch
+    callable texts -> vectors, keeping UDF executor/cache policies."""
+    if embedder is None:
+        return None
+    from ...internals.udfs import as_batch_callable
+
+    return as_batch_callable(embedder)
+
+
 class _VectorPayloadIndex(DeviceKnnIndex):
     """DeviceKnnIndex accepting tuple/list/ndarray payloads."""
 
@@ -64,10 +74,11 @@ class AbstractKnn(InnerIndex):
     def _embed_fns(self):
         if self.embedder is None:
             return None, None
+        embed = normalize_embedder(self.embedder)
 
         def batch_embed(payloads):
             texts = [p if isinstance(p, str) else str(p) for p in payloads]
-            vecs = self.embedder(texts)
+            vecs = embed(texts)
             return [np.asarray(v, np.float32) for v in vecs]
 
         return batch_embed, batch_embed
@@ -203,7 +214,7 @@ class KnnIndexFactory(InnerIndexFactory):
         if self.dimensions:
             return self.dimensions
         assert self.embedder is not None, "need dimensions or an embedder"
-        probe = np.asarray(self.embedder(["."]))
+        probe = np.asarray(normalize_embedder(self.embedder)(["."]))
         return int(probe.shape[-1])
 
 

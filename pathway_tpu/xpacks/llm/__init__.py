@@ -1,1 +1,40 @@
-"""LLM xpack (reference python/pathway/xpacks/llm/)."""
+"""LLM xpack (reference python/pathway/xpacks/llm/).
+
+Embedders, chat models, rerankers, parsers, splitters, prompt
+templates, VectorStore/DocumentStore, RAG question-answering apps, and
+the REST serving layer — with the model hot paths (embedding, cross-
+encoder scoring) running as jit-batched JAX forwards on TPU.
+"""
+
+from . import (
+    embedders,
+    llms,
+    parsers,
+    prompts,
+    question_answering,
+    rerankers,
+    servers,
+    splitters,
+)
+from .document_store import DocumentStore, SlidesDocumentStore
+from .vector_store import (
+    SlidesVectorStoreServer,
+    VectorStoreClient,
+    VectorStoreServer,
+)
+
+__all__ = [
+    "DocumentStore",
+    "SlidesDocumentStore",
+    "SlidesVectorStoreServer",
+    "VectorStoreClient",
+    "VectorStoreServer",
+    "embedders",
+    "llms",
+    "parsers",
+    "prompts",
+    "question_answering",
+    "rerankers",
+    "servers",
+    "splitters",
+]

@@ -1,0 +1,161 @@
+"""Embedder UDFs.
+
+Parity with /root/reference/python/pathway/xpacks/llm/embedders.py
+(BaseEmbedder :64, OpenAIEmbedder :85, LiteLLMEmbedder :180,
+SentenceTransformerEmbedder :270, GeminiEmbedder :330).
+
+The reference's SentenceTransformerEmbedder calls torch
+``model.encode`` per row. Here the same class is a *batched* UDF over
+the framework's jit-compiled JAX encoder (models/sentence_encoder.py):
+rows are gathered into dynamic batches, padded to bucketed static
+shapes, and run as one bf16 forward on the TPU's MXU.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable
+
+import numpy as np
+
+from ...internals import udfs
+from ...internals.expression import ColumnExpression
+from ._utils import _coerce_sync, coerce_async
+
+
+class BaseEmbedder(udfs.UDF):
+    """Base class for embedders: ``__wrapped__(text) -> np.ndarray``."""
+
+    def __call__(self, input: ColumnExpression, **kwargs) -> ColumnExpression:
+        return super().__call__(input, **kwargs)
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        """Embed a probe string and measure the vector length
+        (reference embedders.py:74-84)."""
+        fn = self.func if self.func is not None else self.__wrapped__
+        result = _coerce_sync(fn)(".", **kwargs)
+        return len(result)
+
+
+class SentenceTransformerEmbedder(BaseEmbedder):
+    """TPU-native replacement for the sentence_transformers hot path
+    (reference embedders.py:270-329). ``model`` picks a MiniLM config;
+    weights load from PATHWAY_TPU_CKPT when present, otherwise the
+    encoder runs with deterministic random init (sufficient for tests
+    and throughput benchmarking).
+    """
+
+    def __init__(
+        self,
+        model: str = "all-MiniLM-L6-v2",
+        call_kwargs: dict = {},
+        device: str = "tpu",
+        *,
+        max_batch_size: int = 1024,
+        mesh=None,
+        **init_kwargs,
+    ):
+        executor = init_kwargs.pop("executor", None)
+        if executor is None:
+            executor = udfs.batch_executor(max_batch_size=max_batch_size)
+        super().__init__(executor=executor, **init_kwargs)
+        from ...models.sentence_encoder import SentenceEncoder
+
+        self._encoder = SentenceEncoder(model, mesh=mesh, max_batch=max_batch_size)
+        self.kwargs = dict(call_kwargs)
+
+    def __wrapped__(self, input, **kwargs):
+        # batch_executor delivers a list of rows; plain call delivers one
+        if isinstance(input, list):
+            texts = ["" if t is None else str(t) for t in input]
+            embs = self._encoder.encode(texts)
+            return [e for e in embs]
+        return self._encoder.encode([str(input)])[0]
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self._encoder.dim
+
+
+class OpenAIEmbedder(BaseEmbedder):
+    """OpenAI `embeddings.create` wrapper (reference embedders.py:85).
+    Network calls require the `openai` package and an API key."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        model: str | None = "text-embedding-3-small",
+        **openai_kwargs,
+    ):
+        executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
+        super().__init__(executor=executor, cache_strategy=cache_strategy)
+        self.model = model
+        self.kwargs = dict(openai_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+
+    async def __wrapped__(self, input, **kwargs) -> np.ndarray:
+        try:
+            import openai
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("OpenAIEmbedder requires the openai package") from e
+        kwargs = {**self.kwargs, **kwargs}
+        api_kwargs = {k: v for k, v in kwargs.items() if k not in ("api_key", "base_url")}
+        client = openai.AsyncOpenAI(
+            api_key=kwargs.get("api_key"), base_url=kwargs.get("base_url")
+        )
+        ret = await client.embeddings.create(input=[input or "."], **api_kwargs)
+        return np.array(ret.data[0].embedding)
+
+
+class LiteLLMEmbedder(BaseEmbedder):
+    """litellm.aembedding wrapper (reference embedders.py:180)."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        model: str | None = None,
+        **llmlite_kwargs,
+    ):
+        executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
+        super().__init__(executor=executor, cache_strategy=cache_strategy)
+        self.kwargs = dict(llmlite_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+
+    async def __wrapped__(self, input, **kwargs) -> np.ndarray:
+        try:
+            import litellm
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("LiteLLMEmbedder requires the litellm package") from e
+        ret = await litellm.aembedding(input=[input or "."], **{**self.kwargs, **kwargs})
+        return np.array(ret.data[0]["embedding"])
+
+
+class GeminiEmbedder(BaseEmbedder):
+    """google.generativeai embed_content wrapper (reference embedders.py:330)."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        model: str | None = "models/embedding-001",
+        **gemini_kwargs,
+    ):
+        executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
+        super().__init__(executor=executor, cache_strategy=cache_strategy)
+        self.kwargs = dict(gemini_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+
+    def __wrapped__(self, input, **kwargs) -> np.ndarray:
+        try:
+            import google.generativeai as genai
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("GeminiEmbedder requires google-generativeai") from e
+        response = genai.embed_content(content=[input or "."], **{**self.kwargs, **kwargs})
+        return np.array(response["embedding"][0])

@@ -1,0 +1,239 @@
+"""LLM chat-model UDFs.
+
+Parity with /root/reference/python/pathway/xpacks/llm/llms.py
+(BaseChat :27, OpenAIChat :84, LiteLLMChat :313, HFPipelineChat :441,
+CohereChat :544, prompt_chat_single_qa :686). Network-backed chats are
+thin async wrappers; HFPipelineChat runs a local transformers pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from abc import abstractmethod
+from typing import Any
+
+from ...engine.value import Json
+from ...internals import udfs
+from ...internals.expression import ColumnExpression
+from ._utils import _check_model_accepts_arg
+
+logger = logging.getLogger(__name__)
+
+
+def _prep_message_log(messages: list[dict], verbose: bool) -> str:
+    if verbose:
+        return json.dumps(messages, ensure_ascii=False, default=str)[:5000]
+    return "..."
+
+
+def _messages_to_plain(messages) -> list[dict]:
+    if isinstance(messages, Json):
+        messages = messages.value
+    out = []
+    for m in messages or []:
+        if isinstance(m, Json):
+            m = m.value
+        out.append(dict(m))
+    return out
+
+
+class BaseChat(udfs.UDF):
+    """Base class for chat models: ``__wrapped__(messages) -> str``.
+
+    ``messages`` is a list of {"role": ..., "content": ...} dicts
+    (possibly wrapped in Json).
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.kwargs: dict[str, Any] = getattr(self, "kwargs", {})
+
+    @abstractmethod
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        """Whether the underlying provider/model accepts `arg_name` as a
+        per-call parameter (reference llms.py:48)."""
+
+    @property
+    def model(self) -> str | None:
+        return self.kwargs.get("model")
+
+    def __call__(self, messages: ColumnExpression, **kwargs) -> ColumnExpression:
+        return super().__call__(messages, **kwargs)
+
+
+class OpenAIChat(BaseChat):
+    """OpenAI chat.completions wrapper (reference llms.py:84)."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        model: str | None = "gpt-3.5-turbo",
+        verbose: bool = False,
+        **openai_kwargs,
+    ):
+        executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
+        super().__init__(executor=executor, cache_strategy=cache_strategy)
+        self.verbose = verbose
+        self.kwargs = dict(openai_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+
+    async def __wrapped__(self, messages, **kwargs) -> str | None:
+        try:
+            import openai
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("OpenAIChat requires the openai package") from e
+        messages = _messages_to_plain(messages)
+        kwargs = {**self.kwargs, **kwargs}
+        logger.info("OpenAIChat call: %s", _prep_message_log(messages, self.verbose))
+        client = openai.AsyncOpenAI(
+            api_key=kwargs.pop("api_key", None), base_url=kwargs.pop("base_url", None)
+        )
+        ret = await client.chat.completions.create(messages=messages, **kwargs)
+        return ret.choices[0].message.content
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return _check_model_accepts_arg(self.model or "", "openai", arg_name)
+
+
+class LiteLLMChat(BaseChat):
+    """litellm.acompletion wrapper (reference llms.py:313)."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        model: str | None = None,
+        verbose: bool = False,
+        **litellm_kwargs,
+    ):
+        executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
+        super().__init__(executor=executor, cache_strategy=cache_strategy)
+        self.verbose = verbose
+        self.kwargs = dict(litellm_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+
+    async def __wrapped__(self, messages, **kwargs) -> str | None:
+        try:
+            import litellm
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("LiteLLMChat requires the litellm package") from e
+        messages = _messages_to_plain(messages)
+        logger.info("LiteLLMChat call: %s", _prep_message_log(messages, self.verbose))
+        ret = await litellm.acompletion(messages=messages, **{**self.kwargs, **kwargs})
+        return ret.choices[0]["message"]["content"]
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return _check_model_accepts_arg(self.model or "", "litellm", arg_name)
+
+
+class HFPipelineChat(BaseChat):
+    """Local transformers text-generation pipeline (reference llms.py:441).
+    Runs on host CPU/torch; for TPU-native generation use the models/
+    package directly."""
+
+    def __init__(
+        self,
+        model: str | None = "gpt2",
+        call_kwargs: dict = {},
+        device: str = "cpu",
+        cache_strategy: udfs.CacheStrategy | None = None,
+        **pipeline_kwargs,
+    ):
+        super().__init__(cache_strategy=cache_strategy)
+        self.kwargs = {"model": model}
+        self.call_kwargs = dict(call_kwargs)
+        try:
+            import transformers
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("HFPipelineChat requires transformers") from e
+        self.pipeline = transformers.pipeline(
+            "text-generation", model=model, device=device, **pipeline_kwargs
+        )
+        self.tokenizer = self.pipeline.tokenizer
+
+    def crop_to_max_length(self, input_string: str, max_prompt_length: int = 500) -> str:
+        tokens = self.tokenizer.tokenize(input_string)
+        if len(tokens) > max_prompt_length:
+            tokens = tokens[-max_prompt_length:]
+            return self.tokenizer.convert_tokens_to_string(tokens)
+        return input_string
+
+    def __wrapped__(self, messages, **kwargs) -> str | None:
+        messages_plain = _messages_to_plain(messages)
+        kwargs = {**self.call_kwargs, **kwargs}
+        if getattr(self.tokenizer, "chat_template", None) is not None:
+            prompt_input: Any = messages_plain
+        else:
+            prompt_input = "\n".join(m.get("content", "") for m in messages_plain)
+        output = self.pipeline(prompt_input, **kwargs)
+        text = output[0]["generated_text"]
+        if isinstance(text, list):  # chat-format output
+            return text[-1].get("content")
+        return text
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return arg_name in {"max_new_tokens", "temperature", "top_p", "do_sample"}
+
+
+class CohereChat(BaseChat):
+    """Cohere chat wrapper with RAG citations (reference llms.py:544).
+    Returns (response_text, cited_documents)."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        model: str | None = "command",
+        **cohere_kwargs,
+    ):
+        executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
+        super().__init__(executor=executor, cache_strategy=cache_strategy)
+        self.kwargs = dict(cohere_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+
+    @staticmethod
+    def _to_cohere_history(messages: list[dict]) -> tuple[list[dict], str]:
+        history = [
+            {"role": m.get("role", "user"), "message": m.get("content", "")}
+            for m in messages[:-1]
+        ]
+        last = messages[-1].get("content", "") if messages else ""
+        return history, last
+
+    def __wrapped__(self, messages, docs: list[dict] | None = None, **kwargs) -> tuple:
+        try:
+            import cohere
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("CohereChat requires the cohere package") from e
+        messages = _messages_to_plain(messages)
+        history, message = self._to_cohere_history(messages)
+        kwargs = {**self.kwargs, **kwargs}
+        client = cohere.Client()
+        response = client.chat(
+            chat_history=history, message=message, documents=docs, **kwargs
+        )
+        cited = [dict(d) for d in (response.citations or [])] if hasattr(response, "citations") else []
+        return response.text, cited
+
+    def __call__(self, messages: ColumnExpression, documents=None, **kwargs) -> ColumnExpression:
+        if documents is not None:
+            return super(BaseChat, self).__call__(messages, docs=documents, **kwargs)
+        return super().__call__(messages, **kwargs)
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return _check_model_accepts_arg(self.model or "", "cohere", arg_name)
+
+
+@udfs.udf
+def prompt_chat_single_qa(question: str) -> Json:
+    """Wrap a plain question into a single-turn chat message list
+    (reference llms.py:686). A UDF: call it on a column expression."""
+    return Json([{"role": "user", "content": question}])

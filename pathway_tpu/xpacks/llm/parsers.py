@@ -1,0 +1,204 @@
+"""Document parser UDFs: bytes -> list[(text, metadata)].
+
+Parity with /root/reference/python/pathway/xpacks/llm/parsers.py
+(ParseUtf8 :53, ParseUnstructured :79, OpenParse :235, ImageParser :396,
+SlideParser :569, PypdfParser :746). Parsers requiring optional
+packages (unstructured, openparse, pypdf) import lazily and raise a
+clear error when absent.
+"""
+
+from __future__ import annotations
+
+import logging
+from io import BytesIO
+from typing import Callable
+
+from ...internals import udfs
+from ...internals.expression import ColumnExpression
+
+logger = logging.getLogger(__name__)
+
+
+class ParseUtf8(udfs.UDF):
+    """Decode bytes as UTF-8; whole file is one chunk (reference :53)."""
+
+    def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        if isinstance(contents, str):
+            return [(contents, {})]
+        return [(contents.decode("utf-8", errors="replace"), {})]
+
+    def __call__(self, contents: ColumnExpression, **kwargs) -> ColumnExpression:
+        return super().__call__(contents, **kwargs)
+
+
+#: reference keeps both names
+Utf8Parser = ParseUtf8
+
+
+class ParseUnstructured(udfs.UDF):
+    """unstructured.io partition-based parser (reference :79).
+    mode: single | elements | paged."""
+
+    def __init__(
+        self,
+        mode: str = "single",
+        post_processors: list[Callable] | None = None,
+        **unstructured_kwargs,
+    ):
+        super().__init__()
+        if mode not in ("single", "elements", "paged"):
+            raise ValueError(f"invalid mode: {mode}")
+        try:
+            import unstructured.partition.auto  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("ParseUnstructured requires the unstructured package") from e
+        self.mode = mode
+        self.post_processors = post_processors or []
+        self.unstructured_kwargs = unstructured_kwargs
+
+    def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        import unstructured.partition.auto
+
+        elements = unstructured.partition.auto.partition(
+            file=BytesIO(contents), **{**self.unstructured_kwargs, **kwargs}
+        )
+        for el in elements:
+            for proc in self.post_processors:
+                el.apply(proc)
+        if self.mode == "elements":
+            out = []
+            for el in elements:
+                meta = el.metadata.to_dict() if hasattr(el, "metadata") else {}
+                if hasattr(el, "category"):
+                    meta["category"] = el.category
+                out.append((str(el), meta))
+            return out
+        if self.mode == "paged":
+            pages: dict[int, str] = {}
+            metas: dict[int, dict] = {}
+            for el in elements:
+                page = getattr(getattr(el, "metadata", None), "page_number", 1) or 1
+                pages[page] = pages.get(page, "") + str(el) + "\n\n"
+                metas.setdefault(page, {"page_number": page})
+            return [(pages[p], metas[p]) for p in sorted(pages)]
+        return [("\n\n".join(str(el) for el in elements), {})]
+
+
+class PypdfParser(udfs.UDF):
+    """pypdf text extraction, one chunk per page (reference :746)."""
+
+    def __init__(self, apply_text_cleanup: bool = True, cache_strategy=None):
+        super().__init__(cache_strategy=cache_strategy)
+        try:
+            import pypdf  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("PypdfParser requires the pypdf package") from e
+        self.apply_text_cleanup = apply_text_cleanup
+
+    @staticmethod
+    def _cleanup(text: str) -> str:
+        import re
+
+        text = re.sub(r"-\n(\w)", r"\1", text)  # de-hyphenate line breaks
+        text = re.sub(r"(?<!\n)\n(?!\n)", " ", text)  # unwrap soft breaks
+        text = re.sub(r"[ \t]+", " ", text)
+        return text.strip()
+
+    def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        import pypdf
+
+        reader = pypdf.PdfReader(BytesIO(contents))
+        out = []
+        for i, page in enumerate(reader.pages):
+            text = page.extract_text() or ""
+            if self.apply_text_cleanup:
+                text = self._cleanup(text)
+            if text:
+                out.append((text, {"page_number": i + 1}))
+        return out
+
+
+class ImageParser(udfs.UDF):
+    """Describe images with a vision chat model (reference :396);
+    optionally parse structured fields via a schema."""
+
+    def __init__(
+        self,
+        llm=None,
+        parse_prompt: str | None = None,
+        downsize_horizontal_width: int | None = None,
+        max_image_size: int | None = None,
+        **kwargs,
+    ):
+        super().__init__()
+        self.llm = llm
+        self.parse_prompt = parse_prompt or "Describe the contents of this image."
+        self.downsize_horizontal_width = downsize_horizontal_width
+        self.max_image_size = max_image_size
+
+    def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        import base64
+
+        if self.llm is None:
+            raise ValueError("ImageParser requires a vision-capable llm")
+        b64 = base64.b64encode(contents).decode()
+        messages = [
+            {
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": self.parse_prompt},
+                    {
+                        "type": "image_url",
+                        "image_url": {"url": f"data:image/jpeg;base64,{b64}"},
+                    },
+                ],
+            }
+        ]
+        from ._utils import _coerce_sync
+        from ...engine.value import Json
+
+        fn = self.llm.func if self.llm.func is not None else self.llm.__wrapped__
+        text = _coerce_sync(fn)(Json(messages))
+        return [(text or "", {})]
+
+
+class SlideParser(ImageParser):
+    """Parse slide decks page-by-page through a vision model
+    (reference :569). Requires pdf rendering (pdf2image) for PDFs."""
+
+    def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        try:
+            from pdf2image import convert_from_bytes
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("SlideParser requires the pdf2image package") from e
+        pages = convert_from_bytes(contents)
+        out = []
+        for i, img in enumerate(pages):
+            buf = BytesIO()
+            img.save(buf, format="JPEG")
+            (text, meta), = super().__wrapped__(buf.getvalue())
+            meta = {**meta, "page_number": i + 1}
+            out.append((text, meta))
+        return out
+
+
+class OpenParse(udfs.UDF):
+    """openparse-based PDF chunking (reference :235)."""
+
+    def __init__(self, table_args: dict | None = None, cache_strategy=None, **kwargs):
+        super().__init__(cache_strategy=cache_strategy)
+        try:
+            import openparse  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("OpenParse requires the openparse package") from e
+        self.table_args = table_args
+
+    def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        import openparse
+
+        parser = openparse.DocumentParser(table_args=self.table_args)
+        doc = parser.parse(BytesIO(contents))
+        return [
+            (node.text, {"node_type": getattr(node, "variant", None)})
+            for node in doc.nodes
+        ]

@@ -1,0 +1,70 @@
+"""LLM fakes for xpack tests.
+
+Rebuild of /root/reference/python/pathway/xpacks/llm/tests/mocks.py
+(FakeChatModel, IdentityMockChat, fake_embeddings_model) — zero model
+deps, deterministic outputs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm.llms import BaseChat, _messages_to_plain
+
+
+class FakeChatModel(BaseChat):
+    """Always answers 'Text'."""
+
+    def __wrapped__(self, messages, **kwargs) -> str:
+        return "Text"
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return True
+
+
+class IdentityMockChat(BaseChat):
+    """Echoes 'model: <last message content>'."""
+
+    def __wrapped__(self, messages, model: str = "mock", **kwargs) -> str:
+        plain = _messages_to_plain(messages)
+        last = plain[-1]["content"] if plain else ""
+        return f"{model}: {last}"
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return True
+
+
+@pw.udf
+def fake_embeddings_model(x: str) -> np.ndarray:
+    """Deterministic 8-dim embedding from a text hash; similar inputs
+    don't cluster, but exact matches score 1.0 under cosine."""
+    h = hashlib.sha256((x or "").encode()).digest()
+    v = np.frombuffer(h[:8 * 4], dtype=np.uint32).astype(np.float32)
+    v = v / np.linalg.norm(v)
+    return v
+
+
+def make_docs_table(texts_and_paths: list[tuple[str, str]]) -> pw.Table:
+    """A docs table shaped like pw.io.fs.read(format='binary',
+    with_metadata=True): columns data (bytes) + _metadata (Json)."""
+
+    class DocSchema(pw.Schema):
+        data: bytes
+        _metadata: pw.Json
+
+    rows = []
+    for i, (text, path) in enumerate(texts_and_paths):
+        meta = pw.Json(
+            {
+                "path": path,
+                "modified_at": 1700000000 + i,
+                "seen_at": 1700000100 + i,
+            }
+        )
+        rows.append((text.encode(), meta))
+    from pathway_tpu.debug import table_from_rows
+
+    return table_from_rows(DocSchema, rows)
