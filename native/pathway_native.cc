@@ -1,0 +1,516 @@
+// pathway_tpu native runtime: keyed blob state store, update consolidation,
+// CRC-checked snapshot log, key hashing / shard routing.
+//
+// TPU-native counterpart of the reference engine's Rust state layer
+// (/root/reference/src/engine/dataflow.rs arrangements + /root/reference/
+// src/persistence/{input_snapshot.rs,operator_snapshot.rs,backends/file.rs}).
+// The compute plane is JAX/XLA; this library is the host-side runtime the
+// Python DSL drives: operator state lives here as serialized rows, epoch
+// delta consolidation happens here, and persistence snapshots stream
+// store<->log entirely natively (no per-row Python).
+//
+// C ABI only (consumed via ctypes). All blobs are owned copies.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <new>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#if !defined(_WIN32)
+#include <unistd.h>
+#endif
+
+#if defined(_WIN32)
+#define PN_EXPORT extern "C" __declspec(dllexport)
+#else
+#define PN_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// hashing (splitmix64 — matches pathway_tpu.engine.value.hash_int_array)
+// ---------------------------------------------------------------------------
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+// FNV-1a over bytes, for grouping serialized rows during consolidation.
+inline uint64_t fnv1a(const uint8_t* data, uint64_t len) {
+  uint64_t h = 0xCBF29CE484222325ULL;
+  for (uint64_t i = 0; i < len; ++i) {
+    h ^= data[i];
+    h *= 0x100000001B3ULL;
+  }
+  return h;
+}
+
+// ---------------------------------------------------------------------------
+// CRC32 (for the snapshot log; table-driven, polynomial 0xEDB88320)
+// ---------------------------------------------------------------------------
+
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+const Crc32Table kCrc;
+
+inline uint32_t crc32(const uint8_t* data, uint64_t len, uint32_t seed = 0) {
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (uint64_t i = 0; i < len; ++i) c = kCrc.t[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Blob {
+  std::string data;
+};
+
+struct Store {
+  std::unordered_map<uint64_t, std::string> map;
+  // scratch returned to Python; valid until the next call on this store
+  std::string scratch;
+};
+
+struct StoreIter {
+  Store* store;
+  std::unordered_map<uint64_t, std::string>::const_iterator it;
+};
+
+// Shared output buffer object: Python frees it with pn_buf_free.
+struct Buf {
+  std::vector<uint8_t> data;
+};
+
+inline void put_u32(std::vector<uint8_t>& out, uint32_t v) {
+  out.insert(out.end(), reinterpret_cast<uint8_t*>(&v), reinterpret_cast<uint8_t*>(&v) + 4);
+}
+inline void put_u64(std::vector<uint8_t>& out, uint64_t v) {
+  out.insert(out.end(), reinterpret_cast<uint8_t*>(&v), reinterpret_cast<uint8_t*>(&v) + 8);
+}
+inline void put_i64(std::vector<uint8_t>& out, int64_t v) {
+  out.insert(out.end(), reinterpret_cast<uint8_t*>(&v), reinterpret_cast<uint8_t*>(&v) + 8);
+}
+
+}  // namespace
+
+// ===========================================================================
+// Keyed blob store (operator state / arrangement equivalent)
+// ===========================================================================
+
+PN_EXPORT void* pn_store_new() { return new Store(); }
+
+PN_EXPORT void pn_store_free(void* s) { delete static_cast<Store*>(s); }
+
+PN_EXPORT uint64_t pn_store_len(void* s) {
+  return static_cast<Store*>(s)->map.size();
+}
+
+// Insert/replace. Returns 1 if a previous value existed (copied to scratch,
+// readable via pn_store_scratch), else 0.
+PN_EXPORT int32_t pn_store_upsert(void* sv, uint64_t key, const uint8_t* blob,
+                                  uint64_t len) {
+  Store* s = static_cast<Store*>(sv);
+  auto it = s->map.find(key);
+  if (it != s->map.end()) {
+    s->scratch.swap(it->second);
+    it->second.assign(reinterpret_cast<const char*>(blob), len);
+    return 1;
+  }
+  s->map.emplace(key, std::string(reinterpret_cast<const char*>(blob), len));
+  return 0;
+}
+
+// Remove. Returns 1 if present (old value in scratch), else 0.
+PN_EXPORT int32_t pn_store_remove(void* sv, uint64_t key) {
+  Store* s = static_cast<Store*>(sv);
+  auto it = s->map.find(key);
+  if (it == s->map.end()) return 0;
+  s->scratch.swap(it->second);
+  s->map.erase(it);
+  return 1;
+}
+
+// Lookup. Returns 1 and sets (*ptr, *len) to internal storage if present.
+PN_EXPORT int32_t pn_store_get(void* sv, uint64_t key, const uint8_t** ptr,
+                               uint64_t* len) {
+  Store* s = static_cast<Store*>(sv);
+  auto it = s->map.find(key);
+  if (it == s->map.end()) return 0;
+  *ptr = reinterpret_cast<const uint8_t*>(it->second.data());
+  *len = it->second.size();
+  return 1;
+}
+
+PN_EXPORT int32_t pn_store_contains(void* sv, uint64_t key) {
+  Store* s = static_cast<Store*>(sv);
+  return s->map.count(key) ? 1 : 0;
+}
+
+PN_EXPORT void pn_store_clear(void* sv) { static_cast<Store*>(sv)->map.clear(); }
+
+PN_EXPORT void pn_store_scratch(void* sv, const uint8_t** ptr, uint64_t* len) {
+  Store* s = static_cast<Store*>(sv);
+  *ptr = reinterpret_cast<const uint8_t*>(s->scratch.data());
+  *len = s->scratch.size();
+}
+
+PN_EXPORT void* pn_store_iter_new(void* sv) {
+  Store* s = static_cast<Store*>(sv);
+  StoreIter* it = new StoreIter{s, s->map.cbegin()};
+  return it;
+}
+
+PN_EXPORT int32_t pn_store_iter_next(void* iv, uint64_t* key,
+                                     const uint8_t** ptr, uint64_t* len) {
+  StoreIter* it = static_cast<StoreIter*>(iv);
+  if (it->it == it->store->map.cend()) return 0;
+  *key = it->it->first;
+  *ptr = reinterpret_cast<const uint8_t*>(it->it->second.data());
+  *len = it->it->second.size();
+  ++it->it;
+  return 1;
+}
+
+PN_EXPORT void pn_store_iter_free(void* iv) { delete static_cast<StoreIter*>(iv); }
+
+// ===========================================================================
+// Consolidation kernel
+// ===========================================================================
+// Input: packed records  [u64 key][i64 diff][u32 idx][u32 len][len bytes]...
+// where `idx` indexes the caller's row list and the bytes are a canonical
+// serialization of the row (equal rows serialize equally).  Semantics match
+// pathway_tpu.engine.dataflow.consolidate: group by (key, row bytes), sum
+// diffs, drop zeros; emit per first-seen key order, retractions before
+// insertions within a key; |diff| copies each.
+// Output (Buf): [u32 n] then n × ([u32 idx][i64 diff-sign-unit]) — one
+// record per emitted unit update, referring to input row `idx`.
+
+PN_EXPORT void* pn_consolidate(const uint8_t* in, uint64_t in_len) {
+  struct Ent {
+    uint32_t idx;
+    int64_t diff;
+    uint64_t rowhash;
+    const uint8_t* bytes;
+    uint32_t len;
+  };
+  // key -> entries (distinct rows); also remember key order
+  std::unordered_map<uint64_t, std::vector<Ent>> groups;
+  std::vector<uint64_t> key_order;
+  const uint8_t* p = in;
+  const uint8_t* end = in + in_len;
+  while (p + 24 <= end) {
+    uint64_t key;
+    int64_t diff;
+    uint32_t idx, len;
+    memcpy(&key, p, 8);
+    memcpy(&diff, p + 8, 8);
+    memcpy(&idx, p + 16, 4);
+    memcpy(&len, p + 20, 4);
+    p += 24;
+    if (p + len > end) break;
+    const uint8_t* bytes = p;
+    p += len;
+    uint64_t rh = fnv1a(bytes, len);
+    auto ins = groups.emplace(key, std::vector<Ent>());
+    if (ins.second) key_order.push_back(key);
+    std::vector<Ent>& bucket = ins.first->second;
+    bool merged = false;
+    for (Ent& e : bucket) {
+      if (e.rowhash == rh && e.len == len && memcmp(e.bytes, bytes, len) == 0) {
+        e.diff += diff;
+        merged = true;
+        break;
+      }
+    }
+    if (!merged) bucket.push_back(Ent{idx, diff, rh, bytes, len});
+  }
+  Buf* out = new Buf();
+  put_u32(out->data, 0);  // patched below
+  uint32_t n = 0;
+  for (uint64_t key : key_order) {
+    std::vector<Ent>& bucket = groups[key];
+    // retractions first (stable within equal diff sign)
+    std::vector<const Ent*> neg, pos;
+    for (const Ent& e : bucket) {
+      if (e.diff < 0) neg.push_back(&e);
+      else if (e.diff > 0) pos.push_back(&e);
+    }
+    for (const Ent* e : neg) {
+      for (int64_t i = 0; i < -e->diff; ++i) {
+        put_u32(out->data, e->idx);
+        put_i64(out->data, -1);
+        ++n;
+      }
+    }
+    for (const Ent* e : pos) {
+      for (int64_t i = 0; i < e->diff; ++i) {
+        put_u32(out->data, e->idx);
+        put_i64(out->data, 1);
+        ++n;
+      }
+    }
+  }
+  memcpy(out->data.data(), &n, 4);
+  return out;
+}
+
+PN_EXPORT void pn_buf_read(void* bv, const uint8_t** ptr, uint64_t* len) {
+  Buf* b = static_cast<Buf*>(bv);
+  *ptr = b->data.data();
+  *len = b->data.size();
+}
+
+PN_EXPORT void pn_buf_free(void* bv) { delete static_cast<Buf*>(bv); }
+
+// ===========================================================================
+// Snapshot log (persistence backend)
+// ===========================================================================
+// File format: 8-byte magic "PNLOG1\0\0", then records:
+//   [u8 kind][u64 time][u64 key][u64 len][len bytes][u32 crc]
+// crc is CRC32 over (kind..bytes).  A torn tail (crash mid-append) fails
+// the CRC/length check and reading stops there — crash-tolerant replay,
+// mirroring the reference's chunk-per-file + metadata scheme
+// (/root/reference/src/persistence/backends/file.rs) collapsed into one
+// CRC-delimited log.
+
+namespace {
+const char kMagic[8] = {'P', 'N', 'L', 'O', 'G', '1', 0, 0};
+
+struct LogWriter {
+  FILE* f = nullptr;
+  std::vector<uint8_t> rec;  // reusable record scratch
+};
+
+struct LogReader {
+  FILE* f = nullptr;
+  std::vector<uint8_t> blob;
+};
+}  // namespace
+
+namespace {
+// Scan an existing log and return the byte offset just past the last valid
+// record (>= 8, the magic). Used to truncate a torn tail before appending —
+// otherwise records written after a crash would sit beyond the corruption
+// and be unreachable (pn_log_next stops at the first bad record).
+long valid_prefix_end(FILE* f) {
+  long good = 8;
+  if (fseek(f, 8, SEEK_SET) != 0) return 8;
+  std::vector<uint8_t> buf;
+  for (;;) {
+    uint8_t head[25];
+    if (fread(head, 1, 25, f) != 25) break;
+    uint64_t blen;
+    memcpy(&blen, head + 17, 8);
+    if (blen > (1ULL << 31)) break;
+    buf.assign(head, head + 25);
+    size_t base = buf.size();
+    buf.resize(base + blen + 4);
+    if (blen && fread(buf.data() + base, 1, blen, f) != blen) break;
+    uint32_t crc_stored;
+    if (fread(&crc_stored, 1, 4, f) != 4) break;
+    if (crc32(buf.data(), base + blen) != crc_stored) break;
+    good = ftell(f);
+  }
+  return good;
+}
+}  // namespace
+
+PN_EXPORT void* pn_log_open_write(const char* path, int32_t append) {
+  LogWriter* w = new LogWriter();
+  bool fresh = true;
+  long resume_at = 8;
+  if (append) {
+    FILE* probe = fopen(path, "rb");
+    if (probe) {
+      char m[8];
+      fresh = fread(m, 1, 8, probe) != 8 || memcmp(m, kMagic, 8) != 0;
+      if (!fresh) resume_at = valid_prefix_end(probe);
+      fclose(probe);
+    }
+  }
+  if (append && !fresh) {
+    // r+b so we can truncate a torn tail and continue from the last
+    // valid record
+    w->f = fopen(path, "r+b");
+    if (!w->f) {
+      delete w;
+      return nullptr;
+    }
+    fseek(w->f, resume_at, SEEK_SET);
+#if !defined(_WIN32)
+    if (ftruncate(fileno(w->f), resume_at) != 0) { /* best effort */ }
+#endif
+  } else {
+    w->f = fopen(path, "wb");
+    if (!w->f) {
+      delete w;
+      return nullptr;
+    }
+    fwrite(kMagic, 1, 8, w->f);
+  }
+  return w;
+}
+
+PN_EXPORT int32_t pn_log_append(void* wv, uint8_t kind, uint64_t time,
+                                uint64_t key, const uint8_t* blob,
+                                uint64_t len) {
+  LogWriter* w = static_cast<LogWriter*>(wv);
+  std::vector<uint8_t>& r = w->rec;
+  r.clear();
+  r.push_back(kind);
+  put_u64(r, time);
+  put_u64(r, key);
+  put_u64(r, len);
+  r.insert(r.end(), blob, blob + len);
+  uint32_t crc = crc32(r.data(), r.size());
+  put_u32(r, crc);
+  return fwrite(r.data(), 1, r.size(), w->f) == r.size() ? 1 : 0;
+}
+
+PN_EXPORT int32_t pn_log_flush(void* wv) {
+  LogWriter* w = static_cast<LogWriter*>(wv);
+  if (fflush(w->f) != 0) return 0;
+#if !defined(_WIN32)
+  // fsync for durability across process crashes
+  if (fileno(w->f) >= 0) fsync(fileno(w->f));
+#endif
+  return 1;
+}
+
+PN_EXPORT void pn_log_close_write(void* wv) {
+  LogWriter* w = static_cast<LogWriter*>(wv);
+  if (w->f) fclose(w->f);
+  delete w;
+}
+
+PN_EXPORT void* pn_log_open_read(const char* path) {
+  LogReader* r = new LogReader();
+  r->f = fopen(path, "rb");
+  if (!r->f) {
+    delete r;
+    return nullptr;
+  }
+  char m[8];
+  if (fread(m, 1, 8, r->f) != 8 || memcmp(m, kMagic, 8) != 0) {
+    fclose(r->f);
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+// Returns 1 on a valid record, 0 on EOF or first corrupt/torn record.
+PN_EXPORT int32_t pn_log_next(void* rv, uint8_t* kind, uint64_t* time,
+                              uint64_t* key, const uint8_t** ptr,
+                              uint64_t* len) {
+  LogReader* r = static_cast<LogReader*>(rv);
+  uint8_t head[25];
+  if (fread(head, 1, 25, r->f) != 25) return 0;
+  uint64_t blen;
+  memcpy(&blen, head + 17, 8);
+  if (blen > (1ULL << 31)) return 0;  // implausible; treat as corruption
+  try {
+    r->blob.resize(blen);
+  } catch (const std::bad_alloc&) {
+    return 0;  // corrupt length field; never throw across the C ABI
+  }
+  if (blen && fread(r->blob.data(), 1, blen, r->f) != blen) return 0;
+  uint32_t crc_stored;
+  if (fread(&crc_stored, 1, 4, r->f) != 4) return 0;
+  std::vector<uint8_t> whole(head, head + 25);
+  whole.insert(whole.end(), r->blob.begin(), r->blob.end());
+  if (crc32(whole.data(), whole.size()) != crc_stored) return 0;
+  *kind = head[0];
+  memcpy(time, head + 1, 8);
+  memcpy(key, head + 9, 8);
+  *ptr = r->blob.data();
+  *len = blen;
+  return 1;
+}
+
+PN_EXPORT void pn_log_close_read(void* rv) {
+  LogReader* r = static_cast<LogReader*>(rv);
+  if (r->f) fclose(r->f);
+  delete r;
+}
+
+// ---- store <-> log bridges: full-state snapshot without touching Python ----
+
+// Writes every (key, blob) of the store as records with the given kind/time.
+// Returns the number of records written, or -1 on IO error.
+PN_EXPORT int64_t pn_store_snapshot(void* sv, void* wv, uint8_t kind,
+                                    uint64_t time) {
+  Store* s = static_cast<Store*>(sv);
+  int64_t n = 0;
+  for (const auto& kvp : s->map) {
+    if (!pn_log_append(wv, kind, time, kvp.first,
+                       reinterpret_cast<const uint8_t*>(kvp.second.data()),
+                       kvp.second.size()))
+      return -1;
+    ++n;
+  }
+  return n;
+}
+
+// Loads records of `kind` from the reader into the store (upsert per key).
+// Returns number loaded.
+PN_EXPORT int64_t pn_store_load(void* sv, void* rv, uint8_t want_kind) {
+  Store* s = static_cast<Store*>(sv);
+  uint8_t kind;
+  uint64_t time, key, len;
+  const uint8_t* ptr;
+  int64_t n = 0;
+  while (pn_log_next(rv, &kind, &time, &key, &ptr, &len)) {
+    if (kind != want_kind) continue;
+    s->map[key].assign(reinterpret_cast<const char*>(ptr), len);
+    ++n;
+  }
+  return n;
+}
+
+// ===========================================================================
+// Batch key kernels (shard routing)
+// ===========================================================================
+
+PN_EXPORT void pn_hash64_batch(const uint64_t* in, uint64_t n, uint64_t* out) {
+  for (uint64_t i = 0; i < n; ++i) out[i] = splitmix64(in[i]);
+}
+
+// shard = (key & mask) % n_shards  (reference shard.rs:15-20 + value.rs:38)
+PN_EXPORT void pn_shard_batch(const uint64_t* keys, uint64_t n, uint64_t mask,
+                              uint32_t n_shards, uint32_t* out) {
+  for (uint64_t i = 0; i < n; ++i)
+    out[i] = static_cast<uint32_t>((keys[i] & mask) % n_shards);
+}
+
+// Partition a batch of packed updates by shard: input packed records
+// [u64 key][u32 idx] ... output Buf: for each shard s in 0..n_shards,
+// [u32 count][count × u32 idx].  Used by the multi-worker router to
+// scatter one ingest batch to per-worker queues in one pass.
+PN_EXPORT void* pn_route_batch(const uint64_t* keys, const uint32_t* idxs,
+                               uint64_t n, uint64_t mask, uint32_t n_shards) {
+  std::vector<std::vector<uint32_t>> parts(n_shards);
+  for (uint64_t i = 0; i < n; ++i)
+    parts[(keys[i] & mask) % n_shards].push_back(idxs[i]);
+  Buf* out = new Buf();
+  for (uint32_t s = 0; s < n_shards; ++s) {
+    put_u32(out->data, static_cast<uint32_t>(parts[s].size()));
+    for (uint32_t idx : parts[s]) put_u32(out->data, idx);
+  }
+  return out;
+}
+
+PN_EXPORT const char* pn_version() { return "pathway-native 1.0"; }

@@ -30,6 +30,10 @@ import numpy as np
 from .reducers import Reducer
 from .value import ERROR, Error, Pointer, ref_scalar, rows_equal, values_equal
 
+# Eager import so the one-time g++ build of the native runtime happens at
+# engine load, never mid-epoch inside the hot loop.
+from .. import native as _native
+
 # Update = (key: int, row: tuple, diff: int)
 Update = tuple
 
@@ -42,7 +46,12 @@ class EngineError(Exception):
 
 def consolidate(updates: list[Update]) -> list[Update]:
     """Merge updates per (key, row): sum diffs, drop zeros. Preserves
-    retract-before-insert ordering per key."""
+    retract-before-insert ordering per key. Large batches go through the
+    C++ kernel (native/pathway_native.cc pn_consolidate)."""
+    if len(updates) >= 64:
+        out = _native.consolidate_native(updates)
+        if out is not None:
+            return out
     by_key: dict[int, list[list]] = {}
     order: list[int] = []
     for key, row, diff in updates:

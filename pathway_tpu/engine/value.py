@@ -159,8 +159,13 @@ def wrap_py_object(value: Any) -> PyObjectWrapper:
     return PyObjectWrapper(value)
 
 
-def _serialize_for_hash(value: Any, out: bytearray) -> None:
-    """Stable byte serialization of a value for key derivation."""
+def _serialize_for_hash(value: Any, out: bytearray) -> bool:
+    """Stable byte serialization of a value for key derivation.
+
+    Returns True when the serialization is *exact* — byte equality of two
+    serializations coincides with `values_equal` — and False when a lossy
+    fallback (repr of an arbitrary object) was used. Consolidation only
+    trusts byte-grouping for exact rows (see native.consolidate_native)."""
     if value is None:
         out += b"\x00"
     elif isinstance(value, Pointer):
@@ -171,7 +176,10 @@ def _serialize_for_hash(value: Any, out: bytearray) -> None:
         out += b"\x02" + struct.pack("<q", int(value))
     elif isinstance(value, (float, np.floating)):
         f = float(value)
-        if f.is_integer() and abs(f) < 2**62:
+        if f != f:
+            # canonical NaN: all payload bit-patterns serialize identically
+            out += b"\x03" + struct.pack("<d", float("nan"))
+        elif f.is_integer() and abs(f) < 2**62:
             # int/float hash consistency like python's numeric tower
             out += b"\x02" + struct.pack("<q", int(f))
         else:
@@ -182,20 +190,40 @@ def _serialize_for_hash(value: Any, out: bytearray) -> None:
     elif isinstance(value, bytes):
         out += b"\x05" + struct.pack("<I", len(value)) + value
     elif isinstance(value, (tuple, list)):
-        out += b"\x06" + struct.pack("<I", len(value))
+        # distinct tags: tuple vs list are != in python
+        out += (b"\x06" if isinstance(value, tuple) else b"\x0c") + struct.pack("<I", len(value))
+        exact = True
         for v in value:
-            _serialize_for_hash(v, out)
+            exact = _serialize_for_hash(v, out) and exact
+        return exact
     elif isinstance(value, np.ndarray):
+        if value.dtype == object:
+            # object arrays: element-wise recursion (tobytes would hash
+            # pointers — non-deterministic)
+            out += b"\x08o" + struct.pack("<I", value.ndim)
+            for dim in value.shape:
+                out += struct.pack("<q", dim)
+            exact = True
+            for v in value.ravel().tolist():
+                exact = _serialize_for_hash(v, out) and exact
+            return exact
         out += b"\x08" + value.tobytes() + str(value.dtype).encode()
         out += struct.pack("<I", value.ndim)
         for dim in value.shape:
             out += struct.pack("<q", dim)
     elif isinstance(value, (datetime.datetime, datetime.timedelta)):
+        # repr-based: byte equality implies ==, but not vice versa
+        # (equal instants under different tzinfo) → inexact
         out += b"\x09" + repr(value).encode()
+        return False
     elif isinstance(value, Json):
+        # repr-based: {'a': 1} vs {'a': 1.0} are == but repr-distinct
         out += b"\x0a" + repr(value).encode()
+        return False
     else:
         out += b"\x0b" + repr(value).encode()
+        return False
+    return True
 
 
 def ref_scalar(*values: Any, optional: bool = False) -> Pointer:
@@ -246,8 +274,30 @@ def hash_int_array(values: np.ndarray) -> np.ndarray:
 
 
 def values_equal(a: Any, b: Any) -> bool:
+    """Row-value equality. Defined to coincide with byte equality of
+    `_serialize_for_hash` on exact values, so the python and native
+    consolidation paths group rows identically: bool is distinct from
+    int, NaN == NaN (so stored NaN rows are retractable), arrays compare
+    bitwise with dtype+shape."""
+    if a is b:
+        return True
+    if isinstance(a, (bool, np.bool_)) != isinstance(b, (bool, np.bool_)):
+        return False
     if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
-        return isinstance(a, np.ndarray) and isinstance(b, np.ndarray) and a.shape == b.shape and bool(np.array_equal(a, b))
+        if not (isinstance(a, np.ndarray) and isinstance(b, np.ndarray)):
+            return False
+        if a.dtype != b.dtype or a.shape != b.shape:
+            return False
+        if a.dtype == object:
+            return all(values_equal(x, y) for x, y in zip(a.ravel().tolist(), b.ravel().tolist()))
+        return a.tobytes() == b.tobytes()
+    if isinstance(a, (float, np.floating)) and a != a:
+        return isinstance(b, (float, np.floating)) and b != b
+    if isinstance(a, (tuple, list)) and isinstance(b, (tuple, list)):
+        # recurse so nested bool/NaN/array semantics match the serializer
+        return type(a) is type(b) and len(a) == len(b) and all(
+            values_equal(x, y) for x, y in zip(a, b)
+        )
     return a == b
 
 

@@ -1,0 +1,302 @@
+"""ctypes bindings for the C++ native runtime (native/pathway_native.cc).
+
+The native library is the host-side state/persistence engine — the
+TPU-native counterpart of the reference's Rust engine state layer
+(/root/reference/src/engine/dataflow.rs arrangements,
+/root/reference/src/persistence/). Built on demand with g++ into
+pathway_tpu/_native/ and cached; everything degrades to pure-Python
+fallbacks if the toolchain is missing (`NATIVE` is None then).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import sys
+import threading
+from typing import Any, Iterator
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(_HERE), "native", "pathway_native.cc")
+_OUT_DIR = os.path.join(_HERE, "_native")
+_LIB_PATH = os.path.join(_OUT_DIR, "libpathway_native.so")
+
+_build_lock = threading.Lock()
+
+
+def _build() -> str | None:
+    if not os.path.exists(_SRC):
+        return None
+    with _build_lock:
+        if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+            return _LIB_PATH
+        os.makedirs(_OUT_DIR, exist_ok=True)
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+            "-o", _LIB_PATH + ".tmp", _SRC,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+            print(f"pathway_tpu: native build failed ({e}); using python fallbacks", file=sys.stderr)
+            return None
+        os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+        return _LIB_PATH
+
+
+def _load() -> ctypes.CDLL | None:
+    if os.environ.get("PATHWAY_DISABLE_NATIVE"):
+        return None
+    path = _build()  # no-op when the .so is newer than the source
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    sigs = {
+        "pn_store_new": ([], ctypes.c_void_p),
+        "pn_store_free": ([ctypes.c_void_p], None),
+        "pn_store_len": ([ctypes.c_void_p], ctypes.c_uint64),
+        "pn_store_upsert": ([ctypes.c_void_p, ctypes.c_uint64, u8p, ctypes.c_uint64], ctypes.c_int32),
+        "pn_store_remove": ([ctypes.c_void_p, ctypes.c_uint64], ctypes.c_int32),
+        "pn_store_get": ([ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64)], ctypes.c_int32),
+        "pn_store_contains": ([ctypes.c_void_p, ctypes.c_uint64], ctypes.c_int32),
+        "pn_store_clear": ([ctypes.c_void_p], None),
+        "pn_store_scratch": ([ctypes.c_void_p, ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64)], None),
+        "pn_store_iter_new": ([ctypes.c_void_p], ctypes.c_void_p),
+        "pn_store_iter_next": ([ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64)], ctypes.c_int32),
+        "pn_store_iter_free": ([ctypes.c_void_p], None),
+        "pn_consolidate": ([u8p, ctypes.c_uint64], ctypes.c_void_p),
+        "pn_buf_read": ([ctypes.c_void_p, ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64)], None),
+        "pn_buf_free": ([ctypes.c_void_p], None),
+        "pn_log_open_write": ([ctypes.c_char_p, ctypes.c_int32], ctypes.c_void_p),
+        "pn_log_append": ([ctypes.c_void_p, ctypes.c_uint8, ctypes.c_uint64, ctypes.c_uint64, u8p, ctypes.c_uint64], ctypes.c_int32),
+        "pn_log_flush": ([ctypes.c_void_p], ctypes.c_int32),
+        "pn_log_close_write": ([ctypes.c_void_p], None),
+        "pn_log_open_read": ([ctypes.c_char_p], ctypes.c_void_p),
+        "pn_log_next": ([ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64)], ctypes.c_int32),
+        "pn_log_close_read": ([ctypes.c_void_p], None),
+        "pn_store_snapshot": ([ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint8, ctypes.c_uint64], ctypes.c_int64),
+        "pn_store_load": ([ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint8], ctypes.c_int64),
+        "pn_hash64_batch": ([ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)], None),
+        "pn_shard_batch": ([ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)], None),
+        "pn_version": ([], ctypes.c_char_p),
+    }
+    try:
+        for name, (argtypes, restype) in sigs.items():
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = restype
+    except AttributeError as e:
+        # stale/foreign .so missing a symbol: fall back to python paths
+        print(f"pathway_tpu: native lib missing symbol ({e}); using python fallbacks", file=sys.stderr)
+        return None
+    return lib
+
+
+NATIVE: ctypes.CDLL | None = _load()
+
+
+def is_available() -> bool:
+    return NATIVE is not None
+
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _as_u8p(b: bytes):
+    return ctypes.cast(ctypes.c_char_p(b), _u8p)
+
+
+class NativeStore:
+    """dict-like uint64 -> python-object store backed by the C++ blob
+    store; values are pickled. Snapshottable to a SnapshotLog without
+    per-row Python (pn_store_snapshot)."""
+
+    __slots__ = ("_h",)
+
+    def __init__(self):
+        self._h = NATIVE.pn_store_new()
+
+    def __del__(self):
+        if NATIVE is not None and getattr(self, "_h", None):
+            NATIVE.pn_store_free(self._h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return NATIVE.pn_store_len(self._h)
+
+    def __contains__(self, key: int) -> bool:
+        return bool(NATIVE.pn_store_contains(self._h, ctypes.c_uint64(int(key) & 0xFFFFFFFFFFFFFFFF)))
+
+    def __setitem__(self, key: int, value: Any) -> None:
+        blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        NATIVE.pn_store_upsert(self._h, ctypes.c_uint64(int(key) & 0xFFFFFFFFFFFFFFFF), _as_u8p(blob), len(blob))
+
+    def __getitem__(self, key: int) -> Any:
+        v = self.get(key, _MISSING)
+        if v is _MISSING:
+            raise KeyError(key)
+        return v
+
+    def get(self, key: int, default: Any = None) -> Any:
+        ptr = _u8p()
+        length = ctypes.c_uint64()
+        ok = NATIVE.pn_store_get(self._h, ctypes.c_uint64(int(key) & 0xFFFFFFFFFFFFFFFF), ctypes.byref(ptr), ctypes.byref(length))
+        if not ok:
+            return default
+        return pickle.loads(ctypes.string_at(ptr, length.value))
+
+    def pop(self, key: int, default: Any = None) -> Any:
+        ok = NATIVE.pn_store_remove(self._h, ctypes.c_uint64(int(key) & 0xFFFFFFFFFFFFFFFF))
+        if not ok:
+            return default
+        ptr = _u8p()
+        length = ctypes.c_uint64()
+        NATIVE.pn_store_scratch(self._h, ctypes.byref(ptr), ctypes.byref(length))
+        return pickle.loads(ctypes.string_at(ptr, length.value))
+
+    def clear(self) -> None:
+        NATIVE.pn_store_clear(self._h)
+
+    def items(self) -> Iterator[tuple[int, Any]]:
+        it = NATIVE.pn_store_iter_new(self._h)
+        try:
+            key = ctypes.c_uint64()
+            ptr = _u8p()
+            length = ctypes.c_uint64()
+            while NATIVE.pn_store_iter_next(it, ctypes.byref(key), ctypes.byref(ptr), ctypes.byref(length)):
+                yield key.value, pickle.loads(ctypes.string_at(ptr, length.value))
+        finally:
+            NATIVE.pn_store_iter_free(it)
+
+    def keys(self) -> Iterator[int]:
+        for k, _ in self.items():
+            yield k
+
+    def __iter__(self) -> Iterator[int]:
+        return self.keys()
+
+    def snapshot_to(self, log: "SnapshotLogWriter", kind: int, time: int) -> int:
+        n = NATIVE.pn_store_snapshot(self._h, log._h, kind, ctypes.c_uint64(time))
+        if n < 0:
+            raise OSError("native snapshot write failed")
+        return n
+
+    def load_from(self, log: "SnapshotLogReader", kind: int) -> int:
+        return NATIVE.pn_store_load(self._h, log._h, kind)
+
+
+class _Missing:
+    pass
+
+
+_MISSING = _Missing()
+
+
+class SnapshotLogWriter:
+    """CRC-checked append-only log (native). Record: (kind, time, key, blob)."""
+
+    def __init__(self, path: str, append: bool = True):
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+        self._h = NATIVE.pn_log_open_write(path.encode(), 1 if append else 0)
+        if not self._h:
+            raise OSError(f"cannot open snapshot log for write: {path}")
+
+    def append(self, kind: int, time: int, key: int, blob: bytes) -> None:
+        ok = NATIVE.pn_log_append(
+            self._h, kind, ctypes.c_uint64(time), ctypes.c_uint64(int(key) & 0xFFFFFFFFFFFFFFFF), _as_u8p(blob), len(blob)
+        )
+        if not ok:
+            raise OSError("snapshot log append failed")
+
+    def append_obj(self, kind: int, time: int, key: int, obj: Any) -> None:
+        self.append(kind, time, key, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+
+    def flush(self) -> None:
+        NATIVE.pn_log_flush(self._h)
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            NATIVE.pn_log_close_write(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class SnapshotLogReader:
+    """Reads records until EOF or the first torn/corrupt record."""
+
+    def __init__(self, path: str):
+        self._h = NATIVE.pn_log_open_read(path.encode())
+        if not self._h:
+            raise FileNotFoundError(path)
+
+    def __iter__(self) -> Iterator[tuple[int, int, int, bytes]]:
+        kind = ctypes.c_uint8()
+        time = ctypes.c_uint64()
+        key = ctypes.c_uint64()
+        ptr = _u8p()
+        length = ctypes.c_uint64()
+        while NATIVE.pn_log_next(self._h, ctypes.byref(kind), ctypes.byref(time), ctypes.byref(key), ctypes.byref(ptr), ctypes.byref(length)):
+            yield kind.value, time.value, key.value, ctypes.string_at(ptr, length.value)
+
+    def iter_objects(self) -> Iterator[tuple[int, int, int, Any]]:
+        for kind, time, key, blob in self:
+            yield kind, time, key, pickle.loads(blob)
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            NATIVE.pn_log_close_read(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def consolidate_native(updates: list) -> list | None:
+    """Native consolidation. `updates` is a list of (key, row, diff);
+    returns the consolidated list, or None if native is unavailable or a
+    row is not exactly byte-serializable (arbitrary-object fallback —
+    caller must then use the python path, whose `rows_equal` honors
+    user-defined __eq__). On exact rows, byte equality of the canonical
+    serialization coincides with values_equal, so grouping matches."""
+    if NATIVE is None:
+        return None
+    from .engine.value import _serialize_for_hash
+
+    packed = bytearray()
+    import struct
+
+    for idx, (key, row, diff) in enumerate(updates):
+        canon = bytearray()
+        if not _serialize_for_hash(row, canon):
+            return None
+        packed += struct.pack("<QqII", int(key) & 0xFFFFFFFFFFFFFFFF, diff, idx, len(canon))
+        packed += canon
+    buf = NATIVE.pn_consolidate(_as_u8p(bytes(packed)), len(packed))
+    ptr = _u8p()
+    length = ctypes.c_uint64()
+    NATIVE.pn_buf_read(buf, ctypes.byref(ptr), ctypes.byref(length))
+    raw = ctypes.string_at(ptr, length.value)
+    NATIVE.pn_buf_free(buf)
+    (n,) = struct.unpack_from("<I", raw, 0)
+    out = []
+    off = 4
+    for _ in range(n):
+        idx, diff = struct.unpack_from("<Iq", raw, off)
+        off += 12
+        key, row, _ = updates[idx]
+        out.append((key, row, diff))
+    return out

@@ -177,11 +177,16 @@ def prompt_citing_qa(context: str, query: str, additional_rules: str = "") -> st
 
 
 def parse_cited_response(response_text: str, docs: list[dict]) -> tuple[str, list[dict]]:
-    """Split '<answer> [1][3]' into the answer and the cited docs."""
+    """Split '<answer> [1][3]' into the answer and the cited docs.
+
+    Citation ids are 1-based (sources are presented numbered from 1); a
+    literal [0] switches to 0-based interpretation."""
     cited = re.findall(r"\[(\d+)\]", response_text)
     answer = re.sub(r"\s*\[\d+\]", "", response_text).strip()
     cited_ids = {int(c) for c in cited}
-    cited_docs = [d for i, d in enumerate(docs) if i in cited_ids or i + 1 in cited_ids]
+    if 0 not in cited_ids:
+        cited_ids = {c - 1 for c in cited_ids}
+    cited_docs = [d for i, d in enumerate(docs) if i in cited_ids]
     return answer, cited_docs
 
 

@@ -46,9 +46,21 @@ class LLMReranker(udfs.UDF):
         cache_strategy: udfs.CacheStrategy | None = None,
         use_logit_bias: bool | None = None,
     ):
-        super().__init__(cache_strategy=cache_strategy)
+        super().__init__(
+            executor=(
+                udfs.async_executor(retry_strategy=retry_strategy)
+                if retry_strategy is not None
+                else None
+            ),
+            cache_strategy=cache_strategy,
+        )
         self.llm = llm
+        if use_logit_bias is None:
+            use_logit_bias = getattr(llm, "_accepts_call_arg", lambda _a: False)("logit_bias")
         self.use_logit_bias = use_logit_bias
+        # bias toward the digit tokens "1".."5" (cl100k ids 16-20), the
+        # reference's rating constraint (rerankers.py:140)
+        self.number_biases = {str(tok): 50 for tok in range(16, 21)}
 
     def _build_prompt(self, doc: str, query: str) -> list[dict]:
         return [
@@ -73,6 +85,8 @@ class LLMReranker(udfs.UDF):
         fn = self.llm.func if self.llm.func is not None else self.llm.__wrapped__
         from ._utils import _coerce_sync
 
+        if self.use_logit_bias:
+            kwargs.setdefault("logit_bias", self.number_biases)
         response = _coerce_sync(fn)(Json(self._build_prompt(doc, query)), **kwargs)
         return float(self.get_first_number(response))
 
