@@ -496,21 +496,4 @@ PN_EXPORT void pn_shard_batch(const uint64_t* keys, uint64_t n, uint64_t mask,
     out[i] = static_cast<uint32_t>((keys[i] & mask) % n_shards);
 }
 
-// Partition a batch of packed updates by shard: input packed records
-// [u64 key][u32 idx] ... output Buf: for each shard s in 0..n_shards,
-// [u32 count][count × u32 idx].  Used by the multi-worker router to
-// scatter one ingest batch to per-worker queues in one pass.
-PN_EXPORT void* pn_route_batch(const uint64_t* keys, const uint32_t* idxs,
-                               uint64_t n, uint64_t mask, uint32_t n_shards) {
-  std::vector<std::vector<uint32_t>> parts(n_shards);
-  for (uint64_t i = 0; i < n; ++i)
-    parts[(keys[i] & mask) % n_shards].push_back(idxs[i]);
-  Buf* out = new Buf();
-  for (uint32_t s = 0; s < n_shards; ++s) {
-    put_u32(out->data, static_cast<uint32_t>(parts[s].size()));
-    for (uint32_t idx : parts[s]) put_u32(out->data, idx);
-  }
-  return out;
-}
-
 PN_EXPORT const char* pn_version() { return "pathway-native 1.0"; }

@@ -168,6 +168,26 @@ class InputSession:
         self._pending: list[Update] = []
         self._committed: list[list[Update]] = []
         self._closed = False
+        # reader-position bookmarks, snapshotted per commit so the offsets
+        # drained with a batch never run ahead of its data (reference
+        # connectors/offset.rs + SnapshotEvent::AdvanceTime)
+        self._offsets: dict = {}
+        self._committed_offsets: dict | None = None
+
+    def set_offset(self, key, value) -> None:
+        with self._lock:
+            if value is None:
+                self._offsets.pop(key, None)
+            else:
+                self._offsets[key] = value
+
+    def get_offsets(self) -> dict:
+        with self._lock:
+            return dict(self._offsets)
+
+    def restore_offsets(self, offsets: dict) -> None:
+        with self._lock:
+            self._offsets = dict(offsets)
 
     def insert(self, key: int, row: tuple) -> None:
         with self._lock:
@@ -187,6 +207,7 @@ class InputSession:
             if self._pending:
                 self._committed.append(self._pending)
                 self._pending = []
+            self._committed_offsets = dict(self._offsets)
         self.node.graph.wake()
 
     def close(self) -> None:
@@ -194,6 +215,7 @@ class InputSession:
             if self._pending:
                 self._committed.append(self._pending)
                 self._pending = []
+            self._committed_offsets = dict(self._offsets)
             self._closed = True
         self.node.graph.wake()
 
@@ -203,6 +225,7 @@ class InputSession:
                 return None
             batches = self._committed
             self._committed = []
+            self.node.last_offsets = self._committed_offsets
         return [u for b in batches for u in b]
 
     @property
@@ -222,9 +245,26 @@ class SessionSourceNode(Node):
         super().__init__(graph)
         self.session = InputSession(self)
         self.state: dict[int, tuple] = {}
+        self.persistent_id: str | None = None
+        self.last_offsets: dict | None = None
+        # recovery: finalized batches to replay, in time order
+        self.replay_batches: list[tuple[int, list[Update]]] = []
         graph.session_sources.append(self)
 
-    def feed_batch(self, raw: list[Update], time) -> None:
+    def next_replay_time(self):
+        return self.replay_batches[0][0] if self.replay_batches else None
+
+    def feed_replay(self, time) -> None:
+        while self.replay_batches and self.replay_batches[0][0] == time:
+            _, ups = self.replay_batches.pop(0)
+            for key, row, diff in ups:
+                if diff > 0:
+                    self.state[key] = row
+                else:
+                    self.state.pop(key, None)
+            self.emit(list(ups), time)
+
+    def feed_batch(self, raw: list[Update], time) -> list[Update]:
         out: list[Update] = []
         for key, row, diff in raw:
             if diff == 2:  # upsert marker
@@ -242,7 +282,9 @@ class SessionSourceNode(Node):
                     self.state[key] = row
                 else:
                     self.state.pop(key, None)
-        self.emit(consolidate(out), time)
+        resolved = consolidate(out)
+        self.emit(resolved, time)
+        return resolved
 
     def process(self, time):
         pass
@@ -1068,13 +1110,15 @@ class OutputNode(Node):
         self._saw_data = True
         if self.sort_by_key:
             updates = sorted(updates, key=lambda u: (u[0], u[2]))
-        if self.on_change is not None:
+        # recovered epochs rebuild state but are not re-delivered to sinks
+        # (exactly-once across restarts, reference persistence semantics)
+        if self.on_change is not None and time > self.graph.replay_frontier:
             for key, row, diff in updates:
                 self.on_change(key, row, time, diff)
         self.emit(updates, time)
 
     def time_end(self, time):
-        if self.on_time_end_cb is not None:
+        if self.on_time_end_cb is not None and time > self.graph.replay_frontier:
             self.on_time_end_cb(time)
 
     def on_end(self):
@@ -1154,6 +1198,11 @@ class EngineGraph:
         self._async_loop = None
         self._stop = False
         self.connector_threads: list[threading.Thread] = []
+        # checkpoint/recovery (engine/persistence.py); epochs at or below
+        # replay_frontier are recovered state: rebuilt, not re-emitted
+        self.persistence_config = None
+        self.persistence = None
+        self.replay_frontier = -1
 
     # --- builder helpers used by the graph runner ---
 
@@ -1194,23 +1243,50 @@ class EngineGraph:
         for node in self.nodes:
             node.on_frontier(frontier)
 
+    def _setup_persistence(self) -> None:
+        from .persistence import EnginePersistence
+
+        self.persistence = EnginePersistence(self.persistence_config)
+        frontier = -1
+        for s in self.session_sources:
+            if s.persistent_id is None:
+                continue
+            batches, offsets, f = self.persistence.recover_source(s.persistent_id)
+            s.replay_batches = list(batches)
+            s.session.restore_offsets(offsets)
+            frontier = max(frontier, f)
+        self.replay_frontier = frontier
+
     def run(self, monitoring_callback: Callable | None = None) -> None:
-        """Run to completion: process scripted batches in time order, then
-        live sessions until all close."""
+        """Run to completion: replay recovered epochs, then process
+        scripted batches in time order, then live sessions until all
+        close."""
+        if self.persistence_config is not None:
+            self._setup_persistence()
         for t in self.connector_threads:
             t.start()
         last_time = -1
         while not self._stop:
-            # next scripted time across static sources
+            # next scripted time: static sources + recovery replay queues
             times = [s.next_time() for s in self.static_sources]
+            replay_pending = False
+            for s in self.session_sources:
+                rt = s.next_replay_time()
+                if rt is not None:
+                    times.append(rt)
+                    replay_pending = True
             times = [t for t in times if t is not None]
             scripted_t = min(times) if times else None
 
             session_batches = []
-            for s in self.session_sources:
-                b = s.session.drain()
-                if b:
-                    session_batches.append((s, b))
+            if not replay_pending:
+                # live epochs must land strictly past the recovered frontier
+                if last_time < self.replay_frontier:
+                    last_time = self.replay_frontier
+                for s in self.session_sources:
+                    b = s.session.drain()
+                    if b:
+                        session_batches.append((s, b))
 
             if scripted_t is None and not session_batches:
                 if all(s.session.closed for s in self.session_sources):
@@ -1228,9 +1304,17 @@ class EngineGraph:
             self._frontier_hooks(t)
             for s in self.static_sources:
                 s.feed(t)
+            for s in self.session_sources:
+                s.feed_replay(t)
             for s, b in session_batches:
-                s.feed_batch(b, t)
+                resolved = s.feed_batch(b, t)
+                if self.persistence is not None and s.persistent_id is not None and resolved:
+                    self.persistence.log_batch(s.persistent_id, t, resolved)
             self._topo_pass(t)
+            if self.persistence is not None:
+                for s, _b in session_batches:
+                    if s.persistent_id is not None:
+                        self.persistence.advance(s.persistent_id, t, s.last_offsets or {})
             last_time = t
             if monitoring_callback is not None:
                 monitoring_callback(self)
@@ -1242,6 +1326,8 @@ class EngineGraph:
             self._topo_pass(self.current_time)
         for node in self.nodes:
             node.on_end()
+        if self.persistence is not None:
+            self.persistence.close()
         for t in self.connector_threads:
             t.join(timeout=5.0)
 

@@ -58,11 +58,30 @@ class StreamingContext:
         self.dtypes = schema.dtypes()
         self.names = list(self.dtypes.keys())
         self.pk = schema.primary_key_columns()
-        self._seq = [0]
+        # lazy: offsets are restored from the persistence log after
+        # construction but before reader threads start
+        self._seq: list[int] | None = None
         self._deletions: dict[int, tuple] = {}
 
+    @property
+    def offsets(self) -> dict:
+        """Reader bookmarks restored from the persistence log (empty on a
+        fresh run). Readers use these to skip already-ingested input."""
+        return self.session.get_offsets()
+
+    def set_offset(self, key, value) -> None:
+        """Record a reader bookmark; snapshotted atomically with the next
+        commit (reference connectors/offset.rs semantics)."""
+        self.session.set_offset(key, value)
+
+    def _seq_counter(self) -> list[int]:
+        if self._seq is None:
+            self._seq = [int(self.session.get_offsets().get("__seq__", 0))]
+        return self._seq
+
     def insert(self, values: dict) -> None:
-        key = make_key(self.names, self.pk, values, self._seq)
+        seq = self._seq_counter()
+        key = make_key(self.names, self.pk, values, seq)
         row = coerce_to_schema(values, self.dtypes)
         if self.pk:
             self.session.upsert(key, row)
@@ -70,14 +89,25 @@ class StreamingContext:
         else:
             self.session.insert(key, row)
             self._deletions[key] = row
+        self.session.set_offset("__seq__", seq[0])
 
     def remove(self, values: dict) -> None:
-        key = make_key(self.names, self.pk, values, self._seq)
+        key = make_key(self.names, self.pk, values, self._seq_counter())
         if self.pk:
             self.session.upsert(key, None)
         else:
             row = coerce_to_schema(values, self.dtypes)
             self.session.remove(key, row)
+
+    def upsert_keyed(self, key_parts: tuple, values: dict | None) -> None:
+        """Upsert at an explicit key derived from ``key_parts`` (None
+        values = delete). Lets readers speak a snapshot protocol with
+        stable keys, e.g. (path, line_no) for file scanners."""
+        key = int(ref_scalar(*key_parts))
+        if values is None:
+            self.session.upsert(key, None)
+        else:
+            self.session.upsert(key, coerce_to_schema(values, self.dtypes))
 
     def commit(self) -> None:
         self.session.commit()
@@ -92,14 +122,18 @@ def input_table_from_reader(
     *,
     name: str = "connector",
     autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
 ) -> Table:
     """Create an input Table whose rows are produced by `reader(ctx)`
-    running on a named thread (reference reader threads mod.rs:447)."""
+    running on a named thread (reference reader threads mod.rs:447).
+    With ``persistent_id`` set and a persistence config on the run, the
+    source's committed batches are logged for checkpoint/recovery."""
 
     dtypes = schema.dtypes()
 
     def build(engine: df.EngineGraph, runner) -> df.Node:
         node = df.SessionSourceNode(engine)
+        node.persistent_id = persistent_id
         ctx = StreamingContext(node.session, schema)
 
         def run():

@@ -133,6 +133,7 @@ def read(
     object_pattern: str = "*",
     autocommit_duration_ms: int | None = 1500,
     name: str = "fs",
+    persistent_id: str | None = None,
     **kwargs,
 ) -> Table:
     if schema is None:
@@ -151,9 +152,15 @@ def read(
             rows.extend(_rows_for_file(fpath, format, schema, with_metadata, **kwargs))
         return static_table_from_rows(schema, rows, name=f"fs:{path}")
 
-    # streaming: watch for file additions / modifications / deletions
+    # streaming: watch for file additions / modifications / deletions.
+    # Rows are keyed (path, index) so changes are plain upserts and the
+    # scanner's bookmark is just {path: (mtime, n_rows)} — persisted as
+    # connector offsets, so a recovered run skips unchanged files
+    # (reference scanner/filesystem.rs seen-file metadata).
     def reader(ctx: StreamingContext) -> None:
-        known: dict[str, tuple[float, list[dict]]] = {}
+        known: dict[str, tuple[float, int]] = {
+            p: tuple(v) for p, v in ctx.offsets.items() if isinstance(p, str) and p != "__seq__"
+        }
         while True:
             current = _list_files(path, object_pattern)
             changed = False
@@ -165,18 +172,21 @@ def read(
                 old = known.get(fpath)
                 if old is not None and old[0] == mtime:
                     continue
-                if old is not None:
-                    for row in old[1]:
-                        ctx.remove(row)
+                old_n = old[1] if old is not None else 0
                 rows = list(_rows_for_file(fpath, format, schema, with_metadata, **kwargs))
-                for row in rows:
-                    ctx.insert(row)
-                known[fpath] = (mtime, rows)
+                for i, row in enumerate(rows):
+                    ctx.upsert_keyed((fpath, i), row)
+                for i in range(len(rows), old_n):
+                    ctx.upsert_keyed((fpath, i), None)
+                known[fpath] = (mtime, len(rows))
+                ctx.set_offset(fpath, known[fpath])
                 changed = True
             for fpath in list(known):
                 if fpath not in current:
-                    for row in known.pop(fpath)[1]:
-                        ctx.remove(row)
+                    _mtime, old_n = known.pop(fpath)
+                    for i in range(old_n):
+                        ctx.upsert_keyed((fpath, i), None)
+                    ctx.set_offset(fpath, None)
                     changed = True
             if changed:
                 ctx.commit()
@@ -185,7 +195,11 @@ def read(
             time.sleep(_POLL_INTERVAL_S)
 
     return input_table_from_reader(
-        schema, reader, name=f"fs:{path}", autocommit_duration_ms=autocommit_duration_ms
+        schema,
+        reader,
+        name=f"fs:{path}",
+        autocommit_duration_ms=autocommit_duration_ms,
+        persistent_id=persistent_id,
     )
 
 

@@ -54,6 +54,16 @@ class ConnectorSubject:
         assert self._ctx is not None
         self._ctx.commit()
 
+    @property
+    def offsets(self) -> dict:
+        """Recovered reader bookmarks (persistence); empty on fresh runs."""
+        assert self._ctx is not None
+        return self._ctx.offsets
+
+    def set_offset(self, key, value) -> None:
+        assert self._ctx is not None
+        self._ctx.set_offset(key, value)
+
     def close(self) -> None:
         pass
 
@@ -74,6 +84,7 @@ def read(
     schema: type[Schema],
     autocommit_duration_ms: int | None = 1500,
     name: str = "python",
+    persistent_id: str | None = None,
     **kwargs,
 ) -> Table:
     def reader(ctx: StreamingContext) -> None:
@@ -96,7 +107,11 @@ def read(
             ctx.commit()
 
     return input_table_from_reader(
-        schema, reader, name=name, autocommit_duration_ms=autocommit_duration_ms
+        schema,
+        reader,
+        name=name,
+        autocommit_duration_ms=autocommit_duration_ms,
+        persistent_id=persistent_id,
     )
 
 

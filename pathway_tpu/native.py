@@ -30,20 +30,23 @@ def _build() -> str | None:
     if not os.path.exists(_SRC):
         return None
     with _build_lock:
-        if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
-            return _LIB_PATH
-        os.makedirs(_OUT_DIR, exist_ok=True)
-        cmd = [
-            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-            "-o", _LIB_PATH + ".tmp", _SRC,
-        ]
         try:
+            if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+                return _LIB_PATH
+            os.makedirs(_OUT_DIR, exist_ok=True)
+            # pid-unique temp + atomic replace: concurrent processes (e.g.
+            # pytest-xdist on a fresh checkout) each build their own copy
+            # and the last replace wins with a complete .so
+            tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB_PATH)
+            return _LIB_PATH
         except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+            # includes read-only installs (makedirs/replace PermissionError):
+            # import must survive and fall back to the python paths
             print(f"pathway_tpu: native build failed ({e}); using python fallbacks", file=sys.stderr)
             return None
-        os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
-        return _LIB_PATH
 
 
 def _load() -> ctypes.CDLL | None:
