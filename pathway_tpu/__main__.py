@@ -1,0 +1,5 @@
+"""``python -m pathway_tpu`` → the pathway CLI (cli.py)."""
+
+from .cli import main
+
+main()

@@ -1,0 +1,116 @@
+"""``pathway`` command-line interface.
+
+Rebuild of /root/reference/python/pathway/cli.py: ``spawn`` launches a
+program as N OS processes with the PATHWAY_* worker-topology env vars
+(reference cli.py:53-110; engine config src/engine/dataflow/config.rs:
+88-120), ``spawn-from-env`` re-reads the spawn arguments from
+PATHWAY_SPAWN_ARGS, and ``--record``/``--replay`` wire stream
+record/replay through env (reference cli.py:166-193). In the TPU build
+each spawned process is one host of the slice: processes join a global
+``jax.sharding.Mesh`` via ``jax.distributed`` (see
+pathway_tpu/parallel/sharding.py host_mesh_from_env).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+
+import click
+
+
+@click.group()
+def cli() -> None:
+    """Pathway-TPU command line."""
+
+
+def _spawn_program(
+    threads: int,
+    processes: int,
+    first_port: int,
+    record: bool,
+    record_path: str | None,
+    replay_mode: str | None,
+    program: tuple[str, ...],
+) -> int:
+    argv = list(program)
+    if not argv:
+        raise click.UsageError("no program given")
+    if argv[0].endswith(".py"):
+        argv = [sys.executable] + argv
+    env_base = os.environ.copy()
+    env_base["PATHWAY_THREADS"] = str(threads)
+    env_base["PATHWAY_PROCESSES"] = str(processes)
+    env_base["PATHWAY_FIRST_PORT"] = str(first_port)
+    env_base["PATHWAY_SPAWN_ARGS"] = shlex.join(
+        [f"--threads={threads}", f"--processes={processes}", f"--first-port={first_port}"]
+        + (["--record"] if record else [])
+        + ([f"--record-path={record_path}"] if record_path else [])
+        + ([f"--replay-mode={replay_mode}"] if replay_mode else [])
+        + list(program)
+    )
+    if record or replay_mode:
+        env_base["PATHWAY_REPLAY_STORAGE"] = record_path or "./record"
+        env_base["PATHWAY_REPLAY_MODE"] = replay_mode or "record"
+
+    procs: list[subprocess.Popen] = []
+    for pid in range(processes):
+        env = dict(env_base)
+        env["PATHWAY_PROCESS_ID"] = str(pid)
+        procs.append(subprocess.Popen(argv, env=env))
+    rc = 0
+    try:
+        for p in procs:
+            code = p.wait()
+            if code and not rc:
+                rc = code
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        rc = 130
+    return rc
+
+
+@cli.command(
+    context_settings={"allow_extra_args": True, "ignore_unknown_options": True}
+)
+@click.option("--threads", "-t", default=1, show_default=True, help="worker threads per process")
+@click.option("--processes", "-n", default=1, show_default=True, help="OS processes (hosts of the mesh)")
+@click.option("--first-port", default=10000, show_default=True, help="base port for inter-process coordination")
+@click.option("--record", is_flag=True, help="record input streams to --record-path for later replay")
+@click.option("--record-path", default=None, help="stream record/replay storage directory")
+@click.option(
+    "--replay-mode",
+    default=None,
+    type=click.Choice(["batch", "speedrun"]),
+    help="replay previously recorded streams instead of reading sources",
+)
+@click.argument("program", nargs=-1, required=True)
+def spawn(threads, processes, first_port, record, record_path, replay_mode, program):
+    """Run PROGRAM with a pathway worker topology, e.g.:
+
+    pathway spawn --threads 2 --processes 4 my_pipeline.py
+    """
+    sys.exit(
+        _spawn_program(threads, processes, first_port, record, record_path, replay_mode, program)
+    )
+
+
+@cli.command(name="spawn-from-env")
+def spawn_from_env():
+    """Re-run ``spawn`` with arguments taken from PATHWAY_SPAWN_ARGS
+    (reference cli.py spawn-from-env; used by container deployments)."""
+    raw = os.environ.get("PATHWAY_SPAWN_ARGS", "")
+    if not raw:
+        raise click.UsageError("PATHWAY_SPAWN_ARGS is not set")
+    spawn.main(args=shlex.split(raw), standalone_mode=True)
+
+
+def main() -> None:
+    cli()
+
+
+if __name__ == "__main__":
+    main()

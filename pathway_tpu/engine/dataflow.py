@@ -189,18 +189,25 @@ class InputSession:
         with self._lock:
             self._offsets = dict(offsets)
 
-    def insert(self, key: int, row: tuple) -> None:
+    def insert(self, key: int, row: tuple, offsets: dict | None = None) -> None:
         with self._lock:
             self._pending.append((key, row, 1))
+            if offsets:
+                self._offsets.update(offsets)
 
     def remove(self, key: int, row: tuple) -> None:
         with self._lock:
             self._pending.append((key, row, -1))
 
-    def upsert(self, key: int, row: tuple | None) -> None:
-        """Replace the current row at key (None row = delete)."""
+    def upsert(self, key: int, row: tuple | None, offsets: dict | None = None) -> None:
+        """Replace the current row at key (None row = delete). ``offsets``
+        update atomically with the row: a concurrent commit() must never
+        snapshot offsets that run ahead of (or behind) the data in the
+        batch, or recovery double-reads/skips input."""
         with self._lock:
             self._pending.append((key, row, 2))  # marker; resolved at feed
+            if offsets:
+                self._offsets.update(offsets)
 
     def commit(self) -> None:
         with self._lock:
@@ -246,6 +253,9 @@ class SessionSourceNode(Node):
         self.session = InputSession(self)
         self.state: dict[int, tuple] = {}
         self.persistent_id: str | None = None
+        # readers that honor ctx.offsets can resume without re-reading;
+        # offset-unaware sources need different record/replay handling
+        self.supports_offsets = False
         self.last_offsets: dict | None = None
         # recovery: finalized batches to replay, in time order
         self.replay_batches: list[tuple[int, list[Update]]] = []
@@ -1203,6 +1213,11 @@ class EngineGraph:
         self.persistence_config = None
         self.persistence = None
         self.replay_frontier = -1
+        # speedrun replay: recompute outputs purely from the recorded
+        # stream, never starting readers (reference
+        # PersistenceMode::SpeedrunReplay, connectors/mod.rs:108)
+        self._speedrun = False
+        self._threads_started = False
 
     # --- builder helpers used by the graph runner ---
 
@@ -1247,15 +1262,44 @@ class EngineGraph:
         from .persistence import EnginePersistence
 
         self.persistence = EnginePersistence(self.persistence_config)
+        mode = str(
+            getattr(self.persistence_config, "persistence_mode", "batch") or "batch"
+        ).lower()
+        self._speedrun = "speedrun" in mode
+        # record/replay (CLI --record / --replay-mode): every source is
+        # recorded; ids auto-assign by construction order, deterministic
+        # across runs of the same program (reference cli.py:180-186)
+        record_mode = "record" in mode
+        if (
+            getattr(self.persistence_config, "auto_persistent_ids", False)
+            or record_mode
+            or self._speedrun
+        ):
+            for i, s in enumerate(self.session_sources):
+                if s.persistent_id is not None:
+                    continue
+                # batch-mode recovery only suits offset-aware readers: an
+                # offset-unaware one would re-read everything ON TOP of
+                # the replayed log, duplicating its input. Speedrun never
+                # starts readers, and record mode resets such logs below.
+                if self._speedrun or record_mode or s.supports_offsets:
+                    s.persistent_id = f"auto_{i}"
         frontier = -1
         for s in self.session_sources:
             if s.persistent_id is None:
+                continue
+            if record_mode and not s.supports_offsets:
+                # fresh capture: the reader re-produces all input, so a
+                # stale log would double it — start the recording over
+                self.persistence.reset_source(s.persistent_id)
                 continue
             batches, offsets, f = self.persistence.recover_source(s.persistent_id)
             s.replay_batches = list(batches)
             s.session.restore_offsets(offsets)
             frontier = max(frontier, f)
-        self.replay_frontier = frontier
+        # speedrun recomputes sink output from the recorded stream, so
+        # replayed epochs are NOT suppressed there
+        self.replay_frontier = -1 if self._speedrun else frontier
 
     def run(self, monitoring_callback: Callable | None = None) -> None:
         """Run to completion: replay recovered epochs, then process
@@ -1263,8 +1307,10 @@ class EngineGraph:
         close."""
         if self.persistence_config is not None:
             self._setup_persistence()
-        for t in self.connector_threads:
-            t.start()
+        if not self._speedrun:
+            for t in self.connector_threads:
+                t.start()
+            self._threads_started = True
         last_time = -1
         while not self._stop:
             # next scripted time: static sources + recovery replay queues
@@ -1289,6 +1335,8 @@ class EngineGraph:
                         session_batches.append((s, b))
 
             if scripted_t is None and not session_batches:
+                if self._speedrun:
+                    break  # recorded stream exhausted
                 if all(s.session.closed for s in self.session_sources):
                     break
                 # wait for connector data
@@ -1328,8 +1376,9 @@ class EngineGraph:
             node.on_end()
         if self.persistence is not None:
             self.persistence.close()
-        for t in self.connector_threads:
-            t.join(timeout=5.0)
+        if self._threads_started:
+            for t in self.connector_threads:
+                t.join(timeout=5.0)
 
     def stop(self):
         self._stop = True

@@ -211,6 +211,12 @@ class EnginePersistence:
         self.events = getattr(backend, "events", None)
         self.config = config
         if self.kind == "filesystem":
+            # one namespace per process of the topology — parallel hosts
+            # must not share log files (reference WorkerPersistentStorage,
+            # src/persistence/tracker.rs:49)
+            pid = os.environ.get("PATHWAY_PROCESS_ID")
+            if pid and pid != "0":
+                self.root = os.path.join(self.root, f"proc-{pid}")
             os.makedirs(os.path.join(self.root, "streams"), exist_ok=True)
         elif self.kind == "mock":
             if self.events is None:
@@ -335,6 +341,19 @@ class EnginePersistence:
         w = self.writer_for(source_id)
         w.append(KIND_ADVANCE, time, 0, pickle.dumps(offsets or {}, protocol=4))
         w.flush()
+
+    def reset_source(self, source_id: str) -> None:
+        """Drop a source's log (record mode, offset-unaware reader: the
+        reader re-produces all input, so recording starts over)."""
+        if self.kind == "mock":
+            bucket = self._mock_bucket(source_id)
+            bucket[:] = [r for r in bucket if not (len(r) == 5 and r[0] == source_id)]
+            if isinstance(self.events, dict):
+                bucket.clear()
+            return
+        path = self._source_path(source_id)
+        if os.path.exists(path):
+            os.remove(path)
 
     def close(self) -> None:
         for w in self._writers.values():

@@ -24,6 +24,22 @@ def run(
     """Execute all registered outputs/subscriptions to completion
     (static sources) or until all streaming connectors close."""
     runner = GraphRunner()
+    if persistence_config is None:
+        # CLI record/replay wiring (reference cli.py:166-193): spawn's
+        # --record/--replay-mode flags arrive via PATHWAY_REPLAY_* env
+        from .config import get_pathway_config
+
+        pc = get_pathway_config()
+        if pc.replay_storage:
+            from .. import persistence as _persistence
+
+            persistence_config = _persistence.Config.simple_config(
+                _persistence.Backend.filesystem(pc.replay_storage),
+                persistence_mode=pc.replay_mode or "batch",
+            )
+            # CLI-driven runs record/replay every source, not just those
+            # with an explicit persistent_id
+            persistence_config.auto_persistent_ids = True
     if persistence_config is not None:
         runner.engine.persistence_config = persistence_config
     for table, sink in list(G.outputs):

@@ -79,17 +79,20 @@ class StreamingContext:
             self._seq = [int(self.session.get_offsets().get("__seq__", 0))]
         return self._seq
 
-    def insert(self, values: dict) -> None:
+    def insert(self, values: dict, offsets: dict | None = None) -> None:
         seq = self._seq_counter()
         key = make_key(self.names, self.pk, values, seq)
         row = coerce_to_schema(values, self.dtypes)
+        # the seq bookmark (and any caller offsets) lands in the same
+        # locked append as the row: a concurrent autocommit tick must not
+        # commit the row with pre-row offsets (double-read on recovery)
+        off = {"__seq__": seq[0], **(offsets or {})}
         if self.pk:
-            self.session.upsert(key, row)
+            self.session.upsert(key, row, offsets=off)
             self._deletions[key] = row
         else:
-            self.session.insert(key, row)
+            self.session.insert(key, row, offsets=off)
             self._deletions[key] = row
-        self.session.set_offset("__seq__", seq[0])
 
     def remove(self, values: dict) -> None:
         key = make_key(self.names, self.pk, values, self._seq_counter())
@@ -99,15 +102,17 @@ class StreamingContext:
             row = coerce_to_schema(values, self.dtypes)
             self.session.remove(key, row)
 
-    def upsert_keyed(self, key_parts: tuple, values: dict | None) -> None:
+    def upsert_keyed(
+        self, key_parts: tuple, values: dict | None, offsets: dict | None = None
+    ) -> None:
         """Upsert at an explicit key derived from ``key_parts`` (None
         values = delete). Lets readers speak a snapshot protocol with
         stable keys, e.g. (path, line_no) for file scanners."""
         key = int(ref_scalar(*key_parts))
         if values is None:
-            self.session.upsert(key, None)
+            self.session.upsert(key, None, offsets=offsets)
         else:
-            self.session.upsert(key, coerce_to_schema(values, self.dtypes))
+            self.session.upsert(key, coerce_to_schema(values, self.dtypes), offsets=offsets)
 
     def commit(self) -> None:
         self.session.commit()
@@ -123,6 +128,7 @@ def input_table_from_reader(
     name: str = "connector",
     autocommit_duration_ms: int | None = 1500,
     persistent_id: str | None = None,
+    supports_offsets: bool = False,
 ) -> Table:
     """Create an input Table whose rows are produced by `reader(ctx)`
     running on a named thread (reference reader threads mod.rs:447).
@@ -134,6 +140,7 @@ def input_table_from_reader(
     def build(engine: df.EngineGraph, runner) -> df.Node:
         node = df.SessionSourceNode(engine)
         node.persistent_id = persistent_id
+        node.supports_offsets = supports_offsets
         ctx = StreamingContext(node.session, schema)
 
         def run():

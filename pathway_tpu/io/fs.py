@@ -200,6 +200,7 @@ def read(
         name=f"fs:{path}",
         autocommit_duration_ms=autocommit_duration_ms,
         persistent_id=persistent_id,
+        supports_offsets=True,  # scanner resumes from {path: (mtime, n)}
     )
 
 

@@ -31,6 +31,14 @@ class ConnectorSubject:
         assert self._ctx is not None
         self._ctx.insert(kwargs)
 
+    def next_with_offset(self, offset_key, offset_value, **kwargs) -> None:
+        """Emit a row and advance a reader bookmark in one atomic step —
+        use this (not next() + set_offset()) when resuming from offsets,
+        so a concurrent commit can never persist the row without its
+        bookmark or vice versa."""
+        assert self._ctx is not None
+        self._ctx.insert(kwargs, offsets={offset_key: offset_value})
+
     def next_json(self, message: dict | str) -> None:
         if isinstance(message, str):
             message = json.loads(message)
@@ -112,6 +120,7 @@ def read(
         name=name,
         autocommit_duration_ms=autocommit_duration_ms,
         persistent_id=persistent_id,
+        supports_offsets=True,  # subjects resume via self.offsets
     )
 
 

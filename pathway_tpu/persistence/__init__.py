@@ -17,7 +17,9 @@ class Backend:
     def __init__(self, kind: str, path: str | None = None, events: list | None = None):
         self.kind = kind
         self.path = path
-        self.events = events or []
+        # keep the caller's (initially empty) store object: mock-backend
+        # recovery works by handing the SAME store to a fresh Backend
+        self.events = events if events is not None else []
 
     @classmethod
     def filesystem(cls, path: str) -> "Backend":
@@ -43,6 +45,9 @@ class Config:
     persistence_mode: str = "batch"
     snapshot_access: str = "full"
     continue_after_replay: bool = True
+    # record/replay every source, auto-assigning persistent ids by
+    # construction order (set by the CLI --record/--replay-mode path)
+    auto_persistent_ids: bool = False
 
     @classmethod
     def simple_config(

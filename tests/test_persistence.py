@@ -131,8 +131,9 @@ class _RangeSubject(pw.io.python.ConnectorSubject):
     def run(self):
         start = int(self.offsets.get("next", 0))
         for i in range(start, self.stop):
-            self.next(word=f"w{i}")
-            self.set_offset("next", i + 1)
+            # row + bookmark move atomically: a concurrent autocommit
+            # must never split them
+            self.next_with_offset("next", i + 1, word=f"w{i}")
         self.commit()
 
 
@@ -252,6 +253,85 @@ def test_format_flip_native_to_python(tmp_path, monkeypatch):
     batches3, _off3, f3 = p3.recover_source("s")
     assert f3 == 1 and len(batches3) == 2
     p3.close()
+
+
+def test_mock_backend_shared_store_across_backend_objects():
+    """The documented restart pattern: hand the SAME (initially empty)
+    store to a fresh Backend.mock and recover from it."""
+    store: list = []
+    p = eng_persist.EnginePersistence(
+        pw.persistence.Config.simple_config(pw.persistence.Backend.mock(store))
+    )
+    p.log_batch("s", 0, [(1, ("dog",), 1)])
+    p.advance("s", 0, {})
+    p.close()
+    assert store  # records landed in the caller's store, not a private copy
+    p2 = eng_persist.EnginePersistence(
+        pw.persistence.Config.simple_config(pw.persistence.Backend.mock(store))
+    )
+    batches, _off, frontier = p2.recover_source("s")
+    assert frontier == 0 and batches == [(0, [(1, ("dog",), 1)])]
+    p2.close()
+
+
+def test_row_and_offset_commit_atomically():
+    """commit() snapshots offsets that include every row in the batch,
+    even when racing the insert path (single locked append)."""
+    from pathway_tpu.engine import dataflow as df
+
+    g = df.EngineGraph()
+    node = df.SessionSourceNode(g)
+    s = node.session
+    s.insert(1, ("a",), offsets={"next": 1})
+    s.commit()
+    s.drain()
+    assert node.last_offsets == {"next": 1}
+
+
+class _NoOffsetSubject(pw.io.python.ConnectorSubject):
+    """Offset-unaware reader: re-emits everything on every run."""
+
+    def run(self):
+        for w in ("x", "y"):
+            self.next(word=w)
+        self.commit()
+
+
+def test_record_mode_resets_offset_unaware_source(tmp_path):
+    """Record mode must restart the capture for sources whose readers
+    cannot seek — recovering their log would double the input."""
+    import pathway_tpu.io._connector as conn
+
+    storage = str(tmp_path / "rec")
+
+    def run_once():
+        t = conn.input_table_from_reader(
+            WordSchema,
+            lambda ctx: (_run_reader(ctx)),
+            autocommit_duration_ms=None,
+            supports_offsets=False,
+        )
+        runner = GraphRunner()
+        cfg = pw.persistence.Config.simple_config(
+            pw.persistence.Backend.filesystem(storage), persistence_mode="record"
+        )
+        cfg.auto_persistent_ids = True
+        runner.engine.persistence_config = cfg
+        cap, names = runner.capture(t)
+        runner.run()
+        pw.clear_graph()
+        return cap.state
+
+    def _run_reader(ctx):
+        for w in ("x", "y"):
+            ctx.insert({"word": w})
+        ctx.commit()
+        ctx.close()
+
+    state1 = run_once()
+    assert len(state1) == 2
+    state2 = run_once()  # restart: capture resets, no doubling
+    assert len(state2) == 2
 
 
 def test_mock_backend_isolates_sources():
