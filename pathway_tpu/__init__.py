@@ -98,6 +98,7 @@ from .stdlib.utils.pandas_transformer import pandas_transformer
 from . import persistence
 from . import xpacks
 from .internals.monitoring import MonitoringLevel
+from .internals.errors import ErrorLogSchema, global_error_log, local_error_log
 from .internals.custom_reducers import BaseCustomAccumulator
 
 # engine namespace parity (reference pathway.engine is the PyO3 module)

@@ -213,8 +213,9 @@ def table_from_pandas(
     return Table(cols, Universe(), op, name="from_pandas")
 
 
-def _run_capture(table: Table):
+def _run_capture(table: Table, terminate_on_error: bool = True):
     runner = GraphRunner(debug=True)
+    runner.engine.terminate_on_error = terminate_on_error
     cap, names = runner.capture(table)
     runner.run()
     return cap, names
@@ -252,7 +253,7 @@ def compute_and_print(
     n_rows: int | None = None,
     terminate_on_error: bool = True,
 ) -> None:
-    cap, names = _run_capture(table)
+    cap, names = _run_capture(table, terminate_on_error=terminate_on_error)
     keys = sorted(cap.state.keys())
     if n_rows is not None:
         keys = keys[:n_rows]
@@ -281,7 +282,7 @@ def compute_and_print_update_stream(
     n_rows: int | None = None,
     terminate_on_error: bool = True,
 ) -> None:
-    cap, names = _run_capture(table)
+    cap, names = _run_capture(table, terminate_on_error=terminate_on_error)
     stream = sorted(cap.stream, key=lambda e: (e[2], e[0], e[3]))
     if n_rows is not None:
         stream = stream[:n_rows]

@@ -256,6 +256,9 @@ class SessionSourceNode(Node):
         # readers that honor ctx.offsets can resume without re-reading;
         # offset-unaware sources need different record/replay handling
         self.supports_offsets = False
+        # error-log feeds are engine-internal: they never block run
+        # termination and are not recorded by persistence
+        self.is_error_log = False
         self.last_offsets: dict | None = None
         # recovery: finalized batches to replay, in time order
         self.replay_batches: list[tuple[int, list[Update]]] = []
@@ -332,10 +335,20 @@ class ExprMapNode(Node):
             if not self.deterministic and key in self.memo:
                 out.append((key, self.memo.pop(key), -1))
             else:
-                out.append((key, self._eval_row(key, row, time), -1))
+                # recomputing to retract must not re-report the failure:
+                # the insert already logged it once
+                out.append((key, self._eval_row(key, row, time, report=False), -1))
         if inserts:
             if self.batch_eval is not None:
-                rows_out = self.batch_eval([k for k, _ in inserts], [r for _, r in inserts])
+                try:
+                    rows_out = self.batch_eval(
+                        [k for k, _ in inserts], [r for _, r in inserts]
+                    )
+                except Exception:
+                    if self.graph.terminate_on_error:
+                        raise
+                    # one bad row must not kill the vectorized batch
+                    rows_out = [self._eval_row(k, r, time) for k, r in inserts]
             else:
                 rows_out = [self._eval_row(k, r, time) for k, r in inserts]
             for (key, _), orow in zip(inserts, rows_out):
@@ -344,8 +357,20 @@ class ExprMapNode(Node):
                 out.append((key, orow, 1))
         self.emit(out, time)
 
-    def _eval_row(self, key, row, time):
-        return tuple(e(key, row) for e in self.exprs)
+    def _eval_row(self, key, row, time, report: bool = True):
+        out = []
+        for e in self.exprs:
+            try:
+                out.append(e(key, row))
+            except Exception as exc:
+                # ERROR operands propagate silently; fresh failures are
+                # reported (abort, or log + ERROR cell — graph.rs error
+                # routing with terminate_on_error=False)
+                if not report or any(isinstance(v, Error) for v in row):
+                    out.append(ERROR)
+                else:
+                    out.append(self.graph.report_row_error(self, exc))
+        return tuple(out)
 
 
 class FilterNode(Node):
@@ -357,7 +382,14 @@ class FilterNode(Node):
         updates = self.take()
         out = []
         for key, row, diff in updates:
-            keep = self.pred(key, row)
+            try:
+                keep = self.pred(key, row)
+            except Exception as exc:
+                if any(isinstance(v, Error) for v in row):
+                    keep = False  # ERROR rows silently fail the filter
+                else:
+                    self.graph.report_row_error(self, exc)
+                    keep = False
             if keep is True:
                 out.append((key, row, diff))
         self.emit(out, time)
@@ -1182,7 +1214,8 @@ class AsyncApplyNode(Node):
             results = self.graph.run_async_batch(self.async_fn, pending)
             for (key, row), res in zip(pending, results):
                 if isinstance(res, BaseException):
-                    res = ERROR  # failed UDF → ERROR value (value.rs Error)
+                    # failed UDF: abort, or ERROR value + error-log entry
+                    res = self.graph.report_row_error(self, res)
                 orow = row + (res,)
                 self.memo[key] = orow
                 out.append((key, orow, 1))
@@ -1218,6 +1251,13 @@ class EngineGraph:
         # PersistenceMode::SpeedrunReplay, connectors/mod.rs:108)
         self._speedrun = False
         self._threads_started = False
+        # row-level error handling (reference Graph::error_log
+        # graph.rs:983, terminate_on_error routing internals/errors.py):
+        # True → first failure aborts the run; False → failing rows get
+        # the ERROR value and an entry in the error-log sessions
+        self.terminate_on_error = True
+        self.error_sessions: list[InputSession] = []
+        self._error_seq = 0
 
     # --- builder helpers used by the graph runner ---
 
@@ -1226,6 +1266,34 @@ class EngineGraph:
 
     def wake(self):
         self._wake.set()
+
+    def report_row_error(self, origin: "Node", exc: BaseException):
+        """Route a row-level failure: abort (terminate_on_error) or log
+        it to the error sessions and return the ERROR value to store in
+        the failing cell (reference error routing, engine/error.rs +
+        internals/errors.py)."""
+        if self.terminate_on_error:
+            raise EngineError(
+                f"error in operator {origin.name} (id {origin.id}): {exc!r}"
+            ) from exc
+        import traceback
+
+        tb = traceback.extract_tb(exc.__traceback__)
+        frame = tb[-1] if tb else None
+        trace = (
+            {"file": frame.filename, "line": frame.lineno, "function": frame.name}
+            if frame
+            else None
+        )
+        from .value import Json as _Json
+
+        self._error_seq += 1
+        key = int(ref_scalar("__error__", self._error_seq))
+        row = (origin.id, f"{type(exc).__name__}: {exc}", _Json(trace) if trace else None)
+        for session in self.error_sessions:
+            session.insert(key, row)
+            session.commit()
+        return ERROR
 
     def run_async_batch(self, async_fn, pending):
         import asyncio
@@ -1276,7 +1344,7 @@ class EngineGraph:
             or self._speedrun
         ):
             for i, s in enumerate(self.session_sources):
-                if s.persistent_id is not None:
+                if s.persistent_id is not None or s.is_error_log:
                     continue
                 # batch-mode recovery only suits offset-aware readers: an
                 # offset-unaware one would re-read everything ON TOP of
@@ -1337,7 +1405,10 @@ class EngineGraph:
             if scripted_t is None and not session_batches:
                 if self._speedrun:
                     break  # recorded stream exhausted
-                if all(s.session.closed for s in self.session_sources):
+                # error-log sessions are engine-fed and never close
+                if all(
+                    s.session.closed for s in self.session_sources if not s.is_error_log
+                ):
                     break
                 # wait for connector data
                 self._wake.wait(timeout=0.05)
@@ -1371,6 +1442,19 @@ class EngineGraph:
         self.current_time = last_time + 1
         self._frontier_hooks(INF_TIME)
         if self._dirty:
+            self._topo_pass(self.current_time)
+        # deliver errors raised during the final flush — their sessions
+        # committed after the main loop stopped draining
+        err_batches = []
+        for s in self.session_sources:
+            if s.is_error_log:
+                b = s.session.drain()
+                if b:
+                    err_batches.append((s, b))
+        if err_batches:
+            self.current_time += 1
+            for s, b in err_batches:
+                s.feed_batch(b, self.current_time)
             self._topo_pass(self.current_time)
         for node in self.nodes:
             node.on_end()

@@ -1,0 +1,52 @@
+"""Error-log tables: route row-level failures to data instead of aborting.
+
+Rebuild of /root/reference/python/pathway/internals/errors.py
+(global_error_log/local_error_log) + the engine side Graph::error_log
+(/root/reference/src/engine/graph.rs:983-992). With
+``pw.run(terminate_on_error=False)`` a failing expression/UDF yields the
+ERROR value for that row and appends (operator_id, message, trace) to
+the active error-log tables; with the default ``True`` the run aborts on
+first failure.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Generator
+
+from ..engine.value import Json
+from .parse_graph import G
+from .schema import Schema
+
+
+class ErrorLogSchema(Schema):
+    operator_id: int
+    message: str
+    trace: Json | None
+
+
+def _make_error_log_table():
+    from .table import Column, LogicalOp, Table
+    from .universe import Universe
+
+    # single source of truth: the table shape IS the public schema
+    cols = {n: Column(t) for n, t in ErrorLogSchema.dtypes().items()}
+    op = LogicalOp("error_log", [], {})
+    return Table(cols, Universe(), op, name="error_log")
+
+
+def global_error_log():
+    """The run-wide error log table (errors from rows processed while no
+    local_error_log() context is active)."""
+    if not G.error_log_tables:
+        G.error_log_tables.append(_make_error_log_table())
+    return G.error_log_tables[0]
+
+
+@contextlib.contextmanager
+def local_error_log() -> Generator:
+    """Context manager yielding a fresh error-log table. Divergence from
+    the reference (which scopes logs to operators built inside the
+    context): in this build every lowered error log receives all row
+    errors of the run."""
+    yield _make_error_log_table()

@@ -225,6 +225,14 @@ class GraphRunner:
 
     # -- sources --
 
+    def _lower_error_log(self, table: Table, op: LogicalOp) -> Lowered:
+        """Error-log table (reference Graph::error_log graph.rs:983):
+        a session source fed by the engine's report_row_error."""
+        node = df.SessionSourceNode(self.engine)
+        node.is_error_log = True
+        self.engine.error_sessions.append(node.session)
+        return Lowered(node, list(table._columns.keys()))
+
     def _lower_static(self, table: Table, op: LogicalOp) -> Lowered:
         rows = op.params["rows"]  # list of (key, row_tuple, time, diff)
         by_time: dict[int, list] = {}

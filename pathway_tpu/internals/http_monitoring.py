@@ -1,0 +1,107 @@
+"""Monitoring HTTP server: Prometheus/OpenMetrics endpoint per process.
+
+Rebuild of /root/reference/src/engine/http_server.rs (:21-60): serves
+``/metrics`` in Prometheus text format and ``/status`` as JSON on port
+``20000 + process_id``, exposing row counters, per-operator stats and
+input/output latency gauges (reference telemetry.rs:41-45).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .monitoring import StatsMonitor
+
+BASE_PORT = 20000
+
+
+class MonitoringHttpServer:
+    """Daemon HTTP server reading a StatsMonitor's latest snapshot."""
+
+    def __init__(self, monitor: StatsMonitor, port: int | None = None, host: str = "127.0.0.1"):
+        if port is None:
+            from .config import get_pathway_config
+
+            port = BASE_PORT + get_pathway_config().process_id
+        self.monitor = monitor
+        self.port = port
+        self.host = host
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- rendering --
+
+    def _prometheus(self) -> str:
+        snap = self.monitor.snapshot
+        now = time.monotonic()
+        lines = [
+            "# TYPE pathway_epoch gauge",
+            f"pathway_epoch {snap.time}",
+            "# TYPE pathway_rows_input_total counter",
+            f"pathway_rows_input_total {snap.rows_in}",
+            "# TYPE pathway_rows_output_total counter",
+            f"pathway_rows_output_total {snap.rows_out}",
+            "# TYPE pathway_input_latency_ms gauge",
+            f"pathway_input_latency_ms {self.monitor.input_latency_ms(now)}",
+            "# TYPE pathway_output_latency_ms gauge",
+            f"pathway_output_latency_ms {self.monitor.output_latency_ms(now)}",
+            "# TYPE pathway_operator_rows counter",
+        ]
+        for op_name, (rows_in, rows_out) in sorted(snap.operators.items()):
+            label = op_name.replace("\\", "\\\\").replace('"', '\\"')
+            lines.append(f'pathway_operator_rows{{operator="{label}",direction="in"}} {rows_in}')
+            lines.append(f'pathway_operator_rows{{operator="{label}",direction="out"}} {rows_out}')
+        return "\n".join(lines) + "\n"
+
+    def _status(self) -> str:
+        snap = self.monitor.snapshot
+        return json.dumps(
+            {
+                "epoch": snap.time,
+                "rows_in": snap.rows_in,
+                "rows_out": snap.rows_out,
+                "operators": snap.operators,
+            }
+        )
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    body = server._prometheus().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/status"):
+                    body = server._status().encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence request logging
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port  # resolves port=0 to the bound one
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pathway_tpu:monitoring-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

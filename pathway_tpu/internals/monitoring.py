@@ -37,6 +37,18 @@ class StatsMonitor:
         self.interval = interval
         self._last_render = 0.0
         self.snapshot = StatsSnapshot()
+        # wall-clock of the last observed input/output row-count change,
+        # for the latency gauges (reference telemetry.rs:41-45)
+        self._last_in_change = time.monotonic()
+        self._last_out_change = time.monotonic()
+
+    def input_latency_ms(self, now: float | None = None) -> int:
+        now = time.monotonic() if now is None else now
+        return int((now - self._last_in_change) * 1000)
+
+    def output_latency_ms(self, now: float | None = None) -> int:
+        now = time.monotonic() if now is None else now
+        return int((now - self._last_out_change) * 1000)
 
     def update(self, engine) -> None:
         snap = StatsSnapshot(time=engine.current_time)
@@ -47,6 +59,11 @@ class StatsMonitor:
             )
             snap.rows_in += node.stats.rows_in
             snap.rows_out += node.stats.rows_out
+        now = time.monotonic()
+        if snap.rows_in != self.snapshot.rows_in:
+            self._last_in_change = now
+        if snap.rows_out != self.snapshot.rows_out:
+            self._last_out_change = now
         self.snapshot = snap
         if self.render and time.monotonic() - self._last_render > self.interval:
             self._render()

@@ -24,6 +24,7 @@ def run(
     """Execute all registered outputs/subscriptions to completion
     (static sources) or until all streaming connectors close."""
     runner = GraphRunner()
+    runner.engine.terminate_on_error = terminate_on_error
     if persistence_config is None:
         # CLI record/replay wiring (reference cli.py:166-193): spawn's
         # --record/--replay-mode flags arrive via PATHWAY_REPLAY_* env
@@ -54,11 +55,25 @@ def run(
             on_end=spec.get("on_end"),
         )
     monitor = None
-    if monitoring_level is not None and monitoring_level not in (False, "none"):
+    if with_http_server or (
+        monitoring_level is not None and monitoring_level not in (False, "none")
+    ):
         from .monitoring import StatsMonitor
 
         monitor = StatsMonitor()
-    runner.run(monitoring_callback=monitor.update if monitor else None)
+    http_server = None
+    if with_http_server:
+        # Prometheus endpoint on 20000 + process_id (reference
+        # src/engine/http_server.rs:21)
+        from .http_monitoring import MonitoringHttpServer
+
+        http_server = MonitoringHttpServer(monitor)
+        http_server.start()
+    try:
+        runner.run(monitoring_callback=monitor.update if monitor else None)
+    finally:
+        if http_server is not None:
+            http_server.stop()
 
 
 def run_all(**kwargs: Any) -> None:
