@@ -77,6 +77,16 @@ def consolidate(updates: list[Update]) -> list[Update]:
     return out
 
 
+def _error_operand(fn: Callable, row: tuple) -> bool:
+    """True when an expression's failure traces to an ERROR operand: the
+    compiled closure carries ``_reads`` (the slots it depends on,
+    graph_runner.compile); without it, fall back to the whole row."""
+    reads = getattr(fn, "_reads", None)
+    if reads is None:
+        return any(isinstance(v, Error) for v in row)
+    return any(isinstance(row[i], Error) for i in reads if i < len(row))
+
+
 class OperatorStats:
     """Per-operator probe counters (reference graph.rs:523 OperatorStats)."""
 
@@ -363,10 +373,11 @@ class ExprMapNode(Node):
             try:
                 out.append(e(key, row))
             except Exception as exc:
-                # ERROR operands propagate silently; fresh failures are
-                # reported (abort, or log + ERROR cell — graph.rs error
-                # routing with terminate_on_error=False)
-                if not report or any(isinstance(v, Error) for v in row):
+                # an ERROR among the slots THIS expression reads means a
+                # propagated upstream failure — stay silent; anything
+                # else is a fresh failure and gets reported (abort, or
+                # log + ERROR cell — graph.rs error routing)
+                if not report or _error_operand(e, row):
                     out.append(ERROR)
                 else:
                     out.append(self.graph.report_row_error(self, exc))
@@ -385,8 +396,10 @@ class FilterNode(Node):
             try:
                 keep = self.pred(key, row)
             except Exception as exc:
-                if any(isinstance(v, Error) for v in row):
-                    keep = False  # ERROR rows silently fail the filter
+                # retraction re-evaluation must not re-report (the insert
+                # already did); ERROR operands silently fail the filter
+                if diff < 0 or _error_operand(self.pred, row):
+                    keep = False
                 else:
                     self.graph.report_row_error(self, exc)
                     keep = False
@@ -980,6 +993,57 @@ class FreezeNode(Node):
                 continue
             out.append((key, row, diff))
         self.emit(out, time)
+
+
+class GradualBroadcastNode(Node):
+    """gradual_broadcast (reference R15,
+    src/engine/dataflow/operators/gradual_broadcast.rs): attach an
+    approximate threshold value column to every data row. The attached
+    value only changes when a new threshold's value leaves the previous
+    [lower, upper] band, so threshold churn does not re-emit the table."""
+
+    n_inputs = 2  # port 0: data rows, port 1: (lower, value, upper) rows
+
+    def __init__(self, graph, lower_i: int, value_i: int, upper_i: int):
+        super().__init__(graph, "GradualBroadcast")
+        self.lower_i = lower_i
+        self.value_i = value_i
+        self.upper_i = upper_i
+        self.band: tuple | None = None  # (lower, upper) of the attached value
+        self.apx = None
+        self.rows: dict[int, tuple] = {}
+        self.attached: dict[int, Any] = {}
+
+    def process(self, time):
+        out: list[Update] = []
+        latest = None
+        for _key, row, diff in self.take(1):
+            if diff > 0:
+                latest = row
+        if latest is not None:
+            lower, value, upper = (
+                latest[self.lower_i],
+                latest[self.value_i],
+                latest[self.upper_i],
+            )
+            if self.band is None or not (self.band[0] <= value <= self.band[1]):
+                # threshold moved out of band: rebroadcast to all rows
+                new_apx = value
+                for k, r in self.rows.items():
+                    out.append((k, r + (self.attached[k],), -1))
+                    out.append((k, r + (new_apx,), 1))
+                    self.attached[k] = new_apx
+                self.apx = new_apx
+            self.band = (lower, upper)
+        for key, row, diff in self.take(0):
+            if diff > 0:
+                self.rows[key] = row
+                self.attached[key] = self.apx
+                out.append((key, row + (self.apx,), 1))
+            else:
+                self.rows.pop(key, None)
+                out.append((key, row + (self.attached.pop(key, self.apx),), -1))
+        self.emit(consolidate(out), time)
 
 
 class ExternalIndexNode(Node):

@@ -225,6 +225,19 @@ class GraphRunner:
 
     # -- sources --
 
+    def _lower_gradual_broadcast(self, table: Table, op: LogicalOp) -> Lowered:
+        base = self.lower(op.inputs[0])
+        thr = self.lower(op.inputs[1])
+        node = df.GradualBroadcastNode(
+            self.engine,
+            thr.index(op.params["lower"]),
+            thr.index(op.params["value"]),
+            thr.index(op.params["upper"]),
+        )
+        node.connect(base.node, 0)
+        node.connect(thr.node, 1)
+        return Lowered(node, base.names + ["apx_value"])
+
     def _lower_error_log(self, table: Table, op: LogicalOp) -> Lowered:
         """Error-log table (reference Graph::error_log graph.rs:983):
         a session source fed by the engine's report_row_error."""
@@ -936,9 +949,39 @@ class GraphRunner:
     # ---------- expression compiler ----------
 
     def compile(self, expr: ColumnExpression, layout: Layout) -> Callable:
-        """Compile an expression to fn(key, row) -> value."""
-        c = self.compile_inner
-        return c(expr, layout)
+        """Compile an expression to fn(key, row) -> value. The closure
+        carries ``_reads`` — the row slots it depends on — so the engine
+        can tell a propagated ERROR operand from a fresh failure."""
+        fn = self.compile_inner(expr, layout)
+        try:
+            fn._reads = self._reads_of(expr, layout)
+        except (AttributeError, TypeError):
+            pass  # builtins / bound methods: engine falls back to whole-row
+        return fn
+
+    def _reads_of(self, e: ColumnExpression, layout: Layout) -> frozenset:
+        """Row slots an expression reads (same resolution rules as
+        compile_inner, minus error paths)."""
+        reads: set[int] = set()
+
+        def visit(x):
+            if isinstance(x, SlotRef):
+                reads.add(x._idx)
+            elif isinstance(x, IxExpression):
+                slots = getattr(x, "_pw_ix_slots", {}).get(id(self))
+                if slots and x._name in slots:
+                    reads.add(slots[x._name])
+            elif isinstance(x, ColumnReference) and isinstance(x._table, Table):
+                if x._name == "id":
+                    if x._table._id in layout.id_slots:
+                        reads.add(layout.id_slots[x._table._id])
+                else:
+                    key = (x._table._id, x._name)
+                    if key in layout.slots:
+                        reads.add(layout.slots[key])
+
+        walk_expression(e, visit)
+        return frozenset(reads)
 
     def compile_inner(self, e: ColumnExpression, layout: Layout) -> Callable:
         if isinstance(e, SlotRef):

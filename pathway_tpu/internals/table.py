@@ -299,6 +299,32 @@ class Table:
         op = LogicalOp("with_universe_of", [self, other], {})
         return Table(cols, other._universe, op, name=f"{self._name}.with_universe_of")
 
+    def _gradual_broadcast(
+        self,
+        threshold_table: "Table",
+        lower_column: ColumnReference,
+        value_column: ColumnReference,
+        upper_column: ColumnReference,
+    ) -> "Table":
+        """Attach column ``apx_value`` carrying threshold_table's value
+        column, updated only when it leaves the previous [lower, upper]
+        band (reference Table._gradual_broadcast internals/table.py:631,
+        engine operators/gradual_broadcast.rs R15)."""
+        cols = {n: Column(c.dtype) for n, c in self._columns.items()}
+        from . import dtype as dt
+
+        cols["apx_value"] = Column(dt.ANY)
+        op = LogicalOp(
+            "gradual_broadcast",
+            [self, threshold_table],
+            {
+                "lower": lower_column._name,
+                "value": value_column._name,
+                "upper": upper_column._name,
+            },
+        )
+        return Table(cols, self._universe, op, name=f"{self._name}.gradual_broadcast")
+
     # ---- schema / column manipulation ----
 
     def rename(self, names_mapping: Mapping | None = None, **kwargs) -> "Table":

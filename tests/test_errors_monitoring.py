@@ -106,6 +106,52 @@ def test_retraction_does_not_duplicate_error_entry():
     pw.clear_graph()
 
 
+def test_fresh_failure_next_to_error_cell_still_reported():
+    """A failure in an expression whose OWN operands are healthy must be
+    reported even if another cell of the row already holds ERROR."""
+    t = T(
+        """
+          | a  | b | c
+        1 | 10 | 0 | 0
+        """
+    )
+    step1 = t.select(
+        a=pw.this.a,
+        c=pw.this.c,
+        q=pw.apply(lambda a, b: a // b, pw.this.a, pw.this.b),  # fails
+    )
+    step2 = step1.select(
+        q=pw.this.q,
+        z=pw.apply(lambda a, c: a // c, pw.this.a, pw.this.c),  # also fails
+    )
+    runner = GraphRunner()
+    runner.engine.terminate_on_error = False
+    cap, _ = runner.capture(step2)
+    ecap, _ = runner.capture(pw.global_error_log())
+    runner.run()
+    assert len(ecap.state) == 2  # two distinct failures, two entries
+    pw.clear_graph()
+
+
+def test_filter_retraction_does_not_duplicate_error_entry():
+    t = pw.debug.table_from_markdown(
+        """
+          | a | b | __time__ | __diff__
+        1 | 7 | 0 | 0        | 1
+        1 | 7 | 0 | 2        | -1
+        """
+    )
+    res = t.filter(pw.apply(lambda a, b: a // b > 0, pw.this.a, pw.this.b))
+    runner = GraphRunner()
+    runner.engine.terminate_on_error = False
+    cap, _ = runner.capture(res)
+    ecap, _ = runner.capture(pw.global_error_log())
+    runner.run()
+    assert cap.state == {}
+    assert len(ecap.state) == 1
+    pw.clear_graph()
+
+
 def test_local_error_log_context():
     with pw.local_error_log() as log:
         res = _div_table()

@@ -1,0 +1,227 @@
+"""Fuzzy join, HMM reducer, gradual broadcast.
+
+Mirrors the reference coverage of stdlib/ml/smart_table_ops
+(test_fuzzy_join), ml/hmm, and the gradual_broadcast operator (R15).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+from .utils import T, run_table
+
+
+def test_fuzzy_match_tables_basic():
+    left = T(
+        """
+          | name
+        1 | john smith
+        2 | alice cooper
+        3 | bob marley
+        """
+    )
+    right = T(
+        """
+          | name
+        11 | smith john
+        12 | cooper alice
+        13 | marley bob
+        """
+    )
+    res = pw.ml.fuzzy_match_tables(left, right)
+    state = run_table(res)
+    got = {(int(l), int(r)) for l, r, _w in state.values()}
+    # keys are the original row pointers
+    lkeys, _ = _keys_by_name(left)
+    rkeys, _ = _keys_by_name(right)
+    assert got == {
+        (lkeys["john smith"], rkeys["smith john"]),
+        (lkeys["alice cooper"], rkeys["cooper alice"]),
+        (lkeys["bob marley"], rkeys["marley bob"]),
+    }
+
+
+def _keys_by_name(table):
+    state = run_table(table.select(name=pw.this.name))
+    return {row[0]: int(k) for k, row in state.items()}, state
+
+
+def test_smart_fuzzy_match_one_to_one():
+    """Greedy assignment: the heavier pair wins, each node used once."""
+    left = T(
+        """
+          | name
+        1 | aa bb cc
+        2 | aa bb
+        """
+    )
+    right = T(
+        """
+          | name
+        11 | aa bb cc
+        12 | aa
+        """
+    )
+    res = pw.ml.smart_fuzzy_match(left.name, right.name)
+    state = run_table(res)
+    lkeys, _ = _keys_by_name(left)
+    rkeys, _ = _keys_by_name(right)
+    got = {(int(l), int(r)) for l, r, _w in state.values()}
+    assert (lkeys["aa bb cc"], rkeys["aa bb cc"]) in got
+    assert (lkeys["aa bb"], rkeys["aa"]) in got
+
+
+def test_fuzzy_self_match():
+    t = T(
+        """
+          | name
+        1 | data stream processing
+        2 | stream data processing
+        3 | quantum chess
+        """
+    )
+    # self match: smart_fuzzy_match detects same column on same table
+    res = pw.ml.smart_fuzzy_match(t.name, t.name)
+    state = run_table(res)
+    pairs = {(int(l), int(r)) for l, r, _w in state.values()}
+    keys, _ = _keys_by_name(t)
+    a, b = keys["data stream processing"], keys["stream data processing"]
+    assert (min(a, b), max(a, b)) in pairs
+    assert len(pairs) == 1  # quantum chess matches nobody
+
+
+def test_fuzzy_match_low_level_api():
+    """The Edge/Feature low-level contract (reference fuzzy_match :265)."""
+    feats = T(
+        """
+           | weight | normalization_type
+        f1 | 1.0    | 3
+        f2 | 1.0    | 3
+        """
+    )
+    # feature pointers = the rows' actual keys
+    fstate = run_table(feats.select(w=pw.this.weight))
+    f1, f2 = (pw.Pointer(k) for k in sorted(fstate.keys()))
+    el = pw.debug.table_from_rows(_edge_schema(), [(1, f1, 1.0), (2, f2, 1.0)])
+    er = pw.debug.table_from_rows(_edge_schema(), [(11, f1, 1.0), (12, f2, 1.0)])
+    res = pw.ml.fuzzy_match(el, er, feats)
+    state = run_table(res)
+    got = {(int(l), int(r)) for l, r, _w in state.values()}
+    assert len(got) == 2
+
+
+def _edge_schema():
+    class EdgeSchema(pw.Schema):
+        node: int
+        feature: pw.Pointer
+        weight: float
+
+    return EdgeSchema
+
+
+def test_hmm_reducer():
+    import networkx as nx
+    from functools import partial
+
+    def emission(observation, state):
+        table = {
+            ("HUNGRY", "GRUMPY"): 0.9,
+            ("HUNGRY", "HAPPY"): 0.1,
+            ("FULL", "GRUMPY"): 0.3,
+            ("FULL", "HAPPY"): 0.7,
+        }
+        return float(np.log(table[(state, observation)]))
+
+    g = nx.DiGraph()
+    for s in ("HUNGRY", "FULL"):
+        g.add_node(s, calc_emission_log_ppb=partial(emission, state=s))
+    g.add_edge("HUNGRY", "HUNGRY", log_transition_ppb=float(np.log(0.4)))
+    g.add_edge("HUNGRY", "FULL", log_transition_ppb=float(np.log(0.6)))
+    g.add_edge("FULL", "HUNGRY", log_transition_ppb=float(np.log(0.6)))
+    g.add_edge("FULL", "FULL", log_transition_ppb=float(np.log(0.4)))
+
+    obs = T(
+        """
+          | observation | g
+        1 | GRUMPY      | 0
+        2 | GRUMPY      | 0
+        3 | HAPPY       | 0
+        """
+    )
+    hmm = pw.ml.create_hmm_reducer(g)
+    res = obs.groupby(pw.this.g).reduce(path=hmm(pw.this.observation))
+    state = run_table(res)
+    (row,) = state.values()
+    path = row[0]
+    assert len(path) == 3
+    assert path[-1] == "FULL"  # HAPPY strongly suggests FULL
+    assert path[0] == "HUNGRY"
+
+
+def test_hmm_start_nodes_restrict_initial_state():
+    import networkx as nx
+    from functools import partial
+
+    def emission(observation, state):
+        # HAPPY strongly favors FULL — but only HUNGRY may start
+        table = {
+            ("HUNGRY", "HAPPY"): 0.1,
+            ("FULL", "HAPPY"): 0.9,
+            ("HUNGRY", "GRUMPY"): 0.9,
+            ("FULL", "GRUMPY"): 0.1,
+        }
+        return float(np.log(table[(state, observation)]))
+
+    g = nx.DiGraph(start_nodes=["HUNGRY"])
+    for s in ("HUNGRY", "FULL"):
+        g.add_node(s, calc_emission_log_ppb=partial(emission, state=s))
+    for a in ("HUNGRY", "FULL"):
+        for b in ("HUNGRY", "FULL"):
+            g.add_edge(a, b, log_transition_ppb=float(np.log(0.5)))
+
+    obs = T(
+        """
+          | observation | g
+        1 | HAPPY       | 0
+        """
+    )
+    hmm = pw.ml.create_hmm_reducer(g)
+    res = obs.groupby(pw.this.g).reduce(path=hmm(pw.this.observation))
+    (row,) = run_table(res).values()
+    assert row[0] == ("HUNGRY",)  # FULL forbidden as initial state
+
+
+def test_gradual_broadcast():
+    data = T(
+        """
+          | a
+        1 | 10
+        2 | 20
+        3 | 30
+        """
+    )
+    thresholds = pw.debug.table_from_markdown(
+        """
+          | lower | value | upper | __time__
+        1 | 0.0   | 1.0   | 2.0   | 0
+        2 | 0.5   | 1.5   | 2.5   | 2
+        3 | 5.0   | 6.0   | 7.0   | 4
+        """
+    )
+    res = data._gradual_broadcast(
+        thresholds, thresholds.lower, thresholds.value, thresholds.upper
+    )
+    runner = GraphRunner()
+    cap, names = runner.capture(res)
+    runner.run()
+    apx_i = names.index("apx_value")
+    # final: the t=2 update stayed inside [0,2] band -> kept 1.0; the t=4
+    # update left the band -> rebroadcast 6.0
+    vals = {row[names.index("a")]: row[apx_i] for row in cap.state.values()}
+    assert vals == {10: 6.0, 20: 6.0, 30: 6.0}
+    # intermediate history shows the band logic: no re-emission at t=2
+    times_with_changes = sorted({t for _k, _r, t, _d in cap.stream})
+    assert 2 not in times_with_changes
+    pw.clear_graph()
