@@ -1009,8 +1009,7 @@ class GradualBroadcastNode(Node):
         self.lower_i = lower_i
         self.value_i = value_i
         self.upper_i = upper_i
-        self.band: tuple | None = None  # (lower, upper) of the attached value
-        self.apx = None
+        self.apx = None  # currently-attached approximate value
         self.rows: dict[int, tuple] = {}
         self.attached: dict[int, Any] = {}
 
@@ -1026,15 +1025,16 @@ class GradualBroadcastNode(Node):
                 latest[self.value_i],
                 latest[self.upper_i],
             )
-            if self.band is None or not (self.band[0] <= value <= self.band[1]):
-                # threshold moved out of band: rebroadcast to all rows
+            # rebroadcast when the ATTACHED value falls outside the NEW
+            # threshold's band — checking the new value against the old
+            # band instead lets a drifting threshold run away from apx
+            if self.apx is None or not (lower <= self.apx <= upper):
                 new_apx = value
                 for k, r in self.rows.items():
                     out.append((k, r + (self.attached[k],), -1))
                     out.append((k, r + (new_apx,), 1))
                     self.attached[k] = new_apx
                 self.apx = new_apx
-            self.band = (lower, upper)
         for key, row, diff in self.take(0):
             if diff > 0:
                 self.rows[key] = row

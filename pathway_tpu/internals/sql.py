@@ -100,10 +100,7 @@ class _Parser:
             if not self.accept_op(","):
                 break
         self.expect_kw("from")
-        tname = self.next()[1]
-        if tname not in self.tables:
-            raise ValueError(f"SQL: unknown table {tname!r}")
-        table = self.tables[tname]
+        table = self._parse_from()
         self.current = table
 
         where_expr = None
@@ -128,17 +125,27 @@ class _Parser:
             it[1] != "*" and _contains_agg(it[1]) for it in items
         ) or group_cols
         if has_agg:
-            grouped = table.groupby(*[table[c] for c in group_cols])
+            # after FROM, qualified names resolve by their bare column
+            grouped = table.groupby(*[table[c.split(".")[-1]] for c in group_cols])
             kwargs = {}
             for i, (alias, expr) in enumerate(items):
                 if expr == "*":
                     raise ValueError("SQL: * not allowed with GROUP BY")
                 name = alias or _default_name(expr, i)
                 kwargs[name] = _build(expr, table, allow_agg=True)
+            hidden: dict[str, Any] = {}
+            if having_expr is not None:
+                # aggregates inside HAVING become hidden reduce columns,
+                # filtered on and then projected away
+                having_expr = _extract_aggs(having_expr, hidden, table)
+                kwargs.update(hidden)
             result = grouped.reduce(**kwargs)
             if having_expr is not None:
-                # re-evaluate having over the reduced table by name
                 result = result.filter(_build_on_result(having_expr, result))
+                if hidden:
+                    result = result.select(
+                        **{n: result[n] for n in kwargs if n not in hidden}
+                    )
             return result
 
         kwargs = {}
@@ -150,6 +157,77 @@ class _Parser:
             name = alias or _default_name(expr, i)
             kwargs[name] = _build(expr, table, allow_agg=False)
         return table.select(**kwargs)
+
+    _CLAUSE_KWS = frozenset(
+        {"from", "where", "group", "having", "order", "limit",
+         "join", "inner", "left", "right", "full", "outer", "on", "as"}
+    )
+
+    def _parse_table_ref(self):
+        tname = self.next()[1]
+        if tname not in self.tables:
+            raise ValueError(f"SQL: unknown table {tname!r}")
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.next()[1]
+        else:
+            kind, val = self.peek()
+            if kind == "name" and val.lower() not in self._CLAUSE_KWS:
+                alias = self.next()[1]
+        return self.tables[tname], alias or tname
+
+    def _parse_from(self) -> Table:
+        """FROM t [alias] (JOIN t2 [alias] ON cond)* — joins accumulate
+        left-to-right; aliased dotted columns resolve per side."""
+        current, alias = self._parse_table_ref()
+        left_aliases = {alias}
+        while True:
+            how = None
+            if self.accept_kw("join"):
+                how = "inner"
+            elif self.accept_kw("inner"):
+                self.expect_kw("join")
+                how = "inner"
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                how = "left"
+            elif self.accept_kw("right"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                how = "right"
+            elif self.accept_kw("full"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                how = "outer"
+            if how is None:
+                break
+            t2, alias2 = self._parse_table_ref()
+            self.expect_kw("on")
+            cond_ast = self.parse_expr_deferred()
+
+            def resolver(fullname, _cur=current, _t2=t2, _a2=alias2, _la=frozenset(left_aliases)):
+                if "." in fullname:
+                    prefix, col = fullname.split(".", 1)
+                    if prefix == _a2:
+                        return _t2[col]
+                    if prefix in _la:
+                        return _cur[col]
+                    raise ValueError(f"SQL: unknown table alias {prefix!r}")
+                if fullname in _cur.column_names():
+                    return _cur[fullname]
+                return _t2[fullname]
+
+            cond = _build(cond_ast, resolver, allow_agg=False)
+            jr = current.join(t2, cond, how=how)
+            proj = {n: current[n] for n in current.column_names()}
+            for n in t2.column_names():
+                # name collisions keep the qualified right-side column:
+                # `b.v` must not silently resolve to the left table's v
+                proj[n if n not in proj else f"{alias2}.{n}"] = t2[n]
+            current = jr.select(**proj)
+            left_aliases.add(alias2)
+        return current
 
     # deferred expression AST: tuples
     def parse_expr_deferred(self):
@@ -250,29 +328,40 @@ def _default_name(node, i: int) -> str:
     return f"col_{i}"
 
 
-def _build(node, table: Table, allow_agg: bool) -> Any:
+def _table_resolver(table: Table):
+    def resolve(fullname: str):
+        # qualified duplicates are materialized under their full name
+        if fullname in table.column_names():
+            return table[fullname]
+        return table[fullname.split(".")[-1]]
+
+    return resolve
+
+
+def _build(node, resolver, allow_agg: bool) -> Any:
     from .. import reducers as red
 
+    if not callable(resolver):  # accept a Table for convenience
+        resolver = _table_resolver(resolver)
     if node == "*":
         raise ValueError("unexpected *")
     kind = node[0]
     if kind == "lit":
         return smart_wrap(node[1])
     if kind == "col":
-        name = node[1].split(".")[-1]
-        return table[name]
+        return resolver(node[1])
     if kind == "neg":
-        return -_build(node[1], table, allow_agg)
+        return -_build(node[1], resolver, allow_agg)
     if kind == "not":
         from .expression import ColumnUnaryOpExpression
 
-        return ColumnUnaryOpExpression("~", _build(node[1], table, allow_agg))
+        return ColumnUnaryOpExpression("~", _build(node[1], resolver, allow_agg))
     if kind in ("and", "or"):
-        a = _build(node[1], table, allow_agg)
-        b = _build(node[2], table, allow_agg)
+        a = _build(node[1], resolver, allow_agg)
+        b = _build(node[2], resolver, allow_agg)
         return (a & b) if kind == "and" else (a | b)
     if kind in ("is_null", "is_not_null"):
-        e = _build(node[1], table, allow_agg)
+        e = _build(node[1], resolver, allow_agg)
         return e.is_none() if kind == "is_null" else e.is_not_none()
     if kind == "call":
         fname, args = node[1], node[2]
@@ -281,18 +370,18 @@ def _build(node, table: Table, allow_agg: bool) -> Any:
                 raise ValueError(f"SQL: aggregate {fname} not allowed here")
             if fname == "count":
                 return red.count()
-            arg = _build(args[0], table, allow_agg=False)
+            arg = _build(args[0], resolver, allow_agg=False)
             return getattr(red, fname)(arg)
         if fname == "abs":
-            return abs(_build(args[0], table, allow_agg))
+            return abs(_build(args[0], resolver, allow_agg))
         if fname == "coalesce":
             from .expression import coalesce
 
-            return coalesce(*[_build(a, table, allow_agg) for a in args])
+            return coalesce(*[_build(a, resolver, allow_agg) for a in args])
         raise ValueError(f"SQL: unknown function {fname!r}")
     # binary operator
-    a = _build(node[1], table, allow_agg)
-    b = _build(node[2], table, allow_agg)
+    a = _build(node[1], resolver, allow_agg)
+    b = _build(node[2], resolver, allow_agg)
     import operator
 
     ops = {
@@ -311,6 +400,26 @@ def _build(node, table: Table, allow_agg: bool) -> Any:
     return ops[kind](a, b)
 
 
+def _extract_aggs(node, hidden: dict, table: Table):
+    """Replace aggregate calls in a HAVING AST with references to
+    hidden reduce columns (filled into ``hidden``)."""
+    if isinstance(node, tuple):
+        if node[0] == "call" and node[1] in _AGGS:
+            name = f"_pw_having_{len(hidden)}"
+            hidden[name] = _build(node, table, allow_agg=True)
+            return ("col", name)
+        return tuple(
+            _extract_aggs(c, hidden, table) if isinstance(c, (tuple, list)) else c
+            for c in node
+        )
+    if isinstance(node, list):
+        return [
+            _extract_aggs(c, hidden, table) if isinstance(c, (tuple, list)) else c
+            for c in node
+        ]
+    return node
+
+
 def _build_on_result(node, table: Table):
     # HAVING over reduced table: columns by alias/name
     return _build(node, table, allow_agg=False)
@@ -321,4 +430,10 @@ def sql(query: str, **tables: Table) -> Table:
 
         pw.sql("SELECT a, SUM(b) AS total FROM t GROUP BY a", t=my_table)
     """
-    return _Parser(query, tables).parse_select()
+    parser = _Parser(query, tables)
+    result = parser.parse_select()
+    if parser.peek()[0] != "eof":
+        raise ValueError(
+            f"SQL: unsupported trailing syntax at {parser.peek()[1]!r}"
+        )
+    return result

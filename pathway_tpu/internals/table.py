@@ -579,7 +579,9 @@ class JoinResult:
     ):
         self._left = left
         self._right = right
-        self._on = on
+        # pw.left/pw.right sentinels in the on-conditions resolve to the
+        # join sides right away (lowering sees only concrete tables)
+        self._on = [_resolve_join_this(c, self) for c in on]
         self._how = how
         self._id_from = id_from
         self._filters: list[ColumnExpression] = []

@@ -8,9 +8,23 @@ from __future__ import annotations
 from .expression import ColumnReference
 
 
+_EXPR_INTERNALS = frozenset(
+    {
+        "_name", "_table", "_dtype", "_idx", "_args", "_kwargs", "_expr",
+        "_val", "_left", "_right", "_fn", "_repr_inner", "_id", "_op",
+        "_columns", "_universe", "_keys_expr", "_ix_table", "_optional",
+    }
+)
+
+
 class ThisMetaclass(type):
     def __getattr__(cls, name: str) -> ColumnReference:
-        if name.startswith("__"):
+        # ColumnExpression-internal attribute probes (e.g. repr reading
+        # `_name`, compilers reading `_table`) must NOT produce column
+        # references — intercepting them turns error formatting into
+        # infinite recursion. Real underscore COLUMNS (_metadata,
+        # _pw_window_start, …) stay addressable.
+        if name.startswith("__") or name in _EXPR_INTERNALS:
             raise AttributeError(name)
         return ColumnReference(cls, name)
 

@@ -269,6 +269,20 @@ def with_capacity(fn: Callable, capacity: int) -> Callable:
     return wrapper
 
 
+def with_propagate_none(fn: Callable) -> Callable:
+    """Skip the call (return None) when any argument is None
+    (reference UDF propagate_none semantics)."""
+    afn = coerce_async(fn)
+
+    @functools.wraps(fn)
+    async def wrapper(*args, **kwargs):
+        if any(a is None for a in args) or any(v is None for v in kwargs.values()):
+            return None
+        return await afn(*args, **kwargs)
+
+    return wrapper
+
+
 def with_timeout(fn: Callable, timeout: float) -> Callable:
     afn = coerce_async(fn)
 
@@ -436,12 +450,16 @@ class UDF:
             wrapped = batched
             if self.cache_strategy is not None:
                 wrapped = with_cache_strategy(wrapped, self.cache_strategy)
+            if self.propagate_none:
+                wrapped = with_propagate_none(wrapped)
             return AsyncApplyExpression(wrapped, ret, args, kwargs)
 
         if isinstance(ex, AsyncExecutor) or is_async or (
             isinstance(ex, AutoExecutor) and is_async
         ):
             wrapped = coerce_async(fn)
+            if self.propagate_none:
+                wrapped = with_propagate_none(wrapped)
             if isinstance(ex, AsyncExecutor):
                 if ex.retry_strategy is not None:
                     wrapped = with_retry_strategy(wrapped, ex.retry_strategy)
@@ -462,6 +480,8 @@ class UDF:
         fn_sync = fn
         if self.cache_strategy is not None:
             cached = with_cache_strategy(fn, self.cache_strategy)
+            if self.propagate_none:
+                cached = with_propagate_none(cached)
             return AsyncApplyExpression(cached, ret, args, kwargs)
         return ApplyExpression(
             fn_sync,

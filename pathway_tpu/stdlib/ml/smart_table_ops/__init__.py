@@ -118,6 +118,29 @@ def _greedy_matching(pairs) -> tuple:
     return tuple(out)
 
 
+def _score_pairs(el: Table, er: Table, fweights: Table, symmetric: bool) -> Table:
+    """(left, right, weight) pair scores: join edge sets on shared
+    feature, weight each shared feature (edge weights × feature weight),
+    sum per pair. el/er columns: (node, feature, w); fweights columns:
+    (feature, fw)."""
+    pairs = el.join_inner(er, el.feature == er.feature).select(
+        left=el.node,
+        right=er.node,
+        feature=el.feature,
+        pw_=el.w * er.w,
+    )
+    if symmetric:
+        pairs = pairs.filter(
+            apply(lambda l, r: int(l) < int(r), this.left, this.right)
+        )
+    contrib = pairs.join_inner(fweights, pairs.feature == fweights.feature).select(
+        left=pairs.left, right=pairs.right, c=pairs.pw_ * fweights.fw
+    )
+    return contrib.groupby(this.left, this.right).reduce(
+        left=this.left, right=this.right, weight=reducers.sum(this.c)
+    )
+
+
 def _match_from_scores(scores: Table) -> Table:
     """scores: (left, right, weight) → one-to-one greedy assignment."""
     agg = scores.reduce(
@@ -159,22 +182,7 @@ def _fuzzy_match(
             cnt.cnt,
         ),
     )
-    pairs = el.join_inner(er, el.feature == er.feature).select(
-        left=el.node,
-        right=er.node,
-        feature=el.feature,
-        pw_=el.w * er.w,
-    )
-    if symmetric:
-        pairs = pairs.filter(
-            apply(lambda l, r: int(l) < int(r), this.left, this.right)
-        )
-    contrib = pairs.join_inner(fweights, pairs.feature == fweights.feature).select(
-        left=pairs.left, right=pairs.right, c=pairs.pw_ * fweights.fw
-    )
-    scores = contrib.groupby(this.left, this.right).reduce(
-        left=this.left, right=this.right, weight=reducers.sum(this.c)
-    )
+    scores = _score_pairs(el, er, fweights, symmetric)
     res = _match_from_scores(scores)
     if by_hand_match is not None:
         res = res.concat_reindex(
@@ -240,28 +248,23 @@ def _fuzzy_match_columns(
     feature table is implicit, keyed by token)."""
     gen = feature_generation.generate
     norm = normalization.normalize
-    el = _edges_from_column(left_col, gen)
+    el = _edges_from_column(left_col, gen).select(
+        node=this.node, feature=this.tok, w=1.0
+    )
     # symmetric: alias the same edge set so the self-join sees two tables
     er = (
-        el.select(node=this.node, tok=this.tok)
+        el.select(node=this.node, feature=this.feature, w=this.w)
         if symmetric
-        else _edges_from_column(right_col, gen)
+        else _edges_from_column(right_col, gen).select(
+            node=this.node, feature=this.tok, w=1.0
+        )
     )
     all_edges = el if symmetric else el.concat_reindex(er)
-    cnt = all_edges.groupby(this.tok).reduce(tok=this.tok, cnt=reducers.count())
-    normw = cnt.select(tok=this.tok, fw=apply(norm, this.cnt))
-    pairs = el.join_inner(er, el.tok == er.tok).select(
-        left=el.node, right=er.node, tok=el.tok
+    cnt = all_edges.groupby(this.feature).reduce(
+        feature=this.feature, cnt=reducers.count()
     )
-    if symmetric:
-        pairs = pairs.filter(apply(lambda l, r: int(l) < int(r), this.left, this.right))
-    contrib = pairs.join_inner(normw, pairs.tok == normw.tok).select(
-        left=pairs.left, right=pairs.right, c=normw.fw
-    )
-    scores = contrib.groupby(this.left, this.right).reduce(
-        left=this.left, right=this.right, weight=reducers.sum(this.c)
-    )
-    return _match_from_scores(scores)
+    normw = cnt.select(feature=this.feature, fw=apply(norm, this.cnt))
+    return _match_from_scores(_score_pairs(el, er, normw, symmetric))
 
 
 def smart_fuzzy_match(

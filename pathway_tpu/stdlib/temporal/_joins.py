@@ -31,17 +31,81 @@ def interval(lower_bound, upper_bound) -> Interval:
 
 class _TemporalJoinResult:
     """select()-able result of a temporal join. Wraps an inner JoinResult
-    plus a time filter applied before projection."""
+    plus a time filter applied before projection. User expressions may
+    reference the ORIGINAL tables; they are remapped onto the prepped
+    (time-column-augmented) join sides. For left/right/outer joins the
+    time condition belongs to the JOIN, not a post-filter: rows whose
+    every pair fails the interval come back null-extended (reference
+    _interval_join.py outer semantics)."""
 
-    def __init__(self, join_result: JoinResult, extra_filter: ColumnExpression | None):
+    def __init__(
+        self,
+        join_result: JoinResult,
+        extra_filter: ColumnExpression | None,
+        lmap: Table | None = None,
+        rmap: Table | None = None,
+        lorig: Table | None = None,
+        rorig: Table | None = None,
+        how: str = "inner",
+    ):
+        self._maps = (lmap, rmap, lorig, rorig)
+        self._how = how
         self._jr = join_result if extra_filter is None else join_result.filter(extra_filter)
 
+    def _remap(self, expr):
+        lmap, rmap, lorig, rorig = self._maps
+        if lmap is None:
+            return expr
+        return _remap_on(smart_wrap(expr), lmap, rmap, lorig, rorig)
+
+    def _null_extended(self, keep_side: Table, drop_side: Table, exprs: dict) -> Table:
+        """Rows of keep_side with no surviving pair, with drop_side
+        references replaced by None in the projection."""
+        from ...internals.expression import ConstColumnExpression
+        from ...internals.graph_runner import map_expression
+        from ...internals.thisclass import this
+
+        matched = self._jr.select(_pw_oid=keep_side.id)
+        mk = matched.groupby(this._pw_oid).reduce(_pw_oid=this._pw_oid)
+        mkeyed = mk.with_id(mk._pw_oid)
+        unmatched = keep_side.difference(mkeyed)
+
+        def nullify(e):
+            if isinstance(e, ColumnReference) and e._table is drop_side:
+                return ConstColumnExpression(None)
+            return None
+
+        nulled = {
+            name: map_expression(_rewrite(e, lambda t: unmatched if t is keep_side else t), nullify)
+            for name, e in exprs.items()
+        }
+        return unmatched.select(**nulled)
+
     def select(self, *args, **kwargs) -> Table:
-        return self._jr.select(*args, **kwargs)
+        exprs: dict = {}
+        for a in args:
+            ra = self._remap(a)
+            if not isinstance(ra, ColumnReference):
+                raise TypeError("positional select args must be column references")
+            exprs[ra._name] = ra
+        for k, v in kwargs.items():
+            exprs[k] = self._remap(v)
+        matched = self._jr.select(**exprs)
+        if self._how == "inner":
+            return matched
+        lmap, rmap, _lo, _ro = self._maps
+        parts = [matched]
+        if self._how in ("left", "outer"):
+            parts.append(self._null_extended(lmap, rmap, exprs))
+        if self._how in ("right", "outer"):
+            parts.append(self._null_extended(rmap, lmap, exprs))
+        return parts[0].concat_reindex(*parts[1:])
 
     def filter(self, expr):
         out = object.__new__(_TemporalJoinResult)
-        out._jr = self._jr.filter(expr)
+        out._maps = self._maps
+        out._how = self._how
+        out._jr = self._jr.filter(self._remap(expr))
         return out
 
 
@@ -95,13 +159,13 @@ def interval_join(
         l = l.with_columns(_pw_one=1)
         r = r.with_columns(_pw_one=1)
         conds = [l._pw_one == r._pw_one]
-    jr = l.join(r, *conds, how=how)
+    # the interval condition is part of the join: match on the inner
+    # pairs and null-extend unmatched rows at select time (outer hows)
+    jr = l.join(r, *conds, how="inner")
     filt = (r._pw_t >= l._pw_t + interval.lower_bound) & (
         r._pw_t <= l._pw_t + interval.upper_bound
     )
-    if how in ("left", "right", "outer"):
-        filt = filt | l._pw_t.is_none() | r._pw_t.is_none()
-    return _TemporalJoinResult(jr, filt)
+    return _TemporalJoinResult(jr, filt, lmap=l, rmap=r, lorig=self, rorig=other, how=how)
 
 
 def interval_join_inner(self, other, self_time, other_time, interval, *on, **kw):
@@ -146,7 +210,7 @@ def window_join(
     ).flatten(pw.this._pw_wins)
     conds = [l._pw_wins == r._pw_wins] + [_remap_on(c, l, r, self, other) for c in on]
     jr = l.join(r, *conds, how=how)
-    return _TemporalJoinResult(jr, None)
+    return _TemporalJoinResult(jr, None, lmap=l, rmap=r, lorig=self, rorig=other)
 
 
 def window_join_inner(self, other, self_time, other_time, window, *on, **kw):

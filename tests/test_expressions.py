@@ -1,0 +1,209 @@
+"""Expression namespaces (.str/.num/.dt), datetime/duration values,
+parse helpers, json access.
+
+Mirrors /root/reference/python/pathway/tests test coverage of the
+expressions/ method namespaces and engine/time.rs datetime ops."""
+
+from __future__ import annotations
+
+from datetime import datetime, timedelta
+
+import pathway_tpu as pw
+from .utils import T, run_table
+
+
+def _col(table, name="r"):
+    state = run_table(table)
+    out = sorted(
+        (row[0] for row in state.values()),
+        key=lambda v: (v is None, repr(v)),
+    )
+    pw.clear_graph()
+    return out
+
+
+def test_str_namespace():
+    t = T(
+        """
+          | s
+        1 | Hello_World
+        """
+    )
+    res = t.select(
+        lo=pw.this.s.str.lower(),
+        up=pw.this.s.str.upper(),
+        ln=pw.this.s.str.len(),
+        sw=pw.this.s.str.startswith("Hel"),
+        rep=pw.this.s.str.replace("_", " "),
+        sl=pw.this.s.str.slice(0, 5),
+        rev=pw.this.s.str.reversed(),
+    )
+    (row,) = run_table(res).values()
+    assert row == (
+        "hello_world",
+        "HELLO_WORLD",
+        11,
+        True,
+        "Hello World",
+        "Hello",
+        "dlroW_olleH",
+    )
+
+
+def test_str_parse_helpers():
+    t = T(
+        """
+          | s
+        1 | 42
+        """
+    )
+    res = t.select(
+        i=pw.this.s.str.parse_int(),
+        f=pw.this.s.str.parse_float(),
+    )
+    (row,) = run_table(res).values()
+    assert row == (42, 42.0)
+
+
+def test_num_namespace():
+    t = T(
+        """
+          | x
+        1 | -2.25
+        """
+    )
+    res = t.select(
+        a=pw.this.x.num.abs(),
+        r=pw.this.x.num.round(1),
+        fl=pw.this.x.num.floor(),
+        ce=pw.this.x.num.ceil(),
+    )
+    (row,) = run_table(res).values()
+    assert row == (2.25, -2.2, -3.0, -2.0)
+
+
+def test_dt_namespace_from_strptime():
+    t = T(
+        """
+          | s
+        1 | 2023-03-25T12:30:45
+        """
+    )
+    res = t.select(d=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S")).select(
+        y=pw.this.d.dt.year(),
+        mo=pw.this.d.dt.month(),
+        day=pw.this.d.dt.day(),
+        h=pw.this.d.dt.hour(),
+        mi=pw.this.d.dt.minute(),
+        sec=pw.this.d.dt.second(),
+    )
+    (row,) = run_table(res).values()
+    assert row == (2023, 3, 25, 12, 30, 45)
+
+
+def test_dt_strftime_roundtrip():
+    t = T(
+        """
+          | s
+        1 | 2024-01-02T03:04:05
+        """
+    )
+    res = t.select(
+        out=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S").dt.strftime("%d/%m/%Y %H:%M")
+    )
+    (row,) = run_table(res).values()
+    assert row == ("02/01/2024 03:04",)
+
+
+def test_datetime_arithmetic_durations():
+    t = pw.debug.table_from_rows(_dt_schema(), [(datetime(2024, 1, 1, 12, 0, 0),)])
+    res = t.select(
+        plus=pw.this.d + timedelta(hours=3),
+        minus=pw.this.d - timedelta(days=1),
+    ).select(
+        h=pw.this.plus.dt.hour(),
+        day=pw.this.minus.dt.day(),
+    )
+    (row,) = run_table(res).values()
+    assert row == (15, 31)
+
+
+def _dt_schema():
+    class S(pw.Schema):
+        d: pw.DateTimeNaive
+
+    return S
+
+
+def test_json_field_access():
+    import json
+
+    class S(pw.Schema):
+        data: pw.Json
+
+    t = pw.debug.table_from_rows(
+        S, [(pw.Json({"name": "alice", "age": 3, "tags": ["a", "b"]}),)]
+    )
+    res = t.select(
+        name=pw.this.data["name"].as_str(),
+        age=pw.this.data["age"].as_int(),
+        tag0=pw.this.data["tags"][0].as_str(),
+    )
+    (row,) = run_table(res).values()
+    assert row == ("alice", 3, "a")
+
+
+def test_if_else_chains_and_boolean_logic():
+    t = T(
+        """
+          | a  | b
+        1 | 1  | 10
+        2 | 5  | 2
+        3 | 7  | 7
+        """
+    )
+    res = t.select(
+        m=pw.if_else(pw.this.a > pw.this.b, pw.this.a, pw.this.b),
+        both=(pw.this.a > 2) & (pw.this.b > 2),
+        either=(pw.this.a > 6) | (pw.this.b > 6),
+        inv=~(pw.this.a == pw.this.b),
+    )
+    state = run_table(res)
+    got = sorted(state.values())
+    assert got == [
+        (5, False, False, True),
+        (7, True, True, False),
+        (10, False, True, True),
+    ]
+
+
+def test_coalesce_require_unwrap():
+    t = T(
+        """
+          | a | b
+        1 | 1 | 5
+        2 |   | 6
+        """
+    )
+    res = t.select(
+        c=pw.coalesce(pw.this.a, 0),
+        r=pw.require(pw.this.b, pw.this.a),
+    )
+    state = run_table(res)
+    assert sorted(state.values(), key=repr) == [(0, None), (1, 5)]
+
+
+def test_cast_between_types():
+    t = T(
+        """
+          | x
+        1 | 3
+        """
+    )
+    res = t.select(
+        f=pw.cast(float, pw.this.x),
+        s=pw.cast(str, pw.this.x),
+        b=pw.cast(bool, pw.this.x),
+    )
+    (row,) = run_table(res).values()
+    assert row == (3.0, "3", True)

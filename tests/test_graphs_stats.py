@@ -1,0 +1,124 @@
+"""Graph algorithms + statistical/ordered/stateful stdlib.
+
+Mirrors reference stdlib tests: graphs (pagerank, bellman_ford,
+louvain), statistical interpolate, ordered diff, stateful deduplicate."""
+
+from __future__ import annotations
+
+import math
+
+import pathway_tpu as pw
+from .utils import T, run_table
+
+
+def test_pagerank_star():
+    # everybody links to hub
+    edges = T(
+        """
+          | u | v
+        1 | a | hub
+        2 | b | hub
+        3 | c | hub
+        4 | hub | a
+        """
+    )
+    edges = edges.select(
+        u=edges.u, v=edges.v
+    )
+    ranks = pw.stdlib.graphs.pagerank(edges, steps=10)
+    state = run_table(ranks)
+    vals = sorted(r[0] for r in state.values())
+    assert len(vals) == 4
+    assert vals[-1] > vals[0]  # hub outranks the leaves
+    pw.clear_graph()
+
+
+def test_bellman_ford_shortest_paths():
+    verts = T(
+        """
+          | name | is_source
+        1 | s    | True
+        2 | a    | False
+        3 | b    | False
+        4 | unreachable | False
+        """
+    )
+    keyed = verts.with_id_from(pw.this.name)
+    e0 = T(
+        """
+          | u | v | dist
+        1 | s | a | 1.0
+        2 | a | b | 2.0
+        3 | s | b | 10.0
+        """
+    )
+    edges = e0.select(
+        u=keyed.pointer_from(e0.u),
+        v=keyed.pointer_from(e0.v),
+        dist=e0.dist,
+    )
+    res = pw.stdlib.graphs.bellman_ford(keyed, edges)
+    state = run_table(res)
+    names = run_table(keyed.select(name=pw.this.name))
+    by_name = {names[k][0]: state[k][0] for k in names}
+    assert by_name["s"] == 0.0
+    assert by_name["a"] == 1.0
+    assert by_name["b"] == 3.0  # via a, not the direct 10.0 edge
+    assert math.isinf(by_name["unreachable"])
+    pw.clear_graph()
+
+
+def test_interpolate_linear():
+    t = T(
+        """
+          | t | v
+        1 | 0 | 0.0
+        2 | 2 |
+        3 | 4 | 4.0
+        """
+    )
+    res = pw.stdlib.statistical.interpolate(
+        t, pw.this.t, pw.this.v
+    )
+    state = run_table(res)
+    vals = sorted((row[0], row[1]) for row in state.values())
+    assert vals == [(0, 0.0), (2, 2.0), (4, 4.0)]
+    pw.clear_graph()
+
+
+def test_ordered_diff():
+    t = T(
+        """
+          | t | v
+        1 | 1 | 10
+        2 | 2 | 15
+        3 | 3 | 21
+        """
+    )
+    res = pw.stdlib.ordered.diff(t, pw.this.t, pw.this.v)
+    state = run_table(res)
+    diffs = sorted(
+        (row[0] for row in state.values()), key=lambda v: (v is None, repr(v))
+    )
+    assert diffs == [5, 6, None]
+    pw.clear_graph()
+
+
+def test_stateful_deduplicate():
+    t = pw.debug.table_from_markdown(
+        """
+          | v  | __time__
+        1 | 1  | 0
+        2 | 1  | 2
+        3 | 5  | 4
+        4 | 4  | 6
+        5 | 10 | 8
+        """
+    )
+    # accept only values at least 2 greater than the last accepted
+    res = pw.stdlib.stateful.deduplicate(
+        t, col=pw.this.v, acceptor=lambda new, old: new >= old + 2
+    )
+    state = run_table(res)
+    assert [row[0] for row in state.values()] == [10]
+    pw.clear_graph()

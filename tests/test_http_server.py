@@ -1,0 +1,100 @@
+"""REST connector end-to-end over real HTTP.
+
+Mirrors /root/reference/python/pathway/tests/test_http_server.py:
+rest_connector → pipeline → response_writer, with requests from a
+helper thread; /_schema OpenAPI endpoint."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(url: str, payload: dict, timeout=20):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+class QuerySchema(pw.Schema):
+    value: int
+
+
+def test_rest_connector_roundtrip():
+    port = _free_port()
+    queries, response_writer = pw.io.http.rest_connector(
+        host="127.0.0.1", port=port, schema=QuerySchema, delete_completed_queries=False
+    )
+    results = queries.select(result=pw.this.value * 2)
+    response_writer(results)
+
+    answers = {}
+    errors = []
+
+    def client():
+        try:
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    answers["a"] = _post(f"http://127.0.0.1:{port}/", {"value": 21})
+                    break
+                except Exception:
+                    time.sleep(0.3)
+            answers["b"] = _post(f"http://127.0.0.1:{port}/", {"value": 5})
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/_schema", timeout=5
+            ) as resp:
+                answers["schema"] = json.loads(resp.read().decode())
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+        finally:
+            stopper()
+
+    def stopper():
+        # end the run: the rest reader never closes, so stop the engine
+        runner.engine.stop()
+
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    runner = GraphRunner()
+    for table, sink in list(pw.parse_graph.outputs):
+        build = sink.get("build")
+        if build is not None:
+            build(runner, table)
+    for spec in list(pw.parse_graph.subscriptions):
+        runner.subscribe(
+            spec["table"],
+            on_change=spec.get("on_change"),
+            on_time_end=spec.get("on_time_end"),
+            on_end=spec.get("on_end"),
+        )
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    runner.run()
+    t.join(timeout=30)
+    pw.clear_graph()
+
+    assert not errors, errors
+    assert answers["a"] == 42
+    assert answers["b"] == 10
+    assert "openapi" in json.dumps(answers["schema"]).lower() or "paths" in answers["schema"]

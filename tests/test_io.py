@@ -1,0 +1,149 @@
+"""IO connector coverage: fs/csv/jsonlines/plaintext read+write,
+streaming watch semantics, python write observer, demo streams.
+
+Mirrors reference io tests (python/pathway/tests/test_io.py)."""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from .utils import run_table
+
+
+class WordSchema(pw.Schema):
+    word: str
+    n: int
+
+
+def test_csv_read_static_with_schema_inference(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("word,n\nfoo,1\nbar,2\n")
+    t = pw.io.csv.read(str(p), mode="static")
+    state = run_table(t)
+    assert sorted(state.values()) == [("bar", 2), ("foo", 1)]
+    pw.clear_graph()
+
+
+def test_jsonlines_static_roundtrip(tmp_path):
+    src = tmp_path / "in.jsonl"
+    with open(src, "w") as f:
+        f.write(json.dumps({"word": "x", "n": 7}) + "\n")
+        f.write(json.dumps({"word": "y", "n": 8}) + "\n")
+    t = pw.io.jsonlines.read(str(src), schema=WordSchema, mode="static")
+    out = tmp_path / "out.jsonl"
+    pw.io.jsonlines.write(t, str(out))
+    pw.run()
+    pw.clear_graph()
+    recs = [json.loads(l) for l in open(out) if l.strip()]
+    assert sorted((r["word"], r["n"], r["diff"]) for r in recs) == [
+        ("x", 7, 1),
+        ("y", 8, 1),
+    ]
+
+
+def test_csv_write_includes_time_diff(tmp_path):
+    src = tmp_path / "in.csv"
+    src.write_text("word,n\nfoo,1\n")
+    t = pw.io.csv.read(str(src), mode="static")
+    out = tmp_path / "out.csv"
+    pw.io.csv.write(t, str(out))
+    pw.run()
+    pw.clear_graph()
+    rows = list(csv.DictReader(open(out)))
+    assert rows[0]["word"] == "foo"
+    assert rows[0]["diff"] == "1"
+
+
+def test_plaintext_read(tmp_path):
+    p = tmp_path / "doc.txt"
+    p.write_text("hello\nworld\n")
+    t = pw.io.plaintext.read(str(p), mode="static")
+    state = run_table(t)
+    assert sorted(r[0] for r in state.values()) == ["hello", "world"]
+    pw.clear_graph()
+
+
+def test_fs_streaming_watches_additions_and_deletions(tmp_path):
+    in_dir = tmp_path / "watch"
+    in_dir.mkdir()
+    (in_dir / "a.txt").write_text("one\n")
+
+    events = []
+    t = pw.io.plaintext.read(str(in_dir), mode="streaming", autocommit_duration_ms=50)
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (row["data"], is_addition)
+        ),
+    )
+
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    runner = GraphRunner()
+    for spec in list(pw.parse_graph.subscriptions):
+        runner.subscribe(spec["table"], on_change=spec.get("on_change"))
+
+    def mutate():
+        time.sleep(1.0)
+        (in_dir / "b.txt").write_text("two\n")
+        time.sleep(1.0)
+        os.remove(in_dir / "a.txt")
+        time.sleep(1.0)
+        runner.engine.stop()
+
+    th = threading.Thread(target=mutate, daemon=True)
+    th.start()
+    runner.run()
+    th.join(timeout=10)
+    pw.clear_graph()
+
+    assert ("one", True) in events
+    assert ("two", True) in events
+    assert ("one", False) in events  # deletion retracts
+    assert ("two", False) not in events
+
+
+def test_python_write_observer(tmp_path):
+    src = tmp_path / "in.jsonl"
+    src.write_text(json.dumps({"word": "z", "n": 1}) + "\n")
+    t = pw.io.jsonlines.read(str(src), schema=WordSchema, mode="static")
+
+    seen = []
+
+    class Observer(pw.io.python.ConnectorObserver):
+        def on_change(self, key, row, time, is_addition):
+            seen.append((row["word"], is_addition))
+
+        def on_end(self):
+            seen.append(("END", None))
+
+    pw.io.python.write(t, Observer())
+    pw.run()
+    pw.clear_graph()
+    assert ("z", True) in seen and ("END", None) in seen
+
+
+def test_demo_range_stream():
+    t = pw.demo.range_stream(nb_rows=5, autocommit_duration_ms=10)
+    state = run_table(t)
+    assert sorted(r[0] for r in state.values()) == [0.0, 1.0, 2.0, 3.0, 4.0]
+    pw.clear_graph()
+
+
+def test_null_write():
+    src = pw.debug.table_from_markdown(
+        """
+          | a
+        1 | 1
+        """
+    )
+    pw.io.null.write(src)
+    pw.run()
+    pw.clear_graph()

@@ -225,3 +225,57 @@ def test_gradual_broadcast():
     times_with_changes = sorted({t for _k, _r, t, _d in cap.stream})
     assert 2 not in times_with_changes
     pw.clear_graph()
+
+
+def test_gradual_broadcast_drifting_threshold_rebroadcasts():
+    """A threshold that drifts one band-width per update must eventually
+    rebroadcast: the check is attached-value vs the NEW band, not new
+    value vs the old band."""
+    data = T(
+        """
+          | a
+        1 | 10
+        """
+    )
+    thresholds = pw.debug.table_from_markdown(
+        """
+          | lower | value | upper | __time__
+        1 | 0.0   | 1.0   | 2.0   | 0
+        2 | 1.0   | 1.9   | 3.0   | 2
+        3 | 1.5   | 2.9   | 4.0   | 4
+        4 | 2.5   | 3.9   | 5.0   | 6
+        """
+    )
+    res = data._gradual_broadcast(
+        thresholds, thresholds.lower, thresholds.value, thresholds.upper
+    )
+    runner = GraphRunner()
+    cap, names = runner.capture(res)
+    runner.run()
+    (row,) = cap.state.values()
+    # attached 1.0 leaves [1.5, 4.0] at t=4 -> rebroadcast to 2.9, which
+    # then stays inside the final [2.5, 5.0] band
+    assert row[names.index("apx_value")] == 2.9
+    pw.clear_graph()
+
+
+def test_udf_propagate_none_with_cache():
+    calls = []
+
+    @pw.udf(propagate_none=True, cache_strategy=pw.udfs.InMemoryCache())
+    def inc(x: int) -> int:
+        calls.append(x)
+        return x + 1
+
+    t = T(
+        """
+          | x
+        1 | 5
+        2 |
+        """
+    )
+    res = t.select(y=inc(pw.this.x))
+    state = run_table(res)
+    assert sorted((r[0] for r in state.values()), key=repr) == [6, None]
+    assert calls == [5]
+    pw.clear_graph()

@@ -1,0 +1,114 @@
+"""pw.sql coverage (reference python/pathway/internals/sql.py tests)."""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from .utils import T, run_table
+
+
+SALES = """
+  | city   | amount
+1 | paris  | 10
+2 | paris  | 30
+3 | berlin | 5
+4 | tokyo  | 20
+"""
+
+
+def _rows(table):
+    state = run_table(table)
+    out = sorted(state.values(), key=repr)
+    pw.clear_graph()
+    return out
+
+
+def test_sql_select_where():
+    t = T(SALES)
+    res = pw.sql("SELECT city, amount FROM sales WHERE amount > 9", sales=t)
+    assert _rows(res) == sorted(
+        [("paris", 10), ("paris", 30), ("tokyo", 20)], key=repr
+    )
+
+
+def test_sql_group_by_aggregates():
+    t = T(SALES)
+    res = pw.sql(
+        "SELECT city, SUM(amount) AS total, COUNT(*) AS n FROM sales GROUP BY city",
+        sales=t,
+    )
+    assert _rows(res) == sorted(
+        [("paris", 40, 2), ("berlin", 5, 1), ("tokyo", 20, 1)], key=repr
+    )
+
+
+def test_sql_expressions_and_aliases():
+    t = T(SALES)
+    res = pw.sql(
+        "SELECT city, amount * 2 AS double_amount FROM sales WHERE city = 'paris'",
+        sales=t,
+    )
+    assert _rows(res) == sorted([("paris", 20), ("paris", 60)], key=repr)
+
+
+def test_sql_join():
+    sales = T(SALES)
+    pop = T(
+        """
+          | city   | pop
+        1 | paris  | 2
+        2 | berlin | 4
+        """
+    )
+    res = pw.sql(
+        "SELECT s.city, s.amount, p.pop FROM sales s JOIN pop p ON s.city = p.city",
+        sales=sales,
+        pop=pop,
+    )
+    assert _rows(res) == sorted(
+        [("paris", 10, 2), ("paris", 30, 2), ("berlin", 5, 4)], key=repr
+    )
+
+
+def test_sql_join_group_by_qualified_column():
+    sales = T(SALES)
+    pop = T(
+        """
+          | city   | pop
+        1 | paris  | 2
+        2 | berlin | 4
+        """
+    )
+    res = pw.sql(
+        "SELECT s.city, SUM(s.amount) AS total FROM sales s JOIN pop p "
+        "ON s.city = p.city GROUP BY s.city HAVING SUM(s.amount) > 10",
+        sales=sales,
+        pop=pop,
+    )
+    assert _rows(res) == [("paris", 40)]
+
+
+def test_sql_join_colliding_column_names():
+    """b.v must return B's value, not silently resolve to a.v."""
+    a = T(
+        """
+          | x | v
+        1 | k | 100
+        """
+    )
+    b = T(
+        """
+          | x | v
+        1 | k | 999
+        """
+    )
+    res = pw.sql("SELECT a.v AS av, b.v AS bv FROM a JOIN b ON a.x = b.x", a=a, b=b)
+    assert _rows(res) == [(100, 999)]
+
+
+def test_sql_having_order_limit():
+    t = T(SALES)
+    res = pw.sql(
+        "SELECT city, SUM(amount) AS total FROM sales GROUP BY city HAVING SUM(amount) > 10",
+        sales=t,
+    )
+    assert _rows(res) == sorted([("paris", 40), ("tokyo", 20)], key=repr)

@@ -1,0 +1,273 @@
+"""Temporal stdlib: windows (batch + streamed), interval/asof joins,
+behaviors.
+
+Mirrors /root/reference/python/pathway/tests/temporal/ (test_windows*.py
+batch + _stream variants, test_interval_join.py, test_asof_join.py,
+temporal behaviors)."""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib import temporal
+from .utils import T, run_table
+
+
+def _by_cols(state, names, *cols):
+    idx = [names.index(c) for c in cols]
+    return sorted(tuple(row[i] for i in idx) for row in state.values())
+
+
+def _run(table):
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    runner = GraphRunner()
+    cap, names = runner.capture(table)
+    runner.run()
+    pw.clear_graph()
+    return cap, names
+
+
+def test_tumbling_window_batch():
+    t = T(
+        """
+          | t  | v
+        1 | 1  | 10
+        2 | 2  | 20
+        3 | 5  | 30
+        4 | 6  | 40
+        5 | 9  | 50
+        """
+    )
+    res = t.windowby(pw.this.t, window=temporal.tumbling(duration=4)).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+        n=pw.reducers.count(),
+    )
+    cap, names = _run(res)
+    assert _by_cols(cap.state, names, "start", "total", "n") == [
+        (0, 30, 2),
+        (4, 70, 2),
+        (8, 50, 1),
+    ]
+
+
+def test_tumbling_window_streamed_is_incremental():
+    """Streamed rows land in windows as epochs advance; late rows update
+    previously-emitted windows via retract/insert pairs."""
+    t = pw.debug.table_from_markdown(
+        """
+          | t | v  | __time__
+        1 | 1 | 10 | 0
+        2 | 5 | 30 | 2
+        3 | 2 | 20 | 4
+        """
+    )
+    res = t.windowby(pw.this.t, window=temporal.tumbling(duration=4)).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    cap, names = _run(res)
+    assert _by_cols(cap.state, names, "start", "total") == [(0, 30), (4, 30)]
+    # the t=0 window was updated in place: 10 then retract+insert to 30
+    si, ti = names.index("start"), names.index("total")
+    w0 = [(r[ti], d) for _k, r, _t, d in cap.stream if r[si] == 0]
+    assert (10, 1) in w0 and (10, -1) in w0 and (30, 1) in w0
+
+
+def test_sliding_window_batch():
+    t = T(
+        """
+          | t | v
+        1 | 1 | 1
+        2 | 3 | 1
+        3 | 5 | 1
+        """
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.sliding(hop=2, duration=4)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    cap, names = _run(res)
+    got = _by_cols(cap.state, names, "start", "n")
+    # windows [-2,2),[0,4),[2,6),[4,8): t=1 in first two, t=3 in [0,4)+[2,6), t=5 in [2,6)+[4,8)
+    assert got == [(-2, 1), (0, 2), (2, 2), (4, 1)]
+
+
+def test_session_window_max_gap():
+    t = T(
+        """
+          | t  | v
+        1 | 1  | 1
+        2 | 2  | 1
+        3 | 10 | 1
+        4 | 11 | 1
+        """
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.session(max_gap=3)
+    ).reduce(n=pw.reducers.count())
+    cap, names = _run(res)
+    assert _by_cols(cap.state, names, "n") == [(2,), (2,)]
+
+
+def test_window_instance_keying():
+    t = T(
+        """
+          | u | t | v
+        1 | a | 1 | 1
+        2 | a | 2 | 2
+        3 | b | 1 | 5
+        """
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.tumbling(duration=4), instance=pw.this.u
+    ).reduce(
+        u=pw.this._pw_instance,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    cap, names = _run(res)
+    assert _by_cols(cap.state, names, "u", "total") == [("a", 3), ("b", 5)]
+
+
+def test_interval_join_inner():
+    left = T(
+        """
+          | t | a
+        1 | 1 | l1
+        2 | 5 | l2
+        """
+    )
+    right = T(
+        """
+          | t | b
+        1 | 2 | r1
+        2 | 9 | r2
+        """
+    )
+    res = left.interval_join(
+        right, left.t, right.t, temporal.interval(-2, 2)
+    ).select(a=left.a, b=right.b)
+    cap, names = _run(res)
+    assert _by_cols(cap.state, names, "a", "b") == [("l1", "r1")]
+
+
+def test_interval_join_outer_variants():
+    left = T(
+        """
+          | t | a
+        1 | 1 | l1
+        2 | 5 | l2
+        """
+    )
+    right = T(
+        """
+          | t | b
+        1 | 2 | r1
+        """
+    )
+    res = temporal.interval_join_left(
+        left, right, left.t, right.t, temporal.interval(-2, 2)
+    ).select(a=left.a, b=right.b)
+    cap, names = _run(res)
+    assert _by_cols(cap.state, names, "a", "b") == [("l1", "r1"), ("l2", None)]
+
+
+def test_interval_join_with_on_condition():
+    left = T(
+        """
+          | t | k | a
+        1 | 1 | x | l1
+        2 | 1 | y | l2
+        """
+    )
+    right = T(
+        """
+          | t | k | b
+        1 | 2 | x | r1
+        """
+    )
+    res = left.interval_join(
+        right, left.t, right.t, temporal.interval(-2, 2), left.k == right.k
+    ).select(a=left.a, b=right.b)
+    cap, names = _run(res)
+    assert _by_cols(cap.state, names, "a", "b") == [("l1", "r1")]
+
+
+def test_asof_join():
+    trades = T(
+        """
+          | t  | price
+        1 | 2  | 100
+        2 | 7  | 101
+        """
+    )
+    quotes = T(
+        """
+          | t  | bid
+        1 | 1  | 99
+        2 | 6  | 100
+        3 | 10 | 102
+        """
+    )
+    res = trades.asof_join(quotes, trades.t, quotes.t).select(
+        price=trades.price, bid=quotes.bid
+    )
+    cap, names = _run(res)
+    assert _by_cols(cap.state, names, "price", "bid") == [(100, 99), (101, 100)]
+
+
+def test_windowby_with_cutoff_behavior_ignores_late_rows():
+    """common_behavior(cutoff=c): rows arriving after the window's end +
+    cutoff (in event time, tracked via the time column) are dropped
+    (reference temporal_behavior.py + engine forget R13)."""
+    t = pw.debug.table_from_markdown(
+        """
+          | t  | v  | __time__
+        1 | 1  | 10 | 0
+        2 | 9  | 20 | 2
+        3 | 2  | 99 | 4
+        """
+    )
+    res = t.windowby(
+        pw.this.t,
+        window=temporal.tumbling(duration=4),
+        behavior=temporal.common_behavior(cutoff=2),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    cap, names = _run(res)
+    got = dict(_by_cols(cap.state, names, "start", "total"))
+    # late row (t=2 arriving after watermark passed 9 > 0+4+2) is ignored
+    assert got[0] == 10
+    assert got[8] == 20
+
+
+def test_exactly_once_behavior_single_emission():
+    """exactly_once_behavior: each window emits once, when the watermark
+    passes its end (+shift); no retract/insert churn at the sink."""
+    t = pw.debug.table_from_markdown(
+        """
+          | t | v  | __time__
+        1 | 1 | 10 | 0
+        2 | 2 | 20 | 2
+        3 | 9 | 30 | 4
+        4 | 13| 40 | 6
+        """
+    )
+    res = t.windowby(
+        pw.this.t,
+        window=temporal.tumbling(duration=4),
+        behavior=temporal.exactly_once_behavior(),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    cap, names = _run(res)
+    si = names.index("start")
+    # window [0,4) emitted exactly once, with the final total, no retraction
+    w0 = [(r, d) for _k, r, _t, d in cap.stream if r[si] == 0]
+    assert len(w0) == 1 and w0[0][1] == 1
+    assert w0[0][0][names.index("total")] == 30

@@ -1,0 +1,190 @@
+"""UDF system: executors, caches, retries, timeouts.
+
+Mirrors /root/reference/python/pathway/tests coverage of internals/udfs/
+(executors.py, caches.py, retries.py)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from .utils import T, run_table
+
+
+def test_sync_udf_with_kwargs_and_defaults():
+    @pw.udf
+    def scale(x: int, factor: int = 10) -> int:
+        return x * factor
+
+    t = T(
+        """
+          | x
+        1 | 1
+        2 | 2
+        """
+    )
+    res = t.select(y=scale(pw.this.x))
+    assert sorted(r[0] for r in run_table(res).values()) == [10, 20]
+
+
+def test_async_udf_executor():
+    calls = []
+
+    @pw.udf(executor=pw.udfs.async_executor())
+    async def slow_double(x: int) -> int:
+        calls.append(x)
+        await asyncio.sleep(0.01)
+        return x * 2
+
+    t = T(
+        """
+          | x
+        1 | 3
+        2 | 4
+        """
+    )
+    res = t.select(y=slow_double(pw.this.x))
+    assert sorted(r[0] for r in run_table(res).values()) == [6, 8]
+    assert sorted(calls) == [3, 4]
+
+
+def test_async_udf_retries():
+    attempts = {"n": 0}
+
+    @pw.udf(
+        executor=pw.udfs.async_executor(
+            retry_strategy=pw.udfs.FixedDelayRetryStrategy(max_retries=5, delay_ms=1)
+        )
+    )
+    async def flaky(x: int) -> int:
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("transient")
+        return x
+
+    t = T(
+        """
+          | x
+        1 | 7
+        """
+    )
+    res = t.select(y=flaky(pw.this.x))
+    assert [r[0] for r in run_table(res).values()] == [7]
+    assert attempts["n"] == 3
+
+
+def test_async_udf_timeout_produces_error_value():
+    @pw.udf(executor=pw.udfs.async_executor(timeout=0.01))
+    async def hang(x: int) -> int:
+        await asyncio.sleep(5)
+        return x
+
+    t = T(
+        """
+          | x
+        1 | 1
+        """
+    )
+    res = t.select(y=hang(pw.this.x))
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    runner = GraphRunner()
+    runner.engine.terminate_on_error = False
+    cap, _ = runner.capture(res)
+    runner.run()
+    from pathway_tpu.engine.value import Error
+
+    (row,) = cap.state.values()
+    assert isinstance(row[0], Error)
+    pw.clear_graph()
+
+
+def test_in_memory_cache_deduplicates_calls():
+    calls = []
+
+    @pw.udf(cache_strategy=pw.udfs.InMemoryCache())
+    async def embed(x: str) -> str:
+        calls.append(x)
+        return x.upper()
+
+    t = T(
+        """
+          | s
+        1 | aa
+        2 | aa
+        3 | bb
+        """
+    )
+    res = t.select(y=embed(pw.this.s))
+    assert sorted(r[0] for r in run_table(res).values()) == ["AA", "AA", "BB"]
+    assert sorted(calls) == ["aa", "bb"]  # second "aa" served from cache
+
+
+def test_disk_cache_persists_across_runs(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_PERSISTENT_STORAGE", str(tmp_path))
+    calls = []
+
+    def make_udf():
+        @pw.udf(cache_strategy=pw.udfs.DiskCache(name="testcache"))
+        async def embed(x: str) -> str:
+            calls.append(x)
+            return x + "!"
+
+        return embed
+
+    def run_once():
+        embed = make_udf()
+        t = T(
+            """
+              | s
+            1 | q
+            """
+        )
+        res = t.select(y=embed(pw.this.s))
+        out = [r[0] for r in run_table(res).values()]
+        pw.clear_graph()
+        return out
+
+    assert run_once() == ["q!"]
+    assert run_once() == ["q!"]
+    assert calls == ["q"]  # second run hit the disk cache
+
+
+def test_batch_executor_receives_lists():
+    seen = []
+
+    @pw.udf(executor=pw.udfs.batch_executor(max_batch_size=8))
+    def embed_many(xs: list[int]) -> list[int]:
+        seen.append(list(xs))
+        return [x + 1 for x in xs]
+
+    t = T(
+        """
+          | x
+        1 | 1
+        2 | 2
+        3 | 3
+        """
+    )
+    res = t.select(y=embed_many(pw.this.x))
+    assert sorted(r[0] for r in run_table(res).values()) == [2, 3, 4]
+    assert len(seen) == 1 and sorted(seen[0]) == [1, 2, 3]  # one batch call
+
+
+def test_udf_propagate_none():
+    @pw.udf(propagate_none=True)
+    def double(x: int) -> int:
+        return x * 2
+
+    t = T(
+        """
+          | x
+        1 | 5
+        2 |
+        """
+    )
+    res = t.select(y=double(pw.this.x))
+    assert sorted((r[0] for r in run_table(res).values()), key=repr) == [10, None]
