@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time as _wall
 from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
@@ -103,6 +104,30 @@ class Node:
     """Base dataflow operator."""
 
     n_inputs = 1
+
+    # attribute names forming the node's recoverable state (operator
+    # snapshots, reference src/persistence/operator_snapshot.rs); empty
+    # for stateless operators
+    _snap_attrs: tuple = ()
+
+    def snapshot_state(self):
+        if not self._snap_attrs:
+            return None
+        return {a: getattr(self, a) for a in self._snap_attrs}
+
+    def restore_state(self, state) -> None:
+        for a, v in state.items():
+            setattr(self, a, v)
+
+    def snapshot_signature(self):
+        """Structural identity for snapshot compatibility. Restoring
+        state into a CHANGED program silently corrupts results, so nodes
+        expose their distinguishing configuration here; subclasses add
+        what the generic name cannot see (reducer kinds, join shape).
+        User expressions cannot be fingerprinted — same-program across
+        restarts remains the documented persistence contract (as in the
+        reference) — but common edits are caught."""
+        return (self.name, tuple(sorted((c.id, p) for c, p in self.consumers)))
 
     def __init__(self, graph: "EngineGraph", name: str = ""):
         self.graph = graph
@@ -257,6 +282,7 @@ class SessionSourceNode(Node):
     snapshot protocols."""
 
     n_inputs = 0
+    _snap_attrs = ("state",)
 
     def __init__(self, graph):
         super().__init__(graph)
@@ -332,6 +358,11 @@ class ExprMapNode(Node):
         self.deterministic = deterministic
         self.batch_eval = batch_eval  # (keys, rows) -> list of out rows
         self.memo: dict[int, tuple] = {}
+        if not deterministic:
+            self._snap_attrs = ("memo",)
+
+    def snapshot_signature(self):
+        return (super().snapshot_signature(), len(self.exprs), self.deterministic)
 
     def process(self, time):
         updates = self.take()
@@ -416,6 +447,7 @@ class ConcatNode(Node):
         super().__init__(graph, "Concat")
         self.owners: dict[int, int] = {}
         self.check = check_disjoint
+        self._snap_attrs = ("owners",)
 
     def process(self, time):
         out = []
@@ -496,6 +528,7 @@ class _KeyedStateNode(Node):
         super().__init__(graph, name)
         self.state: list[dict[int, tuple]] = [dict() for _ in range(n_inputs)]
         self.emitted: dict[int, tuple] = {}
+        self._snap_attrs = ("state", "emitted")
 
     def process(self, time):
         affected: set[int] = set()
@@ -604,6 +637,13 @@ class GroupByNode(Node):
         self.groups: dict[int, dict[int, list[tuple]]] = {}
         self.sg_state: dict[int, list[Any]] = {}
         self.emitted: dict[int, tuple] = {}
+        self._snap_attrs = ("groups", "sg_state", "emitted")
+
+    def snapshot_signature(self):
+        return (
+            super().snapshot_signature(),
+            tuple(type(r).__name__ for r, _fns in self.specs),
+        )
 
     def process(self, time):
         updates = self.take()
@@ -668,6 +708,7 @@ class DeduplicateNode(Node):
         self.instance_fn = instance_fn
         self.acceptor = acceptor
         self.accepted: dict[Any, tuple[int, tuple]] = {}
+        self._snap_attrs = ("accepted",)
 
     def process(self, time):
         out = []
@@ -720,6 +761,10 @@ class JoinNode(Node):
         self.exact_match = exact_match
         self.left: dict[Any, dict[int, tuple]] = {}
         self.right: dict[Any, dict[int, tuple]] = {}
+        self._snap_attrs = ("left", "right")
+
+    def snapshot_signature(self):
+        return (super().snapshot_signature(), self.how, self.lw, self.rw)
 
     def _outputs_for(self, jk) -> dict[int, tuple]:
         out: dict[int, tuple] = {}
@@ -796,6 +841,7 @@ class SortNode(Node):
         self.rows: dict[int, tuple[Any, Any]] = {}  # key -> (instance, sort_key)
         self.instances: dict[Any, dict[int, Any]] = {}  # inst -> key -> sort_key
         self.emitted: dict[int, tuple[Any, tuple]] = {}  # key -> (inst, (prev, next))
+        self._snap_attrs = ("rows", "instances", "emitted")
 
     def process(self, time):
         updates = self.take()
@@ -859,6 +905,7 @@ class BufferNode(Node):
         self.time_fn = time_fn
         self.pending: dict[int, tuple[Any, tuple]] = {}
         self.released: set[int] = set()
+        self._snap_attrs = ("pending", "released", "watermark")
         self.flush_on_end = flush_on_end
         self.watermark: Any = None
 
@@ -940,6 +987,7 @@ class ForgetNode(Node):
         self.time_fn = time_fn
         self.live: dict[int, tuple[Any, tuple]] = {}
         self.watermark: Any = None
+        self._snap_attrs = ("live", "watermark")
 
     def process(self, time):
         out = []
@@ -973,6 +1021,8 @@ class ForgetNode(Node):
 class FreezeNode(Node):
     """Graph::freeze: once the watermark passes threshold, changes to the
     row are ignored."""
+
+    _snap_attrs = ("watermark",)
 
     def __init__(self, graph, threshold_fn: Callable, time_fn: Callable | None = None):
         super().__init__(graph, "Freeze")
@@ -1012,6 +1062,7 @@ class GradualBroadcastNode(Node):
         self.apx = None  # currently-attached approximate value
         self.rows: dict[int, tuple] = {}
         self.attached: dict[int, Any] = {}
+        self._snap_attrs = ("apx", "rows", "attached")
 
     def process(self, time):
         out: list[Update] = []
@@ -1098,6 +1149,39 @@ class ExternalIndexNode(Node):
         # incremental mode: live query store key -> (prefix, payload, k, flt)
         self.queries: dict[int, tuple] = {}
 
+    # the index itself holds device arrays — snapshot the host-side row
+    # mirror and rebuild the index from it on restore
+    def snapshot_state(self):
+        return {
+            "data_rows": self.data_rows,
+            "answered": self.answered,
+            "queries": self.queries,
+        }
+
+    def restore_state(self, state) -> None:
+        self.data_rows = state["data_rows"]
+        self.answered = state["answered"]
+        self.queries = state["queries"]
+        self._index_add([(k, *self.data_fn(k, r)) for k, r in self.data_rows.items()])
+
+    def _index_add(self, adds) -> None:
+        """Embed (optionally) and insert (key, payload, metadata) triples."""
+        if not adds:
+            return
+        payloads = [p for _, p, _ in adds]
+        if self.data_embed is not None:
+            payloads = self.data_embed(payloads)
+        items = [
+            (key, payload, metadata)
+            for (key, _, metadata), payload in zip(adds, payloads)
+            if payload is not None
+        ]
+        if hasattr(self.index, "add_batch"):
+            self.index.add_batch(items)
+        else:
+            for key, payload, metadata in items:
+                self.index.add(key, payload, metadata)
+
     def _compile_filter(self, flt):
         if flt is None or self.filter_compiler is None:
             return None
@@ -1120,19 +1204,7 @@ class ExternalIndexNode(Node):
                 self.data_rows.pop(key, None)
                 index_changed = True
         if adds:
-            payloads = [p for _, p, _ in adds]
-            if self.data_embed is not None:
-                payloads = self.data_embed(payloads)
-            items = [
-                (key, payload, metadata)
-                for (key, _, metadata), payload in zip(adds, payloads)
-                if payload is not None
-            ]
-            if hasattr(self.index, "add_batch"):
-                self.index.add_batch(items)
-            else:
-                for key, payload, metadata in items:
-                    self.index.add(key, payload, metadata)
+            self._index_add(adds)
             index_changed = True
 
         out: list[Update] = []
@@ -1240,6 +1312,7 @@ class CaptureNode(Node):
         super().__init__(graph, "Capture")
         self.state: dict[int, tuple] = {}
         self.stream: list[tuple[int, tuple, int, int]] = []  # key,row,time,diff
+        self._snap_attrs = ("state", "stream")
 
     def process(self, time):
         for key, row, diff in consolidate(self.take()):
@@ -1260,6 +1333,7 @@ class AsyncApplyNode(Node):
         super().__init__(graph, name)
         self.async_fn = async_fn  # async (key, row) -> value tuple appended to row
         self.memo: dict[int, tuple] = {}
+        self._snap_attrs = ("memo",)
 
     def process(self, time):
         updates = self.take()
@@ -1322,6 +1396,8 @@ class EngineGraph:
         self.terminate_on_error = True
         self.error_sessions: list[InputSession] = []
         self._error_seq = 0
+        self._opsnap_time = -1       # operator-snapshot restore point
+        self._last_opsnap_wall = 0.0
 
     # --- builder helpers used by the graph runner ---
 
@@ -1432,6 +1508,68 @@ class EngineGraph:
         # speedrun recomputes sink output from the recorded stream, so
         # replayed epochs are NOT suppressed there
         self.replay_frontier = -1 if self._speedrun else frontier
+        # layer 2 — operator snapshots (operator_snapshot.rs): restore
+        # the whole graph's state at the snapshot time and skip replaying
+        # the input events it already covers
+        if not self._speedrun and frontier >= 0:
+            rec = self.persistence.recover_operator_snapshot(frontier)
+            if rec is not None:
+                import pickle
+
+                t0, blob = rec
+                data = pickle.loads(blob)
+                sig_ok = len(data["sig"]) == len(self.nodes) and all(
+                    nid < len(self.nodes)
+                    and self.nodes[nid].snapshot_signature() == node_sig
+                    for nid, node_sig in data["sig"]
+                )
+                # signature mismatch (program changed) → ignore snapshot,
+                # fall back to full input replay
+                if sig_ok:
+                    for nid, st in data["states"].items():
+                        self.nodes[nid].restore_state(st)
+                    for s in self.session_sources:
+                        s.replay_batches = [
+                            (tt, ups) for tt, ups in s.replay_batches if tt > t0
+                        ]
+                    # static sources re-produce deterministically and are
+                    # already inside the restored state: fast-forward past
+                    # the covered epochs (feeding them would double-count;
+                    # not feeding while they queue would livelock run())
+                    for st_src in self.static_sources:
+                        while (
+                            st_src.pos < len(st_src.batches)
+                            and st_src.batches[st_src.pos][0] <= t0
+                        ):
+                            st_src.pos += 1
+                    self._opsnap_time = t0
+
+    def _snapshot_operators(self, t) -> None:
+        """Write layer-2 state. Called AFTER every ADVANCE of epoch t is
+        durable — a snapshot must never cover unfinalized input."""
+        import pickle
+
+        states = {}
+        for n in self.nodes:
+            s = n.snapshot_state()
+            if s is not None:
+                states[n.id] = s
+        # the signature covers EVERY node: a restored snapshot skips
+        # replay, so any topology change (even a new sink) must fall
+        # back to full replay or the new node would stay empty
+        sig = [(n.id, n.snapshot_signature()) for n in self.nodes]
+        blob = pickle.dumps({"sig": sig, "time": t, "states": states}, protocol=4)
+        self.persistence.save_operator_snapshot(int(t), blob)
+        self._last_opsnap_wall = _wall.monotonic()
+
+    def _maybe_snapshot_operators(self, t) -> None:
+        if self.persistence is None or self._speedrun:
+            return
+        interval_ms = getattr(self.persistence_config, "snapshot_interval_ms", 0) or 0
+        if interval_ms <= 0:
+            return  # end-of-run snapshot only
+        if (_wall.monotonic() - self._last_opsnap_wall) * 1000.0 >= interval_ms:
+            self._snapshot_operators(t)
 
     def run(self, monitoring_callback: Callable | None = None) -> None:
         """Run to completion: replay recovered epochs, then process
@@ -1498,10 +1636,21 @@ class EngineGraph:
                 for s, _b in session_batches:
                     if s.persistent_id is not None:
                         self.persistence.advance(s.persistent_id, t, s.last_offsets or {})
+                if session_batches:
+                    self._maybe_snapshot_operators(t)
             last_time = t
             if monitoring_callback is not None:
                 monitoring_callback(self)
 
+        # final snapshot BEFORE the end-of-input flush: the flush assumes
+        # input is over, which a restarted run cannot know
+        if (
+            self.persistence is not None
+            and not self._speedrun
+            and last_time >= 0
+            and any(s.persistent_id is not None for s in self.session_sources)
+        ):
+            self._snapshot_operators(last_time)
         # end of input: flush time-based operators at a final epoch
         self.current_time = last_time + 1
         self._frontier_hooks(INF_TIME)

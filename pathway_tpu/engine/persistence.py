@@ -342,6 +342,67 @@ class EnginePersistence:
         w.append(KIND_ADVANCE, time, 0, pickle.dumps(offsets or {}, protocol=4))
         w.flush()
 
+    OPS_SOURCE = "__operators__"
+
+    def _replace_single_record(
+        self, source_id: str, record: tuple[int, int, int, bytes] | None
+    ) -> None:
+        """Atomically make a source's log hold exactly ``record``
+        ((kind, time, key, blob)) — or nothing. Shared by the
+        snapshot-save and recovery-compaction paths."""
+        if self.kind == "mock":
+            bucket = self._mock_bucket(source_id)
+            bucket[:] = [
+                r for r in bucket if not (len(r) == 5 and r[0] == source_id)
+            ]
+            if record is not None:
+                MemoryLogWriter(bucket, source_id).append(*record)
+            return
+        path = self._source_path(source_id)
+        if record is None:
+            if os.path.exists(path):
+                os.remove(path)
+            return
+        tmp = path + ".compact"
+        if _use_native():
+            w = _native.SnapshotLogWriter(tmp, append=False)
+        else:
+            w = PyLogWriter(tmp, append=False)
+        w.append(*record)
+        w.flush()
+        w.close()
+        os.replace(tmp, path)
+
+    def save_operator_snapshot(self, time: int, blob: bytes) -> None:
+        """Write the whole-graph operator snapshot (layer 2 of the
+        reference's persistence, operator_snapshot.rs). Only the latest
+        snapshot is ever read, so each save REPLACES the log — appending
+        would grow it by full-state-size per interval, unbounded."""
+        self._replace_single_record(self.OPS_SOURCE, (KIND_OPSNAP, int(time), 0, blob))
+
+    def recover_operator_snapshot(self, max_time: int):
+        """Latest snapshot finalized at or before ``max_time`` (the input
+        frontier — a snapshot can never cover unfinalized input, see the
+        write ordering in EngineGraph.run). Returns (time, blob) or
+        None; compacts the log down to the returned record."""
+        reader = self._open_reader(self.OPS_SOURCE)
+        if reader is None:
+            return None
+        best = None
+        try:
+            for kind, time, _key, blob in reader:
+                if kind == KIND_OPSNAP and time <= max_time:
+                    if best is None or time >= best[0]:
+                        best = (time, blob)
+        finally:
+            reader.close()
+        # compact: orphaned/stale snapshots never need a second read
+        self._replace_single_record(
+            self.OPS_SOURCE,
+            None if best is None else (KIND_OPSNAP, best[0], 0, best[1]),
+        )
+        return best
+
     def reset_source(self, source_id: str) -> None:
         """Drop a source's log (record mode, offset-unaware reader: the
         reader re-produces all input, so recording starts over)."""

@@ -23,6 +23,8 @@ class _IterateResultNode(df.Node):
     """Holds the current input state; on each epoch, recompute the batch
     fixpoint and emit output diffs."""
 
+    _snap_attrs = ("state", "emitted")
+
     def __init__(self, graph, body: Callable, n_cols: int, limit: int | None):
         super().__init__(graph, "Iterate")
         self.body = body

@@ -179,6 +179,172 @@ def _log_roundtrip(writer_cls, reader_cls, path):
     assert recs == [(1, 7, 42, b"hello"), (2, 8, 0, b"world")]
 
 
+def test_operator_snapshot_skips_replay(tmp_path):
+    """Layer 2: a restart restores operator state from the snapshot and
+    does NOT re-feed the covered input events through the graph."""
+    in_dir = tmp_path / "in"
+    in_dir.mkdir()
+    _write_jsonl(in_dir / "a.jsonl", ["cat", "dog", "cat"])
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstorage"))
+
+    ev1: list = []
+    _wordcount_run(in_dir, backend, ev1)
+    assert ("cat", 2, True) in ev1
+    assert os.path.exists(tmp_path / "pstorage" / "streams" / "__operators__.bin")
+
+    # restart with the SAME pipeline: groupby state must come from the
+    # snapshot, with zero updates traveling through the GroupBy operator
+    words = pw.io.jsonlines.read(
+        str(in_dir), schema=WordSchema, mode="streaming", persistent_id="words"
+    )
+    counts = words.groupby(pw.this.word).reduce(
+        word=pw.this.word, count=pw.reducers.count()
+    )
+    ev2: list = []
+    runner = GraphRunner()
+    runner.engine.persistence_config = pw.persistence.Config.simple_config(backend)
+    runner.subscribe(
+        counts, on_change=lambda key, row, time, diff: ev2.append(row["word"])
+    )
+    runner.run()
+    assert ev2 == []
+    engine = runner.engine
+    gb = next(n for n in engine.nodes if n.name == "GroupBy")
+    assert gb.stats.rows_in == 0  # no replay traveled through the graph
+    assert engine._opsnap_time >= 0  # restore actually happened
+    # and the restored state is real: one group per distinct word
+    assert len(gb.groups) == 2
+    pw.clear_graph()
+
+
+def test_operator_snapshot_with_new_data_replays_only_tail(tmp_path):
+    in_dir = tmp_path / "in"
+    in_dir.mkdir()
+    _write_jsonl(in_dir / "a.jsonl", ["cat", "dog"])
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstorage"))
+    ev1: list = []
+    _wordcount_run(in_dir, backend, ev1)
+
+    _write_jsonl(in_dir / "b.jsonl", ["cat"])
+    ev2: list = []
+    _wordcount_run(in_dir, backend, ev2)
+    # incremental update computed on top of restored groupby state
+    assert ("cat", 1, False) in ev2 and ("cat", 2, True) in ev2
+    assert not any(w == "dog" for w, _c, _a in ev2)
+
+    # and a third run from the NEW snapshot is silent again
+    ev3: list = []
+    _wordcount_run(in_dir, backend, ev3)
+    assert ev3 == []
+
+
+def test_operator_snapshot_ignored_when_graph_changes(tmp_path):
+    """A different program (operator signature mismatch) falls back to
+    full input replay instead of restoring mismatched state."""
+    in_dir = tmp_path / "in"
+    in_dir.mkdir()
+    _write_jsonl(in_dir / "a.jsonl", ["x", "y"])
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstorage"))
+    ev1: list = []
+    _wordcount_run(in_dir, backend, ev1)
+
+    # new program over the same storage: plain passthrough, no groupby
+    words = pw.io.jsonlines.read(
+        str(in_dir), schema=WordSchema, mode="streaming", persistent_id="words"
+    )
+    runner = GraphRunner()
+    runner.engine.persistence_config = pw.persistence.Config.simple_config(backend)
+    cap, _names = runner.capture(words)
+    runner.run()
+    assert len(cap.state) == 2  # state rebuilt via replay despite stale snapshot
+    pw.clear_graph()
+
+
+def test_snapshot_restore_with_static_source(tmp_path):
+    """Static tables mixed with persistent streams: a restart must
+    neither livelock (static batch never fed) nor double-count (static
+    rows already inside the restored state)."""
+    in_dir = tmp_path / "in"
+    in_dir.mkdir()
+    _write_jsonl(in_dir / "a.jsonl", ["cat"])
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstorage"))
+    cfg = pw.persistence.Config.simple_config(backend)
+
+    def run_once():
+        static = pw.debug.table_from_rows(WordSchema, [("static_word",)])
+        stream = pw.io.jsonlines.read(
+            str(in_dir), schema=WordSchema, mode="streaming", persistent_id="words"
+        )
+        both = stream.concat_reindex(static)
+        counts = both.groupby(pw.this.word).reduce(
+            word=pw.this.word, count=pw.reducers.count()
+        )
+        runner = GraphRunner()
+        runner.engine.persistence_config = cfg
+        cap, names = runner.capture(counts)
+        runner.run()
+        pw.clear_graph()
+        return {
+            row[names.index("word")]: row[names.index("count")]
+            for row in cap.state.values()
+        }
+
+    assert run_once() == {"cat": 1, "static_word": 1}
+    # restart terminates (no livelock) and does not double the static row
+    assert run_once() == {"cat": 1, "static_word": 1}
+
+
+def test_snapshot_ignored_when_reducer_changes(tmp_path):
+    """Same topology, different reducer: the snapshot signature must
+    reject the restore (count-state inside a sum program = silently
+    wrong aggregates) and rebuild via full replay."""
+    in_dir = tmp_path / "in"
+    in_dir.mkdir()
+    _write_jsonl(in_dir / "a.jsonl", ["cat", "dog"])
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstorage"))
+    cfg = pw.persistence.Config.simple_config(backend)
+
+    ev1: list = []
+    _wordcount_run(in_dir, backend, ev1)  # count reducer
+
+    words = pw.io.jsonlines.read(
+        str(in_dir), schema=WordSchema, mode="streaming", persistent_id="words"
+    )
+    sums = words.groupby(pw.this.word).reduce(
+        word=pw.this.word,
+        total=pw.reducers.sum(pw.apply(len, pw.this.word)),
+    )
+    runner = GraphRunner()
+    runner.engine.persistence_config = cfg
+    cap, names = runner.capture(sums)
+    runner.run()
+    got = {
+        row[names.index("word")]: row[names.index("total")]
+        for row in cap.state.values()
+    }
+    assert got == {"cat": 3, "dog": 3}  # replayed + recomputed, not restored
+    assert runner.engine._opsnap_time == -1
+    pw.clear_graph()
+
+
+def test_ops_log_stays_bounded(tmp_path):
+    """Each snapshot REPLACES the ops log — N snapshots must not grow it
+    N-fold."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+    cfg = pw.persistence.Config.simple_config(backend)
+    p = eng_persist.EnginePersistence(cfg)
+    blob = b"x" * 10_000
+    for i in range(20):
+        p.save_operator_snapshot(i, blob)
+    p.close()
+    path = p._source_path(eng_persist.EnginePersistence.OPS_SOURCE)
+    assert os.path.getsize(path) < 3 * len(blob)
+    p2 = eng_persist.EnginePersistence(cfg)
+    rec = p2.recover_operator_snapshot(100)
+    assert rec == (19, blob)
+    p2.close()
+
+
 def test_py_log_roundtrip_and_torn_tail(tmp_path):
     path = str(tmp_path / "log.bin")
     _log_roundtrip(eng_persist.PyLogWriter, eng_persist.PyLogReader, path)
