@@ -1510,8 +1510,16 @@ class EngineGraph:
         self.replay_frontier = -1 if self._speedrun else frontier
         # layer 2 — operator snapshots (operator_snapshot.rs): restore
         # the whole graph's state at the snapshot time and skip replaying
-        # the input events it already covers
-        if not self._speedrun and frontier >= 0:
+        # the input events it already covers. Only sound when EVERY real
+        # source is persistent: a snapshot also contains state derived
+        # from non-persistent sources, whose readers re-feed on restart
+        # and would double-count on top of the restored state.
+        all_persistent = all(
+            s.persistent_id is not None
+            for s in self.session_sources
+            if not s.is_error_log
+        )
+        if not self._speedrun and frontier >= 0 and all_persistent:
             rec = self.persistence.recover_operator_snapshot(frontier)
             if rec is not None:
                 import pickle
@@ -1648,7 +1656,13 @@ class EngineGraph:
             self.persistence is not None
             and not self._speedrun
             and last_time >= 0
-            and any(s.persistent_id is not None for s in self.session_sources)
+            and last_time != self._opsnap_time  # something actually changed
+            and self.session_sources
+            and all(
+                s.persistent_id is not None
+                for s in self.session_sources
+                if not s.is_error_log
+            )
         ):
             self._snapshot_operators(last_time)
         # end of input: flush time-based operators at a final epoch

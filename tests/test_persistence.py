@@ -327,6 +327,45 @@ def test_snapshot_ignored_when_reducer_changes(tmp_path):
     pw.clear_graph()
 
 
+def test_snapshot_disabled_with_non_persistent_source(tmp_path):
+    """A snapshot contains state from ALL sources; if one source is not
+    persistent, its reader re-feeds after restart, so restoring would
+    double-count — such graphs must fall back to input replay."""
+    in_dir = tmp_path / "in"
+    in_dir.mkdir()
+    _write_jsonl(in_dir / "a.jsonl", ["cat"])
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstorage"))
+    cfg = pw.persistence.Config.simple_config(backend)
+
+    class _Once(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(word="dog")  # NOT persistent: re-emits every run
+            self.commit()
+
+    def run_once():
+        stream = pw.io.jsonlines.read(
+            str(in_dir), schema=WordSchema, mode="streaming", persistent_id="words"
+        )
+        other = pw.io.python.read(
+            _Once(), schema=WordSchema, autocommit_duration_ms=None
+        )
+        counts = stream.concat_reindex(other).groupby(pw.this.word).reduce(
+            word=pw.this.word, count=pw.reducers.count()
+        )
+        runner = GraphRunner()
+        runner.engine.persistence_config = cfg
+        cap, names = runner.capture(counts)
+        runner.run()
+        pw.clear_graph()
+        return {
+            row[names.index("word")]: row[names.index("count")]
+            for row in cap.state.values()
+        }
+
+    assert run_once() == {"cat": 1, "dog": 1}
+    assert run_once() == {"cat": 1, "dog": 1}  # not {dog: 2}
+
+
 def test_ops_log_stays_bounded(tmp_path):
     """Each snapshot REPLACES the ops log — N snapshots must not grow it
     N-fold."""
