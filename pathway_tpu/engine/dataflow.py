@@ -29,7 +29,15 @@ from typing import Any, Callable, Iterable, Sequence
 import numpy as np
 
 from .reducers import Reducer
-from .value import ERROR, Error, Pointer, ref_scalar, rows_equal, values_equal
+from .value import (
+    ERROR,
+    Error,
+    Pointer,
+    ref_scalar,
+    rows_equal,
+    shard_of,
+    values_equal,
+)
 
 # Eager import so the one-time g++ build of the native runtime happens at
 # engine load, never mid-epoch inside the hot loop.
@@ -76,6 +84,17 @@ def consolidate(updates: list[Update]) -> list[Update]:
             else:
                 out.extend((key, row, -1) for _ in range(-diff))
     return out
+
+
+def shard_of_value(value, n: int) -> int:
+    """Shard for an arbitrary grouping/instance/join value. Always via
+    the canonical key hash (ref_scalar) so values that compare equal
+    (2 vs 2.0) route to the same shard, exactly like dict-keyed state
+    treats them in a single-worker run."""
+    return shard_of(int(ref_scalar(value)), n)
+
+
+BROADCAST = -1
 
 
 def _error_operand(fn: Callable, row: tuple) -> bool:
@@ -142,13 +161,27 @@ class Node:
         upstream.consumers.append((self, port))
         return self
 
+    def route_owner(self, key: int, row: tuple, port: int, n_shards: int):
+        """Which worker shard must process this update (multi-worker
+        runs): None = wherever it was produced (stateless/key-preserving
+        operators), BROADCAST = every shard, else a shard id. Stateful
+        operators override with their keying rule — the exchange
+        boundary of the reference's timely workers (shard.rs)."""
+        return None
+
     def emit(self, updates: list[Update], time) -> None:
         if not updates:
             return
         self.stats.rows_out += len(updates)
+        cluster = self.graph.cluster
         for node, port in self.consumers:
-            node.queues[port].extend(updates)
-            self.graph._dirty.add(node.id)
+            if cluster is not None:
+                local = cluster.route(self.graph, node, port, updates)
+            else:
+                local = updates
+            if local:
+                node.queues[port].extend(local)
+                self.graph._dirty.add(node.id)
 
     def take(self, port: int = 0) -> list[Update]:
         q = self.queues[port]
@@ -449,6 +482,9 @@ class ConcatNode(Node):
         self.check = check_disjoint
         self._snap_attrs = ("owners",)
 
+    def route_owner(self, key, row, port, n_shards):
+        return shard_of(key, n_shards)
+
     def process(self, time):
         out = []
         for port in range(self.n_inputs):
@@ -529,6 +565,9 @@ class _KeyedStateNode(Node):
         self.state: list[dict[int, tuple]] = [dict() for _ in range(n_inputs)]
         self.emitted: dict[int, tuple] = {}
         self._snap_attrs = ("state", "emitted")
+
+    def route_owner(self, key, row, port, n_shards):
+        return shard_of(key, n_shards)
 
     def process(self, time):
         affected: set[int] = set()
@@ -645,6 +684,11 @@ class GroupByNode(Node):
             tuple(type(r).__name__ for r, _fns in self.specs),
         )
 
+    def route_owner(self, key, row, port, n_shards):
+        # exchange on the GROUP key: all rows of a group reduce on one
+        # shard (reference group_by_table ShardPolicy, dataflow.rs:2991)
+        return shard_of(self.group_key_fn(key, row), n_shards)
+
     def process(self, time):
         updates = self.take()
         if not updates:
@@ -710,6 +754,9 @@ class DeduplicateNode(Node):
         self.accepted: dict[Any, tuple[int, tuple]] = {}
         self._snap_attrs = ("accepted",)
 
+    def route_owner(self, key, row, port, n_shards):
+        return shard_of_value(self.instance_fn(key, row), n_shards)
+
     def process(self, time):
         out = []
         for key, row, diff in self.take():
@@ -765,6 +812,10 @@ class JoinNode(Node):
 
     def snapshot_signature(self):
         return (super().snapshot_signature(), self.how, self.lw, self.rw)
+
+    def route_owner(self, key, row, port, n_shards):
+        jk = self.left_jk_fn(key, row) if port == 0 else self.right_jk_fn(key, row)
+        return shard_of_value(jk, n_shards)
 
     def _outputs_for(self, jk) -> dict[int, tuple]:
         out: dict[int, tuple] = {}
@@ -843,6 +894,9 @@ class SortNode(Node):
         self.emitted: dict[int, tuple[Any, tuple]] = {}  # key -> (inst, (prev, next))
         self._snap_attrs = ("rows", "instances", "emitted")
 
+    def route_owner(self, key, row, port, n_shards):
+        return shard_of_value(self.instance_fn(key, row), n_shards)
+
     def process(self, time):
         updates = self.take()
         if not updates:
@@ -905,9 +959,12 @@ class BufferNode(Node):
         self.time_fn = time_fn
         self.pending: dict[int, tuple[Any, tuple]] = {}
         self.released: set[int] = set()
-        self._snap_attrs = ("pending", "released", "watermark")
         self.flush_on_end = flush_on_end
         self.watermark: Any = None
+        self._snap_attrs = ("pending", "released", "watermark")
+
+    def route_owner(self, key, row, port, n_shards):
+        return shard_of(key, n_shards)
 
     def _advance_watermark(self, key, row):
         if self.time_fn is None:
@@ -989,6 +1046,9 @@ class ForgetNode(Node):
         self.watermark: Any = None
         self._snap_attrs = ("live", "watermark")
 
+    def route_owner(self, key, row, port, n_shards):
+        return shard_of(key, n_shards)
+
     def process(self, time):
         out = []
         for key, row, diff in self.take():
@@ -1063,6 +1123,9 @@ class GradualBroadcastNode(Node):
         self.rows: dict[int, tuple] = {}
         self.attached: dict[int, Any] = {}
         self._snap_attrs = ("apx", "rows", "attached")
+
+    def route_owner(self, key, row, port, n_shards):
+        return BROADCAST if port == 1 else None
 
     def process(self, time):
         out: list[Update] = []
@@ -1148,6 +1211,9 @@ class ExternalIndexNode(Node):
         self.answered: dict[int, tuple] = {}
         # incremental mode: live query store key -> (prefix, payload, k, flt)
         self.queries: dict[int, tuple] = {}
+
+    def route_owner(self, key, row, port, n_shards):
+        return 0  # pinned: device-side sharding lives in ops/knn, not here
 
     # the index itself holds device arrays — snapshot the host-side row
     # mirror and rebuild the index from it on restore
@@ -1280,22 +1346,34 @@ class OutputNode(Node):
         self.on_end_cb = on_end
         self.sort_by_key = sort_by_key
         self._saw_data = False
+        self._epoch_buf: list[Update] = []
+
+    def route_owner(self, key, row, port, n_shards):
+        return 0  # single consolidated, time-ordered sink stream
 
     def process(self, time):
-        updates = consolidate(self.take())
-        if not updates:
-            return
-        self._saw_data = True
-        if self.sort_by_key:
-            updates = sorted(updates, key=lambda u: (u[0], u[2]))
-        # recovered epochs rebuild state but are not re-delivered to sinks
-        # (exactly-once across restarts, reference persistence semantics)
-        if self.on_change is not None and time > self.graph.replay_frontier:
-            for key, row, diff in updates:
-                self.on_change(key, row, time, diff)
-        self.emit(updates, time)
+        # buffer until the epoch closes: multi-wave sharded sweeps (and
+        # back-edge re-passes) may deliver transient partial states that
+        # consolidation at time_end cancels out — sinks must only see
+        # the epoch's net changes (ConsolidateForOutput, operators/
+        # output.rs:27)
+        updates = self.take()
+        if updates:
+            self._epoch_buf.extend(updates)
+            self.emit(updates, time)
 
     def time_end(self, time):
+        updates = consolidate(self._epoch_buf)
+        self._epoch_buf = []
+        if updates:
+            self._saw_data = True
+            if self.sort_by_key:
+                updates = sorted(updates, key=lambda u: (u[0], u[2]))
+            # recovered epochs rebuild state but are not re-delivered to
+            # sinks (exactly-once across restarts)
+            if self.on_change is not None and time > self.graph.replay_frontier:
+                for key, row, diff in updates:
+                    self.on_change(key, row, time, diff)
         if self.on_time_end_cb is not None and time > self.graph.replay_frontier:
             self.on_time_end_cb(time)
 
@@ -1312,10 +1390,24 @@ class CaptureNode(Node):
         super().__init__(graph, "Capture")
         self.state: dict[int, tuple] = {}
         self.stream: list[tuple[int, tuple, int, int]] = []  # key,row,time,diff
+        self._epoch_buf: list[Update] = []
         self._snap_attrs = ("state", "stream")
 
+    def route_owner(self, key, row, port, n_shards):
+        return 0
+
     def process(self, time):
-        for key, row, diff in consolidate(self.take()):
+        # buffered like OutputNode: only the epoch's NET changes belong
+        # in the captured stream (transient partials from sharded sweeps
+        # or back-edge re-passes cancel at consolidation)
+        updates = self.take()
+        if updates:
+            self._epoch_buf.extend(updates)
+
+    def time_end(self, time):
+        updates = consolidate(self._epoch_buf)
+        self._epoch_buf = []
+        for key, row, diff in updates:
             self.stream.append((key, row, int(time), diff))
             if diff > 0:
                 self.state[key] = row
@@ -1398,6 +1490,8 @@ class EngineGraph:
         self._error_seq = 0
         self._opsnap_time = -1       # operator-snapshot restore point
         self._last_opsnap_wall = 0.0
+        # multi-worker: set by parallel.sharded.ShardCluster
+        self.cluster = None
 
     # --- builder helpers used by the graph runner ---
 
@@ -1428,7 +1522,8 @@ class EngineGraph:
         from .value import Json as _Json
 
         self._error_seq += 1
-        key = int(ref_scalar("__error__", self._error_seq))
+        # include the worker id: per-shard counters must not collide
+        key = int(ref_scalar("__error__", self.worker_id, self._error_seq))
         row = (origin.id, f"{type(exc).__name__}: {exc}", _Json(trace) if trace else None)
         for session in self.error_sessions:
             session.insert(key, row)
@@ -1457,10 +1552,12 @@ class EngineGraph:
                 if node.id in self._dirty:
                     self._dirty.discard(node.id)
                     node.process(time)
-        # time-end notifications for outputs
+        # time-end notifications: outputs/captures deliver the epoch's
+        # consolidated changes
         for node in self.nodes:
-            if isinstance(node, OutputNode):
-                node.time_end(time)
+            te = getattr(node, "time_end", None)
+            if te is not None:
+                te(time)
 
     def _frontier_hooks(self, frontier):
         for node in self.nodes:

@@ -173,10 +173,22 @@ class GraphRunner:
         self.engine = df.EngineGraph(n_workers=n_workers)
         self.lowered: dict[int, Lowered] = {}
         self.debug = debug
+        # multi-worker (PATHWAY_THREADS>1): replica runners lower the
+        # SAME graph in the same order, so node ids line up across
+        # shards and emit-time routing can address peers by id
+        # (parallel/sharded.py ShardCluster)
+        self._replicas: list["GraphRunner"] = (
+            [GraphRunner(debug=debug) for _ in range(n_workers - 1)]
+            if n_workers > 1
+            else []
+        )
+        self._cluster = None
 
     # ---------- public API ----------
 
     def capture(self, table: Table) -> tuple[df.CaptureNode, list[str]]:
+        for r in self._replicas:
+            r.capture(table)  # routed to shard 0; replica's stays empty
         low = self.lower(table)
         cap = df.CaptureNode(self.engine)
         cap.connect(low.node)
@@ -190,6 +202,8 @@ class GraphRunner:
         on_time_end: Callable | None = None,
         on_end: Callable | None = None,
     ) -> df.OutputNode:
+        for r in self._replicas:
+            r.subscribe(table)  # callbacks fire on shard 0 only
         low = self.lower(table)
         names = low.names
 
@@ -208,7 +222,15 @@ class GraphRunner:
         return out
 
     def run(self, monitoring_callback=None) -> None:
-        self.engine.run(monitoring_callback)
+        if self._replicas:
+            from ..parallel.sharded import ShardCluster
+
+            self._cluster = ShardCluster(
+                [self.engine] + [r.engine for r in self._replicas]
+            )
+            self._cluster.run(monitoring_callback)
+        else:
+            self.engine.run(monitoring_callback)
 
     # ---------- lowering ----------
 

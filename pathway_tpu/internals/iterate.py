@@ -25,6 +25,11 @@ class _IterateResultNode(df.Node):
 
     _snap_attrs = ("state", "emitted")
 
+    def route_owner(self, key, row, port, n_shards):
+        # the fixpoint body sees the whole input state: pin to shard 0
+        # (per-key sharding would split connected components)
+        return 0
+
     def __init__(self, graph, body: Callable, n_cols: int, limit: int | None):
         super().__init__(graph, "Iterate")
         self.body = body

@@ -23,8 +23,13 @@ def run(
 ) -> None:
     """Execute all registered outputs/subscriptions to completion
     (static sources) or until all streaming connectors close."""
-    runner = GraphRunner()
+    from .config import get_pathway_config
+
+    n_workers = max(1, get_pathway_config().threads)
+    runner = GraphRunner(n_workers=n_workers)
     runner.engine.terminate_on_error = terminate_on_error
+    for r in runner._replicas:
+        r.engine.terminate_on_error = terminate_on_error
     if persistence_config is None:
         # CLI record/replay wiring (reference cli.py:166-193): spawn's
         # --record/--replay-mode flags arrive via PATHWAY_REPLAY_* env
