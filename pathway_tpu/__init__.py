@@ -99,6 +99,9 @@ from . import persistence
 from . import xpacks
 from .internals.monitoring import MonitoringLevel
 from .internals.errors import ErrorLogSchema, global_error_log, local_error_log
+from .internals.export_import import ExportedTable, export_table, import_table
+from .internals.licensing import License, LicenseError
+from .internals.telemetry import Telemetry
 from .internals.custom_reducers import BaseCustomAccumulator
 
 # engine namespace parity (reference pathway.engine is the PyO3 module)

@@ -24,8 +24,16 @@ def run(
     """Execute all registered outputs/subscriptions to completion
     (static sources) or until all streaming connectors close."""
     from .config import get_pathway_config
+    from .licensing import License, check_worker_count
+    from .telemetry import Telemetry
 
-    n_workers = max(1, get_pathway_config().threads)
+    pwcfg = get_pathway_config()
+    lic = License.new(license_key or pwcfg.license_key)
+    # scale gate (reference config.rs MAX_WORKERS free tier)
+    check_worker_count(lic, pwcfg.n_workers)
+    telemetry = Telemetry()  # PATHWAY_TELEMETRY_SERVER (local file) or no-op
+
+    n_workers = max(1, pwcfg.threads)
     runner = GraphRunner(n_workers=n_workers)
     runner.engine.terminate_on_error = terminate_on_error
     for r in runner._replicas:
@@ -33,15 +41,12 @@ def run(
     if persistence_config is None:
         # CLI record/replay wiring (reference cli.py:166-193): spawn's
         # --record/--replay-mode flags arrive via PATHWAY_REPLAY_* env
-        from .config import get_pathway_config
-
-        pc = get_pathway_config()
-        if pc.replay_storage:
+        if pwcfg.replay_storage:
             from .. import persistence as _persistence
 
             persistence_config = _persistence.Config.simple_config(
-                _persistence.Backend.filesystem(pc.replay_storage),
-                persistence_mode=pc.replay_mode or "batch",
+                _persistence.Backend.filesystem(pwcfg.replay_storage),
+                persistence_mode=pwcfg.replay_mode or "batch",
             )
             # CLI-driven runs record/replay every source, not just those
             # with an explicit persistent_id
@@ -75,8 +80,13 @@ def run(
         http_server = MonitoringHttpServer(monitor)
         http_server.start()
     try:
-        runner.run(monitoring_callback=monitor.update if monitor else None)
+        with telemetry.span("graph_runner.run", workers=pwcfg.n_workers):
+            runner.run(monitoring_callback=monitor.update if monitor else None)
     finally:
+        if monitor is not None:
+            telemetry.gauge("rows_in", monitor.snapshot.rows_in)
+            telemetry.gauge("rows_out", monitor.snapshot.rows_out)
+        telemetry.flush()
         if http_server is not None:
             http_server.stop()
 

@@ -1,0 +1,79 @@
+"""Run telemetry: spans + counters, pluggable exporter.
+
+Rebuild of /root/reference/src/engine/telemetry.rs (:37-45 — OTLP
+traces/metrics with process mem/cpu and IO latency gauges) and the
+Python-side graph_runner spans (graph_runner/telemetry.py). This build
+never phones home: the exporter only activates when
+PATHWAY_TELEMETRY_SERVER / monitoring_server is explicitly configured,
+and it degrades to a local JSON-lines file path or a no-op."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: float | None = None
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> float:
+        return ((self.end or time.monotonic()) - self.start) * 1000.0
+
+
+class Telemetry:
+    """Collects spans/metrics for one run. ``endpoint`` may be a local
+    file path (JSON lines) — remote OTLP is intentionally not wired."""
+
+    def __init__(self, endpoint: str | None = None):
+        self.endpoint = endpoint or os.environ.get("PATHWAY_TELEMETRY_SERVER")
+        self.spans: list[Span] = []
+        self.metrics: dict[str, float] = {}
+
+    @property
+    def enabled(self) -> bool:
+        # only local file paths are exporters; URL endpoints (remote
+        # OTLP in the reference) are intentionally not wired — treat
+        # them as disabled rather than opening a file named like a URL
+        return self.endpoint is not None and "://" not in self.endpoint
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        s = Span(name, time.monotonic(), attrs=dict(attrs))
+        self.spans.append(s)
+        try:
+            yield s
+        finally:
+            s.end = time.monotonic()
+
+    def gauge(self, name: str, value: float) -> None:
+        self.metrics[name] = float(value)
+
+    def flush(self) -> None:
+        if not self.enabled:
+            return
+        try:
+            with open(self.endpoint, "a") as f:
+                f.write(
+                    json.dumps(
+                        {
+                            "ts": time.time(),
+                            "spans": [
+                                {"name": s.name, "ms": round(s.duration_ms, 3), **s.attrs}
+                                for s in self.spans
+                            ],
+                            "metrics": self.metrics,
+                        }
+                    )
+                    + "\n"
+                )
+        except OSError:
+            pass  # telemetry must never break the run
+

@@ -31,25 +31,25 @@ class _PwTag:
 
 
 def _resolve_path(path: str) -> Any:
+    # progressive module import + attribute walk: handles subpackages
+    # that the parent package does not import eagerly
     if path.startswith("pw."):
-        module_path = "pathway_tpu"
-        attrs = path.split(".")[1:]
+        parts = ["pathway_tpu"] + path.split(".")[1:]
     else:
         parts = path.split(".")
-        for split in range(len(parts), 0, -1):
-            try:
-                mod = importlib.import_module(".".join(parts[:split]))
-                obj = mod
-                for a in parts[split:]:
-                    obj = getattr(obj, a)
-                return obj
-            except (ImportError, AttributeError):
-                continue
-        raise ImportError(f"cannot resolve {path!r}")
-    obj: Any = importlib.import_module(module_path)
-    for a in attrs:
-        obj = getattr(obj, a)
-    return obj
+    last_exc: Exception | None = None
+    for split in range(len(parts), 0, -1):
+        try:
+            mod = importlib.import_module(".".join(parts[:split]))
+            obj: Any = mod
+            for a in parts[split:]:
+                obj = getattr(obj, a)
+            return obj
+        except (ImportError, AttributeError) as e:
+            if last_exc is None:
+                last_exc = e  # the longest split carries the real cause
+            continue
+    raise ImportError(f"cannot resolve {path!r}") from last_exc
 
 
 def _materialize(value: Any, variables: dict) -> Any:

@@ -1,0 +1,182 @@
+"""Auxiliary subsystems: license gating, telemetry, export/import,
+AsyncTransformer, YAML loader, viz, monitoring dashboard.
+
+Covers SURVEY.md §5's aux inventory (R27 telemetry, R28 license, R32
+export/import, P8 AsyncTransformer, P9 YAML config)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.licensing import License, LicenseError, check_worker_count
+from pathway_tpu.internals.telemetry import Telemetry
+from .utils import T, run_table
+
+
+def test_license_free_tier_worker_gate():
+    lic = License.new(None)
+    check_worker_count(lic, 8)  # at the limit: fine
+    with pytest.raises(LicenseError):
+        check_worker_count(lic, 9)
+    ent = License.new("enterprise-abc123")
+    check_worker_count(ent, 64)
+    assert lic.telemetry_required and not ent.telemetry_required
+
+
+def test_license_entitlements():
+    with pytest.raises(LicenseError):
+        License.new(None).check_entitlement("enterprise-connectors")
+    License.new("enterprise-x").check_entitlement("enterprise-connectors")
+
+
+def test_run_rejects_too_many_workers(monkeypatch):
+    monkeypatch.setenv("PATHWAY_THREADS", "4")
+    monkeypatch.setenv("PATHWAY_PROCESSES", "4")  # 16 > 8 free tier
+    t = T(
+        """
+          | a
+        1 | 1
+        """
+    )
+    pw.io.subscribe(t, on_change=lambda **kw: None)
+    with pytest.raises(LicenseError):
+        pw.run()
+    pw.clear_graph()
+
+
+def test_telemetry_local_file_exporter(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    tel = Telemetry(endpoint=path)
+    with tel.span("graph_runner.build", nodes=3):
+        pass
+    tel.gauge("rows_in", 42)
+    tel.flush()
+    rec = json.loads(open(path).read())
+    assert rec["metrics"]["rows_in"] == 42.0
+    assert rec["spans"][0]["name"] == "graph_runner.build"
+    assert Telemetry(endpoint=None).enabled is False
+
+
+def test_export_import_roundtrip():
+    t = T(
+        """
+          | word | n
+        1 | a    | 1
+        2 | b    | 2
+        """
+    )
+    agg = t.groupby(pw.this.word).reduce(word=pw.this.word, n=pw.reducers.sum(pw.this.n))
+    exported = pw.export_table(agg)
+    pw.clear_graph()
+
+    # new graph: imported table joins against fresh data
+    assert sorted(exported.rows.values()) == [("a", 1), ("b", 2)]
+    imp = pw.import_table(exported)
+    doubled = imp.select(word=pw.this.word, n2=pw.this.n * 2)
+    state = run_table(doubled)
+    assert sorted(state.values()) == [("a", 2), ("b", 4)]
+    pw.clear_graph()
+
+
+def test_export_import_with_history():
+    t = pw.debug.table_from_markdown(
+        """
+          | v | __time__ | __diff__
+        1 | 1 | 0        | 1
+        1 | 1 | 2        | -1
+        2 | 5 | 2        | 1
+        """
+    )
+    exported = pw.export_table(t)
+    pw.clear_graph()
+    imp = pw.import_table(exported, with_history=True)
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    runner = GraphRunner()
+    cap, _ = runner.capture(imp)
+    runner.run()
+    assert sorted(r[0] for r in cap.state.values()) == [5]
+    assert len(cap.stream) == 3  # full history replayed
+    pw.clear_graph()
+
+
+def test_async_transformer():
+    class Upper(pw.AsyncTransformer, output_schema=_out_schema()):
+        async def invoke(self, data: str) -> dict:
+            return {"data": data.upper()}
+
+    t = T(
+        """
+          | data
+        1 | cat
+        2 | dog
+        """
+    )
+    res = Upper(input_table=t).successful
+    state = run_table(res)
+    assert sorted(r[0] for r in state.values()) == ["CAT", "DOG"]
+    pw.clear_graph()
+
+
+def _out_schema():
+    class Out(pw.Schema):
+        data: str
+
+    return Out
+
+
+def test_yaml_loader(tmp_path):
+    cfg = tmp_path / "pipeline.yaml"
+    cfg.write_text(
+        """
+$run_name: demo
+splitter: !pw.xpacks.llm.splitters.TokenCountSplitter
+  max_tokens: 100
+name: $run_name
+nested:
+  k: 5
+"""
+    )
+    loaded = pw.load_yaml(open(cfg))
+    from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+
+    assert isinstance(loaded["splitter"], TokenCountSplitter)
+    assert loaded["name"] == "demo"
+    assert loaded["nested"]["k"] == 5
+
+
+def test_viz_table_to_pandas_and_repr():
+    t = T(
+        """
+          | a | b
+        1 | 1 | x
+        2 | 2 | y
+        """
+    )
+    df = pw.debug.table_to_pandas(t, include_id=False)
+    assert list(df.columns) == ["a", "b"]
+    assert sorted(df["a"].tolist()) == [1, 2]
+    pw.clear_graph()
+
+
+def test_monitoring_dashboard_snapshot():
+    from pathway_tpu.internals.graph_runner import GraphRunner
+    from pathway_tpu.internals.monitoring import StatsMonitor
+
+    t = T(
+        """
+          | a
+        1 | 1
+        """
+    )
+    res = t.select(b=pw.this.a + 1)
+    monitor = StatsMonitor()
+    runner = GraphRunner()
+    cap, _ = runner.capture(res)
+    runner.run(monitoring_callback=monitor.update)
+    assert monitor.snapshot.rows_in > 0
+    assert monitor.snapshot.operators
+    pw.clear_graph()
