@@ -23,12 +23,14 @@ def run(
 ) -> None:
     """Execute all registered outputs/subscriptions to completion
     (static sources) or until all streaming connectors close."""
-    from .config import get_pathway_config
+    from .config import get_pathway_config, pathway_config
     from .licensing import License, check_worker_count
     from .telemetry import Telemetry
 
     pwcfg = get_pathway_config()
-    lic = License.new(license_key or pwcfg.license_key)
+    # precedence: explicit arg > pw.set_license_key() (mutates the
+    # module-level pathway_config) > env
+    lic = License.new(license_key or pathway_config.license_key or pwcfg.license_key)
     # scale gate (reference config.rs MAX_WORKERS free tier)
     check_worker_count(lic, pwcfg.n_workers)
     telemetry = Telemetry()  # PATHWAY_TELEMETRY_SERVER (local file) or no-op

@@ -56,6 +56,55 @@ def _topk_fn(metric: str) -> Callable:
     return _JIT[metric]
 
 
+def _pallas_eligible(metric: str, k: int, mesh) -> bool:
+    """Use the fused pallas kernel on a real TPU for small k (its
+    merge is k max-extraction passes) and an unsharded index; the
+    sharded path rides the jit collectives instead."""
+    import jax
+
+    return jax.default_backend() == "tpu" and k <= 64 and mesh is None
+
+
+_BIAS_JIT: dict = {}
+
+
+def _pallas_bias(metric: str, matrix, valid):
+    """Validity (+ L2 -|doc|^2) bias for the fused kernel. Jitted so the
+    full-matrix reduction is one fused device pass; the index caches the
+    result per _sync so repeated searches don't recompute it."""
+    import jax
+    import jax.numpy as jnp
+
+    from .pallas_knn import NEG as _PNEG
+
+    if "fn" not in _BIAS_JIT:
+
+        @jax.jit
+        def bias_fn(matrix, valid, l2: bool):
+            b = jnp.where(valid, 0.0, _PNEG)
+            return jax.lax.cond(
+                l2, lambda: b - jnp.sum(matrix * matrix, axis=1), lambda: b
+            )
+
+        _BIAS_JIT["fn"] = bias_fn
+    return _BIAS_JIT["fn"](matrix, valid, metric == "l2")
+
+
+def _pallas_topk(metric: str, matrix, valid, queries, k: int, bias=None):
+    import jax.numpy as jnp
+
+    from .pallas_knn import NEG as _PNEG, knn_topk
+
+    if bias is None:
+        bias = _pallas_bias(metric, matrix, valid)
+    factor = 2.0 if metric == "l2" else 1.0
+    vals, idx = knn_topk(queries, matrix, k=k, bias=bias, factor=factor)
+    if metric == "l2":
+        qq = jnp.sum(jnp.asarray(queries) ** 2, axis=1, keepdims=True)
+        vals = jnp.where(vals > _PNEG / 2, vals - qq, vals)
+    return vals, idx
+
+
 def _k_bucket(k: int) -> int:
     b = 8
     while b < k:
@@ -94,6 +143,7 @@ class DeviceKnnIndex:
         self._dirty = True
         self._dev_matrix = None
         self._dev_valid = None
+        self._dev_bias = None
 
     def __len__(self) -> int:
         return len(self._slot_of)
@@ -162,6 +212,12 @@ class DeviceKnnIndex:
         else:
             self._dev_matrix = jax.device_put(mat)
             self._dev_valid = jax.device_put(val)
+        # bias for the fused pallas path, computed once per upload
+        self._dev_bias = (
+            _pallas_bias(self.metric, self._dev_matrix, self._dev_valid)
+            if _pallas_eligible(self.metric, 8, self.mesh)
+            else None
+        )
         self._dirty = False
 
     # --- search ---
@@ -191,7 +247,17 @@ class DeviceKnnIndex:
         results: list[list[tuple[Any, float]] | None] = [None] * len(q)
         todo = list(range(len(q)))
         while todo:
-            scores, idx = fn(self._dev_matrix, self._dev_valid, q[todo], fetch)
+            if _pallas_eligible(self.metric, fetch, self.mesh):
+                scores, idx = _pallas_topk(
+                    self.metric,
+                    self._dev_matrix,
+                    self._dev_valid,
+                    q[todo],
+                    fetch,
+                    bias=self._dev_bias,
+                )
+            else:
+                scores, idx = fn(self._dev_matrix, self._dev_valid, q[todo], fetch)
             scores = np.asarray(scores)
             idx = np.asarray(idx)
             next_todo = []

@@ -1,0 +1,136 @@
+"""Pallas TPU kernel: fused KNN scores + top-k.
+
+The RAG query hot path (reference USearch HNSW search,
+/root/reference/src/external_integration/usearch_integration.rs:53,
+rebuilt as brute-force matmul top-k in ops/knn.py) materializes a
+[Q, N] score matrix in HBM before `lax.top_k`. At index scale (10M
+docs) that matrix dominates HBM traffic and capacity. This kernel
+blocks over the document axis and keeps a running per-query top-k in
+VMEM, so scores never round-trip through HBM: one pass over the doc
+matrix, O(Q·k) output.
+
+Grid: (query_tiles, doc_blocks); the doc axis is `arbitrary` (sequential
+on TPU), accumulating into the output block that lives in VMEM across
+the inner iterations. Top-k per block via k iterative max-extractions
+on the VPU (k is small: 8-64), then merged with the running top-k the
+same way. Falls back to interpret mode off-TPU so tests run on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -3.0e38  # sentinel below any real score
+
+
+def _merge_topk(cand_scores, cand_idx, k: int):
+    """Top-k of candidates [TQ, C] via k max-extractions (VPU-friendly:
+    no sort). Returns ([TQ, k], [TQ, k])."""
+    tq, c = cand_scores.shape
+    out_s = []
+    out_i = []
+    s = cand_scores
+    iota = jax.lax.broadcasted_iota(jnp.int32, (tq, c), 1)
+    for _ in range(k):
+        best = jnp.max(s, axis=1)
+        arg = jnp.argmax(s, axis=1)
+        hit = iota == arg[:, None]
+        out_s.append(best)
+        # gather-free select (dynamic gathers do not lower in Mosaic)
+        out_i.append(jnp.max(jnp.where(hit, cand_idx, -1), axis=1))
+        s = jnp.where(hit, NEG, s)
+    return jnp.stack(out_s, axis=1), jnp.stack(out_i, axis=1)
+
+
+def _kernel(
+    q_ref, d_ref, bias_ref, vals_ref, idx_ref, *, k: int, block_n: int, n_docs: int, factor: float
+):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        vals_ref[...] = jnp.full(vals_ref.shape, NEG, vals_ref.dtype)
+        idx_ref[...] = jnp.full(idx_ref.shape, -1, idx_ref.dtype)
+
+    scores = jnp.dot(
+        q_ref[...], d_ref[...].T, preferred_element_type=jnp.float32
+    )  # [TQ, BN]
+    # bias folds in validity masking (NEG for dead slots) and, for L2,
+    # the -|doc|^2 term: top-k by factor*dot + bias
+    scores = scores * factor + bias_ref[...].reshape(1, -1)
+    base = j * block_n
+    block_idx = base + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    # padded doc rows (zero vectors) must never displace real matches
+    scores = jnp.where(block_idx < n_docs, scores, NEG)
+    # candidates = running top-k ∪ this block's scores
+    cand_s = jnp.concatenate([vals_ref[...], scores], axis=1)
+    cand_i = jnp.concatenate([idx_ref[...], block_idx], axis=1)
+    new_s, new_i = _merge_topk(cand_s, cand_i, k)
+    vals_ref[...] = new_s
+    idx_ref[...] = new_i
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "block_q", "block_n", "interpret", "factor")
+)
+def knn_topk(
+    queries,
+    docs,
+    *,
+    k: int,
+    bias=None,
+    factor: float = 1.0,
+    block_q: int = 128,
+    block_n: int = 2048,
+    interpret: bool | None = None,
+):
+    """Fused top-k of ``factor * (queries @ docs.T) + bias``:
+    queries [Q, D] x docs [N, D] (+ bias [N]) -> (scores [Q, k],
+    indices [Q, k]). bias carries validity masking (NEG for dead index
+    slots) and the -|doc|^2 term for L2 distance. Pads Q/N to block
+    multiples; padded docs never surface."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    q, d = jnp.asarray(queries, jnp.float32), jnp.asarray(docs, jnp.float32)
+    Q, D = q.shape
+    N = d.shape[0]
+    if bias is None:
+        bias = jnp.zeros((N,), jnp.float32)
+    bias = jnp.asarray(bias, jnp.float32).reshape(N, 1)
+    qpad = (-Q) % block_q
+    npad = (-N) % block_n
+    if qpad:
+        q = jnp.pad(q, ((0, qpad), (0, 0)))
+    if npad:
+        d = jnp.pad(d, ((0, npad), (0, 0)))
+        bias = jnp.pad(bias, ((0, npad), (0, 0)), constant_values=NEG)
+    grid = (q.shape[0] // block_q, d.shape[0] // block_n)
+
+    vals, idx = pl.pallas_call(
+        functools.partial(_kernel, k=k, block_n=block_n, n_docs=N, factor=factor),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, D), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_q, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_q, k), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q.shape[0], k), jnp.float32),
+            jax.ShapeDtypeStruct((q.shape[0], k), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q, d, bias)
+    return vals[:Q], idx[:Q]
