@@ -98,6 +98,7 @@ from .stdlib.utils.pandas_transformer import pandas_transformer
 from . import persistence
 from . import xpacks
 from .internals.monitoring import MonitoringLevel
+from .internals.interactive import LiveTable
 from .internals.errors import ErrorLogSchema, global_error_log, local_error_log
 from .internals.export_import import ExportedTable, export_table, import_table
 from .internals.licensing import License, LicenseError

@@ -137,6 +137,9 @@ def _ln(x, lnp, eps):
     return y * lnp["scale"] + lnp["bias"]
 
 
+_RING_JIT: dict = {}
+
+
 def ring_encode(params, cfg, ids, mask, mesh: Mesh, axis: str = "data"):
     """Encode [B, S] token ids with S sharded over ``axis`` of ``mesh``
     (S must divide by the axis size). Returns [B, hidden] pooled
@@ -144,15 +147,28 @@ def ring_encode(params, cfg, ids, mask, mesh: Mesh, axis: str = "data"):
     n = mesh.shape[axis]
     B, S = ids.shape
     assert S % n == 0, f"sequence {S} must divide across {n} shards"
+    if S > cfg.max_position:
+        # JAX clamps out-of-range embedding lookups: tokens past
+        # max_position would silently share one position vector
+        raise ValueError(
+            f"sequence length {S} exceeds max_position {cfg.max_position}; "
+            "raise EncoderConfig.max_position for long-context encoding"
+        )
     from flax import linen as nn
 
     params = nn.meta.unbox(params)  # raw pytree access below
-    fwd = functools.partial(_sp_encoder_forward, axis_name=axis)
-    shard = jax.shard_map(
-        lambda p, i, m: fwd(p, cfg, i, m),
-        mesh=mesh,
-        in_specs=(P(), P(None, axis), P(None, axis)),
-        out_specs=P(),
-        check_vma=False,
-    )
-    return jax.jit(shard)(params, ids, mask)
+    # cache the compiled sequence-parallel forward per (config, mesh,
+    # axis): re-wrapping per call would recompile per document
+    key = (repr(cfg), tuple(map(id, mesh.devices.flat)), mesh.axis_names, axis)
+    fn = _RING_JIT.get(key)
+    if fn is None:
+        fwd = functools.partial(_sp_encoder_forward, axis_name=axis)
+        shard = jax.shard_map(
+            lambda p, i, m: fwd(p, cfg, i, m),
+            mesh=mesh,
+            in_specs=(P(), P(None, axis), P(None, axis)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        fn = _RING_JIT[key] = jax.jit(shard)
+    return fn(params, ids, mask)

@@ -1,1 +1,5 @@
 """xpacks (reference python/pathway/xpacks/)."""
+
+from . import connectors, llm
+
+__all__ = ["connectors", "llm"]

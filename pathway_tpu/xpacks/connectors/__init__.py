@@ -1,0 +1,5 @@
+"""Enterprise xpack connectors (reference xpacks/connectors)."""
+
+from . import sharepoint
+
+__all__ = ["sharepoint"]

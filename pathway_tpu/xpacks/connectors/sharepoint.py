@@ -1,0 +1,23 @@
+"""SharePoint connector (enterprise).
+
+Rebuild of /root/reference/python/pathway/xpacks/connectors/sharepoint —
+which is itself an enterprise stub in the public reference: the open
+distribution gates it behind a license entitlement."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals.config import get_pathway_config, pathway_config
+from ...internals.licensing import License
+
+
+def read(url: str, *args: Any, **kwargs: Any):
+    """Read documents from a SharePoint site (enterprise feature)."""
+    key = pathway_config.license_key or get_pathway_config().license_key
+    License.new(key).check_entitlement("enterprise-connectors")
+    raise NotImplementedError(
+        "pw.xpacks.connectors.sharepoint.read: the SharePoint client needs "
+        "network access and Office365 credentials; wire it via "
+        "pw.io.python.ConnectorSubject in this environment"
+    )
