@@ -99,6 +99,14 @@ from . import persistence
 from . import xpacks
 from .internals.monitoring import MonitoringLevel
 from .internals.interactive import LiveTable
+from .internals.row_transformer import (
+    ClassArg,
+    attribute,
+    input_attribute,
+    method,
+    output_attribute,
+    transformer,
+)
 from .internals.errors import ErrorLogSchema, global_error_log, local_error_log
 from .internals.export_import import ExportedTable, export_table, import_table
 from .internals.licensing import License, LicenseError
@@ -136,6 +144,8 @@ __all__ = [
     "persistence", "reducers", "ref_scalar", "require", "right", "run",
     "run_all", "schema_builder", "schema_from_csv", "schema_from_dict",
     "schema_from_pandas", "schema_from_types", "set_license_key",
+    "ClassArg", "attribute", "input_attribute", "method",
+    "output_attribute", "transformer",
     "set_monitoring_config", "sql", "stdlib", "temporal", "this", "udf",
     "udfs", "unpack_col", "unsafe_make_pointer", "unwrap", "utils",
     "wrap_py_object", "xpacks",

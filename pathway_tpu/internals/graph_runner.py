@@ -247,6 +247,18 @@ class GraphRunner:
 
     # -- sources --
 
+    def _lower_row_transformer(self, table: Table, op: LogicalOp) -> Lowered:
+        from .row_transformer import _RowTransformerNode
+
+        spec = op.params["spec"]
+        which = op.params["which"]
+        arg_order = op.params["arg_order"]
+        node = _RowTransformerNode(self.engine, spec, which, arg_order)
+        for port, src in enumerate(op.inputs):
+            low = self.lower(src)
+            node.connect(low.node, port)
+        return Lowered(node, list(table._columns.keys()))
+
     def _lower_gradual_broadcast(self, table: Table, op: LogicalOp) -> Lowered:
         base = self.lower(op.inputs[0])
         thr = self.lower(op.inputs[1])

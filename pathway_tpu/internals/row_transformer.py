@@ -1,0 +1,280 @@
+"""Row transformers (legacy "complex columns").
+
+Rebuild of /root/reference/python/pathway/internals/row_transformer.py
+(RowTransformer :26, ClassArg :148) + the engine machinery
+(src/engine/dataflow/complex_columns.rs, `Computer` graph.rs:323, R31):
+class-based per-row computations where output attributes may reference
+OTHER rows — including recursively through pointers (the classic
+linked-list length example) — with memoized evaluation.
+
+Usage (reference-compatible surface):
+
+    @pw.transformer
+    class compute_lengths:
+        class linked_list(pw.ClassArg):
+            next = pw.input_attribute()
+
+            @pw.output_attribute
+            def len(self) -> int:
+                if self.next is None:
+                    return 0
+                return 1 + self.transformer.linked_list[self.next].len
+
+    result = compute_lengths(linked_list=my_table).linked_list
+
+Unsupported (reference-legacy, rarely used): pw.method columns.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Any, Callable
+
+from ..engine import dataflow as df
+from ..engine.value import ERROR, Pointer, rows_equal
+from . import dtype as dt_mod
+from .table import Column, LogicalOp, Table
+
+
+class CycleError(Exception):
+    """An output attribute transitively depends on itself (distinct
+    from a genuine Python stack overflow on very deep acyclic chains)."""
+
+
+class _InputAttribute:
+    def __init__(self):
+        self.name: str | None = None
+
+
+class _OutputAttribute:
+    def __init__(self, fn: Callable):
+        self.fn = fn
+        self.name = fn.__name__
+
+
+class _Attribute(_OutputAttribute):
+    """Computed helper attribute: memoized but NOT materialized as an
+    output column (reference pw.attribute)."""
+
+
+def input_attribute(type: Any = None):  # noqa: A002 - reference signature
+    return _InputAttribute()
+
+
+def output_attribute(fn: Callable) -> _OutputAttribute:
+    return _OutputAttribute(fn)
+
+
+def attribute(fn: Callable) -> _Attribute:
+    return _Attribute(fn)
+
+
+def method(fn: Callable):
+    raise NotImplementedError(
+        "pw.method columns are not supported in this build (legacy "
+        "reference machinery); expose the computation as an "
+        "output_attribute or a pw.udf instead"
+    )
+
+
+class ClassArg:
+    """Base for transformer inner classes. Subclass bodies declare
+    pw.input_attribute() slots and @pw.output_attribute methods."""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        cls._inputs = {}
+        cls._outputs = {}
+        cls._computed = {}
+        for base in reversed(cls.__mro__):
+            for name, v in vars(base).items():
+                if isinstance(v, _InputAttribute):
+                    v.name = name
+                    cls._inputs[name] = v
+                elif isinstance(v, _Attribute):
+                    cls._computed[name] = v
+                elif isinstance(v, _OutputAttribute):
+                    cls._outputs[name] = v
+        cls._input_index = {n: i for i, n in enumerate(cls._inputs)}
+
+
+class _RowRef:
+    """`self` inside attribute functions: reads input slots from the
+    shared state, computes output/auxiliary attributes recursively with
+    per-pass memoization."""
+
+    __slots__ = ("_ctx", "_arg", "_key")
+
+    def __init__(self, ctx, arg_name: str, key: int):
+        self._ctx = ctx
+        self._arg = arg_name
+        self._key = key
+
+    @property
+    def id(self) -> Pointer:
+        return Pointer(self._key)
+
+    @property
+    def transformer(self):
+        return self._ctx.namespace
+
+    def pointer_from(self, *args) -> Pointer:
+        from ..engine.value import ref_scalar
+
+        return Pointer(ref_scalar(*args))
+
+    def __getattr__(self, name: str):
+        return self._ctx.resolve(self._arg, self._key, name)
+
+
+class _ArgAccessor:
+    """transformer.<class_arg> namespace: indexable by Pointer."""
+
+    __slots__ = ("_ctx", "_name")
+
+    def __init__(self, ctx, name: str):
+        self._ctx = ctx
+        self._name = name
+
+    def __getitem__(self, pointer) -> _RowRef:
+        return _RowRef(self._ctx, self._name, int(pointer))
+
+
+class _EvalContext:
+    def __init__(self, spec: "Transformer", states: dict[str, dict[int, tuple]]):
+        self.spec = spec
+        self.states = states  # arg name -> key -> input row tuple
+        self.memo: dict[tuple, Any] = {}
+        self.in_progress: set[tuple] = set()
+        self.namespace = SimpleNamespace(
+            **{n: _ArgAccessor(self, n) for n in spec.args}
+        )
+
+    def resolve(self, arg: str, key: int, name: str):
+        cls = self.spec.args[arg]
+        if name in cls._inputs:
+            row = self.states[arg].get(key)
+            if row is None:
+                raise KeyError(f"{arg}[{key:#x}] not present")
+            return row[cls._input_index[name]]
+        fn_holder = cls._outputs.get(name) or cls._computed.get(name)
+        if fn_holder is None:
+            raise AttributeError(f"{arg} has no attribute {name!r}")
+        mk = (arg, key, name)
+        if mk in self.memo:
+            return self.memo[mk]
+        if mk in self.in_progress:
+            raise CycleError(
+                f"cyclic attribute reference at {arg}.{name} for row {key:#x}"
+            )
+        self.in_progress.add(mk)
+        try:
+            value = fn_holder.fn(_RowRef(self, arg, key))
+        finally:
+            self.in_progress.discard(mk)
+        self.memo[mk] = value
+        return value
+
+
+class _RowTransformerNode(df.Node):
+    """Engine node computing one class arg's output attributes. Inputs:
+    every class arg's table (port per arg); recomputes affected rows'
+    outputs per epoch against the full shared state (legacy semantics:
+    these transformers run on small control tables)."""
+
+    def __init__(self, graph, spec: "Transformer", which: str, arg_order: list[str]):
+        self.n_inputs = len(arg_order)
+        super().__init__(graph, f"RowTransformer:{which}")
+        self.spec = spec
+        self.which = which
+        self.arg_order = arg_order
+        self.states: dict[str, dict[int, tuple]] = {n: {} for n in arg_order}
+        self.emitted: dict[int, tuple] = {}
+        self._snap_attrs = ("states", "emitted")
+
+    def route_owner(self, key, row, port, n_shards):
+        return 0  # cross-row pointer chasing needs the whole state
+
+    def process(self, time):
+        changed = False
+        for port, arg in enumerate(self.arg_order):
+            for key, row, diff in self.take(port):
+                if diff > 0:
+                    self.states[arg][key] = row
+                else:
+                    self.states[arg].pop(key, None)
+                changed = True
+        if not changed:
+            return
+        ctx = _EvalContext(self.spec, self.states)
+        cls = self.spec.args[self.which]
+        out_names = list(cls._outputs)
+        updates: list = []
+        live = self.states[self.which]
+        for key in live:
+            try:
+                row = tuple(ctx.resolve(self.which, key, n) for n in out_names)
+            except Exception as exc:
+                # per-row failure (dangling pointer, user bug): route it
+                # like every other operator — abort, or ERROR cells + log
+                self.graph.report_row_error(self, exc)
+                row = tuple(ERROR for _ in out_names)
+            old = self.emitted.get(key)
+            if old is not None and rows_equal(old, row):
+                continue
+            if old is not None:
+                updates.append((key, old, -1))
+            updates.append((key, row, 1))
+            self.emitted[key] = row
+        for key in list(self.emitted):
+            if key not in live:
+                updates.append((key, self.emitted.pop(key), -1))
+        self.emit(updates, time)
+
+
+class Transformer:
+    def __init__(self, name: str, args: dict[str, type[ClassArg]]):
+        self.name = name
+        self.args = args
+
+    def __call__(self, *pos_tables: Table, **kw_tables: Table) -> SimpleNamespace:
+        tables = dict(zip(self.args, pos_tables))
+        tables.update(kw_tables)
+        if set(tables) != set(self.args):
+            raise TypeError(
+                f"transformer {self.name} expects tables for {list(self.args)}, "
+                f"got {list(tables)}"
+            )
+        arg_order = list(self.args)
+        # project each arg table to its declared input attributes ONCE, in
+        # declaration order (the node indexes rows positionally); sharing
+        # the select tables lets lowering dedupe them across output nodes
+        ins = [
+            tables[n].select(**{a: tables[n][a] for a in self.args[n]._inputs})
+            for n in arg_order
+        ]
+        out = {}
+        for which, cls in self.args.items():
+            cols = {n: Column(dt_mod.ANY) for n in cls._outputs}
+            op = LogicalOp(
+                "row_transformer",
+                ins,
+                {"spec": self, "which": which, "arg_order": arg_order},
+            )
+            out[which] = Table(
+                cols, tables[which]._universe, op, name=f"{self.name}.{which}"
+            )
+        return SimpleNamespace(**out)
+
+
+def transformer(cls) -> Transformer:
+    """Class decorator: turn a namespace of ClassArg subclasses into a
+    callable row transformer (reference pw.transformer)."""
+    args = {
+        name: v
+        for name, v in vars(cls).items()
+        if isinstance(v, type) and issubclass(v, ClassArg)
+    }
+    if not args:
+        raise TypeError("pw.transformer class must contain ClassArg subclasses")
+    return Transformer(cls.__name__, args)
