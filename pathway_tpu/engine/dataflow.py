@@ -879,6 +879,76 @@ class JoinNode(Node):
         self.emit(out, time)
 
 
+class AsofNowJoinNode(JoinNode):
+    """asof_now_join: each left row matches the right side AS OF its
+    arrival epoch and is never retroactively updated when the right side
+    changes (reference stdlib/temporal/_asof_now_join.py; same asof-now
+    semantics as use_external_index_as_of_now). Left retractions retract
+    exactly what was emitted."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.how not in ("inner", "left"):
+            raise ValueError(
+                "asof_now_join supports how='inner'/'left' (matching the "
+                "reference); right/outer would need retroactive updates"
+            )
+        # distinct name: a persisted JoinNode snapshot must NOT restore
+        # into this node (frozen would stay empty → missed retractions)
+        self.name = "AsofNowJoin"
+        self.stats.name = self.name
+        # left key -> (input_row, [(out_key, out_row)]): the input row
+        # disambiguates which version a late retraction refers to
+        self.frozen: dict[int, tuple] = {}
+        self._snap_attrs = ("left", "right", "frozen")
+
+    def process(self, time):
+        out: list[Update] = []
+        # right side first: this epoch's queries see this epoch's state
+        for key, row, diff in self.take(1):
+            jk = self.right_jk_fn(key, row)
+            if jk is None:
+                continue
+            bucket = self.right.setdefault(jk, {})
+            if diff > 0:
+                bucket[key] = row
+            else:
+                bucket.pop(key, None)
+                if not bucket:
+                    self.right.pop(jk, None)
+        for key, row, diff in self.take(0):
+            cur = self.frozen.get(key)
+            if diff < 0:
+                # only retract if this -1 refers to the version we hold:
+                # a +1 replacement earlier in the batch already retracted
+                # (and superseded) the old outputs
+                if cur is not None and rows_equal(cur[0], row):
+                    del self.frozen[key]
+                    for ok, orow in cur[1]:
+                        out.append((ok, orow, -1))
+                continue
+            # an upsert may deliver the +1 before its -1 within a batch:
+            # retract whatever this key previously emitted first
+            if cur is not None:
+                for ok, orow in cur[1]:
+                    out.append((ok, orow, -1))
+            jk = self.left_jk_fn(key, row)
+            matches = list(self.right.get(jk, {}).items()) if jk is not None else []
+            outs: list[Update] = []
+            if matches:
+                for rk, rrow in matches:
+                    ok = self.id_fn(key, rk)
+                    outs.append((int(ok), row + rrow + (Pointer(key), Pointer(rk)), 1))
+            elif self.how == "left":
+                ok = self.id_fn(key, None)
+                outs.append(
+                    (int(ok), row + (None,) * self.rw + (Pointer(key), None), 1)
+                )
+            self.frozen[key] = (row, [(ok, orow) for ok, orow, _ in outs])
+            out.extend(outs)
+        self.emit(out, time)
+
+
 class SortNode(Node):
     """sort_table → prev/next pointer columns (reference
     operators/prev_next.rs over bidirectional traces; here: per-instance

@@ -729,7 +729,11 @@ class GraphRunner:
         else:
             id_fn = None
 
-        join = df.JoinNode(
+        node_cls = df.JoinNode
+        if how.startswith("asof_now_"):
+            node_cls = df.AsofNowJoinNode
+            how = how[len("asof_now_"):]
+        join = node_cls(
             self.engine,
             left_jk_fn=left_jk,
             right_jk_fn=right_jk,

@@ -1,0 +1,5 @@
+"""Dataset loaders (reference stdlib/ml/datasets)."""
+
+from . import classification
+
+__all__ = ["classification"]

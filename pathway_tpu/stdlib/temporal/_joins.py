@@ -361,9 +361,8 @@ def asof_now_join(
 ) -> JoinResult:
     """Join each (streaming) left row against the right table as of the
     row's processing time; results are not updated retroactively
-    (reference _asof_now_join.py). Round 1: regular join — the asof-now
-    freezing matters only under retractions of `other`."""
-    return self.join(other, *on, how=how, id=id)
+    (reference _asof_now_join.py; engine AsofNowJoinNode)."""
+    return self.join(other, *on, how=f"asof_now_{how}", id=id)
 
 
 def asof_now_join_inner(self, other, *on, **kw):

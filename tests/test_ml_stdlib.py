@@ -279,3 +279,15 @@ def test_udf_propagate_none_with_cache():
     assert sorted((r[0] for r in state.values()), key=repr) == [6, None]
     assert calls == [5]
     pw.clear_graph()
+
+
+def test_ml_dataset_loader_synthetic():
+    train, test = pw.ml.datasets.classification.load_mnist_sample(
+        1000, synthetic=True
+    )
+    s_train = run_table(train)
+    s_test = run_table(test)
+    assert len(s_train) == 900 and len(s_test) == 100
+    row = next(iter(s_train.values()))
+    assert row[0].shape == (784,) and row[1] in set("0123456789")
+    pw.clear_graph()

@@ -271,3 +271,94 @@ def test_exactly_once_behavior_single_emission():
     w0 = [(r, d) for _k, r, _t, d in cap.stream if r[si] == 0]
     assert len(w0) == 1 and w0[0][1] == 1
     assert w0[0][0][names.index("total")] == 30
+
+
+def test_window_join():
+    left = T(
+        """
+          | t | a
+        1 | 1 | l1
+        2 | 5 | l2
+        """
+    )
+    right = T(
+        """
+          | t | b
+        1 | 2 | r1
+        2 | 6 | r2
+        3 | 9 | r3
+        """
+    )
+    res = temporal.window_join(
+        left, right, left.t, right.t, temporal.tumbling(duration=4)
+    ).select(a=left.a, b=right.b)
+    cap, names = _run(res)
+    assert _by_cols(cap.state, names, "a", "b") == [("l1", "r1"), ("l2", "r2")]
+
+
+def test_asof_now_join():
+    """asof_now: queries join against the CURRENT state of the right
+    side at arrival time and are never retroactively updated."""
+    queries = pw.debug.table_from_markdown(
+        """
+          | q  | __time__
+        1 | q1 | 2
+        2 | q2 | 6
+        """
+    )
+    state = pw.debug.table_from_markdown(
+        """
+          | v  | __time__ | __diff__
+        1 | s1 | 0        | 1
+        1 | s1 | 4        | -1
+        2 | s2 | 4        | 1
+        """
+    )
+    res = temporal.asof_now_join(queries, state).select(q=queries.q, v=state.v)
+    cap, names = _run(res)
+    got = _by_cols(cap.state, names, "q", "v")
+    assert got == [("q1", "s1"), ("q2", "s2")]  # q1 NOT updated to s2
+
+
+def test_asof_now_join_replacement_ordering():
+    """A +1 replacement processed before its -1 retraction in the same
+    epoch must leave exactly the new row (reference upsert ordering)."""
+    queries = pw.debug.table_from_markdown(
+        """
+          | q   | __time__ | __diff__
+        1 | q1  | 0        | 1
+        1 | q1b | 2        | 1
+        1 | q1  | 2        | -1
+        """
+    )
+    state = T(
+        """
+          | v
+        1 | s1
+        """
+    )
+    res = temporal.asof_now_join(queries, state).select(q=queries.q, v=state.v)
+    cap, names = _run(res)
+    assert _by_cols(cap.state, names, "q", "v") == [("q1b", "s1")]
+
+
+def test_asof_now_join_rejects_outer():
+    import pytest
+
+    left = T(
+        """
+          | a
+        1 | x
+        """
+    )
+    right = T(
+        """
+          | b
+        1 | y
+        """
+    )
+    with pytest.raises(ValueError):
+        res = temporal.asof_now_join(left, right, how="outer").select(
+            a=left.a, b=right.b
+        )
+        _run(res)
