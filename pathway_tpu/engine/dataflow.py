@@ -900,7 +900,7 @@ class AsofNowJoinNode(JoinNode):
         # left key -> (input_row, [(out_key, out_row)]): the input row
         # disambiguates which version a late retraction refers to
         self.frozen: dict[int, tuple] = {}
-        self._snap_attrs = ("left", "right", "frozen")
+        self._snap_attrs = ("right", "frozen")  # left side is never stored
 
     def process(self, time):
         out: list[Update] = []

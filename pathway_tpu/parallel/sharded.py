@@ -154,26 +154,143 @@ class ShardCluster:
                 if te is not None:
                     te(time)
 
+    # -- persistence (input snapshots + whole-cluster operator snapshots;
+    #    sources live on shard 0, state is spread across all shards) --
+
+    def _setup_persistence(self) -> None:
+        import pickle
+
+        from ..engine.persistence import EnginePersistence
+
+        primary = self.engines[0]
+        cfg = primary.persistence_config
+        mode = str(getattr(cfg, "persistence_mode", "batch") or "batch").lower()
+        if "speedrun" in mode:
+            raise NotImplementedError(
+                "speedrun replay is single-worker (PATHWAY_THREADS=1)"
+            )
+        p = EnginePersistence(cfg)
+        self._persistence = p
+        record_mode = "record" in mode
+        if getattr(cfg, "auto_persistent_ids", False) or record_mode:
+            for i, s in enumerate(primary.session_sources):
+                if s.persistent_id is not None or s.is_error_log:
+                    continue
+                # mirror the single-worker rules (engine _setup_persistence):
+                # batch recovery only suits offset-aware readers; record
+                # mode captures everything
+                if record_mode or s.supports_offsets:
+                    s.persistent_id = f"auto_{i}"
+        frontier = -1
+        for s in primary.session_sources:
+            if s.persistent_id is None:
+                continue
+            if record_mode and not s.supports_offsets:
+                # fresh capture: the reader re-produces all input
+                p.reset_source(s.persistent_id)
+                continue
+            batches, offsets, f = p.recover_source(s.persistent_id)
+            s.replay_batches = list(batches)
+            s.session.restore_offsets(offsets)
+            frontier = max(frontier, f)
+        for e in self.engines:
+            e.replay_frontier = frontier
+        all_persistent = all(
+            s.persistent_id is not None
+            for s in primary.session_sources
+            if not s.is_error_log
+        )
+        self._opsnap_ok = all_persistent
+        self._opsnap_time = -1
+        self._last_opsnap_wall = 0.0
+        if frontier >= 0 and all_persistent:
+            rec = p.recover_operator_snapshot(frontier)
+            if rec is not None:
+                t0, blob = rec
+                data = pickle.loads(blob)
+                sig = self._cluster_signature()
+                if data.get("sig") == sig:
+                    for (shard, nid), st in data["states"].items():
+                        self.engines[shard].nodes[nid].restore_state(st)
+                    for s in primary.session_sources:
+                        s.replay_batches = [
+                            (tt, ups) for tt, ups in s.replay_batches if tt > t0
+                        ]
+                    for st_src in primary.static_sources:
+                        while (
+                            st_src.pos < len(st_src.batches)
+                            and st_src.batches[st_src.pos][0] <= t0
+                        ):
+                            st_src.pos += 1
+                    self._opsnap_time = t0
+
+    def _cluster_signature(self):
+        return [
+            (shard, n.id, n.snapshot_signature())
+            for shard, e in enumerate(self.engines)
+            for n in e.nodes
+        ]
+
+    def _maybe_snapshot_operators(self, t: int) -> None:
+        """Interval snapshots (persistence_config.snapshot_interval_ms):
+        bound crash-recovery replay for long-running jobs, like the
+        single-worker _maybe_snapshot_operators."""
+        import time as _wall
+
+        if not self._opsnap_ok:
+            return
+        cfg = self.engines[0].persistence_config
+        interval_ms = getattr(cfg, "snapshot_interval_ms", 0) or 0
+        if interval_ms <= 0:
+            return
+        if (_wall.monotonic() - self._last_opsnap_wall) * 1000.0 >= interval_ms:
+            self._snapshot_operators(t)
+
+    def _snapshot_operators(self, t: int) -> None:
+        import pickle
+        import time as _wall
+
+        states = {}
+        for shard, e in enumerate(self.engines):
+            for n in e.nodes:
+                s = n.snapshot_state()
+                if s is not None:
+                    states[(shard, n.id)] = s
+        blob = pickle.dumps(
+            {"sig": self._cluster_signature(), "time": int(t), "states": states},
+            protocol=4,
+        )
+        self._persistence.save_operator_snapshot(int(t), blob)
+        self._last_opsnap_wall = _wall.monotonic()
+
     def run(self, monitoring_callback: Callable | None = None) -> None:
         primary = self.engines[0]
+        self._persistence = None
         if primary.persistence_config is not None:
-            raise NotImplementedError(
-                "persistence is single-worker for now (PATHWAY_THREADS=1)"
-            )
+            self._setup_persistence()
         for t in primary.connector_threads:
             t.start()
         primary._threads_started = True
         last_time = -1
         while not (self._stop or primary._stop):
             times = [s.next_time() for s in primary.static_sources]
+            replay_pending = False
+            for s in primary.session_sources:
+                rt = s.next_replay_time()
+                if rt is not None:
+                    times.append(rt)
+                    replay_pending = True
             times = [t for t in times if t is not None]
             scripted_t = min(times) if times else None
 
             session_batches = []
-            for s in primary.session_sources:
-                b = s.session.drain()
-                if b:
-                    session_batches.append((s, b))
+            if not replay_pending:
+                if last_time < primary.replay_frontier:
+                    last_time = primary.replay_frontier
+                for s in primary.session_sources:
+                    b = s.session.drain()
+                    if b:
+                        session_batches.append((s, b))
             # row errors reported on replica shards land in THEIR error
             # sessions; drain them all (delivery routes to shard 0)
             for e in self.engines[1:]:
@@ -204,14 +321,40 @@ class ShardCluster:
                 e._frontier_hooks(t)
             for s in primary.static_sources:
                 s.feed(t)
+            for s in primary.session_sources:
+                s.feed_replay(t)
             for s, b in session_batches:
-                s.feed_batch(b, t)
+                resolved = s.feed_batch(b, t)
+                if (
+                    self._persistence is not None
+                    and s.persistent_id is not None
+                    and resolved
+                ):
+                    self._persistence.log_batch(s.persistent_id, t, resolved)
             self._deliver_mail()
             self._sweep(t)
+            if self._persistence is not None:
+                for s, _b in session_batches:
+                    if s.persistent_id is not None:
+                        self._persistence.advance(
+                            s.persistent_id, t, s.last_offsets or {}
+                        )
+                if session_batches:
+                    self._maybe_snapshot_operators(t)
             last_time = t
             if monitoring_callback is not None:
                 monitoring_callback(primary)
 
+        # final snapshot BEFORE the end-of-input flush (the flush assumes
+        # input is over, which a restarted run cannot know)
+        if (
+            self._persistence is not None
+            and self._opsnap_ok
+            and last_time >= 0
+            and last_time != self._opsnap_time
+            and primary.session_sources
+        ):
+            self._snapshot_operators(last_time)
         # end of input: final flush on every shard
         self._sync_watermarks()
         for e in self.engines:
@@ -238,6 +381,8 @@ class ShardCluster:
         for e in self.engines:
             for node in e.nodes:
                 node.on_end()
+        if self._persistence is not None:
+            self._persistence.close()
         for t in primary.connector_threads:
             t.join(timeout=5.0)
         if self._pool is not None:

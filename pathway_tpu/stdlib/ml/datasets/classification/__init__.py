@@ -30,7 +30,7 @@ def load_mnist_sample(
     elif path is not None:
         with np.load(path) as z:
             images = z["x_train"].reshape(-1, 784).astype(np.float64)
-            labels = z["y_train"]
+            labels = z["y_train"] if with_labels else np.zeros(len(images), np.int64)
         images, labels = images[:sample_size], labels[:sample_size]
     else:
         raise NotImplementedError(
