@@ -116,7 +116,7 @@ from .internals.custom_reducers import BaseCustomAccumulator
 # engine namespace parity (reference pathway.engine is the PyO3 module)
 from . import engine
 
-universes = stdlib.utils  # placeholder namespace parity
+from .internals import universes
 
 
 def __getattr__(name):
@@ -148,5 +148,5 @@ __all__ = [
     "output_attribute", "transformer",
     "set_monitoring_config", "sql", "stdlib", "temporal", "this", "udf",
     "udfs", "unpack_col", "unsafe_make_pointer", "unwrap", "utils",
-    "wrap_py_object", "xpacks",
+    "wrap_py_object", "xpacks", "universes", "LiveTable",
 ]

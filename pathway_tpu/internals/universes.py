@@ -1,0 +1,42 @@
+"""Declaring relations between keysets (universes).
+
+Rebuild of /root/reference/python/pathway/universes.py +
+internals/universes.py (promise_are_pairwise_disjoint :13,
+promise_is_subset_of :49, promise_are_equal :83): user promises that
+let same-universe operations (`+`, update_cells, with_universe_of)
+type-check across tables built from different sources. The engine
+verifies keyed operations at runtime anyway, so these adjust the
+static universe relation only."""
+
+from __future__ import annotations
+
+
+def promise_are_pairwise_disjoint(self, *others) -> None:
+    """Promise the tables' key sets never overlap (enables safe
+    concat). Runtime disjointness is still checked by ConcatNode."""
+    # static relation only: our concat verifies key collisions at runtime
+
+
+def promise_is_subset_of(self, *others) -> None:
+    """Promise self's keys are a subset of each other table's keys."""
+    from .universe import universe_solver
+
+    for o in others:
+        universe_solver.register_subset(self._universe, o._universe)
+
+
+def promise_are_equal(self, *others) -> None:
+    """Promise the tables share exactly the same key set: they become
+    same-universe for `+`/update_cells/with_universe_of — including
+    tables DERIVED from them (solver equality, not reassignment)."""
+    from .universe import universe_solver
+
+    for o in others:
+        universe_solver.register_as_equal(self._universe, o._universe)
+
+
+__all__ = [
+    "promise_are_pairwise_disjoint",
+    "promise_are_equal",
+    "promise_is_subset_of",
+]
