@@ -2,37 +2,36 @@
 
 Rebuild of /root/reference/python/pathway/universes.py +
 internals/universes.py (promise_are_pairwise_disjoint :13,
-promise_is_subset_of :49, promise_are_equal :83): user promises that
-let same-universe operations (`+`, update_cells, with_universe_of)
-type-check across tables built from different sources. The engine
-verifies keyed operations at runtime anyway, so these adjust the
-static universe relation only."""
+promise_is_subset_of :49, promise_are_equal :83). These record user
+promises in the universe solver; in this build the engine re-verifies
+keyed operations at runtime (e.g. concat key collisions), so the
+promises primarily unlock the static same-universe check used by
+``+``/``with_columns``. Delegates to the Table promise methods so both
+surfaces stay in sync."""
 
 from __future__ import annotations
 
 
 def promise_are_pairwise_disjoint(self, *others) -> None:
-    """Promise the tables' key sets never overlap (enables safe
-    concat). Runtime disjointness is still checked by ConcatNode."""
-    # static relation only: our concat verifies key collisions at runtime
+    """Promise the tables' key sets never overlap. Concat verifies
+    collisions at runtime regardless."""
+    for o in others:
+        self.promise_universes_are_disjoint(o)
 
 
 def promise_is_subset_of(self, *others) -> None:
     """Promise self's keys are a subset of each other table's keys."""
-    from .universe import universe_solver
-
     for o in others:
-        universe_solver.register_subset(self._universe, o._universe)
+        self.promise_universe_is_subset_of(o)
 
 
 def promise_are_equal(self, *others) -> None:
-    """Promise the tables share exactly the same key set: they become
-    same-universe for `+`/update_cells/with_universe_of — including
-    tables DERIVED from them (solver equality, not reassignment)."""
-    from .universe import universe_solver
-
+    """Promise the tables share exactly the same key set: they (and
+    same-universe projections of them, e.g. ``select``) become valid
+    operands for ``+``. Subset-universe derivations (``filter``) stay
+    distinct — filtering genuinely changes the key set."""
     for o in others:
-        universe_solver.register_as_equal(self._universe, o._universe)
+        self.promise_universes_are_equal(o)
 
 
 __all__ = [
