@@ -16,6 +16,8 @@
 #include <cstring>
 #include <new>
 #include <string>
+#include <fstream>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -494,6 +496,196 @@ PN_EXPORT void pn_shard_batch(const uint64_t* keys, uint64_t n, uint64_t mask,
                               uint32_t n_shards, uint32_t* out) {
   for (uint64_t i = 0; i < n; ++i)
     out[i] = static_cast<uint32_t>((keys[i] & mask) % n_shards);
+}
+
+
+// ===========================================================================
+// Batched WordPiece tokenizer (embedder host hot path)
+//
+// Mirrors pathway_tpu/models/tokenizer.py exactly for ASCII text: basic
+// split into [A-Za-z0-9]+ runs / single other chars (UTF-8 codepoints
+// count as one char), hash-mode ids 999 + crc32(word) % (V - 1000), or
+// greedy longest-match WordPiece when a vocab is loaded. The pure-
+// Python tokenizer tops out near 50k texts/s — below a single chip's
+// embed rate — so the framework path runs this instead (reference runs
+// HF fast tokenizers in Rust for the same reason).
+// ===========================================================================
+
+namespace {
+
+struct Tok {
+  bool lowercase = true;
+  uint32_t vocab_size = 30522;
+  int32_t cls_id = 101, sep_id = 102, pad_id = 0, unk_id = 100;
+  bool has_vocab = false;
+  std::unordered_map<std::string, int32_t> vocab;
+  int max_chars = 100;
+};
+
+inline bool is_ascii_alnum(uint8_t c) {
+  return (c >= '0' && c <= '9') || (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z');
+}
+inline bool is_ascii_space(uint8_t c) {
+  // python's \s on str also covers \x1c-\x1f (file/group/record/unit
+  // separators) — required for id parity with tokenizer.py
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+         c == '\v' || (c >= 0x1c && c <= 0x1f);
+}
+inline int utf8_len(uint8_t lead) {
+  if (lead < 0x80) return 1;
+  if ((lead >> 5) == 0x6) return 2;
+  if ((lead >> 4) == 0xe) return 3;
+  if ((lead >> 3) == 0x1e) return 4;
+  return 1;  // invalid byte: treat as single char
+}
+
+// append the ids of one word; returns false when the caller's budget
+// (max_len - 1) is already met, mirroring the python early break
+inline void word_ids(const Tok& t, const std::string& w, std::vector<int32_t>& out) {
+  if (!t.has_vocab) {
+    out.push_back(static_cast<int32_t>(
+        999 + crc32(reinterpret_cast<const uint8_t*>(w.data()), w.size()) %
+                  (t.vocab_size - 1000)));
+    return;
+  }
+  if (static_cast<int>(w.size()) > t.max_chars) {
+    out.push_back(t.unk_id);
+    return;
+  }
+  size_t before = out.size();
+  size_t start = 0;
+  while (start < w.size()) {
+    size_t end = w.size();
+    int32_t cur = -1;
+    std::string sub;
+    while (start < end) {
+      sub.assign(w, start, end - start);
+      if (start > 0) sub = "##" + sub;
+      auto it = t.vocab.find(sub);
+      if (it != t.vocab.end()) {
+        cur = it->second;
+        break;
+      }
+      --end;
+    }
+    if (cur < 0) {
+      out.resize(before);
+      out.push_back(t.unk_id);
+      return;
+    }
+    out.push_back(cur);
+    start = end;
+  }
+}
+
+}  // namespace
+
+PN_EXPORT void* pn_tok_new(const char* vocab_file, uint32_t vocab_size, int lowercase,
+                           int32_t max_chars) {
+  Tok* t = new Tok();
+  t->lowercase = lowercase != 0;
+  t->vocab_size = vocab_size;
+  t->max_chars = max_chars > 0 ? max_chars : 100;
+  if (vocab_file && *vocab_file) {
+    std::ifstream f(vocab_file);
+    if (f) {
+      std::string line;
+      int32_t i = 0;
+      while (std::getline(f, line)) {
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        t->vocab.emplace(line, i++);
+      }
+      t->has_vocab = !t->vocab.empty();
+      auto g = [&](const char* k, int32_t d) {
+        auto it = t->vocab.find(k);
+        return it == t->vocab.end() ? d : it->second;
+      };
+      t->cls_id = g("[CLS]", 101);
+      t->sep_id = g("[SEP]", 102);
+      t->pad_id = g("[PAD]", 0);
+      t->unk_id = g("[UNK]", 100);
+    }
+  }
+  return t;
+}
+
+PN_EXPORT void pn_tok_free(void* tv) { delete static_cast<Tok*>(tv); }
+
+PN_EXPORT void pn_tok_info(void* tv, int32_t* cls_id, int32_t* sep_id,
+                           int32_t* pad_id, int32_t* unk_id, int32_t* has_vocab) {
+  Tok* t = static_cast<Tok*>(tv);
+  *cls_id = t->cls_id;
+  *sep_id = t->sep_id;
+  *pad_id = t->pad_id;
+  *unk_id = t->unk_id;
+  *has_vocab = t->has_vocab ? 1 : 0;
+}
+
+// texts: concatenated UTF-8; offsets: n+1 byte offsets. Writes ids into
+// out_ids[i*max_len ..] (pad_id filled) and true lengths into out_lens.
+namespace {
+
+void tok_encode_range(const Tok* t, const uint8_t* texts, const uint64_t* offsets,
+                      uint64_t row_begin, uint64_t row_end, int32_t max_len,
+                      int32_t* out_ids, int32_t* out_lens) {
+  std::vector<int32_t> ids;
+  std::string word;
+  for (uint64_t row = row_begin; row < row_end; ++row) {
+    const uint8_t* p = texts + offsets[row];
+    const uint8_t* endp = texts + offsets[row + 1];
+    ids.clear();
+    ids.push_back(t->cls_id);
+    const size_t budget = static_cast<size_t>(max_len) - 1;
+    while (p < endp && ids.size() < budget) {
+      uint8_t c = *p;
+      if (is_ascii_space(c)) {
+        ++p;
+        continue;
+      }
+      word.clear();
+      if (is_ascii_alnum(c)) {
+        while (p < endp && is_ascii_alnum(*p)) {
+          uint8_t b = *p++;
+          if (t->lowercase && b >= 'A' && b <= 'Z') b += 32;
+          word.push_back(static_cast<char>(b));
+        }
+      } else {
+        int len = utf8_len(c);
+        for (int i = 0; i < len && p < endp; ++i) word.push_back(static_cast<char>(*p++));
+      }
+      word_ids(*t, word, ids);
+    }
+    if (ids.size() > budget) ids.resize(budget);
+    ids.push_back(t->sep_id);
+    int32_t* dst = out_ids + row * max_len;
+    for (int32_t i = 0; i < max_len; ++i)
+      dst[i] = i < static_cast<int32_t>(ids.size()) ? ids[i] : t->pad_id;
+    out_lens[row] = static_cast<int32_t>(ids.size());
+  }
+}
+
+}  // namespace
+
+PN_EXPORT void pn_tok_encode_batch(void* tv, const uint8_t* texts,
+                                   const uint64_t* offsets, uint64_t n,
+                                   int32_t max_len, int32_t* out_ids,
+                                   int32_t* out_lens) {
+  const Tok* t = static_cast<Tok*>(tv);
+  unsigned hw = std::thread::hardware_concurrency();
+  uint64_t nt = hw ? (hw < 8 ? hw : 8) : 1;
+  if (n < 4096 || nt <= 1) {
+    tok_encode_range(t, texts, offsets, 0, n, max_len, out_ids, out_lens);
+    return;
+  }
+  std::vector<std::thread> threads;
+  uint64_t chunk = (n + nt - 1) / nt;
+  for (uint64_t i = 0; i < nt; ++i) {
+    uint64_t b = i * chunk, e = b + chunk < n ? b + chunk : n;
+    if (b >= e) break;
+    threads.emplace_back(tok_encode_range, t, texts, offsets, b, e, max_len,
+                         out_ids, out_lens);
+  }
+  for (auto& th : threads) th.join();
 }
 
 PN_EXPORT const char* pn_version() { return "pathway-native 1.0"; }

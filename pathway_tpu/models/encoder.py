@@ -13,8 +13,6 @@ parameter layouts annotated for tensor-parallel sharding over a
 from __future__ import annotations
 
 import dataclasses
-import math
-from functools import partial
 from typing import Any
 
 import jax
@@ -45,6 +43,9 @@ class EncoderConfig:
     # pooling: "mean" (sentence-transformers MiniLM), "cls" (cross-encoder)
     pooling: str = "mean"
     normalize: bool = True
+    # attention path: "auto" (pallas fused kernel on TPU — see
+    # ops/fused_attention.py), "xla", "fused", "interpret"
+    attention_impl: str = "auto"
 
     @classmethod
     def minilm_l6(cls, **kw) -> "EncoderConfig":
@@ -84,18 +85,9 @@ class SelfAttention(nn.Module):
         hd = d // h
         # QKV fused into one projection: one big matmul for the MXU.
         qkv = _dense(3 * d, "qkv", (EMBED, HEADS), cfg.dtype)(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        from ..ops.fused_attention import attention
 
-        def heads(t):
-            return t.reshape(t.shape[0], t.shape[1], h, hd)
-
-        q, k, v = heads(q), heads(k), heads(v)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
-        big_neg = jnp.finfo(scores.dtype).min
-        scores = jnp.where(mask[:, None, None, :], scores, big_neg)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-        ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], d)
+        ctx = attention(qkv, mask, n_heads=h, impl=cfg.attention_impl)
         return _dense(d, "out", (HEADS, EMBED), cfg.dtype)(ctx)
 
 

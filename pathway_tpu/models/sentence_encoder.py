@@ -118,8 +118,112 @@ class SentenceEncoder:
     def encode(self, texts: Sequence[str]) -> np.ndarray:
         if not len(texts):
             return np.zeros((0, self.dim), np.float32)
-        toks = [self.tokenizer.encode(t or "", self.max_seq_len) for t in texts]
-        return self.encode_tokens(toks)
+        texts = ["" if t is None else str(t) for t in texts]
+        m = self.tokenizer.batch_encode_matrix(texts, self.max_seq_len)
+        if m is None:  # no native lib / non-ascii input
+            toks = [self.tokenizer.encode(t, self.max_seq_len) for t in texts]
+            return self.encode_tokens(toks)
+        return self._encode_matrix(*m)
+
+    def _matrix_groups(self, ids_mat: np.ndarray, lens: np.ndarray):
+        """Bucketed dispatch straight from the native tokenizer's padded
+        ids matrix — no per-row Python lists on the hot path. Yields
+        (group_indices, n_real, device_embeddings)."""
+        from .batching import DEFAULT_BATCH_BUCKETS, DEFAULT_SEQ_BUCKETS, bucket
+
+        n = len(lens)
+        order = np.argsort(lens, kind="stable")  # dense length buckets
+        batch = self.max_batch
+        if self.mesh is not None:
+            ndata = self.mesh.shape[self.data_axis]
+            batch = max(batch - batch % ndata, ndata)
+        pending = []
+        for start in range(0, n, batch):
+            group = order[start : start + batch]
+            L = min(
+                bucket(int(lens[group].max()), DEFAULT_SEQ_BUCKETS),
+                ids_mat.shape[1],
+            )
+            ng = len(group)
+            ids = np.take(ids_mat[:, :L], group, axis=0)
+            mask = np.arange(L)[None, :] < lens[group][:, None]
+            if ng < batch:
+                bb = tuple(b for b in DEFAULT_BATCH_BUCKETS if b < batch) + (batch,)
+                B = max(bucket(ng, bb), ng)
+                if B > ng:
+                    ids = np.pad(ids, ((0, B - ng), (0, 0)))
+                    mask = np.pad(mask, ((0, B - ng), (0, 0)))
+            pending.append((group, ng, self._run_padded(ids, mask)))
+        return pending
+
+    def _encode_matrix(self, ids_mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
+        out = np.empty((len(lens), self.dim), np.float32)
+        for group, ng, emb in self._matrix_groups(ids_mat, lens):
+            out[group] = np.asarray(emb)[:ng]
+        return out
+
+    def encode_device(self, texts: Sequence[str]):
+        """texts -> embeddings as a DEVICE-resident [n, dim] jax array
+        in input order. The streaming pipeline's TPU-native hot path:
+        embeddings feed the on-device KNN index directly, so they never
+        round-trip through host memory (on tunneled/remote devices the
+        host link would dominate end-to-end rate). Token ids ship as
+        int16 and masks are built on device from lengths — halves the
+        host->device bytes on the ingest path."""
+        import jax.numpy as jnp
+
+        if not len(texts):
+            return jnp.zeros((0, self.dim), jnp.float32)
+        texts = ["" if t is None else str(t) for t in texts]
+        m = self.tokenizer.batch_encode_matrix(texts, self.max_seq_len)
+        if m is None:
+            return jnp.asarray(self.encode(texts))
+        ids_mat, lens = m
+        packed = self._pack_uniform(ids_mat, lens)
+        if packed is None:
+            pending = self._matrix_groups(ids_mat, lens)
+            embs = jnp.concatenate([emb[:ng] for _, ng, emb in pending], axis=0)
+            order = np.concatenate([group for group, _, _ in pending])
+        else:
+            order, embs = packed
+        out = jnp.zeros((len(lens), self.dim), jnp.float32)
+        return out.at[jnp.asarray(order)].set(embs.astype(jnp.float32))
+
+    def _pack_uniform(self, ids_mat: np.ndarray, lens: np.ndarray):
+        """Single-dispatch path when every bucket group shares one
+        (batch, seq) shape: all groups stacked into [G, B, L] int16 and
+        run through one jit'd lax.scan — one transfer, one dispatch."""
+        from .batching import DEFAULT_SEQ_BUCKETS, bucket
+
+        if self.mesh is not None or self.cfg.vocab_size >= 32768:
+            return None
+        n = len(lens)
+        B = self.max_batch
+        if n < 2 * B or n % B:
+            return None
+        L = min(bucket(int(lens.max()), DEFAULT_SEQ_BUCKETS), ids_mat.shape[1])
+        import jax
+        import jax.numpy as jnp
+
+        order = np.argsort(lens, kind="stable")
+        G = n // B
+        ids = np.take(ids_mat[:, :L], order, axis=0).astype(np.int16)
+        ids = ids.reshape(G, B, L)
+        ln = lens[order].reshape(G, B).astype(np.int32)
+
+        if getattr(self, "_fwd_scan", None) is None:
+
+            def fwd_scan(p, ids16, lens_):
+                def body(c, batch):
+                    i, l = batch
+                    mask = jnp.arange(i.shape[1])[None, :] < l[:, None]
+                    return c, self.module.apply(p, i.astype(jnp.int32), mask)
+
+                return jax.lax.scan(body, 0, (ids16, lens_))[1]
+
+            self._fwd_scan = jax.jit(fwd_scan)
+        embs = self._fwd_scan(self.params, ids, ln)  # (G, B, dim)
+        return order, embs.reshape(n, self.dim)
 
     def __call__(self, texts: Sequence[str]) -> np.ndarray:
         return self.encode(texts)

@@ -31,8 +31,10 @@ class WordPieceTokenizer:
         self.lowercase = lowercase
         self.max_chars = max_input_chars_per_word
         self.vocab: dict[str, int] | None = None
-        if vocab_file and os.path.exists(vocab_file):
-            with open(vocab_file, encoding="utf-8") as f:
+        self._vocab_file = vocab_file if vocab_file and os.path.exists(vocab_file) else None
+        self._native = None  # lazy NativeTokenizer (C++ batched hot path)
+        if self._vocab_file:
+            with open(self._vocab_file, encoding="utf-8") as f:
                 self.vocab = {line.rstrip("\n"): i for i, line in enumerate(f)}
         self.cls_id, self.sep_id, self.pad_id, self.unk_id = CLS, SEP, PAD, UNK
         if self.vocab is not None:
@@ -75,6 +77,35 @@ class WordPieceTokenizer:
         ids = ids[: max_len - 1]
         ids.append(self.sep_id)
         return ids
+
+    def batch_encode(self, texts, max_len: int = 128) -> list[list[int]]:
+        """Tokenize many texts at once. ASCII texts run through the C++
+        batched tokenizer (pn_tok_encode_batch — same ids as encode());
+        others fall back to the per-text Python path. The pure-Python
+        loop tops out near 50k texts/s, below one chip's embed rate, so
+        the embed framework path depends on this."""
+        m = self.batch_encode_matrix(texts, max_len)
+        if m is None:
+            return [self.encode(t, max_len=max_len) for t in texts]
+        ids, lens = m
+        return [ids[i, : lens[i]].tolist() for i in range(len(texts))]
+
+    def batch_encode_matrix(self, texts, max_len: int = 128):
+        """Native-only zero-copy variant: -> (ids [n, max_len] int32,
+        lens [n] int32) or None when the native path can't be used.
+        Rows are pad_id-filled past their length — feedable straight
+        into the encoder's bucketed batching without Python lists."""
+        from .. import native as native_mod  # pathway_tpu.native
+
+        # python lowercases non-ascii letters; the C++ path is
+        # ascii-only, so parity is only guaranteed for ascii input
+        if not (native_mod.is_available() and all(t.isascii() for t in texts)):
+            return None
+        if self._native is None:
+            self._native = native_mod.NativeTokenizer(
+                self._vocab_file, self.vocab_size, self.lowercase, self.max_chars
+            )
+        return self._native.encode_batch(texts, max_len)
 
     def encode_pair(self, a: str, b: str, max_len: int = 256) -> tuple[list[int], list[int]]:
         """(ids, token_type_ids) for cross-encoder input [CLS] a [SEP] b [SEP]."""

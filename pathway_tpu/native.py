@@ -87,6 +87,14 @@ def _load() -> ctypes.CDLL | None:
         "pn_store_load": ([ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint8], ctypes.c_int64),
         "pn_hash64_batch": ([ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)], None),
         "pn_shard_batch": ([ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)], None),
+        "pn_tok_new": ([ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int32, ctypes.c_int32], ctypes.c_void_p),
+        "pn_tok_free": ([ctypes.c_void_p], None),
+        "pn_tok_info": ([ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_int32)] * 5, None),
+        "pn_tok_encode_batch": (
+            [ctypes.c_void_p, u8p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+             ctypes.c_int32, ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)],
+            None,
+        ),
         "pn_version": ([], ctypes.c_char_p),
     }
     try:
@@ -303,3 +311,55 @@ def consolidate_native(updates: list) -> list | None:
         key, row, _ = updates[idx]
         out.append((key, row, diff))
     return out
+
+
+class NativeTokenizer:
+    """Batched WordPiece tokenizer backed by pn_tok_encode_batch — the
+    embedder host hot path (the reference leans on HF fast tokenizers'
+    Rust core the same way; embedders.py:270)."""
+
+    __slots__ = ("_h", "cls_id", "sep_id", "pad_id", "unk_id", "has_vocab")
+
+    def __init__(
+        self,
+        vocab_file: str | None,
+        vocab_size: int,
+        lowercase: bool,
+        max_chars: int = 100,
+    ):
+        self._h = NATIVE.pn_tok_new(
+            (vocab_file or "").encode(), vocab_size, 1 if lowercase else 0, max_chars
+        )
+        vals = [ctypes.c_int32() for _ in range(5)]
+        NATIVE.pn_tok_info(self._h, *[ctypes.byref(v) for v in vals])
+        self.cls_id, self.sep_id, self.pad_id, self.unk_id = (
+            v.value for v in vals[:4]
+        )
+        self.has_vocab = bool(vals[4].value)
+
+    def __del__(self):
+        if NATIVE is not None and getattr(self, "_h", None):
+            NATIVE.pn_tok_free(self._h)
+            self._h = None
+
+    def encode_batch(self, texts: list[str], max_len: int):
+        """-> (ids [n, max_len] int32 ndarray, lens [n] int32 ndarray)"""
+        import numpy as np
+
+        blobs = [t.encode("utf-8") for t in texts]
+        n = len(blobs)
+        offsets = np.zeros(n + 1, np.uint64)
+        np.cumsum([len(b) for b in blobs], out=offsets[1:])
+        concat = b"".join(blobs)
+        out_ids = np.empty((n, max_len), np.int32)
+        out_lens = np.empty(n, np.int32)
+        NATIVE.pn_tok_encode_batch(
+            self._h,
+            _as_u8p(concat),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            n,
+            max_len,
+            out_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out_ids, out_lens

@@ -72,6 +72,11 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             return [e for e in embs]
         return self._encoder.encode([str(input)])[0]
 
+    def encode_device(self, texts):
+        """Batch ingest surface: texts -> DEVICE-resident [n, dim] jax
+        array (no host round-trip; feeds the on-device KNN index)."""
+        return self._encoder.encode_device(texts)
+
     def get_embedding_dimension(self, **kwargs) -> int:
         return self._encoder.dim
 
