@@ -688,4 +688,127 @@ PN_EXPORT void pn_tok_encode_batch(void* tv, const uint8_t* texts,
   for (auto& th : threads) th.join();
 }
 
+// ---------------------------------------------------------------------------
+// blake2b (RFC 7693), batched keyed 8-byte digests.
+//
+// Matches python hashlib.blake2b(msg, digest_size=8, key=K) exactly — the
+// canonical key derivation of pathway_tpu.engine.value.ref_scalar (the
+// reference's seeded key hashing, python_api.rs:3369). Batched so the
+// columnar groupby/re-key path hashes a whole delta batch per call.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+static const uint64_t B2B_IV[8] = {
+    0x6A09E667F3BCC908ULL, 0xBB67AE8584CAA73BULL, 0x3C6EF372FE94F82BULL,
+    0xA54FF53A5F1D36F1ULL, 0x510E527FADE682D1ULL, 0x9B05688C2B3E6C1FULL,
+    0x1F83D9ABFB41BD6BULL, 0x5BE0CD19137E2179ULL};
+
+static const uint8_t B2B_SIGMA[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+inline uint64_t rotr64(uint64_t x, int n) { return (x >> n) | (x << (64 - n)); }
+
+inline void b2b_compress(uint64_t h[8], const uint8_t block[128], uint64_t t0,
+                         bool last) {
+  uint64_t m[16];
+  std::memcpy(m, block, 128);  // little-endian host
+  uint64_t v[16];
+  for (int i = 0; i < 8; ++i) {
+    v[i] = h[i];
+    v[i + 8] = B2B_IV[i];
+  }
+  v[12] ^= t0;  // t1 is always 0 at these message sizes
+  if (last) v[14] = ~v[14];
+#define PN_B2B_G(a, b, c, d, x, y)            \
+  v[a] = v[a] + v[b] + (x);                   \
+  v[d] = rotr64(v[d] ^ v[a], 32);             \
+  v[c] = v[c] + v[d];                         \
+  v[b] = rotr64(v[b] ^ v[c], 24);             \
+  v[a] = v[a] + v[b] + (y);                   \
+  v[d] = rotr64(v[d] ^ v[a], 16);             \
+  v[c] = v[c] + v[d];                         \
+  v[b] = rotr64(v[b] ^ v[c], 63);
+  for (int r = 0; r < 12; ++r) {
+    const uint8_t* s = B2B_SIGMA[r];
+    PN_B2B_G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+    PN_B2B_G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+    PN_B2B_G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+    PN_B2B_G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+    PN_B2B_G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+    PN_B2B_G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+    PN_B2B_G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+    PN_B2B_G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+  }
+#undef PN_B2B_G
+  for (int i = 0; i < 8; ++i) h[i] ^= v[i] ^ v[i + 8];
+}
+
+void b2b8_range(const uint8_t* data, const uint64_t* offsets, uint64_t begin,
+                uint64_t end, const uint64_t* hkey, uint64_t* out) {
+  uint8_t block[128];
+  for (uint64_t i = begin; i < end; ++i) {
+    const uint8_t* msg = data + offsets[i];
+    uint64_t len = offsets[i + 1] - offsets[i];
+    uint64_t h[8];
+    std::memcpy(h, hkey, sizeof(h));
+    uint64_t t = 128;  // key block already consumed
+    while (len > 128) {
+      t += 128;
+      b2b_compress(h, msg, t, false);
+      msg += 128;
+      len -= 128;
+    }
+    std::memset(block, 0, 128);
+    std::memcpy(block, msg, len);
+    b2b_compress(h, block, t + len, true);
+    out[i] = h[0];  // first 8 little-endian bytes == h[0]
+  }
+}
+
+}  // namespace
+
+// Keyed blake2b, digest_size=8, over n variable-length messages laid out in
+// `data` at `offsets` (n+1 entries). Empty messages are NOT supported (the
+// serialized tuple header is never empty).
+PN_EXPORT void pn_blake2b8_batch(const uint8_t* data, const uint64_t* offsets,
+                                 uint64_t n, const uint8_t* key,
+                                 uint32_t key_len, uint64_t* out) {
+  uint64_t h0[8];
+  for (int i = 0; i < 8; ++i) h0[i] = B2B_IV[i];
+  // param block: digest_len=8, key_len, fanout=1, depth=1
+  h0[0] ^= 0x01010000ULL ^ (static_cast<uint64_t>(key_len) << 8) ^ 8ULL;
+  uint8_t keyblock[128];
+  std::memset(keyblock, 0, 128);
+  if (key_len > 128) key_len = 128;
+  std::memcpy(keyblock, key, key_len);
+  // the key block state is shared by every message: compress it once
+  b2b_compress(h0, keyblock, 128, false);
+  unsigned hw = std::thread::hardware_concurrency();
+  uint64_t nt = hw ? (hw < 8 ? hw : 8) : 1;
+  if (n < 16384 || nt <= 1) {
+    b2b8_range(data, offsets, 0, n, h0, out);
+    return;
+  }
+  std::vector<std::thread> threads;
+  uint64_t chunk = (n + nt - 1) / nt;
+  for (uint64_t i = 0; i < nt; ++i) {
+    uint64_t b = i * chunk, e = b + chunk < n ? b + chunk : n;
+    if (b >= e) break;
+    threads.emplace_back(b2b8_range, data, offsets, b, e, h0, out);
+  }
+  for (auto& th : threads) th.join();
+}
+
 PN_EXPORT const char* pn_version() { return "pathway-native 1.0"; }

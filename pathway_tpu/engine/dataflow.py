@@ -97,6 +97,20 @@ def shard_of_value(value, n: int) -> int:
 BROADCAST = -1
 
 
+class UpdateBatch(list):
+    """A delta batch (list of (key, row, diff)) carrying the columnar
+    arrays already materialized by the producing node (col_cache: row
+    slot -> ndarray, row-aligned), so consumers skip re-extracting them
+    from the row tuples. Node.emit passes it through by reference when
+    the consumer's queue is empty; treat as read-only."""
+
+    __slots__ = ("col_cache",)
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.col_cache: dict[int, Any] = {}
+
+
 def _error_operand(fn: Callable, row: tuple) -> bool:
     """True when an expression's failure traces to an ERROR operand: the
     compiled closure carries ``_reads`` (the slots it depends on,
@@ -180,7 +194,13 @@ class Node:
             else:
                 local = updates
             if local:
-                node.queues[port].extend(local)
+                q = node.queues[port]
+                if not q and isinstance(local, UpdateBatch):
+                    # keep the columnar batch (and its col_cache) intact
+                    # for the consumer; safe to share read-only
+                    node.queues[port] = local
+                else:
+                    q.extend(local)
                 self.graph._dirty.add(node.id)
 
     def take(self, port: int = 0) -> list[Update]:
@@ -401,6 +421,31 @@ class ExprMapNode(Node):
         updates = self.take()
         if not updates:
             return
+        if (
+            self.batch_eval is not None
+            and self.deterministic
+            and not any(d <= 0 for _, _, d in updates)
+        ):
+            # all-inserts batch (the streaming-ingest common case): one
+            # vectorized evaluation, zero per-row bookkeeping; the
+            # materialized columns ride along for downstream consumers
+            keys = [k for k, _, _ in updates]
+            try:
+                result = self.batch_eval(
+                    keys,
+                    [r for _, r, _ in updates],
+                    getattr(updates, "col_cache", None),
+                )
+            except Exception:
+                if self.graph.terminate_on_error:
+                    raise
+                result = None
+            if result is not None:
+                rows_out, out_cache = result
+                batch = UpdateBatch(zip(keys, rows_out, itertools.repeat(1)))
+                batch.col_cache = out_cache
+                self.emit(batch, time)
+                return
         out: list[Update] = []
         inserts = [(k, r) for k, r, d in updates if d > 0]
         retracts = [(k, r) for k, r, d in updates if d < 0]
@@ -413,17 +458,21 @@ class ExprMapNode(Node):
                 # the insert already logged it once
                 out.append((key, self._eval_row(key, row, time, report=False), -1))
         if inserts:
+            rows_out = None
             if self.batch_eval is not None:
                 try:
-                    rows_out = self.batch_eval(
+                    # None = "batch not cleanly typed / has error rows":
+                    # re-evaluate per row, which has exact null/error
+                    # routing semantics
+                    result = self.batch_eval(
                         [k for k, _ in inserts], [r for _, r in inserts]
                     )
+                    rows_out = result[0] if result is not None else None
                 except Exception:
                     if self.graph.terminate_on_error:
                         raise
-                    # one bad row must not kill the vectorized batch
-                    rows_out = [self._eval_row(k, r, time) for k, r in inserts]
-            else:
+                    rows_out = None
+            if rows_out is None:
                 rows_out = [self._eval_row(k, r, time) for k, r in inserts]
             for (key, _), orow in zip(inserts, rows_out):
                 if not self.deterministic:
@@ -449,12 +498,40 @@ class ExprMapNode(Node):
 
 
 class FilterNode(Node):
-    def __init__(self, graph, pred: Callable, name: str = "Filter"):
+    def __init__(
+        self,
+        graph,
+        pred: Callable,
+        name: str = "Filter",
+        batch_pred: Callable | None = None,
+    ):
         super().__init__(graph, name)
         self.pred = pred
+        self.batch_pred = batch_pred  # (keys, rows) -> list[bool] | None
 
     def process(self, time):
         updates = self.take()
+        if not updates:
+            return
+        if self.batch_pred is not None:
+            cache = getattr(updates, "col_cache", None)
+            mask = self.batch_pred(
+                [k for k, _, _ in updates], [r for _, r, _ in updates], cache
+            )
+            if mask is not None:
+                out = UpdateBatch(
+                    itertools.compress(updates, mask.tolist())
+                )
+                if cache:
+                    # slice the cached columns through the same mask so
+                    # they stay row-aligned for downstream consumers
+                    out.col_cache = {
+                        i: a[mask]
+                        for i, a in cache.items()
+                        if isinstance(a, np.ndarray)
+                    }
+                self.emit(out, time)
+                return
         out = []
         for key, row, diff in updates:
             try:
@@ -667,13 +744,30 @@ class GroupByNode(Node):
         graph,
         group_key_fn: Callable,  # (key, row) -> group key (int)
         reducer_specs: list[tuple[Reducer, Callable]],  # (reducer, args_fn(key,row)->tuple)
+        batch_prep: Callable | None = None,
+        # (keys, rows) -> (gks, spec_cols | None, make_args_rows) | None
     ):
         super().__init__(graph, "GroupBy")
         self.group_key_fn = group_key_fn
         self.specs = reducer_specs
+        # columnar fast path: group keys + reducer args for a whole delta
+        # batch at once (vectorized exprs + batched ref_scalar); returns
+        # None for batches it cannot type cleanly
+        self.batch_prep = batch_prep
+        self._needs_time = [getattr(r, "needs_time", False) for r, _ in reducer_specs]
         self.all_semigroup = all(r.is_semigroup for r, _ in reducer_specs)
-        # gk -> key -> list of per-reducer args
-        self.groups: dict[int, dict[int, list[tuple]]] = {}
+        # LIGHT state: when every reducer is a semigroup AND the args are
+        # vectorizable (hence deterministic), retractions recompute their
+        # args instead of replaying stored ones, so per-group state is
+        # just the member-key set — no per-key args are kept. HEAVY
+        # state keeps gk -> key -> per-reducer args for general reducers.
+        self._light = batch_prep is not None and self.all_semigroup
+        self._can_fold = (
+            self._light
+            and all(hasattr(r, "fold_batch") for r, _ in reducer_specs)
+            and not any(self._needs_time)
+        )
+        self.groups: dict[int, Any] = {}  # gk -> key set (light) | key->args dict
         self.sg_state: dict[int, list[Any]] = {}
         self.emitted: dict[int, tuple] = {}
         self._snap_attrs = ("groups", "sg_state", "emitted")
@@ -682,6 +776,7 @@ class GroupByNode(Node):
         return (
             super().snapshot_signature(),
             tuple(type(r).__name__ for r, _fns in self.specs),
+            self._light,
         )
 
     def route_owner(self, key, row, port, n_shards):
@@ -693,19 +788,55 @@ class GroupByNode(Node):
         updates = self.take()
         if not updates:
             return
+        prepped = None
+        if self.batch_prep is not None:
+            prepped = self.batch_prep(
+                [k for k, _, _ in updates],
+                [r for _, r, _ in updates],
+                getattr(updates, "col_cache", None),
+            )
+        if prepped is not None and self._can_fold and prepped[1] is not None:
+            self._process_folded(updates, prepped[0], prepped[1], time)
+            return
         affected: set[int] = set()
-        for key, row, diff in updates:
-            gk = self.group_key_fn(key, row)
+        any_time = any(self._needs_time)
+        args_rows = None
+        if prepped is not None:
+            gks = prepped[0]
+            try:
+                args_rows = prepped[2]()
+            except Exception:
+                args_rows = None  # untyped arg columns: per-row path
+        light = self._light
+        for i, (key, row, diff) in enumerate(updates):
+            if args_rows is not None:
+                gk = gks[i]
+                args_list = args_rows[i]
+                if any_time:
+                    args_list = [
+                        ((time,) + a) if nt else a
+                        for nt, a in zip(self._needs_time, args_list)
+                    ]
+            else:
+                gk = self.group_key_fn(key, row)
+                args_list = [
+                    ((time,) + tuple(args_fn(key, row)) if getattr(red, "needs_time", False) else tuple(args_fn(key, row)))
+                    for red, args_fn in self.specs
+                ]
             affected.add(gk)
-            args_list = [
-                ((time,) + tuple(args_fn(key, row)) if getattr(red, "needs_time", False) else tuple(args_fn(key, row)))
-                for red, args_fn in self.specs
-            ]
             grp = self.groups.get(gk)
             if grp is None:
-                grp = self.groups[gk] = {}
+                grp = self.groups[gk] = set() if light else {}
                 self.sg_state[gk] = [r.init_state() if r.is_semigroup else None for r, _ in self.specs]
-            if diff > 0:
+            if light:
+                # deterministic args: retracts recompute instead of replay
+                if diff > 0:
+                    grp.add(key)
+                else:
+                    grp.discard(key)
+                    if not grp:
+                        del self.groups[gk]
+            elif diff > 0:
                 grp[key] = args_list
             else:
                 stored = grp.pop(key, None)
@@ -715,21 +846,84 @@ class GroupByNode(Node):
                     del self.groups[gk]
             sg = self.sg_state.get(gk)
             if sg is not None:
-                for i, (red, _) in enumerate(self.specs):
+                for si, (red, _) in enumerate(self.specs):
                     if red.is_semigroup:
-                        sg[i] = red.add(sg[i], args_list[i], diff)
+                        sg[si] = red.add(sg[si], args_list[si], diff)
                 if gk not in self.groups:
                     del self.sg_state[gk]
+        self._emit_affected(affected, time)
+
+    def _process_folded(self, updates, gks, spec_cols, time):
+        """Whole-batch columnar aggregation: group rows with np.unique,
+        fold each semigroup reducer over the batch (reduce.rs semigroup
+        path, vectorized), update member-key sets per group slice."""
+        n = len(updates)
+        gk_arr = np.asarray(gks, dtype=np.uint64)
+        uniq, inv = np.unique(gk_arr, return_inverse=True)
+        ug = [int(u) for u in uniq]
+        groups = self.groups
+        sg_state = self.sg_state
+        for g in ug:
+            if g not in groups:
+                groups[g] = set()
+                sg_state[g] = [r.init_state() for r, _ in self.specs]
+        all_inserts = not any(d <= 0 for _, _, d in updates)
+        # diffs=None means "all +1" for the reducer folds
+        diffs = (
+            None
+            if all_inserts
+            else np.fromiter((d for _, _, d in updates), np.int64, n)
+        )
+        order = np.argsort(inv, kind="stable")
+        sorted_inv = inv[order]
+        bounds = np.searchsorted(sorted_inv, np.arange(len(ug) + 1))
+        if all_inserts:
+            try:
+                karr = np.fromiter((k for k, _, _ in updates), np.uint64, n)
+            except (OverflowError, TypeError, ValueError):
+                karr = None
+            if karr is not None:
+                for j, g in enumerate(ug):
+                    groups[g].update(
+                        karr[order[bounds[j] : bounds[j + 1]]].tolist()
+                    )
+            else:
+                for (key, _, _), gk in zip(updates, gks):
+                    groups[gk].add(key)
+        else:
+            for (key, _, diff), gk in zip(updates, gks):
+                if diff > 0:
+                    groups[gk].add(key)
+                else:
+                    groups[gk].discard(key)
+        for si, (red, _) in enumerate(self.specs):
+            states = [sg_state[g][si] for g in ug]
+            red.fold_batch(states, spec_cols[si], inv, diffs)
+            for j, g in enumerate(ug):
+                sg_state[g][si] = states[j]
+        for g in ug:
+            if not groups[g]:
+                del groups[g]
+                del sg_state[g]
+        self._emit_affected(ug, time)
+
+    def _emit_affected(self, affected, time):
         out = []
         for gk in affected:
             grp = self.groups.get(gk)
             if grp:
-                new_row = tuple(
-                    red.extract(self.sg_state[gk][i])
-                    if red.is_semigroup
-                    else red.compute([argv[i] for argv in grp.values()])
-                    for i, (red, _) in enumerate(self.specs)
-                )
+                if self._light:
+                    new_row = tuple(
+                        red.extract(self.sg_state[gk][i])
+                        for i, (red, _) in enumerate(self.specs)
+                    )
+                else:
+                    new_row = tuple(
+                        red.extract(self.sg_state[gk][i])
+                        if red.is_semigroup
+                        else red.compute([argv[i] for argv in grp.values()])
+                        for i, (red, _) in enumerate(self.specs)
+                    )
             else:
                 new_row = None
             old_row = self.emitted.get(gk)
@@ -1430,11 +1624,13 @@ class OutputNode(Node):
         updates = self.take()
         if updates:
             self._epoch_buf.extend(updates)
-            self.emit(updates, time)
 
     def time_end(self, time):
         updates = consolidate(self._epoch_buf)
         self._epoch_buf = []
+        # sinks are terminal: nothing is emitted downstream, so the
+        # "net changes only" invariant holds structurally
+        assert not self.consumers, "OutputNode is a terminal sink"
         if updates:
             self._saw_data = True
             if self.sort_by_key:
@@ -1663,9 +1859,11 @@ class EngineGraph:
         for s in self.session_sources:
             if s.persistent_id is None:
                 continue
-            if record_mode and not s.supports_offsets:
-                # fresh capture: the reader re-produces all input, so a
-                # stale log would double it — start the recording over
+            if (record_mode or not self._speedrun) and not s.supports_offsets:
+                # offset-unaware reader: run() re-produces all input from
+                # scratch, so replaying a stale log on top would double
+                # it — start the recording over (speedrun never starts
+                # readers, so there replay stays safe)
                 self.persistence.reset_source(s.persistent_id)
                 continue
             batches, offsets, f = self.persistence.recover_source(s.persistent_id)
@@ -1686,6 +1884,17 @@ class EngineGraph:
             for s in self.session_sources
             if not s.is_error_log
         )
+        if not self._speedrun and frontier >= 0 and not all_persistent:
+            import warnings
+
+            warnings.warn(
+                "only a subset of sources has a persistent_id: "
+                "non-persistent sources re-feed at fresh epochs after a "
+                "restart, so rows derived from them may be delivered to "
+                "sinks again — exactly-once only holds when every source "
+                "is persisted",
+                stacklevel=2,
+            )
         if not self._speedrun and frontier >= 0 and all_persistent:
             rec = self.persistence.recover_operator_snapshot(frontier)
             if rec is not None:

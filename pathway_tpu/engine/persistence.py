@@ -23,6 +23,16 @@ from the last persisted snapshot):
 - Sinks are exactly-once across restarts: replayed epochs rebuild
   operator state but are suppressed at OutputNodes
   (``EngineGraph.replay_frontier``).
+
+Trust boundary: checkpoint rows, offsets, and operator snapshots are
+encoded with ``pickle`` — anyone with write access to the persistence
+root can execute arbitrary code in the recovering process. Treat the
+persistence directory (or S3 prefix) with the same trust as the program
+itself: same file permissions as the deploying user, no shared writable
+buckets. (The reference uses non-executable bincode encodings; a
+restricted encoder for the closed Value vocabulary is a possible
+hardening step, but arbitrary Python objects in rows — PyObjectWrapper
+equivalents, UDF state — make pickle the honest default here.)
 """
 
 from __future__ import annotations

@@ -60,6 +60,16 @@ class CountReducer(Reducer):
     def compute(self, values):
         return sum(1 for _ in values)
 
+    def fold_batch(self, states, cols, inv, diffs):
+        # diffs=None means every row is a +1 insert
+        if diffs is None:
+            acc = np.bincount(inv, minlength=len(states))
+        else:
+            acc = np.zeros(len(states), np.int64)
+            np.add.at(acc, inv, diffs)
+        for j, c in enumerate(acc.tolist()):
+            states[j] = states[j] + c
+
 
 class SumReducer(Reducer):
     """Int/Float/Array sum (reference IntSum/FloatSum/ArraySum)."""
@@ -91,6 +101,21 @@ class SumReducer(Reducer):
         for args in values:
             state = self.add(state, args, 1)
         return self.extract(state)
+
+    def fold_batch(self, states, cols, inv, diffs):
+        # cols are typed by construction (no None/Error); int sums exact,
+        # float sums accumulate in row order like the per-row path
+        v = cols[0]
+        if v.dtype.kind == "b":
+            v = v.astype(np.int64)
+        contrib = v if diffs is None else v * diffs
+        acc = np.zeros(len(states), contrib.dtype)
+        np.add.at(acc, inv, contrib)
+        for j, c in enumerate(acc.tolist()):
+            s = states[j]
+            if isinstance(s, Error):
+                continue
+            states[j] = c if s is None else s + c
 
 
 class MinReducer(Reducer):
@@ -276,6 +301,66 @@ class AvgReducer(Reducer):
         for args in values:
             state = self.add(state, args, 1)
         return self.extract(state)
+
+    def fold_batch(self, states, cols, inv, diffs):
+        v = cols[0]
+        if v.dtype.kind == "b":
+            v = v.astype(np.int64)
+        contrib = v if diffs is None else v * diffs
+        sacc = np.zeros(len(states), np.float64)
+        np.add.at(sacc, inv, contrib)
+        if diffs is None:
+            nacc = np.bincount(inv, minlength=len(states))
+        else:
+            nacc = np.zeros(len(states), np.int64)
+            np.add.at(nacc, inv, diffs)
+        for j in range(len(states)):
+            st = states[j]
+            if isinstance(st, Error):
+                continue
+            s, n = st
+            states[j] = (s + sacc[j].item(), n + int(nacc[j]))
+
+
+class GroupColReducer(Reducer):
+    """Reducer backing a grouping column in reduce() output. Within a
+    group every argument equals the group value (it is part of the group
+    key), which makes "pick any" a semigroup: keep the latest inserted
+    value; retractions never change it (remaining rows carry the same
+    value, and empty groups are deleted by the node)."""
+
+    is_semigroup = True
+    name = "group_col"
+
+    def init_state(self):
+        return None
+
+    def add(self, state, args, diff):
+        if diff > 0:
+            return args[0]
+        return state
+
+    def extract(self, state):
+        return state
+
+    def compute(self, values):
+        for (v,) in values:
+            return v
+        return None
+
+    def fold_batch(self, states, cols, inv, diffs):
+        v = cols[0]
+        pos = np.ones(len(inv), bool) if diffs is None else diffs > 0
+        if diffs is None or bool(pos.all()):
+            tmp = np.empty(len(states), v.dtype)
+            tmp[inv] = v  # last write per group wins
+            vals = tmp.tolist()
+            for j in range(len(states)):
+                states[j] = vals[j]
+            return
+        for j in np.unique(inv[pos]).tolist():
+            sel = np.flatnonzero(pos & (inv == j))
+            states[j] = v[sel[-1]].item()
 
 
 class EarliestReducer(Reducer):

@@ -43,6 +43,7 @@ from .expression import (
     SequenceGetExpression,
     UnwrapExpression,
 )
+from . import vector_eval
 from .parse_graph import G
 from .table import LogicalOp, Table
 
@@ -446,7 +447,20 @@ class GraphRunner:
         for e in final_exprs:
             walk_expression(e, check_det)
         fns = [self.compile(e, layout) for e in final_exprs]
-        out = df.ExprMapNode(self.engine, fns, deterministic=deterministic, name="Select")
+        # columnar fast path (SURVEY §7): vectorized numpy kernels over
+        # the delta batch, per-row closures as exact-semantics fallback
+        batch = (
+            vector_eval.try_compile_batch(final_exprs, layout, fns)
+            if deterministic
+            else None
+        )
+        out = df.ExprMapNode(
+            self.engine,
+            fns,
+            deterministic=deterministic,
+            batch_eval=batch,
+            name="Select",
+        )
         out.connect(node)
         return out
 
@@ -543,12 +557,25 @@ class GraphRunner:
         pred_expr = op.params["expr"]
         node, layout = self._zip_context(base, [pred_expr])
         pred = self.compile(pred_expr, layout)
-        fnode = df.FilterNode(self.engine, pred)
+        fnode = df.FilterNode(
+            self.engine,
+            pred,
+            batch_pred=vector_eval.try_compile_batch_pred(pred_expr, layout),
+        )
         fnode.connect(node)
-        # project back to base's columns
+        # project back to base's columns; the context layout usually IS
+        # the base's columns (no zip/ix slots) — skip the identity node
         base_names = list(base._columns.keys())
-        proj_fns = [_slot_getter(layout.slots[(base._id, n)]) for n in base_names]
-        proj = df.ExprMapNode(self.engine, proj_fns, name="FilterProj")
+        slots = [layout.slots[(base._id, n)] for n in base_names]
+        if slots == list(range(layout.width)):
+            return Lowered(fnode, list(table._columns.keys()))
+        proj_fns = [_slot_getter(i) for i in slots]
+        proj = df.ExprMapNode(
+            self.engine,
+            proj_fns,
+            batch_eval=vector_eval.make_projection_batch(slots),
+            name="FilterProj",
+        )
         proj.connect(fnode)
         return Lowered(proj, list(table._columns.keys()))
 
@@ -574,9 +601,40 @@ class GraphRunner:
 
         specs: list[tuple[Any, Callable]] = []
         slot_of: dict[int, int] = {}
+        # columnar fast path (parallel to specs): builder(cols, keys) ->
+        # per-row args tuples, or None when the spec can't vectorize
+        vec_builders: list[Callable | None] = []
 
         def make_args_fn(fns: list[Callable]):
             return lambda key, row: tuple(f(key, row) for f in fns)
+
+        def _vec_of(exprs_list) -> list[Callable] | None:
+            try:
+                return [vector_eval.compile_vec(a, layout) for a in exprs_list]
+            except vector_eval.NotVectorized:
+                return None
+
+        def _vec_tuple_builder(vfs: list[Callable]) -> Callable:
+            def build(cols, keys):
+                lists = [vector_eval._to_list(vf(cols), cols.n) for vf in vfs]
+                return list(zip(*lists)) if lists else [()] * cols.n
+
+            build._vec_fns = vfs  # columnar form for semigroup folding
+            return build
+
+        def _vec_key_payload_builder(cmp_vf: Callable) -> Callable:
+            def build(cols, keys):
+                cmps = vector_eval._to_list(cmp_vf(cols), cols.n)
+                return list(zip(cmps, (Pointer(k) for k in keys)))
+
+            return build
+
+        def _vec_keysort_builder(val_vf: Callable) -> Callable:
+            def build(cols, keys):
+                vals = vector_eval._to_list(val_vf(cols), cols.n)
+                return list(zip(keys, vals))
+
+            return build
 
         def assign_slot(e) -> ColumnExpression | None:
             if isinstance(e, ReducerExpression):
@@ -594,17 +652,38 @@ class GraphRunner:
                     cmp_fn = arg_fns[0]
                     if len(arg_fns) > 1:
                         payload_fn = arg_fns[1]
+                        args_fn = lambda key, row, c=cmp_fn, p=payload_fn: (c(key, row), p(key, row))
+                        vfs = _vec_of(list(e._args[:2]))
+                        vec_builders.append(_vec_tuple_builder(vfs) if vfs else None)
                     else:
                         payload_fn = lambda key, row: Pointer(key)
-                    args_fn = lambda key, row, c=cmp_fn, p=payload_fn: (c(key, row), p(key, row))
+                        args_fn = lambda key, row, c=cmp_fn, p=payload_fn: (c(key, row), p(key, row))
+                        vfs = _vec_of([e._args[0]])
+                        vec_builders.append(
+                            _vec_key_payload_builder(vfs[0]) if vfs else None
+                        )
                 elif name in ("tuple", "ndarray"):
                     val_fn = arg_fns[0]
-                    sfn = sort_fn or (lambda key, row: key)
+                    if sort_fn is not None:
+                        sfn = sort_fn
+                        vfs = _vec_of([sort_by, e._args[0]])
+                        vec_builders.append(_vec_tuple_builder(vfs) if vfs else None)
+                    else:
+                        sfn = lambda key, row: key
+                        vfs = _vec_of([e._args[0]])
+                        vec_builders.append(
+                            _vec_keysort_builder(vfs[0]) if vfs else None
+                        )
                     args_fn = lambda key, row, v=val_fn, s=sfn: (s(key, row), v(key, row))
                 elif name == "count":
                     args_fn = lambda key, row: ()
+                    count_builder = lambda cols, keys: [()] * cols.n
+                    count_builder._vec_fns = []
+                    vec_builders.append(count_builder)
                 else:
                     args_fn = make_args_fn(arg_fns)
+                    vfs = _vec_of(list(e._args))
+                    vec_builders.append(_vec_tuple_builder(vfs) if vfs else None)
                 idx = len(specs)
                 specs.append((red, args_fn))
                 slot_of[id(e)] = idx
@@ -618,10 +697,12 @@ class GraphRunner:
                     for si, (red, af) in enumerate(specs):
                         if getattr(red, "_gcol", None) == gi:
                             return SlotRef(si, e._dtype)
-                    red = engine_reducers.AnyReducer()
+                    red = engine_reducers.GroupColReducer()
                     red._gcol = gi
                     fn = group_fns[gi]
                     specs.append((red, lambda key, row, f=fn: (f(key, row),)))
+                    gvf = _vec_of([grouping[gi]])
+                    vec_builders.append(_vec_tuple_builder(gvf) if gvf else None)
                     return SlotRef(len(specs) - 1, e._dtype)
                 raise ValueError(
                     f"column {e._name!r} used in reduce() is not a grouping column; "
@@ -634,7 +715,50 @@ class GraphRunner:
         def group_key_fn(key, row):
             return int(ref_scalar(*[f(key, row) for f in group_fns]))
 
-        gnode = df.GroupByNode(self.engine, group_key_fn, specs)
+        batch_prep = None
+        group_vfs = _vec_of(list(grouping))
+        if group_vfs and all(b is not None for b in vec_builders):
+            from ..engine.value import ref_scalar_columns
+
+            def batch_prep(keys, rows, cache=None, _g=group_vfs, _b=list(vec_builders)):
+                cols = vector_eval.Cols(rows, cache)
+                try:
+                    garrs = [
+                        np.asarray(vector_eval._as_array(f(cols), cols.n))
+                        for f in _g
+                    ]
+                    gks = ref_scalar_columns(garrs)
+                    if gks is None:
+                        return None  # e.g. string group keys: per-row path
+                    # columnar args per spec, for semigroup fold_batch
+                    spec_cols = []
+                    for b in _b:
+                        vfs = getattr(b, "_vec_fns", None)
+                        if vfs is None:
+                            spec_cols = None
+                            break
+                        spec_cols.append(
+                            tuple(
+                                np.asarray(
+                                    vector_eval._as_array(vf(cols), cols.n)
+                                )
+                                for vf in vfs
+                            )
+                        )
+                except vector_eval.NotVectorized:
+                    return None
+                except Exception:
+                    return None  # error rows etc: per-row path reports
+
+                def make_args_rows(_b=_b, cols=cols, keys=keys):
+                    args_cols = [b(cols, keys) for b in _b]
+                    return (
+                        list(zip(*args_cols)) if args_cols else [()] * cols.n
+                    )
+
+                return gks.tolist(), spec_cols, make_args_rows
+
+        gnode = df.GroupByNode(self.engine, group_key_fn, specs, batch_prep=batch_prep)
         gnode.connect(node)
 
         post_layout = Layout()

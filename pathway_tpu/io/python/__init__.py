@@ -17,9 +17,18 @@ from .._connector import StreamingContext, input_table_from_reader
 
 class ConnectorSubject:
     """Subclass and implement run(); call next()/next_json()/next_str()/
-    next_bytes() to emit rows, commit() to flush an epoch."""
+    next_bytes() to emit rows, commit() to flush an epoch.
+
+    Set ``supports_offsets = True`` (class attribute) when run() honors
+    ``self.offsets`` to resume from reader bookmarks. Subjects that do
+    NOT opt in get record-mode persistence semantics: on recovery the
+    logged batches are replayed and the subject is not re-run, which
+    keeps exactly-once without requiring the subject to seek."""
 
     _ctx: StreamingContext | None
+    #: opt-in: the subject reads self.offsets and resumes — safe to re-run
+    #: run() after recovery without duplicating rows
+    supports_offsets: bool = False
 
     def __init__(self, datasource_name: str = "python"):
         self._ctx = None
@@ -93,8 +102,18 @@ def read(
     autocommit_duration_ms: int | None = 1500,
     name: str = "python",
     persistent_id: str | None = None,
+    supports_offsets: bool | None = None,
     **kwargs,
 ) -> Table:
+    """Read from a custom ConnectorSubject.
+
+    MIGRATION (round 2): subjects used to be treated as offset-aware by
+    default; now a subject must opt in (``supports_offsets = True``
+    class attribute, or the explicit keyword here) before recovery will
+    replay its persisted log. Offset-unaware subjects get record-mode
+    reset semantics instead — the log restarts rather than doubling the
+    re-produced input. Subjects that resume via ``self.offsets`` MUST
+    set the flag or recovery re-reads from scratch."""
     def reader(ctx: StreamingContext) -> None:
         subject._ctx = ctx
         stop = threading.Event()
@@ -114,13 +133,15 @@ def read(
             subject.on_stop()
             ctx.commit()
 
+    if supports_offsets is None:
+        supports_offsets = bool(getattr(subject, "supports_offsets", False))
     return input_table_from_reader(
         schema,
         reader,
         name=name,
         autocommit_duration_ms=autocommit_duration_ms,
         persistent_id=persistent_id,
-        supports_offsets=True,  # subjects resume via self.offsets
+        supports_offsets=supports_offsets,
     )
 
 

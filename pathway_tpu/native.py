@@ -87,6 +87,11 @@ def _load() -> ctypes.CDLL | None:
         "pn_store_load": ([ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint8], ctypes.c_int64),
         "pn_hash64_batch": ([ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)], None),
         "pn_shard_batch": ([ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)], None),
+        "pn_blake2b8_batch": (
+            [u8p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, u8p,
+             ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint64)],
+            None,
+        ),
         "pn_tok_new": ([ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int32, ctypes.c_int32], ctypes.c_void_p),
         "pn_tok_free": ([ctypes.c_void_p], None),
         "pn_tok_info": ([ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_int32)] * 5, None),
@@ -310,6 +315,28 @@ def consolidate_native(updates: list) -> list | None:
         off += 12
         key, row, _ = updates[idx]
         out.append((key, row, diff))
+    return out
+
+
+def blake2b8_batch(buf: bytes, offsets, key: bytes):
+    """Keyed blake2b-8 digests over n messages packed in `buf` at
+    `offsets` (uint64 ndarray, n+1 entries) -> uint64 ndarray, or None
+    when the native lib is unavailable (caller falls back to hashlib)."""
+    if NATIVE is None:
+        return None
+    import numpy as np
+
+    offs = np.ascontiguousarray(offsets, np.uint64)
+    n = len(offs) - 1
+    out = np.empty(n, np.uint64)
+    NATIVE.pn_blake2b8_batch(
+        _as_u8p(buf),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        n,
+        _as_u8p(key),
+        len(key),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
     return out
 
 

@@ -185,8 +185,10 @@ class ShardCluster:
         for s in primary.session_sources:
             if s.persistent_id is None:
                 continue
-            if record_mode and not s.supports_offsets:
-                # fresh capture: the reader re-produces all input
+            if not s.supports_offsets:
+                # offset-unaware reader: run() re-produces all input, so
+                # replaying a stale log on top would double it — reset
+                # (no speedrun in the sharded path, so this is all modes)
                 p.reset_source(s.persistent_id)
                 continue
             batches, offsets, f = p.recover_source(s.persistent_id)
@@ -200,6 +202,17 @@ class ShardCluster:
             for s in primary.session_sources
             if not s.is_error_log
         )
+        if frontier >= 0 and not all_persistent:
+            import warnings
+
+            warnings.warn(
+                "only a subset of sources has a persistent_id: "
+                "non-persistent sources re-feed at fresh epochs after a "
+                "restart, so rows derived from them may be delivered to "
+                "sinks again — exactly-once only holds when every source "
+                "is persisted",
+                stacklevel=2,
+            )
         self._opsnap_ok = all_persistent
         self._opsnap_time = -1
         self._last_opsnap_wall = 0.0

@@ -124,6 +124,8 @@ def test_file_modification_after_restart(tmp_path):
 class _RangeSubject(pw.io.python.ConnectorSubject):
     """Emits rows [start, stop); resumes from the persisted offset."""
 
+    supports_offsets = True  # honors self.offsets → replay-safe
+
     def __init__(self, stop):
         super().__init__()
         self.stop = stop
