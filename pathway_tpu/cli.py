@@ -40,10 +40,15 @@ def _spawn_program(
         raise click.UsageError("no program given")
     if argv[0].endswith(".py"):
         argv = [sys.executable] + argv
+    import secrets
+
     env_base = os.environ.copy()
     env_base["PATHWAY_THREADS"] = str(threads)
     env_base["PATHWAY_PROCESSES"] = str(processes)
     env_base["PATHWAY_FIRST_PORT"] = str(first_port)
+    # per-cluster shared secret authenticating the worker protocol
+    # (parallel/multiprocess.py handshake)
+    env_base.setdefault("PATHWAY_CLUSTER_TOKEN", secrets.token_hex(16))
     env_base["PATHWAY_SPAWN_ARGS"] = shlex.join(
         [f"--threads={threads}", f"--processes={processes}", f"--first-port={first_port}"]
         + (["--record"] if record else [])

@@ -421,6 +421,7 @@ class ExprMapNode(Node):
         updates = self.take()
         if not updates:
             return
+        batch_failed = False
         if (
             self.batch_eval is not None
             and self.deterministic
@@ -446,6 +447,7 @@ class ExprMapNode(Node):
                 batch.col_cache = out_cache
                 self.emit(batch, time)
                 return
+            batch_failed = True  # don't re-scan the same batch below
         out: list[Update] = []
         inserts = [(k, r) for k, r, d in updates if d > 0]
         retracts = [(k, r) for k, r, d in updates if d < 0]
@@ -459,7 +461,7 @@ class ExprMapNode(Node):
                 out.append((key, self._eval_row(key, row, time, report=False), -1))
         if inserts:
             rows_out = None
-            if self.batch_eval is not None:
+            if self.batch_eval is not None and not batch_failed:
                 try:
                     # None = "batch not cleanly typed / has error rows":
                     # re-evaluate per row, which has exact null/error

@@ -103,11 +103,29 @@ class SumReducer(Reducer):
         return self.extract(state)
 
     def fold_batch(self, states, cols, inv, diffs):
-        # cols are typed by construction (no None/Error); int sums exact,
-        # float sums accumulate in row order like the per-row path
+        # cols are typed by construction (no None/Error); float sums
+        # accumulate in row order like the per-row path
         v = cols[0]
         if v.dtype.kind == "b":
             v = v.astype(np.int64)
+        n = len(inv)
+        if v.dtype.kind == "i" and n:
+            # the per-row path sums with python bignums; only use the
+            # int64 accumulator when overflow is provably impossible
+            amax = int(np.abs(v).max())
+            dmax = 1 if diffs is None else max(1, int(np.abs(diffs).max()))
+            if amax and amax > (2**62) // (n * dmax):
+                vals = v.tolist()
+                dl = None if diffs is None else diffs.tolist()
+                accs = [0] * len(states)
+                for i, j in enumerate(inv.tolist()):
+                    accs[j] += vals[i] if dl is None else vals[i] * dl[i]
+                for j, c in enumerate(accs):
+                    s = states[j]
+                    if isinstance(s, Error):
+                        continue
+                    states[j] = c if s is None else s + c
+                return
         contrib = v if diffs is None else v * diffs
         acc = np.zeros(len(states), contrib.dtype)
         np.add.at(acc, inv, contrib)
@@ -350,17 +368,25 @@ class GroupColReducer(Reducer):
 
     def fold_batch(self, states, cols, inv, diffs):
         v = cols[0]
-        pos = np.ones(len(inv), bool) if diffs is None else diffs > 0
-        if diffs is None or bool(pos.all()):
+        if diffs is None or bool((diffs > 0).all()):
             tmp = np.empty(len(states), v.dtype)
             tmp[inv] = v  # last write per group wins
             vals = tmp.tolist()
             for j in range(len(states)):
                 states[j] = vals[j]
             return
-        for j in np.unique(inv[pos]).tolist():
-            sel = np.flatnonzero(pos & (inv == j))
-            states[j] = v[sel[-1]].item()
+        # mixed batch: last INSERTED value per group, via one stable sort
+        pos_idx = np.flatnonzero(diffs > 0)
+        if not len(pos_idx):
+            return
+        sub_inv = inv[pos_idx]
+        order = np.argsort(sub_inv, kind="stable")
+        sorted_inv = sub_inv[order]
+        last = np.flatnonzero(
+            np.r_[sorted_inv[1:] != sorted_inv[:-1], True]
+        )
+        for b in last.tolist():
+            states[int(sorted_inv[b])] = v[pos_idx[order[b]]].item()
 
 
 class EarliestReducer(Reducer):

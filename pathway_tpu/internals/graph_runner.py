@@ -174,6 +174,10 @@ class GraphRunner:
         self.engine = df.EngineGraph(n_workers=n_workers)
         self.lowered: dict[int, Lowered] = {}
         self.debug = debug
+        # worker processes (PATHWAY_PROCESS_ID > 0) build the same graph
+        # but must not fire sink callbacks — delivery happens on the
+        # coordinator (global shard 0) only
+        self.suppress_callbacks = False
         # multi-worker (PATHWAY_THREADS>1): replica runners lower the
         # SAME graph in the same order, so node ids line up across
         # shards and emit-time routing can address peers by id
@@ -203,6 +207,8 @@ class GraphRunner:
         on_time_end: Callable | None = None,
         on_end: Callable | None = None,
     ) -> df.OutputNode:
+        if self.suppress_callbacks:
+            on_change = on_time_end = on_end = None
         for r in self._replicas:
             r.subscribe(table)  # callbacks fire on shard 0 only
         low = self.lower(table)
@@ -222,16 +228,41 @@ class GraphRunner:
         self.engine.outputs.append(out)
         return out
 
+    def _cluster_engines(self) -> list[df.EngineGraph]:
+        return [self.engine] + [r.engine for r in self._replicas]
+
     def run(self, monitoring_callback=None) -> None:
         if self._replicas:
             from ..parallel.sharded import ShardCluster
 
-            self._cluster = ShardCluster(
-                [self.engine] + [r.engine for r in self._replicas]
-            )
+            self._cluster = ShardCluster(self._cluster_engines())
             self._cluster.run(monitoring_callback)
         else:
             self.engine.run(monitoring_callback)
+
+    def run_coordinator(self, processes: int, first_port: int, monitoring_callback=None) -> None:
+        """Process 0 of a PATHWAY_PROCESSES cluster: local shards
+        [0, T), sources/sinks/persistence + the worker protocol."""
+        from ..parallel.multiprocess import CoordinatorCluster
+
+        self._cluster = CoordinatorCluster(
+            self._cluster_engines(), processes=processes, first_port=first_port
+        )
+        self._cluster.run(monitoring_callback)
+
+    def run_worker(self, processes: int, first_port: int, process_id: int) -> None:
+        """Process p > 0: serve bulk-synchronous rounds for global
+        shards [p*T, (p+1)*T)."""
+        from ..parallel import multiprocess as mp
+        from ..parallel.sharded import ShardCluster
+
+        threads = 1 + len(self._replicas)
+        cluster = ShardCluster(
+            self._cluster_engines(),
+            base=process_id * threads,
+            world=processes * threads,
+        )
+        mp.run_worker(cluster, first_port, process_id)
 
     # ---------- lowering ----------
 

@@ -36,7 +36,12 @@ def run(
     telemetry = Telemetry()  # PATHWAY_TELEMETRY_SERVER (local file) or no-op
 
     n_workers = max(1, pwcfg.threads)
+    processes = max(1, pwcfg.processes)
     runner = GraphRunner(n_workers=n_workers)
+    if processes > 1 and pwcfg.process_id > 0:
+        # worker process of a `pathway spawn --processes P` cluster:
+        # same graph, no sink callbacks, no reader threads
+        runner.suppress_callbacks = True
     runner.engine.terminate_on_error = terminate_on_error
     for r in runner._replicas:
         r.engine.terminate_on_error = terminate_on_error
@@ -83,7 +88,19 @@ def run(
         http_server.start()
     try:
         with telemetry.span("graph_runner.run", workers=pwcfg.n_workers):
-            runner.run(monitoring_callback=monitor.update if monitor else None)
+            if processes > 1:
+                # reference CommunicationConfig::Cluster (config.rs:62-86):
+                # P processes × T threads; coordinator = process 0
+                if pwcfg.process_id == 0:
+                    runner.run_coordinator(
+                        processes,
+                        pwcfg.first_port,
+                        monitoring_callback=monitor.update if monitor else None,
+                    )
+                else:
+                    runner.run_worker(processes, pwcfg.first_port, pwcfg.process_id)
+            else:
+                runner.run(monitoring_callback=monitor.update if monitor else None)
     finally:
         if monitor is not None:
             telemetry.gauge("rows_in", monitor.snapshot.rows_in)

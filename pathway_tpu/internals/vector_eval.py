@@ -90,6 +90,10 @@ class Cols:
                 elif tset <= _BOOL_TYPES:
                     arr = np.asarray(items, bool)
                 elif tset <= _STR_TYPES:
+                    if any(s.endswith("\x00") for s in items):
+                        # numpy '<U' storage drops trailing NULs, which
+                        # would make comparisons diverge from per-row
+                        raise NotVectorized
                     arr = np.asarray(items)
                 else:
                     raise NotVectorized

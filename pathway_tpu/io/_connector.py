@@ -177,10 +177,23 @@ def static_table_from_rows(
     return Table(cols, Universe(), op, name=name)
 
 
-def add_output_sink(table: Table, write_fn: Callable, on_end: Callable | None = None, name: str = "output") -> None:
-    """Register a sink: write_fn(key, row_dict, time, diff) per change."""
+def add_output_sink(
+    table: Table,
+    write_fn: Callable,
+    on_end: Callable | None = None,
+    name: str = "output",
+    on_build: Callable | None = None,
+) -> None:
+    """Register a sink: write_fn(key, row_dict, time, diff) per change.
+    ``on_build(runner)`` runs at graph-build time on the process that
+    will actually deliver changes — resource acquisition (opening output
+    files, connecting clients) belongs there, NOT at registration time,
+    so worker processes of a multi-process run never touch the sink's
+    target."""
 
     def build(runner, t):
+        if on_build is not None and not getattr(runner, "suppress_callbacks", False):
+            on_build(runner)
         runner.subscribe(t, on_change=write_fn, on_end=on_end)
 
     G.add_output(table, {"build": build, "name": name})

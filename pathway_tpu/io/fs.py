@@ -210,31 +210,40 @@ def write(table: Table, filename: str, *, format: str = "csv", name: str = "fs.w
     from ._connector import add_output_sink
 
     names = table.column_names()
-    f = open(filename, "w", newline="")
+    if format not in ("csv", "json", "jsonlines"):
+        raise ValueError(f"unsupported format {format!r}")
+    state: dict = {}
+
+    def on_build(runner):
+        # open at build time on the delivering process only (worker
+        # processes of a multi-process run never create the file)
+        f = open(filename, "w", newline="")
+        state["f"] = f
+        if format == "csv":
+            writer = _csv.writer(f)
+            writer.writerow(names + ["time", "diff"])
+            state["writer"] = writer
+
     if format == "csv":
-        writer = _csv.writer(f)
-        writer.writerow(names + ["time", "diff"])
 
         def on_change(key, row, time_, diff):
-            writer.writerow([row[n] for n in names] + [time_, diff])
-            f.flush()
+            state["writer"].writerow([row[n] for n in names] + [time_, diff])
+            state["f"].flush()
 
-    elif format in ("json", "jsonlines"):
+    else:
 
         def on_change(key, row, time_, diff):
             rec = {n: _jsonable(row[n]) for n in names}
             rec["time"] = time_
             rec["diff"] = diff
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-
-    else:
-        raise ValueError(f"unsupported format {format!r}")
+            state["f"].write(json.dumps(rec) + "\n")
+            state["f"].flush()
 
     def on_end():
-        f.close()
+        if "f" in state:
+            state["f"].close()
 
-    add_output_sink(table, on_change, on_end=on_end, name=name)
+    add_output_sink(table, on_change, on_end=on_end, name=name, on_build=on_build)
 
 
 def _jsonable(v):

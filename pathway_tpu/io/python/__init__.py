@@ -20,10 +20,13 @@ class ConnectorSubject:
     next_bytes() to emit rows, commit() to flush an epoch.
 
     Set ``supports_offsets = True`` (class attribute) when run() honors
-    ``self.offsets`` to resume from reader bookmarks. Subjects that do
-    NOT opt in get record-mode persistence semantics: on recovery the
-    logged batches are replayed and the subject is not re-run, which
-    keeps exactly-once without requiring the subject to seek."""
+    ``self.offsets`` to resume from reader bookmarks — then recovery
+    replays the persisted log and the subject resumes where it left
+    off (exactly-once across restarts). Subjects that do NOT opt in
+    get record-reset semantics: on recovery the stale log is discarded
+    and the subject re-produces its input from scratch — no duplicates,
+    but sinks see the re-produced rows again (replay without re-running
+    only exists under speedrun mode, PATHWAY_REPLAY_MODE)."""
 
     _ctx: StreamingContext | None
     #: opt-in: the subject reads self.offsets and resumes — safe to re-run

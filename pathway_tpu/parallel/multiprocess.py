@@ -1,0 +1,383 @@
+"""Multi-process dataflow execution (PATHWAY_PROCESSES > 1).
+
+TPU-native rebuild of the reference's process cluster (reference
+`CommunicationConfig::Cluster`, src/engine/dataflow/config.rs:62-120;
+`pathway spawn` cli.py:53): P OS processes each own T=PATHWAY_THREADS
+engine shards of a P*T-shard world. Every process runs the SAME user
+program, so node ids line up across processes (exactly like in-process
+replica shards).
+
+Topology: a coordinator star instead of timely's full TCP mesh —
+process 0 (which also owns sources, sinks, and persistence) listens on
+127.0.0.1:PATHWAY_FIRST_PORT; workers connect and run bulk-synchronous
+rounds:
+
+    ROUND(t, frontier, mail, watermarks)
+        worker: apply frontier hooks + watermarks, deliver mail, run
+        its local fixpoint, reply (mail grouped by dest process, local
+        watermarks, activity flag)
+    TIME_END(t)   close the epoch everywhere (sinks only fire on p0)
+    SNAPSHOT / RESTORE   whole-cluster operator snapshots
+    END           on_end hooks, shutdown
+
+Mail that a worker produces for another worker relays through the
+coordinator on the next round; rounds repeat until a full round moves
+no mail, no watermarks, and every process is quiescent. This mirrors
+the reference's frontier agreement, simplified to totally-ordered
+epochs (SURVEY §7: bulk-synchronous micro-epochs per commit tick). The
+data plane of the TPU build (embedders, KNN) scales on the
+jax.sharding.Mesh; this layer scales the host-side dataflow the way
+the reference's TCP cluster does.
+
+Workers suppress sink callbacks and never start connector reader
+threads — sources are read on process 0 and exchanged by key shard
+(the reference's single-reader + forward mode, graph.rs:943).
+
+Trust boundary: after an authenticated JSON handshake, frames are
+pickled (rows may hold arbitrary python values), so a peer that knows
+the cluster token can execute code — exactly the trust level of the
+spawning user. `pathway spawn` generates a random per-cluster token in
+PATHWAY_CLUSTER_TOKEN; set it yourself when launching processes
+manually on a multi-user host (the fallback token only isolates
+clusters per uid, it is not a secret).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import pickle
+import socket
+import struct
+import sys
+import time as _wall
+from typing import Any
+
+from ..engine import dataflow as df
+from .sharded import ShardCluster
+
+_HDR = struct.Struct("<I")
+_MAX_HELLO = 4096  # handshake frames are tiny; bound pre-auth reads
+
+
+def cluster_token() -> str:
+    tok = os.environ.get("PATHWAY_CLUSTER_TOKEN")
+    if tok:
+        return tok
+    return f"pathway-local-uid-{getattr(os, 'getuid', lambda: 0)()}"
+
+
+def _send_json(sock: socket.socket, obj: dict) -> None:
+    blob = json.dumps(obj).encode()
+    sock.sendall(_HDR.pack(len(blob)) + blob)
+
+
+def _recv_json(sock: socket.socket) -> dict:
+    hdr = _recv_exact(sock, _HDR.size)
+    (n,) = _HDR.unpack(hdr)
+    if n > _MAX_HELLO:
+        raise ConnectionError("oversized handshake frame")
+    return json.loads(_recv_exact(sock, n))
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    blob = pickle.dumps(obj, protocol=4)
+    sock.sendall(_HDR.pack(len(blob)) + blob)
+
+
+def _recv(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, _HDR.size)
+    (n,) = _HDR.unpack(hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _group_by_process(boxes: dict[int, list], threads: int) -> dict[int, dict[int, list]]:
+    """{global_shard: box} -> {pid: {global_shard: box}}."""
+    out: dict[int, dict[int, list]] = {}
+    for shard, box in boxes.items():
+        out.setdefault(shard // threads, {})[shard] = box
+    return out
+
+
+class CoordinatorCluster(ShardCluster):
+    """Process 0's cluster: local shards [0, T) of a P*T world, plus the
+    protocol driving P-1 remote worker processes."""
+
+    def __init__(self, engines, processes: int, first_port: int, accept_timeout: float = 60.0):
+        threads = len(engines)
+        super().__init__(engines, base=0, world=processes * threads)
+        self.threads = threads
+        self.processes = processes
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", first_port))
+        srv.listen(processes)
+        srv.settimeout(accept_timeout)
+        self._conns: dict[int, socket.socket] = {}
+        sig = _graph_sig(engines[0])
+        token = cluster_token()
+        try:
+            while len(self._conns) < processes - 1:
+                conn, _ = srv.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # the handshake is JSON and token-checked BEFORE any
+                # pickle frame is accepted from the peer
+                try:
+                    hello = _recv_json(conn)
+                except (ConnectionError, ValueError):
+                    conn.close()
+                    continue
+                if hello.get("op") != "hello" or not hmac.compare_digest(
+                    str(hello.get("token", "")), token
+                ):
+                    conn.close()
+                    continue
+                if hello["sig"] != sig:
+                    _send_json(conn, {"op": "fatal", "error": "graph mismatch: every process must run the same program"})
+                    raise RuntimeError(
+                        f"worker {hello['pid']} built a different graph "
+                        f"(sig {hello['sig']} != {sig})"
+                    )
+                if hello["threads"] != threads:
+                    _send_json(conn, {"op": "fatal", "error": "PATHWAY_THREADS mismatch"})
+                    raise RuntimeError("PATHWAY_THREADS differs across processes")
+                _send_json(conn, {"op": "welcome", "token": token})
+                self._conns[hello["pid"]] = conn
+        finally:
+            srv.close()
+        # relay buffer: worker→worker mail waiting for the next round
+        self._relay: dict[int, dict[int, list]] = {}
+        self._epoch_frontier: Any = None
+
+    # -- protocol helpers --
+
+    def _round_all(self, msg_per_pid: dict[int, dict]) -> dict[int, dict]:
+        for pid, conn in self._conns.items():
+            _send(conn, msg_per_pid[pid])
+        replies = {}
+        for pid, conn in self._conns.items():
+            r = _recv(conn)
+            if r.get("op") == "error":
+                raise df.EngineError(
+                    f"worker process {pid} failed:\n{r['traceback']}"
+                )
+            replies[pid] = r
+        return replies
+
+    def _broadcast(self, msg: dict) -> dict[int, dict]:
+        return self._round_all({pid: msg for pid in self._conns})
+
+    # -- distributed sweep --
+
+    def set_epoch_frontier(self, frontier) -> None:
+        """The frontier the workers must apply before sweeping the next
+        epoch (run() applies it locally via _frontier_hooks)."""
+        self._epoch_frontier = frontier
+
+    def _sweep(self, time) -> None:
+        frontier = self._epoch_frontier
+        self._epoch_frontier = None
+        while True:
+            self._sweep_local(time)
+            outbound = _group_by_process(self.drain_remote_mail(), self.threads)
+            # fold in relayed worker→worker mail from the previous round
+            for pid, boxes in self._relay.items():
+                dst = outbound.setdefault(pid, {})
+                for shard, box in boxes.items():
+                    dst.setdefault(shard, []).extend(box)
+            self._relay = {}
+            wm = self.watermark_map()
+            sent_any = any(outbound.values())
+            msgs = {
+                pid: {
+                    "op": "round",
+                    "t": time,
+                    "frontier": frontier,
+                    "mail": outbound.get(pid, {}),
+                    "wm": wm,
+                }
+                for pid in self._conns
+            }
+            frontier = None  # applied once per epoch
+            replies = self._round_all(msgs)
+            got_mail = False
+            wm_changed = False
+            for pid, r in replies.items():
+                for dest_pid, boxes in r["mail"].items():
+                    if dest_pid == 0:
+                        got_mail |= self.post_mail(boxes)
+                    else:
+                        dst = self._relay.setdefault(dest_pid, {})
+                        for shard, box in boxes.items():
+                            dst.setdefault(shard, []).extend(box)
+                        got_mail = True
+                wm_changed |= self.apply_watermarks(r["wm"])
+                wm_changed |= bool(r["active"])
+            if not (sent_any or got_mail or wm_changed or any(e._dirty for e in self.engines)):
+                break
+        self._broadcast({"op": "time_end", "t": time})
+        self._time_end_all(time)
+
+    # -- persistence across processes --
+
+    def _snapshot_operators(self, t: int) -> None:
+        states = {}
+        for shard, e in enumerate(self.engines):
+            for n in e.nodes:
+                s = n.snapshot_state()
+                if s is not None:
+                    states[(shard, n.id)] = s
+        for pid, r in self._broadcast({"op": "snapshot"}).items():
+            states.update(r["states"])
+        blob = pickle.dumps(
+            {"sig": self._cluster_signature(), "time": int(t), "states": states},
+            protocol=4,
+        )
+        self._persistence.save_operator_snapshot(int(t), blob)
+        self._last_opsnap_wall = _wall.monotonic()
+
+    def _cluster_signature(self):
+        # all processes build the identical graph, so the signature of
+        # global shard s equals shard 0's with the shard id substituted
+        base = [(n.id, n.snapshot_signature()) for n in self.engines[0].nodes]
+        return [(shard, nid, s) for shard in range(self.world) for nid, s in base]
+
+    def _restore_states(self, states: dict) -> None:
+        local: dict = {}
+        remote: dict[int, dict] = {}
+        for (shard, nid), st in states.items():
+            if self._is_local(shard):
+                self.engines[shard].nodes[nid].restore_state(st)
+            else:
+                remote.setdefault(shard // self.threads, {})[(shard, nid)] = st
+        for pid, conn in self._conns.items():
+            _send(conn, {"op": "restore", "states": remote.get(pid, {})})
+            r = _recv(conn)
+            assert r.get("op") == "ok"
+
+    def _flush_needed(self) -> bool:
+        return True  # remote processes may hold buffered state
+
+    def _finish_remote(self) -> None:
+        for pid, conn in self._conns.items():
+            try:
+                _send(conn, {"op": "end"})
+            except Exception:
+                pass
+        for conn in self._conns.values():
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+
+def _graph_sig(engine: df.EngineGraph) -> str:
+    # JSON-safe digest: node count + names in creation order
+    h = hashlib.sha256()
+    h.update(str(len(engine.nodes)).encode())
+    for n in engine.nodes:
+        h.update(b"\x00" + n.name.encode())
+    return h.hexdigest()
+
+
+def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 120) -> None:
+    """Worker process main loop (PATHWAY_PROCESS_ID > 0): serve rounds
+    until the coordinator says END."""
+    sock = None
+    for _ in range(retries):
+        try:
+            sock = socket.create_connection(("127.0.0.1", first_port), timeout=5.0)
+            break
+        except OSError:
+            _wall.sleep(0.25)
+    if sock is None:
+        raise ConnectionError(f"cannot reach coordinator on port {first_port}")
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    token = cluster_token()
+    _send_json(
+        sock,
+        {
+            "op": "hello",
+            "pid": pid,
+            "threads": cluster.n,
+            "sig": _graph_sig(cluster.engines[0]),
+            "token": token,
+        },
+    )
+    welcome = _recv_json(sock)
+    if welcome.get("op") == "fatal":
+        raise RuntimeError(welcome["error"])
+    # mutual auth: a port squatter can't feed us pickles either
+    assert welcome.get("op") == "welcome"
+    if not hmac.compare_digest(str(welcome.get("token", "")), token):
+        raise ConnectionError("coordinator failed token check")
+    try:
+        while True:
+            msg = _recv(sock)
+            op = msg["op"]
+            if op == "round":
+                t = msg["t"]
+                had = False
+                if msg.get("frontier") is not None:
+                    for e in cluster.engines:
+                        e.current_time = t
+                        e._frontier_hooks(msg["frontier"])
+                had |= cluster.post_mail(msg["mail"])
+                had |= cluster.apply_watermarks(msg["wm"])
+                cluster._sweep_local(t)
+                out = _group_by_process(cluster.drain_remote_mail(), cluster.n)
+                _send(
+                    sock,
+                    {
+                        "op": "reply",
+                        "mail": out,
+                        "wm": cluster.watermark_map(),
+                        "active": had or bool(out),
+                    },
+                )
+            elif op == "time_end":
+                cluster._time_end_all(msg["t"])
+                _send(sock, {"op": "ok"})
+            elif op == "snapshot":
+                states = {}
+                for i, e in enumerate(cluster.engines):
+                    for n in e.nodes:
+                        s = n.snapshot_state()
+                        if s is not None:
+                            states[(cluster.base + i, n.id)] = s
+                _send(sock, {"op": "states", "states": states})
+            elif op == "restore":
+                for (shard, nid), st in msg["states"].items():
+                    cluster.engines[shard - cluster.base].nodes[nid].restore_state(st)
+                _send(sock, {"op": "ok"})
+            elif op == "end":
+                for e in cluster.engines:
+                    for n in e.nodes:
+                        n.on_end()
+                return
+            elif op == "fatal":
+                raise RuntimeError(msg["error"])
+            else:
+                raise RuntimeError(f"unknown op {op!r}")
+    except Exception:
+        import traceback
+
+        try:
+            _send(sock, {"op": "error", "traceback": traceback.format_exc()})
+        except Exception:
+            pass
+        raise
+    finally:
+        sock.close()

@@ -32,21 +32,33 @@ from ..engine import dataflow as df
 
 
 class ShardCluster:
-    """Owns N EngineGraph shards and the inter-shard mailboxes."""
+    """Owns a contiguous slice of the global shard space — all of it in
+    a single-process run (base=0, world=n), or this process's T shards
+    in a multi-process run (base=pid*T, world=P*T; reference
+    CommunicationConfig::Cluster, config.rs:62-86). Mailboxes span the
+    WHOLE world: boxes addressed to local shards deliver in-process,
+    boxes for remote shards are drained by the multiprocess transport
+    (parallel/multiprocess.py)."""
 
-    def __init__(self, engines: list[df.EngineGraph]):
+    def __init__(self, engines: list[df.EngineGraph], base: int = 0, world: int | None = None):
         assert len(engines) >= 1
         self.engines = engines
         self.n = len(engines)
+        self.base = base
+        self.world = world if world is not None else len(engines)
+        assert self.base + self.n <= self.world
         for i, e in enumerate(engines):
-            e.worker_id = i
-            e.n_workers = self.n
+            e.worker_id = base + i
+            e.n_workers = self.world
             e.cluster = self
-        # mail[shard] = list of (node_id, port, update)
-        self._mail: list[list] = [[] for _ in engines]
+        # mail[global_shard] = list of (node_id, port, update)
+        self._mail: list[list] = [[] for _ in range(self.world)]
         self._mail_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=self.n) if self.n > 1 else None
         self._stop = False
+
+    def _is_local(self, shard: int) -> bool:
+        return self.base <= shard < self.base + self.n
 
     # -- routing (called from Node.emit during topo sweeps) --
 
@@ -55,14 +67,14 @@ class ShardCluster:
         mail = None
         me = from_graph.worker_id
         for u in updates:
-            owner = consumer.route_owner(u[0], u[1], port, self.n)
+            owner = consumer.route_owner(u[0], u[1], port, self.world)
             if owner is None or owner == me:
                 local.append(u)
             elif owner == df.BROADCAST:
                 local.append(u)
                 if mail is None:
                     mail = []
-                for j in range(self.n):
+                for j in range(self.world):
                     if j != me:
                         mail.append((j, consumer.id, port, u))
             else:
@@ -76,19 +88,44 @@ class ShardCluster:
         return local
 
     def _deliver_mail(self) -> bool:
-        """Move mailbox contents into target shard queues; True if any."""
+        """Move local-shard mailbox contents into target shard queues;
+        True if any. Remote-shard boxes stay for the transport."""
+        boxes = {}
         with self._mail_lock:
-            boxes = self._mail
-            self._mail = [[] for _ in self.engines]
+            for shard in range(self.base, self.base + self.n):
+                if self._mail[shard]:
+                    boxes[shard] = self._mail[shard]
+                    self._mail[shard] = []
         delivered = False
-        for shard, box in enumerate(boxes):
-            if not box:
-                continue
+        for shard, box in boxes.items():
             delivered = True
-            engine = self.engines[shard]
+            engine = self.engines[shard - self.base]
             for nid, port, u in box:
                 engine.nodes[nid].queues[port].append(u)
                 engine._dirty.add(nid)
+        return delivered
+
+    def drain_remote_mail(self) -> dict[int, list]:
+        """Pop mail addressed to shards outside this process:
+        {global_shard: [(node_id, port, update), ...]}."""
+        out = {}
+        with self._mail_lock:
+            for shard in range(self.world):
+                if not self._is_local(shard) and self._mail[shard]:
+                    out[shard] = self._mail[shard]
+                    self._mail[shard] = []
+        return out
+
+    def post_mail(self, boxes: dict[int, list]) -> bool:
+        """Deliver transport-received mail into local shard queues."""
+        delivered = False
+        for shard, box in boxes.items():
+            assert self._is_local(shard), (shard, self.base, self.n)
+            engine = self.engines[shard - self.base]
+            for nid, port, u in box:
+                engine.nodes[nid].queues[port].append(u)
+                engine._dirty.add(nid)
+                delivered = True
         return delivered
 
     # -- epoch machinery --
@@ -121,10 +158,39 @@ class ShardCluster:
                         e._dirty.add(nid)
         return changed
 
-    def _sweep(self, time) -> None:
-        """One bulk-synchronous round: every dirty shard runs its local
-        topological pass (in parallel), then mail is exchanged; repeat
-        until globally quiescent."""
+    def watermark_map(self) -> dict[int, object]:
+        """Per-node max watermark across this process's shards (for the
+        cross-process frontier gossip)."""
+        out: dict[int, object] = {}
+        for nid in range(len(self.engines[0].nodes)):
+            if not hasattr(self.engines[0].nodes[nid], "watermark"):
+                continue
+            wms = [
+                e.nodes[nid].watermark
+                for e in self.engines
+                if e.nodes[nid].watermark is not None
+            ]
+            if wms:
+                out[nid] = max(wms)
+        return out
+
+    def apply_watermarks(self, wm: dict[int, object]) -> bool:
+        """Raise local watermarks to the global maxima; marks nodes
+        dirty so releases happen in the same epoch."""
+        changed = False
+        for nid, global_wm in wm.items():
+            for e in self.engines:
+                n = e.nodes[nid]
+                if n.watermark is None or n.watermark < global_wm:
+                    n.watermark = global_wm
+                    e._dirty.add(nid)
+                    changed = True
+        return changed
+
+    def _sweep_local(self, time) -> None:
+        """Local fixpoint: every dirty local shard runs its topological
+        pass (in parallel), local mail is exchanged; repeat until the
+        process's shards are quiescent (remote mail may remain)."""
 
         def run_one(e):
             while e._dirty:
@@ -148,11 +214,19 @@ class ShardCluster:
                     run_one(e)
             self._deliver_mail()
             self._sync_watermarks(mark_dirty=True)
+
+    def _time_end_all(self, time) -> None:
         for e in self.engines:
             for node in e.nodes:
                 te = getattr(node, "time_end", None)
                 if te is not None:
                     te(time)
+
+    def _sweep(self, time) -> None:
+        """One bulk-synchronous epoch sweep (single-process: the world
+        is local, so the local fixpoint is the global one)."""
+        self._sweep_local(time)
+        self._time_end_all(time)
 
     # -- persistence (input snapshots + whole-cluster operator snapshots;
     #    sources live on shard 0, state is spread across all shards) --
@@ -223,8 +297,7 @@ class ShardCluster:
                 data = pickle.loads(blob)
                 sig = self._cluster_signature()
                 if data.get("sig") == sig:
-                    for (shard, nid), st in data["states"].items():
-                        self.engines[shard].nodes[nid].restore_state(st)
+                    self._restore_states(data["states"])
                     for s in primary.session_sources:
                         s.replay_batches = [
                             (tt, ups) for tt, ups in s.replay_batches if tt > t0
@@ -243,6 +316,10 @@ class ShardCluster:
             for shard, e in enumerate(self.engines)
             for n in e.nodes
         ]
+
+    def _restore_states(self, states: dict) -> None:
+        for (shard, nid), st in states.items():
+            self.engines[shard].nodes[nid].restore_state(st)
 
     def _maybe_snapshot_operators(self, t: int) -> None:
         """Interval snapshots (persistence_config.snapshot_interval_ms):
@@ -332,6 +409,7 @@ class ShardCluster:
             for e in self.engines:
                 e.current_time = t
                 e._frontier_hooks(t)
+            self.set_epoch_frontier(t)
             for s in primary.static_sources:
                 s.feed(t)
             for s in primary.session_sources:
@@ -373,10 +451,13 @@ class ShardCluster:
         for e in self.engines:
             e.current_time = last_time + 1
             e._frontier_hooks(df.INF_TIME)
+        self.set_epoch_frontier(df.INF_TIME)
         self._deliver_mail()
         # only run (and fire time_end for) the flush epoch if it has
         # work — single-worker runs skip it when nothing is dirty
-        if any(e._dirty for e in self.engines):
+        # (remote processes may hold buffered state, so the coordinator
+        # always sweeps)
+        if self._flush_needed():
             self._sweep(last_time + 1)
         # trailing error deliveries
         err = []
@@ -394,12 +475,23 @@ class ShardCluster:
         for e in self.engines:
             for node in e.nodes:
                 node.on_end()
+        self._finish_remote()
         if self._persistence is not None:
             self._persistence.close()
         for t in primary.connector_threads:
             t.join(timeout=5.0)
         if self._pool is not None:
             self._pool.shutdown(wait=False)
+
+    # hooks the multi-process coordinator overrides
+    def set_epoch_frontier(self, frontier) -> None:
+        pass
+
+    def _flush_needed(self) -> bool:
+        return any(e._dirty for e in self.engines)
+
+    def _finish_remote(self) -> None:
+        pass
 
     def stop(self) -> None:
         self._stop = True

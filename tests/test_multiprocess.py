@@ -1,0 +1,256 @@
+"""Multi-process dataflow execution (PATHWAY_PROCESSES > 1).
+
+Reference: `pathway spawn --processes P` launches P OS processes that
+run the same program and exchange data by key shard over TCP
+(/root/reference/python/pathway/cli.py:53,
+/root/reference/src/engine/dataflow/config.rs:62-120). Here: wordcount
+output of a 2-process run must be byte-identical to the single-process
+run, sinks fire on process 0 only, and cross-process exchange actually
+carries rows (groups hash to both processes)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROGRAM = textwrap.dedent(
+    """
+    import os
+    import pathway_tpu as pw
+
+    class S(pw.Schema):
+        word: str
+
+    t = pw.io.jsonlines.read(os.environ["WC_IN"], schema=S, mode="static")
+    c = t.groupby(pw.this.word).reduce(
+        pw.this.word, n=pw.reducers.count()
+    )
+    out = os.environ["WC_OUT"] + "." + os.environ.get("PATHWAY_PROCESS_ID", "0")
+    pw.io.csv.write(c, out)
+    pw.run(monitoring_level="none")
+    """
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(tmp_path, processes: int, threads: int, tag: str) -> str:
+    prog = tmp_path / f"wc_{tag}.py"
+    prog.write_text(PROGRAM)
+    out = str(tmp_path / f"out_{tag}.csv")
+    env = dict(os.environ)
+    env.update(
+        WC_IN=str(tmp_path / "in"),
+        WC_OUT=out,
+        JAX_PLATFORMS="cpu",
+        PATHWAY_THREADS=str(threads),
+        PATHWAY_PROCESSES=str(processes),
+        PATHWAY_FIRST_PORT=str(_free_port()),
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    procs = []
+    for pid in range(processes):
+        e = dict(env)
+        e["PATHWAY_PROCESS_ID"] = str(pid)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(prog)],
+                env=e,
+                cwd=str(tmp_path),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    for p in procs:
+        try:
+            outp, errp = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"rc={p.returncode}\n{errp[-4000:]}"
+    return out
+
+
+STREAM_PROGRAM = textwrap.dedent(
+    """
+    import os, threading, time, json
+    import pathway_tpu as pw
+
+    class S(pw.Schema):
+        word: str
+
+    t = pw.io.jsonlines.read(
+        os.environ["WC_IN"], schema=S, mode="streaming",
+        autocommit_duration_ms=150,
+    )
+    c = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+    out = os.environ["WC_OUT"] + "." + os.environ.get("PATHWAY_PROCESS_ID", "0")
+    pw.io.jsonlines.write(c, out)
+
+    def mutate():
+        if os.environ.get("PATHWAY_PROCESS_ID", "0") == "0":
+            time.sleep(1.0)
+            with open(os.path.join(os.environ["WC_IN"], "late.jsonl"), "w") as f:
+                for w in ["cat", "late", "late"]:
+                    f.write(json.dumps({"word": w}) + "\\n")
+        time.sleep(3.0)
+        os._exit(0)
+
+    threading.Thread(target=mutate, daemon=True).start()
+    pw.run(monitoring_level="none")
+    """
+)
+
+
+def _net_counts(path: str) -> dict:
+    state: dict = {}
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            key = rec["word"]
+            if rec["diff"] > 0:
+                state[key] = rec["n"]
+            elif state.get(key) == rec["n"]:
+                del state[key]
+    return state
+
+
+def test_streaming_two_process_wordcount(wc_input):
+    """Multiple live epochs over the round protocol: the net state after
+    streaming updates matches the single-process run."""
+    tmp = wc_input
+    prog = tmp / "wc_stream.py"
+    prog.write_text(STREAM_PROGRAM)
+    out = str(tmp / "out_stream.csv")
+    env = dict(os.environ)
+    env.update(
+        WC_IN=str(tmp / "in"),
+        WC_OUT=out,
+        JAX_PLATFORMS="cpu",
+        PATHWAY_THREADS="1",
+        PATHWAY_PROCESSES="2",
+        PATHWAY_FIRST_PORT=str(_free_port()),
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    procs = []
+    for pid in range(2):
+        e = dict(env)
+        e["PATHWAY_PROCESS_ID"] = str(pid)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(prog)],
+                env=e,
+                cwd=str(tmp),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    for p in procs:
+        outp, errp = p.communicate(timeout=120)
+        assert p.returncode == 0, errp[-4000:]
+    got = _net_counts(out + ".0")
+    assert got == {
+        "cat": 22,
+        "dog": 14,
+        "bird": 7,
+        "emu": 7,
+        "fox": 7,
+        "owl": 7,
+        "late": 2,
+    }
+
+
+@pytest.fixture()
+def wc_input(tmp_path):
+    d = tmp_path / "in"
+    d.mkdir()
+    words = ["cat", "dog", "cat", "bird", "dog", "cat", "emu", "fox", "owl"] * 7
+    with open(d / "words.jsonl", "w") as f:
+        for w in words:
+            f.write(json.dumps({"word": w}) + "\n")
+    return tmp_path
+
+
+def test_two_process_wordcount_matches_single(wc_input):
+    tmp = wc_input
+    single = _spawn(tmp, processes=1, threads=1, tag="single")
+    multi = _spawn(tmp, processes=2, threads=1, tag="multi")
+    with open(single + ".0") as f:
+        expect = f.read()
+    with open(multi + ".0") as f:
+        got = f.read()
+    assert got == expect
+    assert "cat" in expect and "21" in expect
+    # sinks fire on process 0 only
+    assert not os.path.exists(multi + ".1")
+
+
+def test_pathway_spawn_processes_cli(wc_input):
+    """`pathway spawn --processes 2 prog.py` end to end (reference
+    cli.py:53): CLI sets the PATHWAY_* topology env and launches both
+    processes; output equals the single-process run."""
+    tmp = wc_input
+    single = _spawn(tmp, processes=1, threads=1, tag="cli_ref")
+    prog = tmp / "wc_cli.py"
+    prog.write_text(PROGRAM)
+    out = str(tmp / "out_cli.csv")
+    env = dict(os.environ)
+    env.update(
+        WC_IN=str(tmp / "in"),
+        WC_OUT=out,
+        JAX_PLATFORMS="cpu",
+        PATHWAY_FIRST_PORT=str(_free_port()),
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pathway_tpu",
+            "spawn",
+            "--processes",
+            "2",
+            "--first-port",
+            env["PATHWAY_FIRST_PORT"],
+            str(prog),
+        ],
+        env=env,
+        cwd=str(tmp),
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert r.returncode == 0, r.stderr[-4000:]
+    with open(single + ".0") as f:
+        expect = f.read()
+    with open(out + ".0") as f:
+        got = f.read()
+    assert got == expect
+
+
+def test_two_process_two_threads_wordcount(wc_input):
+    tmp = wc_input
+    single = _spawn(tmp, processes=1, threads=1, tag="s2")
+    multi = _spawn(tmp, processes=2, threads=2, tag="m2")
+    with open(single + ".0") as f:
+        expect = f.read()
+    with open(multi + ".0") as f:
+        got = f.read()
+    assert got == expect
