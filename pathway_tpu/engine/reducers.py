@@ -112,7 +112,8 @@ class SumReducer(Reducer):
         if v.dtype.kind == "i" and n:
             # the per-row path sums with python bignums; only use the
             # int64 accumulator when overflow is provably impossible
-            amax = int(np.abs(v).max())
+            # python-int abs: np.abs(INT64_MIN) wraps negative
+            amax = max(abs(int(v.max())), abs(int(v.min())))
             dmax = 1 if diffs is None else max(1, int(np.abs(diffs).max()))
             if amax and amax > (2**62) // (n * dmax):
                 vals = v.tolist()

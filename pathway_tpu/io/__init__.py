@@ -1,10 +1,13 @@
 """pw.io: connectors.
 
 Rebuild of /root/reference/python/pathway/io/ (30 connector packages).
-Fully implemented this round: fs, csv, jsonlines, plaintext, python,
-http (server + client), null, subscribe. Service-backed connectors
-(kafka, s3, postgres, …) share the same reader/writer machinery and are
-gated on their client libraries being installed."""
+Local connectors (fs, csv, jsonlines, plaintext, python, http, sqlite,
+null, subscribe) run standalone; service-backed connectors (kafka, s3,
+minio, s3_csv, postgres, debezium, mongodb, elasticsearch, nats,
+deltalake, bigquery, pubsub, logstash, slack, gdrive, pyfilesystem,
+redpanda, airbyte) implement the full read/parse/commit or
+format/write loop over injectable clients — unit-tested with fakes,
+and gated on their client libraries only for real deployments."""
 
 from __future__ import annotations
 
@@ -12,10 +15,10 @@ from . import csv, fs, jsonlines, null, plaintext, python
 from ._subscribe import subscribe
 from ._connector import add_output_sink
 
-# service-backed connectors (gated on client libs at call time)
-from . import kafka, s3, minio, elasticsearch, postgres, debezium, mongodb
+# service-backed connectors (client libs needed only at run time)
+from . import kafka, s3, s3_csv, minio, elasticsearch, postgres, debezium, mongodb
 from . import redpanda, nats, gdrive, sqlite, deltalake, bigquery, pubsub, logstash
-from . import airbyte, http
+from . import airbyte, http, pyfilesystem, slack
 
 __all__ = [
     "add_output_sink",
@@ -38,9 +41,12 @@ __all__ = [
     "plaintext",
     "postgres",
     "pubsub",
+    "pyfilesystem",
     "python",
     "redpanda",
     "s3",
+    "s3_csv",
+    "slack",
     "sqlite",
     "subscribe",
 ]

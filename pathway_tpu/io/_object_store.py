@@ -1,0 +1,184 @@
+"""Generic object-store scanner shared by the bucket-style connectors
+(s3, minio, s3_csv, gdrive, pyfilesystem).
+
+Rebuild of the reference's POSIX-like scanner abstraction
+(/root/reference/src/connectors/posix_like.rs:279 with the
+scanner/{filesystem,s3}.rs backends): a connector provides an
+``ObjectStoreClient`` (list + fetch with version stamps) and the shared
+loop turns objects into keyed row upserts, exactly like the local fs
+scanner — streaming mode re-lists and upserts changed/deleted objects,
+offsets persist {key: (version, n_rows)} so recovery skips unchanged
+objects.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import io as _io
+import json
+import time
+from typing import Any, Iterable, Protocol
+
+from ..engine.value import Json
+from ..internals import dtype as dt
+from ..internals.schema import ColumnDefinition, Schema, schema_builder
+from ..internals.table import Table
+from ._connector import (
+    StreamingContext,
+    input_table_from_reader,
+    static_table_from_rows,
+)
+
+_POLL_INTERVAL_S = 1.0
+
+
+class ObjectStoreClient(Protocol):
+    def list_objects(self) -> Iterable[tuple[str, Any]]:
+        """-> (key, version) pairs; version changes when content does."""
+
+    def get_object(self, key: str) -> bytes:
+        """-> the object's raw bytes."""
+
+
+def rows_from_payload(
+    payload: bytes,
+    format: str,
+    with_metadata: bool,
+    metadata: dict | None,
+    **kwargs,
+) -> list[dict]:
+    """Decode one object's payload into dict rows (same format
+    vocabulary as pw.io.fs)."""
+    rows: list[dict] = []
+    if format == "binary":
+        rows.append({"data": payload})
+    elif format in ("plaintext", "plaintext_by_file"):
+        text = payload.decode(errors="replace")
+        if format == "plaintext_by_file":
+            rows.append({"data": text.rstrip("\n")})
+        else:
+            rows.extend(
+                {"data": line} for line in text.splitlines() if line
+            )
+    elif format == "csv":
+        reader = _csv.DictReader(
+            _io.StringIO(payload.decode(errors="replace")),
+            **{k: v for k, v in kwargs.items() if k in ("delimiter", "quotechar")},
+        )
+        rows.extend(dict(rec) for rec in reader)
+    elif format in ("json", "jsonlines"):
+        for line in payload.decode(errors="replace").splitlines():
+            line = line.strip()
+            if line:
+                rows.append(dict(json.loads(line)))
+    else:
+        raise ValueError(f"unsupported format {format!r}")
+    if with_metadata:
+        meta = Json(metadata or {})
+        for r in rows:
+            r["_metadata"] = meta
+    return rows
+
+
+def default_schema(format: str, with_metadata: bool) -> type[Schema]:
+    col = dt.BYTES if format == "binary" else dt.STR
+    cols: dict[str, Any] = {"data": ColumnDefinition(dtype=col)}
+    if with_metadata:
+        cols["_metadata"] = ColumnDefinition(dtype=dt.JSON)
+    return schema_builder(cols, name="ObjectStoreSchema")
+
+
+def read_object_store(
+    client_factory,
+    *,
+    format: str,
+    schema: type[Schema] | None,
+    mode: str,
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    name: str = "object_store",
+    persistent_id: str | None = None,
+    poll_interval_s: float = _POLL_INTERVAL_S,
+    **kwargs,
+) -> Table:
+    """Build an input table over an ObjectStoreClient.
+
+    ``client_factory()`` is called on the reader thread (so slow client
+    construction/auth never blocks graph building).
+    """
+    if schema is None:
+        schema = default_schema(format, with_metadata)
+    elif with_metadata and "_metadata" not in schema.column_names():
+        cols = dict(schema.columns())
+        cols["_metadata"] = ColumnDefinition(dtype=dt.JSON)
+        schema = schema_builder(cols, name=schema.__name__)
+
+    if mode == "static":
+        client = client_factory()
+        rows: list[dict] = []
+        for key, version in sorted(client.list_objects()):
+            payload = client.get_object(key)
+            rows.extend(
+                rows_from_payload(
+                    payload, format, with_metadata, {"path": key}, **kwargs
+                )
+            )
+        return static_table_from_rows(schema, rows, name=name)
+
+    def reader(ctx: StreamingContext) -> None:
+        client = client_factory()
+        known: dict[str, tuple[Any, int]] = {
+            k: tuple(v)
+            for k, v in ctx.offsets.items()
+            if isinstance(k, str) and k != "__seq__"
+        }
+        while True:
+            current: dict[str, Any] = dict(client.list_objects())
+            changed = False
+            for key in sorted(current):
+                version = current[key]
+                old = known.get(key)
+                if old is not None and old[0] == version:
+                    continue
+                old_n = old[1] if old is not None else 0
+                rows = rows_from_payload(
+                    client.get_object(key),
+                    format,
+                    with_metadata,
+                    {"path": key},
+                    **kwargs,
+                )
+                for i, row in enumerate(rows):
+                    ctx.upsert_keyed((key, i), row)
+                for i in range(len(rows), old_n):
+                    ctx.upsert_keyed((key, i), None)
+                known[key] = (version, len(rows))
+                ctx.set_offset(key, known[key])
+                changed = True
+            for key in list(known):
+                if key not in current:
+                    _v, old_n = known.pop(key)
+                    for i in range(old_n):
+                        ctx.upsert_keyed((key, i), None)
+                    ctx.set_offset(key, None)
+                    changed = True
+            if changed:
+                ctx.commit()
+            if _oneshot():
+                break
+            time.sleep(poll_interval_s)
+
+    return input_table_from_reader(
+        schema,
+        reader,
+        name=name,
+        autocommit_duration_ms=autocommit_duration_ms,
+        persistent_id=persistent_id,
+        supports_offsets=True,  # resumes from {key: (version, n_rows)}
+    )
+
+
+def _oneshot() -> bool:
+    import os
+
+    return bool(os.environ.get("PATHWAY_TPU_FS_ONESHOT"))

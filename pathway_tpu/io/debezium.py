@@ -1,34 +1,105 @@
-"""pw.io.debezium — Debezium CDC source (reference DebeziumMessageParser data_format.rs:1053).
+"""pw.io.debezium — Debezium CDC source.
 
-Requires `confluent_kafka` at call time; shares the connector runtime in
-pathway_tpu/io/_connector.py. TPU build note: the dataflow side (reader
-threads, commit ticks, upsert sessions) is identical to the implemented
-connectors (fs/kafka/sqlite); only the client-protocol glue needs the
-third-party lib."""
+Rebuild of the reference's Debezium path
+(/root/reference/src/connectors/data_format.rs DebeziumMessageParser
+:1053; python/pathway/io/debezium/__init__.py read): change events
+arrive on a Kafka topic as key/value JSON envelopes; ``payload.op``
+r/c/u/d maps to inserts/deletes (postgres-style, which carries
+``before``) or keyed upserts (mongodb-style, which does not). The
+consumer is injectable (``_consumer`` — an iterable of
+(key_bytes, value_bytes)) so the whole parse/apply loop unit-tests
+without a broker.
+"""
 
 from __future__ import annotations
 
 from ..internals.schema import Schema
 from ..internals.table import Table
+from ._connector import StreamingContext, input_table_from_reader
+from ._formats import DebeziumMessageParser
+from .kafka import _get_consumer
 
 
-def _require():
-    try:
-        import confluent_kafka  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "pw.io.debezium requires the 'confluent_kafka' package to be installed"
-        ) from e
+def read(
+    rdkafka_settings: dict,
+    topic_name: str | None = None,
+    *,
+    schema: type[Schema],
+    db_type: str = "postgres",
+    autocommit_duration_ms: int | None = 1500,
+    name: str = "debezium",
+    persistent_id: str | None = None,
+    _consumer=None,
+    **kwargs,
+) -> Table:
+    parser = DebeziumMessageParser(
+        value_field_names=schema.column_names(), db_type=db_type
+    )
 
+    # keyless topics: content identity must preserve MULTIPLICITY (two
+    # identical inserts are two rows; one delete removes one) — track a
+    # per-content counter so each instance gets a distinct key
+    multiplicity: dict[tuple, int] = {}
 
-def read(*args, schema: type[Schema] | None = None, **kwargs) -> Table:
-    _require()
-    raise NotImplementedError(
-        "pw.io.debezium.read: client glue pending; see pw.io.fs/kafka/sqlite for "
-        "the implemented pattern (CDC messages over kafka)"
+    def apply_events(ctx: StreamingContext, key_payload, value_payload) -> None:
+        for event in parser.parse(key_payload, value_payload):
+            op, values, key_values = event
+            if key_values is not None:
+                # the Debezium key payload IS the row's primary key, so
+                # every op is a keyed upsert: r/c/u set the after-state,
+                # d clears it (reference upsert session, adaptors.rs:176)
+                kt = _key_tuple(key_values)
+                ctx.upsert_keyed(kt, None if op == "delete" else values)
+                continue
+            if values is None:
+                continue
+            content = tuple(str(values.get(n)) for n in schema.column_names())
+            if op == "delete":
+                n = multiplicity.get(content, 0)
+                if n > 0:
+                    multiplicity[content] = n - 1
+                    ctx.upsert_keyed((*content, n - 1), None)
+            else:
+                n = multiplicity.get(content, 0)
+                multiplicity[content] = n + 1
+                ctx.upsert_keyed((*content, n), values)
+
+    def reader(ctx: StreamingContext) -> None:
+        if _consumer is not None:
+            for key_payload, value_payload in _consumer:
+                apply_events(ctx, key_payload, value_payload)
+            ctx.commit()
+            return
+        kind, consumer = _get_consumer(rdkafka_settings, topic_name)
+        try:
+            if kind == "confluent":
+                while True:
+                    msg = consumer.poll(timeout=1.0)
+                    if msg is None:
+                        ctx.commit()
+                        continue
+                    if msg.error():
+                        continue
+                    apply_events(ctx, msg.key(), msg.value())
+            else:
+                for msg in consumer:
+                    apply_events(ctx, msg.key, msg.value)
+        finally:
+            try:
+                consumer.close()
+            except Exception:
+                pass
+
+    return input_table_from_reader(
+        schema,
+        reader,
+        name=name,
+        autocommit_duration_ms=autocommit_duration_ms,
+        persistent_id=persistent_id,
     )
 
 
-def write(table: Table, *args, **kwargs) -> None:
-    _require()
-    raise NotImplementedError("pw.io.debezium.write: client glue pending")
+def _key_tuple(key_values) -> tuple:
+    if isinstance(key_values, dict):
+        return tuple(v for _k, v in sorted(key_values.items()))
+    return (key_values,)

@@ -1,34 +1,96 @@
-"""pw.io.gdrive — Google Drive source (reference io/gdrive, 401 LoC).
+"""pw.io.gdrive — Google Drive source.
 
-Requires `googleapiclient` at call time; shares the connector runtime in
-pathway_tpu/io/_connector.py. TPU build note: the dataflow side (reader
-threads, commit ticks, upsert sessions) is identical to the implemented
-connectors (fs/kafka/sqlite); only the client-protocol glue needs the
-third-party lib."""
+Rebuild of /root/reference/python/pathway/io/gdrive/__init__.py
+(_GDriveClient :73, _GDriveSubject :261, read :336): a Drive folder is
+scanned like an object store — files list with their version/md5,
+changed files re-download, deletions retract. The Drive client is
+injectable (``_client`` — list_objects()/get_object()) so the scanner
+unit-tests without Google credentials; google-api-python-client is only
+needed for real drives.
+"""
 
 from __future__ import annotations
 
+from typing import Any
+
 from ..internals.schema import Schema
 from ..internals.table import Table
+from ._object_store import read_object_store
 
 
-def _require():
-    try:
-        import googleapiclient  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "pw.io.gdrive requires the 'googleapiclient' package to be installed"
-        ) from e
+class _GDriveClient:
+    """ObjectStoreClient over the Drive v3 API."""
+
+    def __init__(self, object_id: str, credentials_file: str):
+        try:
+            from google.oauth2.service_account import Credentials  # type: ignore
+            from googleapiclient.discovery import build  # type: ignore
+        except ImportError as e:
+            raise ImportError(
+                "pw.io.gdrive requires the 'google-api-python-client' package"
+            ) from e
+        creds = Credentials.from_service_account_file(
+            credentials_file, scopes=["https://www.googleapis.com/auth/drive.readonly"]
+        )
+        self.service = build("drive", "v3", credentials=creds)
+        self.object_id = object_id
+
+    def list_objects(self):
+        page_token = None
+        while True:
+            resp = (
+                self.service.files()
+                .list(
+                    q=f"'{self.object_id}' in parents and trashed=false",
+                    fields="nextPageToken, files(id, name, md5Checksum, modifiedTime)",
+                    pageToken=page_token,
+                )
+                .execute()
+            )
+            for f in resp.get("files", []):
+                yield f["id"], f.get("md5Checksum") or f.get("modifiedTime")
+            page_token = resp.get("nextPageToken")
+            if not page_token:
+                return
+
+    def get_object(self, key: str) -> bytes:
+        return self.service.files().get_media(fileId=key).execute()
 
 
-def read(*args, schema: type[Schema] | None = None, **kwargs) -> Table:
-    _require()
-    raise NotImplementedError(
-        "pw.io.gdrive.read: client glue pending; see pw.io.fs/kafka/sqlite for "
-        "the implemented pattern (files by folder id)"
+def read(
+    object_id: str,
+    *,
+    mode: str = "streaming",
+    format: str = "binary",
+    object_size_limit: int | None = None,
+    service_user_credentials_file: str | None = None,
+    with_metadata: bool = False,
+    refresh_interval: int = 30,
+    schema: type[Schema] | None = None,
+    name: str = "gdrive",
+    persistent_id: str | None = None,
+    _client: Any = None,
+    **kwargs,
+) -> Table:
+    if object_size_limit is not None:
+        raise NotImplementedError(
+            "gdrive object_size_limit is not implemented yet; filter "
+            "oversized files on the Drive side or drop the argument"
+        )
+
+    def client_factory():
+        if _client is not None:
+            return _client
+        return _GDriveClient(object_id, service_user_credentials_file)
+
+    return read_object_store(
+        client_factory,
+        format=format,
+        schema=schema,
+        mode=mode,
+        with_metadata=with_metadata,
+        name=f"{name}:{object_id}",
+        persistent_id=persistent_id,
+        poll_interval_s=float(refresh_interval),
+        **kwargs,
     )
-
-
-def write(table: Table, *args, **kwargs) -> None:
-    _require()
-    raise NotImplementedError("pw.io.gdrive.write: client glue pending")

@@ -50,8 +50,12 @@ def read(
     format: str = "json",
     autocommit_duration_ms: int | None = 1500,
     name: str = "kafka",
+    _consumer=None,
     **kwargs,
 ) -> Table:
+    """Stream a Kafka topic. ``_consumer`` injects a fake for tests: an
+    iterable of (key_bytes, value_bytes) message pairs — the stream
+    closes when it is exhausted (a real consumer polls forever)."""
     if schema is None:
         if format == "raw":
             schema = schema_builder(
@@ -61,6 +65,11 @@ def read(
             raise ValueError("kafka.read requires schema= for json format")
 
     def reader(ctx: StreamingContext) -> None:
+        if _consumer is not None:
+            for _key, value in _consumer:
+                _emit(ctx, value, format, schema)
+            ctx.commit()
+            return
         kind, consumer = _get_consumer(rdkafka_settings, topic)
         try:
             if kind == "confluent":
@@ -104,12 +113,18 @@ def write(
     *,
     format: str = "json",
     name: str = "kafka.write",
+    _producer=None,
     **kwargs,
 ) -> None:
+    """``_producer`` injects a fake for tests: an object with
+    produce(topic, payload)."""
     producer_holder: list = []
 
     def get_producer():
         if producer_holder:
+            return producer_holder[0]
+        if _producer is not None:
+            producer_holder.append(("confluent", _producer))
             return producer_holder[0]
         try:
             from confluent_kafka import Producer  # type: ignore

@@ -1,34 +1,60 @@
-"""pw.io.minio — MinIO connector (reference io/minio) — S3-compatible.
+"""pw.io.minio — MinIO (S3-compatible) reader.
 
-Requires `boto3` at call time; shares the connector runtime in
-pathway_tpu/io/_connector.py. TPU build note: the dataflow side (reader
-threads, commit ticks, upsert sessions) is identical to the implemented
-connectors (fs/kafka/sqlite); only the client-protocol glue needs the
-third-party lib."""
+Rebuild of /root/reference/python/pathway/io/minio/__init__.py: a
+settings wrapper that fills the S3 endpoint, then delegates to the
+shared S3 scanner (pw.io.s3.read / scanner/s3.rs)."""
 
 from __future__ import annotations
 
 from ..internals.schema import Schema
 from ..internals.table import Table
+from .s3 import AwsS3Settings
+from . import s3 as _s3
 
 
-def _require():
-    try:
-        import boto3  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "pw.io.minio requires the 'boto3' package to be installed"
-        ) from e
+class MinIOSettings:
+    def __init__(
+        self,
+        endpoint: str,
+        bucket_name: str,
+        access_key: str,
+        secret_access_key: str,
+        *,
+        with_path_style: bool = True,
+        region: str | None = None,
+    ):
+        self.endpoint = endpoint
+        self.bucket_name = bucket_name
+        self.access_key = access_key
+        self.secret_access_key = secret_access_key
+        self.with_path_style = with_path_style
+        self.region = region
+
+    def create_aws_settings(self) -> AwsS3Settings:
+        endpoint = self.endpoint
+        if "://" not in endpoint:
+            endpoint = "https://" + endpoint
+        return AwsS3Settings(
+            bucket_name=self.bucket_name,
+            access_key=self.access_key,
+            secret_access_key=self.secret_access_key,
+            with_path_style=self.with_path_style,
+            region=self.region,
+            endpoint=endpoint,
+        )
 
 
-def read(*args, schema: type[Schema] | None = None, **kwargs) -> Table:
-    _require()
-    raise NotImplementedError(
-        "pw.io.minio.read: client glue pending; see pw.io.fs/kafka/sqlite for "
-        "the implemented pattern (objects via s3 API)"
+def read(
+    path: str,
+    minio_settings: MinIOSettings,
+    *,
+    schema: type[Schema] | None = None,
+    **kwargs,
+) -> Table:
+    return _s3.read(
+        path,
+        aws_s3_settings=minio_settings.create_aws_settings(),
+        schema=schema,
+        name="minio",
+        **kwargs,
     )
-
-
-def write(table: Table, *args, **kwargs) -> None:
-    _require()
-    raise NotImplementedError("pw.io.minio.write: client glue pending")

@@ -1,34 +1,64 @@
-"""pw.io.mongodb — MongoDB sink (reference MongoWriter data_storage.rs:2232).
+"""pw.io.mongodb — MongoDB sink.
 
-Requires `pymongo` at call time; shares the connector runtime in
-pathway_tpu/io/_connector.py. TPU build note: the dataflow side (reader
-threads, commit ticks, upsert sessions) is identical to the implemented
-connectors (fs/kafka/sqlite); only the client-protocol glue needs the
-third-party lib."""
+Rebuild of the reference's Mongo writer
+(/root/reference/src/connectors/data_storage.rs MongoWriter :2232 with
+the Bson formatter data_format.rs :1975;
+python/pathway/io/mongodb/__init__.py write :14): each change becomes a
+document with the row's fields plus time/diff, inserted into the target
+collection. The collection is injectable (``_collection``) so the
+format/insert loop unit-tests against a fake; pymongo is only needed
+for real deployments.
+"""
 
 from __future__ import annotations
 
-from ..internals.schema import Schema
+from typing import Any
+
 from ..internals.table import Table
+from ._connector import add_output_sink
+from ._formats import BsonFormatter
 
 
-def _require():
-    try:
-        import pymongo  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "pw.io.mongodb requires the 'pymongo' package to be installed"
-        ) from e
+def write(
+    table: Table,
+    *,
+    connection_string: str | None = None,
+    database: str | None = None,
+    collection: str | None = None,
+    max_batch_size: int | None = None,
+    _collection: Any = None,
+) -> None:
+    fmt = BsonFormatter(table.column_names())
+    state: dict = {"batch": []}
 
+    def on_build(runner):
+        if _collection is not None:
+            state["coll"] = _collection
+            return
+        try:
+            from pymongo import MongoClient  # type: ignore
+        except ImportError as e:
+            raise ImportError("pw.io.mongodb requires the 'pymongo' package") from e
+        client = MongoClient(connection_string)
+        state["client"] = client
+        state["coll"] = client[database][collection]
 
-def read(*args, schema: type[Schema] | None = None, **kwargs) -> Table:
-    _require()
-    raise NotImplementedError(
-        "pw.io.mongodb.read: client glue pending; see pw.io.fs/kafka/sqlite for "
-        "the implemented pattern (BSON documents)"
+    def flush():
+        if state["batch"]:
+            state["coll"].insert_many(state["batch"])
+            state["batch"] = []
+
+    def on_change(key, row, time, diff):
+        state["batch"].append(fmt.format(row, time, diff))
+        if max_batch_size is None or len(state["batch"]) >= max_batch_size:
+            flush()
+
+    def on_end():
+        flush()
+        client = state.get("client")
+        if client is not None:
+            client.close()
+
+    add_output_sink(
+        table, on_change, on_end=on_end, name="mongodb.write", on_build=on_build
     )
-
-
-def write(table: Table, *args, **kwargs) -> None:
-    _require()
-    raise NotImplementedError("pw.io.mongodb.write: client glue pending")

@@ -1,34 +1,9 @@
-"""pw.io.redpanda — Redpanda connector (kafka-compatible, reference io/redpanda).
+"""pw.io.redpanda — Redpanda connector.
 
-Requires `confluent_kafka` at call time; shares the connector runtime in
-pathway_tpu/io/_connector.py. TPU build note: the dataflow side (reader
-threads, commit ticks, upsert sessions) is identical to the implemented
-connectors (fs/kafka/sqlite); only the client-protocol glue needs the
-third-party lib."""
+Redpanda speaks the Kafka protocol, so this module is a thin alias of
+pw.io.kafka (exactly like the reference,
+/root/reference/python/pathway/io/redpanda/__init__.py)."""
 
 from __future__ import annotations
 
-from ..internals.schema import Schema
-from ..internals.table import Table
-
-
-def _require():
-    try:
-        import confluent_kafka  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "pw.io.redpanda requires the 'confluent_kafka' package to be installed"
-        ) from e
-
-
-def read(*args, schema: type[Schema] | None = None, **kwargs) -> Table:
-    _require()
-    raise NotImplementedError(
-        "pw.io.redpanda.read: client glue pending; see pw.io.fs/kafka/sqlite for "
-        "the implemented pattern (kafka protocol)"
-    )
-
-
-def write(table: Table, *args, **kwargs) -> None:
-    _require()
-    raise NotImplementedError("pw.io.redpanda.write: client glue pending")
+from .kafka import read, write  # noqa: F401

@@ -37,9 +37,9 @@ Trust boundary: after an authenticated JSON handshake, frames are
 pickled (rows may hold arbitrary python values), so a peer that knows
 the cluster token can execute code — exactly the trust level of the
 spawning user. `pathway spawn` generates a random per-cluster token in
-PATHWAY_CLUSTER_TOKEN; set it yourself when launching processes
-manually on a multi-user host (the fallback token only isolates
-clusters per uid, it is not a secret).
+PATHWAY_CLUSTER_TOKEN; manual launches must set it themselves (there
+is deliberately no fallback — a guessable token would be an RCE door
+on multi-user hosts).
 """
 
 from __future__ import annotations
@@ -64,9 +64,16 @@ _MAX_HELLO = 4096  # handshake frames are tiny; bound pre-auth reads
 
 def cluster_token() -> str:
     tok = os.environ.get("PATHWAY_CLUSTER_TOKEN")
-    if tok:
-        return tok
-    return f"pathway-local-uid-{getattr(os, 'getuid', lambda: 0)()}"
+    if not tok:
+        # a guessable fallback (uid-derived etc.) would hand any local
+        # user pickle-deserialization RCE — refuse instead
+        raise RuntimeError(
+            "multi-process execution needs a shared secret: launch via "
+            "`pathway spawn` (which generates one) or set "
+            "PATHWAY_CLUSTER_TOKEN to the same random value in every "
+            "process"
+        )
+    return tok
 
 
 def _send_json(sock: socket.socket, obj: dict) -> None:

@@ -60,6 +60,7 @@ def _spawn(tmp_path, processes: int, threads: int, tag: str) -> str:
         PATHWAY_THREADS=str(threads),
         PATHWAY_PROCESSES=str(processes),
         PATHWAY_FIRST_PORT=str(_free_port()),
+        PATHWAY_CLUSTER_TOKEN="test-cluster-secret",
         PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
     )
     procs = []
@@ -146,6 +147,7 @@ def test_streaming_two_process_wordcount(wc_input):
         PATHWAY_THREADS="1",
         PATHWAY_PROCESSES="2",
         PATHWAY_FIRST_PORT=str(_free_port()),
+        PATHWAY_CLUSTER_TOKEN="test-cluster-secret",
         PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
     )
     procs = []
@@ -217,6 +219,7 @@ def test_pathway_spawn_processes_cli(wc_input):
         WC_OUT=out,
         JAX_PLATFORMS="cpu",
         PATHWAY_FIRST_PORT=str(_free_port()),
+        PATHWAY_CLUSTER_TOKEN="test-cluster-secret",
         PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
     )
     r = subprocess.run(
