@@ -1897,6 +1897,7 @@ class EngineGraph:
                 "is persisted",
                 stacklevel=2,
             )
+        restored_t: int | None = None
         if not self._speedrun and frontier >= 0 and all_persistent:
             rec = self.persistence.recover_operator_snapshot(frontier)
             if rec is not None:
@@ -1929,6 +1930,31 @@ class EngineGraph:
                         ):
                             st_src.pos += 1
                     self._opsnap_time = t0
+                    restored_t = t0
+        # trimmed input logs (compact_inputs_on_snapshot) are only
+        # recoverable THROUGH a compatible snapshot that covers the
+        # trimmed range — any other path (changed program, mixed
+        # persistence, lost snapshot) would silently replay a partial
+        # log, so fail loudly instead
+        if not self._speedrun:
+            max_compacted = max(
+                (
+                    self.persistence.compacted_to.get(s.persistent_id, -1)
+                    for s in self.session_sources
+                    if s.persistent_id is not None
+                ),
+                default=-1,
+            )
+            if max_compacted >= 0 and (
+                restored_t is None or restored_t < max_compacted
+            ):
+                raise EngineError(
+                    "the persisted input logs were snapshot-compacted, but "
+                    "no compatible operator snapshot covering the trimmed "
+                    "range could be restored (changed program, missing "
+                    "snapshot, or non-persistent sources added) — clear "
+                    "the persistence root or run the original program"
+                )
 
     def _snapshot_operators(self, t) -> None:
         """Write layer-2 state. Called AFTER every ADVANCE of epoch t is
@@ -1946,6 +1972,13 @@ class EngineGraph:
         sig = [(n.id, n.snapshot_signature()) for n in self.nodes]
         blob = pickle.dumps({"sig": sig, "time": t, "states": states}, protocol=4)
         self.persistence.save_operator_snapshot(int(t), blob)
+        # opt-in: the snapshot covers all input <= t, so trim the input
+        # logs to keep them bounded on long-running jobs (background
+        # compaction role, reference operator_snapshot.rs:491)
+        if getattr(self.persistence_config, "compact_inputs_on_snapshot", False):
+            for s in self.session_sources:
+                if s.persistent_id is not None and not s.is_error_log:
+                    self.persistence.compact_source_below(s.persistent_id, int(t))
         self._last_opsnap_wall = _wall.monotonic()
 
     def _maybe_snapshot_operators(self, t) -> None:

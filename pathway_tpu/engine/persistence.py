@@ -47,6 +47,10 @@ from .. import native as _native
 KIND_DATA = 1
 KIND_ADVANCE = 2
 KIND_OPSNAP = 3
+# marker: DATA <= time was dropped because an operator snapshot covers
+# it; recovery into a CHANGED program must fail loudly, not silently
+# compute from a partial log
+KIND_COMPACT = 4
 
 _PY_MAGIC = b"PWPYLOG1"
 
@@ -208,6 +212,190 @@ def _use_native() -> bool:
     return _native.is_available() and not os.environ.get("PATHWAY_PERSISTENCE_FORCE_PY")
 
 
+def _safe_id(source_id: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_." else "_" for c in source_id)
+
+
+class _ListReader:
+    """Reader facade over pre-fetched records (S3 backend)."""
+
+    def __init__(self, records):
+        self.records = records
+
+    def __iter__(self):
+        return iter(self.records)
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# S3 backend (reference src/persistence/backends/s3.rs:34).
+#
+# Logs are append-oriented but S3 objects are immutable, so a source's
+# log is a GENERATION of objects: {prefix}/g{N}/{seq}.bin, each object a
+# run of records in the python log format. The single pointer object
+# {prefix}/GEN names the live generation; compaction writes the whole
+# compacted log into generation N+1 and then flips the pointer with one
+# atomic PUT — a crash between the write and the old-generation cleanup
+# leaves only unreferenced garbage, never duplicate records.
+# ---------------------------------------------------------------------------
+
+
+def _pack_record(kind: int, time: int, key: int, blob: bytes) -> bytes:
+    body = struct.pack("<BQQI", kind, time, key & 0xFFFFFFFFFFFFFFFF, len(blob)) + blob
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return struct.pack("<I", len(body)) + body + struct.pack("<I", crc)
+
+
+def _iter_records(buf: bytes) -> Iterator[tuple[int, int, int, bytes]]:
+    """Parse packed records; stops at the first torn/corrupt record."""
+    off = 0
+    n = len(buf)
+    while off + 4 <= n:
+        (blen,) = struct.unpack_from("<I", buf, off)
+        if off + 4 + blen + 4 > n:
+            return
+        body = buf[off + 4 : off + 4 + blen]
+        (crc,) = struct.unpack_from("<I", buf, off + 4 + blen)
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            return
+        kind, time, key, plen = struct.unpack_from("<BQQI", body, 0)
+        blob = body[21 : 21 + plen]
+        if len(blob) != plen:
+            return
+        yield kind, time, key, bytes(blob)
+        off += 4 + blen + 4
+
+
+class S3LogStorage:
+    """Key-value plumbing for one persistence root on an S3-compatible
+    store. The client is boto3-shaped (list_objects_v2 / get_object /
+    put_object / delete_object) and injectable for tests."""
+
+    def __init__(self, client, bucket: str, root: str):
+        self.client = client
+        self.bucket = bucket
+        self.root = root.strip("/")
+
+    # -- raw object helpers --
+
+    @staticmethod
+    def _is_missing_key(e: Exception) -> bool:
+        if isinstance(e, (KeyError, FileNotFoundError)):
+            return True  # in-memory/disk fakes
+        name = type(e).__name__
+        if name in ("NoSuchKey", "NoSuchBucket"):
+            return True
+        code = ""
+        resp = getattr(e, "response", None)
+        if isinstance(resp, dict):
+            code = str(resp.get("Error", {}).get("Code", ""))
+        return code in ("NoSuchKey", "NoSuchBucket", "404")
+
+    def _get(self, key: str) -> bytes | None:
+        try:
+            return self.client.get_object(Bucket=self.bucket, Key=key)["Body"].read()
+        except Exception as e:
+            # ONLY a missing key maps to None — a transient S3 error
+            # must not be mistaken for "no persisted state" (that would
+            # orphan the live generation on the next compaction flip)
+            if self._is_missing_key(e):
+                return None
+            raise
+
+    def _put(self, key: str, body: bytes) -> None:
+        self.client.put_object(Bucket=self.bucket, Key=key, Body=body)
+
+    def _list(self, prefix: str) -> list[str]:
+        keys: list[str] = []
+        token = None
+        while True:
+            kw = {"Bucket": self.bucket, "Prefix": prefix}
+            if token:
+                kw["ContinuationToken"] = token
+            resp = self.client.list_objects_v2(**kw)
+            keys.extend(o["Key"] for o in resp.get("Contents", []))
+            if not resp.get("IsTruncated"):
+                return sorted(keys)
+            token = resp.get("NextContinuationToken")
+
+    def _delete(self, key: str) -> None:
+        try:
+            self.client.delete_object(Bucket=self.bucket, Key=key)
+        except Exception:
+            pass
+
+    # -- per-source generations --
+
+    def _gen_key(self, source_id: str) -> str:
+        return f"{self.root}/{source_id}/GEN"
+
+    def generation(self, source_id: str) -> int:
+        body = self._get(self._gen_key(source_id))
+        return int(body.decode()) if body else 0
+
+    def _gen_prefix(self, source_id: str, gen: int) -> str:
+        return f"{self.root}/{source_id}/g{gen:06d}/"
+
+    def read_records(self, source_id: str) -> list[tuple[int, int, int, bytes]]:
+        gen = self.generation(source_id)
+        out: list[tuple[int, int, int, bytes]] = []
+        for key in self._list(self._gen_prefix(source_id, gen)):
+            body = self._get(key)
+            if body:
+                out.extend(_iter_records(body))
+        return out
+
+    def replace_records(
+        self, source_id: str, records: Iterable[tuple[int, int, int, bytes]]
+    ) -> None:
+        """Compaction flip: next generation holds exactly ``records``."""
+        old_gen = self.generation(source_id)
+        new_gen = old_gen + 1
+        buf = bytearray()
+        for rec in records:
+            buf += _pack_record(*rec)
+        if buf:
+            self._put(self._gen_prefix(source_id, new_gen) + "00000000.bin", bytes(buf))
+        self._put(self._gen_key(source_id), str(new_gen).encode())
+        for key in self._list(self._gen_prefix(source_id, old_gen)):
+            self._delete(key)
+
+    def writer(self, source_id: str) -> "S3LogWriter":
+        gen = self.generation(source_id)
+        prefix = self._gen_prefix(source_id, gen)
+        existing = self._list(prefix)
+        seq = len(existing)
+        return S3LogWriter(self, prefix, seq)
+
+    def drop_source(self, source_id: str) -> None:
+        self.replace_records(source_id, [])
+
+
+class S3LogWriter:
+    """Buffers appended records; each flush uploads one new object."""
+
+    def __init__(self, storage: S3LogStorage, prefix: str, seq: int):
+        self.storage = storage
+        self.prefix = prefix
+        self.seq = seq
+        self.buf = bytearray()
+
+    def append(self, kind: int, time: int, key: int, blob: bytes) -> None:
+        self.buf += _pack_record(kind, time, key, blob)
+
+    def flush(self) -> None:
+        if not self.buf:
+            return
+        self.storage._put(f"{self.prefix}{self.seq:08d}.bin", bytes(self.buf))
+        self.seq += 1
+        self.buf = bytearray()
+
+    def close(self) -> None:
+        self.flush()
+
+
 class EnginePersistence:
     """Per-run persistence manager: owns one log per persistent source
     (reference WorkerPersistentStorage, src/persistence/tracker.rs:49)."""
@@ -220,6 +408,7 @@ class EnginePersistence:
         self.root = backend.path
         self.events = getattr(backend, "events", None)
         self.config = config
+        self._s3: S3LogStorage | None = None
         if self.kind == "filesystem":
             # one namespace per process of the topology — parallel hosts
             # must not share log files (reference WorkerPersistentStorage,
@@ -231,18 +420,47 @@ class EnginePersistence:
         elif self.kind == "mock":
             if self.events is None:
                 backend.events = self.events = []
+        elif self.kind == "s3":
+            # reference src/persistence/backends/s3.rs:34
+            bucket, prefix = self._parse_s3_root(backend)
+            pid = os.environ.get("PATHWAY_PROCESS_ID")
+            if pid and pid != "0":
+                prefix = f"{prefix}/proc-{pid}"
+            client = getattr(backend, "client", None)
+            if client is None:
+                settings = getattr(backend, "bucket_settings", None)
+                if settings is None:
+                    raise ValueError(
+                        "Backend.s3 needs bucket_settings (AwsS3Settings) "
+                        "or an injected client"
+                    )
+                client = settings.create_client()
+                if not bucket:
+                    bucket = settings.bucket_name
+            self._s3 = S3LogStorage(client, bucket, prefix)
         else:
             raise NotImplementedError(
                 f"persistence backend {self.kind!r} is not available in this build; "
-                "use Backend.filesystem or Backend.mock"
+                "use Backend.filesystem, Backend.s3, or Backend.mock"
             )
         self._writers: dict[str, Any] = {}
+        # per-source trim frontier discovered at recovery (KIND_COMPACT)
+        self.compacted_to: dict[str, int] = {}
+
+    @staticmethod
+    def _parse_s3_root(backend) -> tuple[str, str]:
+        from ..utils.uri import split_s3_path
+
+        bucket, prefix = split_s3_path(backend.path or "")
+        if bucket is None:
+            settings = getattr(backend, "bucket_settings", None)
+            bucket = getattr(settings, "bucket_name", None) or ""
+        return bucket, prefix.strip("/") or "pathway-persistence"
 
     # -- storage plumbing --
 
     def _source_path(self, source_id: str) -> str:
-        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in source_id)
-        return os.path.join(self.root, "streams", safe + ".bin")
+        return os.path.join(self.root, "streams", _safe_id(source_id) + ".bin")
 
     def _mock_bucket(self, source_id: str) -> list:
         # events may be a dict-of-lists keyed by source; a flat list gets
@@ -254,6 +472,8 @@ class EnginePersistence:
     def _open_reader(self, source_id: str):
         if self.kind == "mock":
             return MemoryLogReader(self._mock_bucket(source_id), source_id)
+        if self.kind == "s3":
+            return _ListReader(self._s3.read_records(_safe_id(source_id)))
         return sniff_log_reader(self._source_path(source_id))
 
     def writer_for(self, source_id: str):
@@ -261,6 +481,8 @@ class EnginePersistence:
         if w is None:
             if self.kind == "mock":
                 w = MemoryLogWriter(self._mock_bucket(source_id), source_id)
+            elif self.kind == "s3":
+                w = self._s3.writer(_safe_id(source_id))
             elif _use_native():
                 w = _native.SnapshotLogWriter(self._source_path(source_id), append=True)
             else:
@@ -282,6 +504,7 @@ class EnginePersistence:
         by_time: dict[int, list] = {}
         offsets: dict = {}
         frontier = -1
+        compacted_to = -1
         try:
             for kind, time, key, blob in reader:
                 if kind == KIND_DATA:
@@ -290,8 +513,11 @@ class EnginePersistence:
                 elif kind == KIND_ADVANCE:
                     frontier = max(frontier, time)
                     offsets = pickle.loads(blob)
+                elif kind == KIND_COMPACT:
+                    compacted_to = max(compacted_to, time)
         finally:
             reader.close()
+        self.compacted_to[source_id] = compacted_to
         batches = sorted((t, ups) for t, ups in by_time.items() if t <= frontier)
         # Compact the log down to exactly the finalized records before any
         # new writes. This (a) drops orphaned DATA past the last ADVANCE —
@@ -302,14 +528,98 @@ class EnginePersistence:
         # The analog of the reference's snapshot compaction
         # (src/persistence/operator_snapshot.rs:491).
         if self.kind == "filesystem":
-            self._rewrite_log(source_id, batches, offsets, frontier)
+            self._rewrite_log(source_id, batches, offsets, frontier, compacted_to)
+        elif self.kind == "s3":
+            self._s3.replace_records(
+                _safe_id(source_id),
+                self._records_for(batches, offsets, frontier, compacted_to),
+            )
         else:
             self._compact_mock(source_id, frontier)
         return batches, offsets, frontier
 
-    def _rewrite_log(self, source_id: str, batches, offsets, frontier: int) -> None:
+    @staticmethod
+    def _records_for(batches, offsets, frontier: int, compacted_to: int = -1):
         import pickle
 
+        if frontier < 0:
+            return []
+        recs = [
+            (KIND_DATA, t, key, pickle.dumps((row, diff), protocol=4))
+            for t, ups in batches
+            for key, row, diff in ups
+        ]
+        recs.append(
+            (KIND_ADVANCE, frontier, 0, pickle.dumps(offsets or {}, protocol=4))
+        )
+        if compacted_to >= 0:
+            recs.append((KIND_COMPACT, compacted_to, 0, b""))
+        return recs
+
+    def compact_source_below(self, source_id: str, t0: int) -> None:
+        """Drop finalized DATA <= t0 — an operator snapshot at t0 covers
+        it — so input logs stay bounded on long-running jobs (the role
+        of the reference's background snapshot compaction,
+        src/persistence/operator_snapshot.rs:491). A KIND_COMPACT marker
+        records the trim so recovery into a changed program (which would
+        need the dropped input) fails loudly instead of silently
+        computing from a partial log."""
+        import pickle
+
+        w = self._writers.pop(source_id, None)
+        if w is not None:
+            try:
+                w.flush()
+            finally:
+                w.close()
+        if self.kind == "mock":
+            bucket = self._mock_bucket(source_id)
+            keep = []
+            for rec in bucket:
+                sid, kind, time = (
+                    (rec[0], rec[1], rec[2]) if len(rec) == 5 else (source_id, rec[0], rec[1])
+                )
+                if sid == source_id and kind == KIND_DATA and time <= t0:
+                    continue
+                keep.append(rec)
+            bucket[:] = keep
+            MemoryLogWriter(bucket, source_id).append(KIND_COMPACT, int(t0), 0, b"")
+            self.compacted_to[source_id] = max(
+                self.compacted_to.get(source_id, -1), int(t0)
+            )
+            return
+        # re-read, filter, rewrite (file/s3)
+        reader = self._open_reader(source_id)
+        if reader is None:
+            return
+        by_time: dict[int, list] = {}
+        offsets: dict = {}
+        frontier = -1
+        try:
+            for kind, time, key, blob in reader:
+                if kind == KIND_DATA and time > t0:
+                    row, diff = pickle.loads(blob)
+                    by_time.setdefault(time, []).append((key, row, diff))
+                elif kind == KIND_ADVANCE:
+                    frontier = max(frontier, time)
+                    offsets = pickle.loads(blob)
+        finally:
+            reader.close()
+        batches = sorted(by_time.items())
+        if self.kind == "s3":
+            self._s3.replace_records(
+                _safe_id(source_id),
+                self._records_for(batches, offsets, frontier, int(t0)),
+            )
+        else:
+            self._rewrite_log(source_id, batches, offsets, frontier, int(t0))
+        self.compacted_to[source_id] = max(
+            self.compacted_to.get(source_id, -1), int(t0)
+        )
+
+    def _rewrite_log(
+        self, source_id: str, batches, offsets, frontier: int, compacted_to: int = -1
+    ) -> None:
         path = self._source_path(source_id)
         if frontier < 0:
             if os.path.exists(path):
@@ -320,10 +630,8 @@ class EnginePersistence:
             w = _native.SnapshotLogWriter(tmp, append=False)
         else:
             w = PyLogWriter(tmp, append=False)
-        for t, ups in batches:
-            for key, row, diff in ups:
-                w.append(KIND_DATA, t, key, pickle.dumps((row, diff), protocol=4))
-        w.append(KIND_ADVANCE, frontier, 0, pickle.dumps(offsets or {}, protocol=4))
+        for rec in self._records_for(batches, offsets, frontier, compacted_to):
+            w.append(*rec)
         w.flush()
         w.close()
         os.replace(tmp, path)
@@ -367,6 +675,12 @@ class EnginePersistence:
             ]
             if record is not None:
                 MemoryLogWriter(bucket, source_id).append(*record)
+            return
+        if self.kind == "s3":
+            self._writers.pop(source_id, None)
+            self._s3.replace_records(
+                _safe_id(source_id), [] if record is None else [record]
+            )
             return
         path = self._source_path(source_id)
         if record is None:
@@ -421,6 +735,10 @@ class EnginePersistence:
             bucket[:] = [r for r in bucket if not (len(r) == 5 and r[0] == source_id)]
             if isinstance(self.events, dict):
                 bucket.clear()
+            return
+        if self.kind == "s3":
+            self._writers.pop(source_id, None)
+            self._s3.drop_source(_safe_id(source_id))
             return
         path = self._source_path(source_id)
         if os.path.exists(path):

@@ -183,17 +183,21 @@ def add_output_sink(
     on_end: Callable | None = None,
     name: str = "output",
     on_build: Callable | None = None,
+    on_time_end: Callable | None = None,
 ) -> None:
-    """Register a sink: write_fn(key, row_dict, time, diff) per change.
-    ``on_build(runner)`` runs at graph-build time on the process that
-    will actually deliver changes — resource acquisition (opening output
-    files, connecting clients) belongs there, NOT at registration time,
-    so worker processes of a multi-process run never touch the sink's
-    target."""
+    """Register a sink: write_fn(key, row_dict, time, diff) per change;
+    ``on_time_end(time)`` fires once per closed epoch (transaction
+    boundaries belong there). ``on_build(runner)`` runs at graph-build
+    time on the process that will actually deliver changes — resource
+    acquisition (opening output files, connecting clients) belongs
+    there, NOT at registration time, so worker processes of a
+    multi-process run never touch the sink's target."""
 
     def build(runner, t):
         if on_build is not None and not getattr(runner, "suppress_callbacks", False):
             on_build(runner)
-        runner.subscribe(t, on_change=write_fn, on_end=on_end)
+        runner.subscribe(
+            t, on_change=write_fn, on_time_end=on_time_end, on_end=on_end
+        )
 
     G.add_output(table, {"build": build, "name": name})

@@ -51,6 +51,14 @@ def read(
                 kt = _key_tuple(key_values)
                 ctx.upsert_keyed(kt, None if op == "delete" else values)
                 continue
+            if op == "upsert" or (op != "delete" and values is None):
+                # mongodb envelopes carry no before-state: without a key
+                # payload there is nothing to correlate an update/delete
+                # with — appending would silently accumulate stale rows
+                raise ValueError(
+                    "debezium mongodb events need a key payload to "
+                    "correlate updates/deletes; this topic has none"
+                )
             if values is None:
                 continue
             content = tuple(str(values.get(n)) for n in schema.column_names())

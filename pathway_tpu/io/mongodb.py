@@ -50,7 +50,9 @@ def write(
 
     def on_change(key, row, time, diff):
         state["batch"].append(fmt.format(row, time, diff))
-        if max_batch_size is None or len(state["batch"]) >= max_batch_size:
+        # default: one insert_many per closed epoch (on_time_end);
+        # max_batch_size bounds a single write within an epoch
+        if max_batch_size is not None and len(state["batch"]) >= max_batch_size:
             flush()
 
     def on_end():
@@ -60,5 +62,10 @@ def write(
             client.close()
 
     add_output_sink(
-        table, on_change, on_end=on_end, name="mongodb.write", on_build=on_build
+        table,
+        on_change,
+        on_end=on_end,
+        name="mongodb.write",
+        on_build=on_build,
+        on_time_end=lambda time: flush(),
     )

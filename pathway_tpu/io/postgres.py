@@ -57,7 +57,14 @@ class _PsqlSink:
         finally:
             cur.close()
         self.pending += 1
-        if self.max_batch_size is None or self.pending >= self.max_batch_size:
+        # default: one transaction per epoch (see on_time_end);
+        # max_batch_size bounds a single transaction within an epoch
+        if self.max_batch_size is not None and self.pending >= self.max_batch_size:
+            self.conn.commit()
+            self.pending = 0
+
+    def on_time_end(self, time: int) -> None:
+        if self.pending:
             self.conn.commit()
             self.pending = 0
 
@@ -76,6 +83,7 @@ def _attach(table: Table, sink: _PsqlSink, name: str) -> None:
         on_end=sink.on_end,
         name=name,
         on_build=sink.on_build,
+        on_time_end=sink.on_time_end,
     )
 
 
@@ -118,5 +126,5 @@ def write_snapshot(
 def read(*args, **kwargs):
     raise NotImplementedError(
         "postgres is a sink in pathway (the reference has no Psql reader); "
-        "ingest change streams via pw.io.debezium.read_from_kafka"
+        "ingest change streams via pw.io.debezium.read"
     )

@@ -92,14 +92,15 @@ class _S3Client:
 
 def _split_path(path: str, settings: AwsS3Settings | None) -> tuple[str, str]:
     """'s3://bucket/prefix' or 'prefix' (bucket from settings)."""
-    if path.startswith("s3://"):
-        rest = path[len("s3://") :]
-        bucket, _, prefix = rest.partition("/")
+    from ..utils.uri import split_s3_path
+
+    bucket, prefix = split_s3_path(path)
+    if bucket is not None:
         return bucket, prefix
     bucket = settings.bucket_name if settings else None
     if not bucket:
         raise ValueError("pass aws_s3_settings with bucket_name or an s3:// path")
-    return bucket, path
+    return bucket, prefix
 
 
 def read(

@@ -253,6 +253,7 @@ class CoordinatorCluster(ShardCluster):
             protocol=4,
         )
         self._persistence.save_operator_snapshot(int(t), blob)
+        self._compact_inputs(int(t))
         self._last_opsnap_wall = _wall.monotonic()
 
     def _cluster_signature(self):

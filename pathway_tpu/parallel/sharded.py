@@ -290,6 +290,7 @@ class ShardCluster:
         self._opsnap_ok = all_persistent
         self._opsnap_time = -1
         self._last_opsnap_wall = 0.0
+        restored_t = None
         if frontier >= 0 and all_persistent:
             rec = p.recover_operator_snapshot(frontier)
             if rec is not None:
@@ -309,6 +310,25 @@ class ShardCluster:
                         ):
                             st_src.pos += 1
                     self._opsnap_time = t0
+                    restored_t = t0
+        # trimmed logs are only recoverable through a compatible snapshot
+        # (see EngineGraph._setup_persistence)
+        max_compacted = max(
+            (
+                p.compacted_to.get(s.persistent_id, -1)
+                for s in primary.session_sources
+                if s.persistent_id is not None
+            ),
+            default=-1,
+        )
+        if max_compacted >= 0 and (restored_t is None or restored_t < max_compacted):
+            raise df.EngineError(
+                "the persisted input logs were snapshot-compacted, but no "
+                "compatible operator snapshot covering the trimmed range "
+                "could be restored (changed program, missing snapshot, or "
+                "non-persistent sources added) — clear the persistence "
+                "root or run the original program"
+            )
 
     def _cluster_signature(self):
         return [
@@ -351,7 +371,16 @@ class ShardCluster:
             protocol=4,
         )
         self._persistence.save_operator_snapshot(int(t), blob)
+        self._compact_inputs(int(t))
         self._last_opsnap_wall = _wall.monotonic()
+
+    def _compact_inputs(self, t: int) -> None:
+        cfg = self.engines[0].persistence_config
+        if not getattr(cfg, "compact_inputs_on_snapshot", False):
+            return
+        for s in self.engines[0].session_sources:
+            if s.persistent_id is not None and not s.is_error_log:
+                self._persistence.compact_source_below(s.persistent_id, t)
 
     def run(self, monitoring_callback: Callable | None = None) -> None:
         primary = self.engines[0]

@@ -14,20 +14,35 @@ from typing import Any
 class Backend:
     """Storage backend for persistence snapshots."""
 
-    def __init__(self, kind: str, path: str | None = None, events: list | None = None):
+    def __init__(
+        self,
+        kind: str,
+        path: str | None = None,
+        events: list | None = None,
+        bucket_settings: Any = None,
+        client: Any = None,
+    ):
         self.kind = kind
         self.path = path
         # keep the caller's (initially empty) store object: mock-backend
         # recovery works by handing the SAME store to a fresh Backend
         self.events = events if events is not None else []
+        self.bucket_settings = bucket_settings
+        # injectable boto3-shaped client (tests use an in-memory fake)
+        self.client = client
 
     @classmethod
     def filesystem(cls, path: str) -> "Backend":
         return cls("filesystem", path=path)
 
     @classmethod
-    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
-        return cls("s3", path=root_path)
+    def s3(
+        cls, root_path: str, bucket_settings: Any = None, *, _client: Any = None
+    ) -> "Backend":
+        """S3-backed persistence (reference backends/s3.rs:34).
+        ``root_path`` is 's3://bucket/prefix' or a bare prefix with the
+        bucket taken from ``bucket_settings`` (pw.io.s3.AwsS3Settings)."""
+        return cls("s3", path=root_path, bucket_settings=bucket_settings, client=_client)
 
     @classmethod
     def azure(cls, root_path: str, account: Any = None, **kw) -> "Backend":
@@ -48,6 +63,11 @@ class Config:
     # record/replay every source, auto-assigning persistent ids by
     # construction order (set by the CLI --record/--replay-mode path)
     auto_persistent_ids: bool = False
+    # trim input logs below each operator snapshot so they stay bounded
+    # on long-running jobs. Trade-off: after a trim, recovery into a
+    # CHANGED program can no longer fall back to full replay (it fails
+    # loudly instead) — hence opt-in.
+    compact_inputs_on_snapshot: bool = False
 
     @classmethod
     def simple_config(
@@ -56,12 +76,14 @@ class Config:
         *,
         snapshot_interval_ms: int = 0,
         persistence_mode: str = "batch",
+        compact_inputs_on_snapshot: bool = False,
         **kwargs,
     ) -> "Config":
         return cls(
             backend=backend,
             snapshot_interval_ms=snapshot_interval_ms,
             persistence_mode=persistence_mode,
+            compact_inputs_on_snapshot=compact_inputs_on_snapshot,
         )
 
     def __post_init__(self):
