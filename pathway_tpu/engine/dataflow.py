@@ -1933,28 +1933,16 @@ class EngineGraph:
                     restored_t = t0
         # trimmed input logs (compact_inputs_on_snapshot) are only
         # recoverable THROUGH a compatible snapshot that covers the
-        # trimmed range — any other path (changed program, mixed
-        # persistence, lost snapshot) would silently replay a partial
-        # log, so fail loudly instead
-        if not self._speedrun:
-            max_compacted = max(
-                (
-                    self.persistence.compacted_to.get(s.persistent_id, -1)
-                    for s in self.session_sources
-                    if s.persistent_id is not None
-                ),
-                default=-1,
-            )
-            if max_compacted >= 0 and (
-                restored_t is None or restored_t < max_compacted
-            ):
-                raise EngineError(
-                    "the persisted input logs were snapshot-compacted, but "
-                    "no compatible operator snapshot covering the trimmed "
-                    "range could be restored (changed program, missing "
-                    "snapshot, or non-persistent sources added) — clear "
-                    "the persistence root or run the original program"
-                )
+        # trimmed range — every other path, INCLUDING speedrun replay
+        # (which never restores snapshots), fails loudly
+        self.persistence.check_compaction_covered(
+            [
+                s.persistent_id
+                for s in self.session_sources
+                if s.persistent_id is not None
+            ],
+            restored_t,
+        )
 
     def _snapshot_operators(self, t) -> None:
         """Write layer-2 state. Called AFTER every ADVANCE of epoch t is
@@ -1976,9 +1964,14 @@ class EngineGraph:
         # logs to keep them bounded on long-running jobs (background
         # compaction role, reference operator_snapshot.rs:491)
         if getattr(self.persistence_config, "compact_inputs_on_snapshot", False):
-            for s in self.session_sources:
-                if s.persistent_id is not None and not s.is_error_log:
-                    self.persistence.compact_source_below(s.persistent_id, int(t))
+            self.persistence.compact_inputs(
+                [
+                    s.persistent_id
+                    for s in self.session_sources
+                    if s.persistent_id is not None and not s.is_error_log
+                ],
+                int(t),
+            )
         self._last_opsnap_wall = _wall.monotonic()
 
     def _maybe_snapshot_operators(self, t) -> None:

@@ -556,6 +556,31 @@ class EnginePersistence:
             recs.append((KIND_COMPACT, compacted_to, 0, b""))
         return recs
 
+    def compact_inputs(self, source_ids, t: int) -> None:
+        """Trim every given source's log below an operator snapshot at
+        ``t`` (engine hook after save_operator_snapshot)."""
+        for sid in source_ids:
+            self.compact_source_below(sid, int(t))
+
+    def check_compaction_covered(self, source_ids, restored_t) -> None:
+        """Fail loudly when any input log was snapshot-compacted and the
+        restored snapshot (restored_t; None = none restored) does not
+        cover the trimmed range — every other path would silently replay
+        a partial log (changed program, lost snapshot, speedrun, mixed
+        persistence)."""
+        max_compacted = max(
+            (self.compacted_to.get(sid, -1) for sid in source_ids),
+            default=-1,
+        )
+        if max_compacted >= 0 and (restored_t is None or restored_t < max_compacted):
+            raise RuntimeError(
+                "the persisted input logs were snapshot-compacted, but no "
+                "compatible operator snapshot covering the trimmed range "
+                "could be restored (changed program, missing snapshot, "
+                "speedrun replay, or non-persistent sources added) — "
+                "clear the persistence root or run the original program"
+            )
+
     def compact_source_below(self, source_id: str, t0: int) -> None:
         """Drop finalized DATA <= t0 — an operator snapshot at t0 covers
         it — so input logs stay bounded on long-running jobs (the role

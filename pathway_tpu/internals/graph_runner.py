@@ -188,6 +188,7 @@ class GraphRunner:
             else []
         )
         self._cluster = None
+        self._iterate_hubs: dict[int, Any] = {}
 
     # ---------- public API ----------
 
@@ -1129,15 +1130,29 @@ class GraphRunner:
         proj.connect(node)
         return Lowered(proj, base_names)
 
-    def _lower_iterate(self, table: Table, op: LogicalOp) -> Lowered:
-        from .iterate import _IterateResultNode
+    def _lower_iterate_output(self, table: Table, op: LogicalOp) -> Lowered:
+        """One returned table of a pw.iterate: the (shared) hub holds
+        every input table's state and runs the fixpoint; a selector
+        untags this output's diffs."""
+        from .iterate import _IterateHubNode, _IterateSelectNode
 
-        base = self.lower(op.inputs[0])
-        node = _IterateResultNode(
-            self.engine, op.params["body"], op.params["n_cols"], op.params["limit"]
-        )
-        node.connect(base.node)
-        return Lowered(node, list(table._columns.keys()))
+        parent = op.params["parent"]
+        hub = self._iterate_hubs.get(id(parent))
+        if hub is None:
+            lows = [self.lower(t) for t in parent.inputs]
+            hub = _IterateHubNode(
+                self.engine,
+                parent.params["body"],
+                parent.params["in_names"],
+                parent.params["out_names"],
+                parent.params["limit"],
+            )
+            for i, low in enumerate(lows):
+                hub.connect(low.node, i)
+            self._iterate_hubs[id(parent)] = hub
+        sel = _IterateSelectNode(self.engine, op.params["index"])
+        sel.connect(hub)
+        return Lowered(sel, list(table._columns.keys()))
 
     # ---------- expression compiler ----------
 

@@ -1,75 +1,119 @@
 """pw.iterate — fixed-point iteration.
 
 Rebuild of the reference's iterate (Graph::iterate src/engine/graph.rs,
-python internals/operator.py IterateOperator). Implementation: per epoch,
-the engine maintains the input table; the body is executed as a batch
-fixpoint (rebuild + rerun a fresh inner graph per iteration) and the
-fixpoint output is diffed against the previous epoch's output. Semantics
-match for deterministic bodies; incremental nested timestamps are not
-needed for totally-ordered times."""
+python internals/operator.py IterateOperator). Implementation: per
+epoch, the engine maintains the input tables' state; the body executes
+as a batch fixpoint (rebuild + rerun a fresh inner graph per iteration)
+and each returned table's fixpoint is diffed against the previous
+epoch's output. Semantics match for deterministic bodies; incremental
+nested timestamps are not needed for totally-ordered times.
+
+Multi-table form (as the reference's louvain uses it): every keyword
+table is visible to ``func``; the tables it RETURNS (dict keys /
+dataclass fields) iterate until they all converge, the rest stay
+constant within the epoch. A single returned Table comes back as a
+Table; multiple come back as a namespace with one Table per name.
+"""
 
 from __future__ import annotations
 
+from types import SimpleNamespace
 from typing import Any, Callable
 
 from ..engine import dataflow as df
 from ..engine.value import rows_equal
-from . import dtype as dt
 from .table import Column, LogicalOp, Table
 from .universe import Universe
 
 
-class _IterateResultNode(df.Node):
-    """Holds the current input state; on each epoch, recompute the batch
-    fixpoint and emit output diffs."""
+class _IterateHubNode(df.Node):
+    """Holds every input table's current state; per epoch, recompute the
+    batch fixpoint and emit per-output diffs tagged with the output
+    index ((key, (idx, row), diff) — unpacked by _IterateSelectNode)."""
 
-    _snap_attrs = ("state", "emitted")
+    _snap_attrs = ("states", "emitted")
 
     def route_owner(self, key, row, port, n_shards):
         # the fixpoint body sees the whole input state: pin to shard 0
         # (per-key sharding would split connected components)
         return 0
 
-    def __init__(self, graph, body: Callable, n_cols: int, limit: int | None):
+    def __init__(
+        self,
+        graph,
+        body: Callable,  # ({name: {key: row}}) -> {out_name: {key: row}}
+        in_names: list[str],
+        out_names: list[str],
+        limit: int | None,
+    ):
+        self.n_inputs = len(in_names)
         super().__init__(graph, "Iterate")
         self.body = body
-        self.state: dict[int, tuple] = {}
-        self.emitted: dict[int, tuple] = {}
+        self.in_names = in_names
+        self.out_names = out_names
         self.limit = limit
+        self.states: dict[str, dict[int, tuple]] = {n: {} for n in in_names}
+        self.emitted: dict[str, dict[int, tuple]] = {n: {} for n in out_names}
 
     def process(self, time):
-        updates = self.take()
-        if not updates:
+        any_updates = False
+        for port, name in enumerate(self.in_names):
+            updates = self.take(port)
+            if not updates:
+                continue
+            any_updates = True
+            st = self.states[name]
+            for key, row, diff in updates:
+                if diff > 0:
+                    st[key] = row
+                else:
+                    st.pop(key, None)
+        if not any_updates:
             return
-        for key, row, diff in updates:
-            if diff > 0:
-                self.state[key] = row
-            else:
-                self.state.pop(key, None)
-        new_out = self._fixpoint(dict(self.state))
+        new_outs = self._fixpoint({n: dict(st) for n, st in self.states.items()})
         out = []
-        for key, row in self.emitted.items():
-            nrow = new_out.get(key)
-            if nrow is None or not rows_equal(row, nrow):
-                out.append((key, row, -1))
-        for key, nrow in new_out.items():
-            orow = self.emitted.get(key)
-            if orow is None or not rows_equal(orow, nrow):
-                out.append((key, nrow, 1))
-        self.emitted = new_out
+        for idx, name in enumerate(self.out_names):
+            new_out = new_outs[name]
+            emitted = self.emitted[name]
+            for key, row in emitted.items():
+                nrow = new_out.get(key)
+                if nrow is None or not rows_equal(row, nrow):
+                    out.append((key, (idx, row), -1))
+            for key, nrow in new_out.items():
+                orow = emitted.get(key)
+                if orow is None or not rows_equal(orow, nrow):
+                    out.append((key, (idx, nrow), 1))
+            self.emitted[name] = new_out
         self.emit(out, time)
 
-    def _fixpoint(self, rows: dict[int, tuple]) -> dict[int, tuple]:
-        current = rows
+    def _fixpoint(self, states: dict[str, dict[int, tuple]]) -> dict[str, dict[int, tuple]]:
+        current = {n: states[n] for n in self.out_names}
         iteration = 0
         while True:
             iteration += 1
-            nxt = self.body(current)
-            if _same_table(current, nxt):
+            nxt = self.body({**states, **current})
+            if all(_same_table(current[n], nxt[n]) for n in self.out_names):
                 return nxt
             current = nxt
             if self.limit is not None and iteration >= self.limit:
                 return current
+
+
+class _IterateSelectNode(df.Node):
+    """Untag one output of the iterate hub."""
+
+    def __init__(self, graph, idx: int):
+        super().__init__(graph, f"IterateOut{idx}")
+        self.idx = idx
+
+    def process(self, time):
+        idx = self.idx
+        out = [
+            (key, tagged[1], diff)
+            for key, tagged, diff in self.take()
+            if tagged[0] == idx
+        ]
+        self.emit(out, time)
 
 
 def _same_table(a: dict[int, tuple], b: dict[int, tuple]) -> bool:
@@ -82,59 +126,106 @@ def _same_table(a: dict[int, tuple], b: dict[int, tuple]) -> bool:
     return True
 
 
+def _result_tables(result: Any) -> dict[str, Table]:
+    """Normalize func's return value to {name: Table}."""
+    if isinstance(result, Table):
+        return {"__single__": result}
+    if isinstance(result, dict):
+        out = {k: v for k, v in result.items() if isinstance(v, Table)}
+        if not out:
+            raise TypeError("pw.iterate body returned no tables")
+        return out
+    fields = {
+        k: v for k, v in vars(result).items() if isinstance(v, Table)
+    }
+    if not fields:
+        raise TypeError(f"pw.iterate body returned {type(result).__name__} with no tables")
+    return fields
+
+
 def iterate(
     func: Callable,
     iteration_limit: int | None = None,
     **kwargs: Table,
 ) -> Any:
-    """pw.iterate(func, **tables): repeatedly apply func until all
-    returned tables stop changing.
+    """pw.iterate(func, **tables): repeatedly apply func until every
+    table it returns stops changing. Tables passed but not returned are
+    constants within the epoch (the reference's louvain passes V/WE
+    this way). All tables the body reads must arrive via ``kwargs``."""
+    if not kwargs:
+        raise ValueError("pw.iterate needs at least one table argument")
+    in_names = list(kwargs.keys())
+    in_tables = [kwargs[n] for n in in_names]
 
-    Round-1 support: exactly one iterated table argument (the common
-    case: connected components, shortest paths, collatz…); func may
-    return a Table or a dataclass/dict with one table."""
-    if len(kwargs) != 1:
-        raise NotImplementedError(
-            "pw.iterate currently supports exactly one iterated table"
+    # probe once on the OUTER tables to learn output names/columns (the
+    # registered logical ops are tree-shaken away)
+    probe_out = _result_tables(func(**kwargs))
+    out_names = list(probe_out.keys())
+    single = out_names == ["__single__"]
+    if single and len(in_names) > 1:
+        # with several tables a bare return is ambiguous (kwargs order
+        # would silently pick the iterated one) — require named returns
+        raise ValueError(
+            "pw.iterate with multiple tables needs the body to return a "
+            "dict (or dataclass) naming the iterated table(s), e.g. "
+            "dict(state=...)"
         )
-    (name, table), = kwargs.items()
+    for n in out_names:
+        if not single and n not in kwargs:
+            raise ValueError(
+                f"pw.iterate body returned table {n!r} that is not among "
+                f"its arguments {in_names}"
+            )
 
-    def body(rows: dict[int, tuple]) -> dict[int, tuple]:
-        # build an inner program: static table from rows, run func, capture
+    def body(states: dict[str, dict[int, tuple]]) -> dict[str, dict[int, tuple]]:
         from .graph_runner import GraphRunner
 
-        records = [(k, r, 0, 1) for k, r in rows.items()]
-        cols = {n: Column(c.dtype) for n, c in table._columns.items()}
-        op = LogicalOp("static", [], {"rows": records})
-        inner_input = Table(cols, Universe(), op, name=f"iterate_{name}")
-        result = func(**{name: inner_input})
-        if isinstance(result, dict):
-            result = next(iter(result.values()))
-        if not isinstance(result, Table):
-            # dataclass-like
-            fields = [v for v in vars(result).values() if isinstance(v, Table)]
-            result = fields[0]
+        inner_tables = {}
+        for name, outer in zip(in_names, in_tables):
+            records = [(k, r, 0, 1) for k, r in states[name].items()]
+            cols = {n: Column(c.dtype) for n, c in outer._columns.items()}
+            op = LogicalOp("static", [], {"rows": records})
+            inner_tables[name] = Table(
+                cols, Universe(), op, name=f"iterate_{name}"
+            )
+        result = _result_tables(func(**inner_tables))
         runner = GraphRunner()
-        cap, names = runner.capture(result)
+        caps = {name: runner.capture(t)[0] for name, t in result.items()}
         runner.run()
-        return dict(cap.state)
+        return {name: dict(cap.state) for name, cap in caps.items()}
 
-    # output columns: func applied to the table determines names; probe once
-    probe_result = func(**{name: table})
-    if isinstance(probe_result, dict):
-        probe_table = next(iter(probe_result.values()))
-    elif isinstance(probe_result, Table):
-        probe_table = probe_result
+    if single:
+        # a bare returned Table iterates the FIRST keyword table
+        raw_body = body
+
+        def hub_body(states):
+            return {in_names[0]: raw_body(states)["__single__"]}
+
+        hub_out_names = [in_names[0]]
+        probe_out = {in_names[0]: probe_out["__single__"]}
     else:
-        probe_table = [v for v in vars(probe_result).values() if isinstance(v, Table)][0]
+        hub_out_names = out_names
+        hub_body = body
 
-    cols = {n: Column(c.dtype) for n, c in probe_table._columns.items()}
     op = LogicalOp(
         "iterate",
-        [table],
-        {"body": body, "limit": iteration_limit, "n_cols": len(cols)},
+        in_tables,
+        {
+            "body": hub_body,
+            "in_names": in_names,
+            "out_names": hub_out_names,
+            "limit": iteration_limit,
+        },
     )
-    return Table(cols, Universe(), op, name="iterate")
+    out_tables: dict[str, Table] = {}
+    for idx, name in enumerate(hub_out_names):
+        probe_table = probe_out[name]
+        cols = {n: Column(c.dtype) for n, c in probe_table._columns.items()}
+        sub = LogicalOp("iterate_output", [], {"parent": op, "index": idx})
+        out_tables[name] = Table(cols, Universe(), sub, name=f"iterate:{name}")
+    if single:
+        return out_tables[in_names[0]]
+    return SimpleNamespace(**out_tables)
 
 
 def iterate_universe(func: Callable, **kwargs) -> Any:

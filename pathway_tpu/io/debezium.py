@@ -51,7 +51,7 @@ def read(
                 kt = _key_tuple(key_values)
                 ctx.upsert_keyed(kt, None if op == "delete" else values)
                 continue
-            if op == "upsert" or (op != "delete" and values is None):
+            if op == "upsert":
                 # mongodb envelopes carry no before-state: without a key
                 # payload there is nothing to correlate an update/delete
                 # with — appending would silently accumulate stale rows
@@ -59,8 +59,6 @@ def read(
                     "debezium mongodb events need a key payload to "
                     "correlate updates/deletes; this topic has none"
                 )
-            if values is None:
-                continue
             content = tuple(str(values.get(n)) for n in schema.column_names())
             if op == "delete":
                 n = multiplicity.get(content, 0)

@@ -25,9 +25,12 @@ def write(
     connection_string: str | None = None,
     database: str | None = None,
     collection: str | None = None,
-    max_batch_size: int | None = None,
+    max_batch_size: int | None = 1000,
     _collection: Any = None,
 ) -> None:
+    """Changes buffer up to ``max_batch_size`` documents (bounding both
+    memory and insert_many size) and always flush at epoch close; pass
+    None to batch whole epochs regardless of size."""
     fmt = BsonFormatter(table.column_names())
     state: dict = {"batch": []}
 

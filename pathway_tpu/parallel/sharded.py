@@ -312,23 +312,14 @@ class ShardCluster:
                     self._opsnap_time = t0
                     restored_t = t0
         # trimmed logs are only recoverable through a compatible snapshot
-        # (see EngineGraph._setup_persistence)
-        max_compacted = max(
-            (
-                p.compacted_to.get(s.persistent_id, -1)
+        p.check_compaction_covered(
+            [
+                s.persistent_id
                 for s in primary.session_sources
                 if s.persistent_id is not None
-            ),
-            default=-1,
+            ],
+            restored_t,
         )
-        if max_compacted >= 0 and (restored_t is None or restored_t < max_compacted):
-            raise df.EngineError(
-                "the persisted input logs were snapshot-compacted, but no "
-                "compatible operator snapshot covering the trimmed range "
-                "could be restored (changed program, missing snapshot, or "
-                "non-persistent sources added) — clear the persistence "
-                "root or run the original program"
-            )
 
     def _cluster_signature(self):
         return [
@@ -378,9 +369,14 @@ class ShardCluster:
         cfg = self.engines[0].persistence_config
         if not getattr(cfg, "compact_inputs_on_snapshot", False):
             return
-        for s in self.engines[0].session_sources:
-            if s.persistent_id is not None and not s.is_error_log:
-                self._persistence.compact_source_below(s.persistent_id, t)
+        self._persistence.compact_inputs(
+            [
+                s.persistent_id
+                for s in self.engines[0].session_sources
+                if s.persistent_id is not None and not s.is_error_log
+            ],
+            t,
+        )
 
     def run(self, monitoring_callback: Callable | None = None) -> None:
         primary = self.engines[0]

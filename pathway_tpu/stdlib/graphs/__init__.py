@@ -16,6 +16,19 @@ class Graph:
     E: Table
 
 
+@dataclass
+class WeightedGraph(Graph):
+    """Weighted (multi)graph: WE has columns (u, v, weight), directed-
+    doubled for undirected graphs (reference stdlib/graphs/graph.py
+    WeightedGraph :121)."""
+
+    WE: Table
+
+    @staticmethod
+    def from_vertices_and_weighted_edges(V: Table, WE: Table) -> "WeightedGraph":
+        return WeightedGraph(V=V, E=WE, WE=WE)
+
+
 def pagerank(edges: Table, steps: int = 5, damping: float = 0.85) -> Table:
     """PageRank over an edge table with columns (u, v): returns table
     keyed by vertex with column `rank` (scaled int, like the reference
@@ -86,5 +99,14 @@ def bellman_ford(vertices: Table, edges: Table, iteration_limit: int = 50) -> Ta
 
 
 from . import louvain_communities
+from .louvain_communities import exact_modularity, louvain_level
 
-__all__ = ["Graph", "bellman_ford", "pagerank", "louvain_communities"]
+__all__ = [
+    "Graph",
+    "WeightedGraph",
+    "bellman_ford",
+    "exact_modularity",
+    "louvain_communities",
+    "louvain_level",
+    "pagerank",
+]
