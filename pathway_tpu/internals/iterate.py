@@ -176,6 +176,18 @@ def iterate(
                 f"pw.iterate body returned table {n!r} that is not among "
                 f"its arguments {in_names}"
             )
+        in_name = in_names[0] if single else n
+        got = sorted(probe_out[n]._columns.keys())
+        want = sorted(kwargs[in_name]._columns.keys())
+        if got != want:
+            raise ValueError(
+                f"pw.iterate body returned table {in_name!r} with columns "
+                f"{got}, but the iterated input has {want} — the returned "
+                f"table feeds back as next iteration's input, so column "
+                f"names must match"
+            )
+
+    input_col_names = {n: list(t._columns.keys()) for n, t in kwargs.items()}
 
     def body(states: dict[str, dict[int, tuple]]) -> dict[str, dict[int, tuple]]:
         from .graph_runner import GraphRunner
@@ -190,9 +202,28 @@ def iterate(
             )
         result = _result_tables(func(**inner_tables))
         runner = GraphRunner()
-        caps = {name: runner.capture(t)[0] for name, t in result.items()}
+        caps = {name: runner.capture(t) for name, t in result.items()}
         runner.run()
-        return {name: dict(cap.state) for name, cap in caps.items()}
+        out: dict[str, dict[int, tuple]] = {}
+        for name, (cap, out_cols) in caps.items():
+            # rows feed back as the NEXT iteration's input: reorder them
+            # from the body-output column order into the input table's
+            # order (else a reordering select would silently swap values)
+            want = input_col_names.get(name if name != "__single__" else in_names[0])
+            if want is not None and out_cols != want:
+                if sorted(out_cols) != sorted(want):
+                    raise ValueError(
+                        f"pw.iterate body returned table {name!r} with "
+                        f"columns {out_cols}, but the iterated input has "
+                        f"{want} — names must match"
+                    )
+                idx = [out_cols.index(n) for n in want]
+                out[name] = {
+                    k: tuple(r[i] for i in idx) for k, r in cap.state.items()
+                }
+            else:
+                out[name] = dict(cap.state)
+        return out
 
     if single:
         # a bare returned Table iterates the FIRST keyword table
@@ -219,8 +250,13 @@ def iterate(
     )
     out_tables: dict[str, Table] = {}
     for idx, name in enumerate(hub_out_names):
-        probe_table = probe_out[name]
-        cols = {n: Column(c.dtype) for n, c in probe_table._columns.items()}
+        probe_table = probe_out[name]  # single case was re-keyed above
+        # rows circulate in the INPUT table's column order (see body's
+        # reorder), so the output table declares that order too
+        cols = {
+            n: Column(probe_table._columns[n].dtype)
+            for n in input_col_names[name]
+        }
         sub = LogicalOp("iterate_output", [], {"parent": op, "index": idx})
         out_tables[name] = Table(cols, Universe(), sub, name=f"iterate:{name}")
     if single:

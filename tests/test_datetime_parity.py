@@ -1,0 +1,203 @@
+"""Datetime/duration parity with the reference engine.
+
+Expected values below are the reference's OWN doctest outputs
+(/root/reference/python/pathway/internals/expressions/date_time.py:
+timestamp :384, add_duration_in_timezone :840, strptime :555) and the
+chrono semantics of src/engine/time.rs:16-100 (duration_round /
+duration_trunc, fixed-width fractions).
+"""
+
+from __future__ import annotations
+
+import datetime as dtm
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+class SS(pw.Schema):
+    s: str
+
+
+def test_strptime_nanoseconds_and_timestamp():
+    """Reference timestamp doctest (date_time.py:384): nanosecond
+    strings parse (sub-us truncated) and timestamp units match."""
+    rows = [
+        "1969-01-01T00:00:00.000000000",
+        "1970-01-01T00:00:00.000000000",
+        "2023-01-01T00:00:00.000000000",
+        "2023-03-25T13:45:26.000000000",
+    ]
+    t = pw.debug.table_from_rows(schema=SS, rows=[(r,) for r in rows])
+    parsed = t.select(
+        orig=pw.this.s,
+        ns=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S.%f").dt.timestamp(unit="ns"),
+        s=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S.%f").dt.timestamp(unit="s"),
+    )
+    runner = GraphRunner()
+    cap, names = runner.capture(parsed)
+    runner.run()
+    pw.clear_graph()
+    got = {
+        row[names.index("orig")]: (row[names.index("ns")], row[names.index("s")])
+        for row in cap.state.values()
+    }
+    # reference doctest outputs
+    assert got["1969-01-01T00:00:00.000000000"] == (-3.1536e16, -31536000.0)
+    assert got["1970-01-01T00:00:00.000000000"] == (0.0, 0.0)
+    assert got["2023-01-01T00:00:00.000000000"] == (1.6725312e18, 1672531200.0)
+    assert got["2023-03-25T13:45:26.000000000"] == (1.679751926e18, 1679751926.0)
+
+
+def test_strptime_timezone_aware_timestamp():
+    rows = [
+        ("1970-01-01T00:00:00.000000000+02:00", -7200.0),
+        ("1970-01-01T00:00:00.000000000-03:00", 10800.0),
+        ("2023-01-01T00:00:00.000000000+01:00", 1672527600.0),
+    ]
+    t = pw.debug.table_from_rows(schema=SS, rows=[(r,) for r, _ in rows])
+    parsed = t.select(
+        orig=pw.this.s,
+        ts=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S.%f%z").dt.timestamp(unit="s"),
+    )
+    runner = GraphRunner()
+    cap, names = runner.capture(parsed)
+    runner.run()
+    pw.clear_graph()
+    got = {
+        row[names.index("orig")]: row[names.index("ts")]
+        for row in cap.state.values()
+    }
+    for s, expect in rows:
+        assert got[s] == expect
+
+
+def test_add_duration_in_timezone_dst():
+    """Reference doctest (date_time.py:840): +2h across Europe/Warsaw
+    DST transitions."""
+    cases = {
+        "2023-03-26T01:23:00": "2023-03-26 04:23:00",  # spring forward
+        "2023-03-27T01:23:00": "2023-03-27 03:23:00",
+        "2023-10-29T01:23:00": "2023-10-29 02:23:00",  # fall back
+        "2023-10-30T01:23:00": "2023-10-30 03:23:00",
+    }
+    t = pw.debug.table_from_rows(schema=SS, rows=[(r,) for r in cases])
+    out = t.select(
+        orig=pw.this.s,
+        new=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S")
+        .dt.add_duration_in_timezone(
+            dtm.timedelta(hours=2), timezone="Europe/Warsaw"
+        )
+        .dt.strftime("%Y-%m-%d %H:%M:%S"),
+    )
+    runner = GraphRunner()
+    cap, names = runner.capture(out)
+    runner.run()
+    pw.clear_graph()
+    got = {
+        row[names.index("orig")]: row[names.index("new")]
+        for row in cap.state.values()
+    }
+    assert got == cases
+
+
+def test_subtract_date_time_in_timezone_dst():
+    t = pw.debug.table_from_rows(schema=SS, rows=[("2023-03-26T03:30:00",)])
+    other = dtm.datetime(2023, 3, 26, 1, 30, 0)
+    out = t.select(
+        d=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S").dt.subtract_date_time_in_timezone(
+            other, timezone="Europe/Warsaw"
+        )
+    )
+    runner = GraphRunner()
+    cap, names = runner.capture(out)
+    runner.run()
+    pw.clear_graph()
+    (row,) = cap.state.values()
+    # wall-clock difference is 2h, but the 02:00->03:00 hour doesn't
+    # exist: the real elapsed time is 1h
+    assert row[names.index("d")] == dtm.timedelta(hours=1)
+
+
+def test_strftime_fixed_width_fractions():
+    t = pw.debug.table_from_rows(schema=SS, rows=[("2023-03-25T13:45:26.987654",)])
+    parsed = pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S.%f")
+    out = t.select(
+        ms=parsed.dt.strftime("%S.%3f"),
+        us=parsed.dt.strftime("%S.%6f"),
+        ns=parsed.dt.strftime("%S.%9f"),
+        iso=parsed.dt.strftime("%FT%T"),
+    )
+    runner = GraphRunner()
+    cap, names = runner.capture(out)
+    runner.run()
+    pw.clear_graph()
+    (row,) = cap.state.values()
+    assert row[names.index("ms")] == "26.987"
+    assert row[names.index("us")] == "26.987654"
+    assert row[names.index("ns")] == "26.987654000"
+    assert row[names.index("iso")] == "2023-03-25T13:45:26"
+
+
+def test_round_floor_chrono_semantics():
+    """duration_round rounds half away-from-zero upward; duration_trunc
+    floors (time.rs:86-100)."""
+    rows = [
+        ("2023-01-01T10:14:59", "10:00:00", "10:00:00"),
+        ("2023-01-01T10:15:00", "10:30:00", "10:00:00"),  # tie rounds up
+        ("2023-01-01T10:44:59", "10:30:00", "10:30:00"),
+        ("2023-01-01T10:45:01", "11:00:00", "10:30:00"),
+    ]
+    t = pw.debug.table_from_rows(schema=SS, rows=[(r,) for r, _a, _b in rows])
+    half_hour = dtm.timedelta(minutes=30)
+    parsed = pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S")
+    out = t.select(
+        orig=pw.this.s,
+        r=parsed.dt.round(half_hour).dt.strftime("%H:%M:%S"),
+        f=parsed.dt.floor(half_hour).dt.strftime("%H:%M:%S"),
+    )
+    runner = GraphRunner()
+    cap, names = runner.capture(out)
+    runner.run()
+    pw.clear_graph()
+    got = {
+        row[names.index("orig")]: (row[names.index("r")], row[names.index("f")])
+        for row in cap.state.values()
+    }
+    for s, r, f in rows:
+        assert got[s] == (r, f), s
+
+
+def test_duration_accessors_and_tz_roundtrip():
+    t = pw.debug.table_from_rows(schema=SS, rows=[("2023-06-15T12:00:00",)])
+    naive = pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S")
+    utc = naive.dt.to_utc("America/New_York")
+    back = utc.dt.to_naive_in_timezone("America/New_York")
+    out = t.select(
+        back=back.dt.strftime("%Y-%m-%dT%H:%M:%S"),
+        hour_utc=utc.dt.hour(),
+        weekday=naive.dt.weekday(),
+    )
+    runner = GraphRunner()
+    cap, names = runner.capture(out)
+    runner.run()
+    pw.clear_graph()
+    (row,) = cap.state.values()
+    assert row[names.index("back")] == "2023-06-15T12:00:00"
+    assert row[names.index("hour_utc")] == 16  # EDT = UTC-4
+    assert row[names.index("weekday")] == 3  # Thursday
+
+
+def test_timestamp_unit_none_deprecated_int_ns():
+    t = pw.debug.table_from_rows(schema=SS, rows=[("2023-01-01T00:00:00",)])
+    with pytest.warns(DeprecationWarning):
+        expr = pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S").dt.timestamp()
+    out = t.select(ts=expr)
+    runner = GraphRunner()
+    cap, names = runner.capture(out)
+    runner.run()
+    pw.clear_graph()
+    (row,) = cap.state.values()
+    assert row[0] == 1672531200000000000 and isinstance(row[0], int)
