@@ -57,12 +57,22 @@ def _topk_fn(metric: str) -> Callable:
 
 
 def _pallas_eligible(metric: str, k: int, mesh) -> bool:
-    """Use the fused pallas kernel on a real TPU for small k (its
-    merge is k max-extraction passes) and an unsharded index; the
-    sharded path rides the jit collectives instead."""
+    """Use the fused pallas kernel on a real TPU, unsharded or sharded
+    (shard-local kernel + cross-device candidate merge). The kernel
+    supports k <= 256, but its extraction merge is O(k) passes and the
+    unfused lax.top_k wins past k=64 (measured at 1M docs on v5e), so
+    the index switches there."""
+    import os
+
     import jax
 
-    return jax.default_backend() == "tpu" and k <= 64 and mesh is None
+    force = os.environ.get("PATHWAY_TPU_FORCE_PALLAS", "")  # interpret tests
+    backend_ok = jax.default_backend() == "tpu" or force.lower() in (
+        "1",
+        "true",
+        "yes",
+    )
+    return backend_ok and k <= 64
 
 
 _BIAS_JIT: dict = {}
@@ -90,15 +100,25 @@ def _pallas_bias(metric: str, matrix, valid):
     return _BIAS_JIT["fn"](matrix, valid, metric == "l2")
 
 
-def _pallas_topk(metric: str, matrix, valid, queries, k: int, bias=None):
+def _pallas_topk(metric: str, matrix, valid, queries, k: int, bias=None, mesh=None):
     import jax.numpy as jnp
 
-    from .pallas_knn import NEG as _PNEG, knn_topk
+    from .pallas_knn import NEG as _PNEG, knn_topk, knn_topk_sharded
 
     if bias is None:
         bias = _pallas_bias(metric, matrix, valid)
     factor = 2.0 if metric == "l2" else 1.0
-    vals, idx = knn_topk(queries, matrix, k=k, bias=bias, factor=factor)
+    if mesh is not None:
+        vals, idx = knn_topk_sharded(
+            jnp.asarray(queries, jnp.float32),
+            matrix,
+            bias,
+            k=k,
+            mesh=mesh,
+            factor=factor,
+        )
+    else:
+        vals, idx = knn_topk(queries, matrix, k=k, bias=bias, factor=factor)
     if metric == "l2":
         qq = jnp.sum(jnp.asarray(queries) ** 2, axis=1, keepdims=True)
         vals = jnp.where(vals > _PNEG / 2, vals - qq, vals)
@@ -213,6 +233,7 @@ class DeviceKnnIndex:
             self._dev_matrix = jax.device_put(mat)
             self._dev_valid = jax.device_put(val)
         # bias for the fused pallas path, computed once per upload
+        # (sharded matrices keep it row-sharded alongside the matrix)
         self._dev_bias = (
             _pallas_bias(self.metric, self._dev_matrix, self._dev_valid)
             if _pallas_eligible(self.metric, 8, self.mesh)
@@ -255,6 +276,7 @@ class DeviceKnnIndex:
                     q[todo],
                     fetch,
                     bias=self._dev_bias,
+                    mesh=self.mesh,
                 )
             else:
                 scores, idx = fn(self._dev_matrix, self._dev_valid, q[todo], fetch)

@@ -31,21 +31,49 @@ NEG = -3.0e38  # sentinel below any real score
 
 def _merge_topk(cand_scores, cand_idx, k: int):
     """Top-k of candidates [TQ, C] via k max-extractions (VPU-friendly:
-    no sort). Returns ([TQ, k], [TQ, k])."""
+    no sort, no dynamic gathers). Returns ([TQ, k], [TQ, k]).
+
+    k <= 64 unrolls at trace time; larger k runs the extraction as a
+    fori_loop whose [TQ, k] carry is written via one-hot iota selects
+    (dynamic_update_slice has no Mosaic lowering) to keep compile time
+    flat."""
     tq, c = cand_scores.shape
-    out_s = []
-    out_i = []
-    s = cand_scores
     iota = jax.lax.broadcasted_iota(jnp.int32, (tq, c), 1)
-    for _ in range(k):
+    if k <= 64:
+        out_s = []
+        out_i = []
+        s = cand_scores
+        for _ in range(k):
+            best = jnp.max(s, axis=1)
+            arg = jnp.argmax(s, axis=1)
+            hit = iota == arg[:, None]
+            out_s.append(best)
+            out_i.append(jnp.max(jnp.where(hit, cand_idx, -1), axis=1))
+            s = jnp.where(hit, NEG, s)
+        return jnp.stack(out_s, axis=1), jnp.stack(out_i, axis=1)
+
+    # one-hot select instead of dynamic_update_slice (which has no
+    # Mosaic lowering): position t of the output is claimed by the
+    # t-th extraction via an iota mask — pure elementwise ops
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (tq, k), 1)
+
+    def body(t, carry):
+        s, out_s, out_i = carry
         best = jnp.max(s, axis=1)
         arg = jnp.argmax(s, axis=1)
         hit = iota == arg[:, None]
-        out_s.append(best)
-        # gather-free select (dynamic gathers do not lower in Mosaic)
-        out_i.append(jnp.max(jnp.where(hit, cand_idx, -1), axis=1))
-        s = jnp.where(hit, NEG, s)
-    return jnp.stack(out_s, axis=1), jnp.stack(out_i, axis=1)
+        picked = jnp.max(jnp.where(hit, cand_idx, -1), axis=1)
+        sel = iota_k == t
+        out_s = jnp.where(sel, best[:, None], out_s)
+        out_i = jnp.where(sel, picked[:, None], out_i)
+        return jnp.where(hit, NEG, s), out_s, out_i
+
+    out_s0 = jnp.full((tq, k), NEG, cand_scores.dtype)
+    out_i0 = jnp.full((tq, k), -1, jnp.int32)
+    _, out_s, out_i = jax.lax.fori_loop(
+        0, k, body, (cand_scores, out_s0, out_i0)
+    )
+    return out_s, out_i
 
 
 def _kernel(
@@ -97,6 +125,13 @@ def knn_topk(
     multiples; padded docs never surface."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    # the extraction merge keeps [block_q, block_n + k] candidate copies
+    # live in VMEM — shrink the query tile as k grows to stay inside
+    # the ~16MB scoped budget
+    if k > 64:
+        block_q = min(block_q, 32)
+    elif k > 16:
+        block_q = min(block_q, 64)
     q, d = jnp.asarray(queries, jnp.float32), jnp.asarray(docs, jnp.float32)
     Q, D = q.shape
     N = d.shape[0]
@@ -134,3 +169,62 @@ def knn_topk(
         interpret=interpret,
     )(q, d, bias)
     return vals[:Q], idx[:Q]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "mesh", "factor", "block_q", "block_n", "interpret"),
+)
+def knn_topk_sharded(
+    queries,
+    docs,
+    bias,
+    *,
+    k: int,
+    mesh,
+    factor: float = 1.0,
+    block_q: int = 128,
+    block_n: int = 2048,
+    interpret: bool | None = None,
+):
+    """Sharded fused top-k: ``docs``/``bias`` are row-sharded over the
+    mesh's "data" axis; each device runs the VMEM kernel on its shard,
+    then the per-shard top-k candidates (k per device) concatenate over
+    ICI and one tiny lax.top_k picks the global winners — the
+    cross-device merge of the reference's sharded index story
+    (usearch_integration.rs:53 redesigned for the mesh). Queries are
+    replicated. Returns global ([Q, k], [Q, k])."""
+    from jax import shard_map  # jax >= 0.8 (the pinned runtime)
+    from jax.sharding import PartitionSpec as P
+
+    n_shards = mesh.shape["data"]
+    shard_len = docs.shape[0] // n_shards
+    assert docs.shape[0] % n_shards == 0, "docs must pad to the mesh"
+
+    def local(q, d, b):
+        vals, idx = knn_topk(
+            q,
+            d,
+            k=k,
+            bias=b,
+            factor=factor,
+            block_q=block_q,
+            block_n=block_n,
+            interpret=interpret,
+        )
+        base = jax.lax.axis_index("data").astype(jnp.int32) * shard_len
+        # dead candidates (idx -1) must keep a non-doc index after the
+        # base shift so they can never collide with a real document
+        return vals, jnp.where(idx >= 0, idx + base, -1)
+
+    # check_vma off: pallas_call's out_shape carries no vma annotation
+    vals, idx = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, None), P("data", None), P("data")),
+        out_specs=(P(None, "data"), P(None, "data")),
+        check_vma=False,
+    )(queries, docs, bias)
+    # [Q, n_shards*k] candidates -> global top-k (tiny)
+    best, pos = jax.lax.top_k(vals, k)
+    return best, jnp.take_along_axis(idx, pos, axis=1)
