@@ -191,6 +191,35 @@ class DeviceKnnIndex:
             self._meta[key] = metadata
         self._dirty = True
 
+    def add_batch(self, keys, vectors, metadatas=None) -> None:
+        """Bulk insert: one vectorized staging write for a whole batch
+        (the streaming ingest path batches thousands of adds per epoch;
+        per-row python calls would dominate at index scale)."""
+        vecs = np.asarray(vectors, np.float32)
+        if vecs.ndim != 2 or vecs.shape[1] != self.dim:
+            raise ValueError(f"expected [n, {self.dim}] vectors, got {vecs.shape}")
+        n = len(keys)
+        if n != len(vecs):
+            raise ValueError("keys/vectors length mismatch")
+        for key in keys:
+            if key in self._slot_of:
+                self.remove(key)
+        while len(self._free) < n:
+            self._grow()
+        slots = [self._free.pop() for _ in range(n)]
+        if self.metric == "cos":
+            norms = np.linalg.norm(vecs, axis=1, keepdims=True)
+            vecs = vecs / np.maximum(norms, 1e-12)
+        sl = np.asarray(slots)
+        self._host[sl] = vecs
+        self._valid_host[sl] = True
+        for i, (slot, key) in enumerate(zip(slots, keys)):
+            self._keys[slot] = key
+            self._slot_of[key] = slot
+            if metadatas is not None and metadatas[i] is not None:
+                self._meta[key] = metadatas[i]
+        self._dirty = True
+
     def remove(self, key) -> None:
         slot = self._slot_of.pop(key, None)
         if slot is None:
