@@ -141,7 +141,7 @@ def suite_knn_10k() -> None:
     rng = np.random.default_rng(0)
     idx = DeviceKnnIndex(dim=384, metric="cos", reserved_space=10_000)
     vecs = rng.normal(size=(10_000, 384)).astype(np.float32)
-    idx.add_batch(list(range(10_000)), vecs)
+    idx.add_batch_arrays(list(range(10_000)), vecs)
     q = rng.normal(size=(100, 384)).astype(np.float32)
     idx.search_batch(q, 10)  # sync + compile
     t0 = time.perf_counter()
@@ -182,7 +182,7 @@ def suite_vector_store_ingest() -> None:
     idx = DeviceKnnIndex(dim=emb.get_embedding_dimension(), metric="cos", reserved_space=n)
     t0 = time.perf_counter()
     vecs = np.asarray(emb.encode_device(texts))
-    idx.add_batch(list(range(n)), vecs)
+    idx.add_batch_arrays(list(range(n)), vecs)
     idx.search_batch(np.asarray(vecs[:1]), 1)  # force device sync
     dt = time.perf_counter() - t0
     _emit(
@@ -212,7 +212,7 @@ def suite_adaptive_rag_p50() -> None:
     ]
     vecs = np.asarray(emb.encode_device(docs))
     idx = DeviceKnnIndex(dim=vecs.shape[1], metric="cos", reserved_space=n)
-    idx.add_batch(list(range(n)), vecs)
+    idx.add_batch_arrays(list(range(n)), vecs)
     queries = [f"how does recovery variant {i} work" for i in range(20)]
 
     def one_query(qtext):
@@ -365,7 +365,7 @@ def suite_knn_churn(n_docs: int = 250_000) -> None:
     block = 50_000
     for lo in range(0, n_docs, block):
         vecs = rng.normal(size=(min(block, n_docs - lo), dim)).astype(np.float32)
-        idx.add_batch(list(range(lo, lo + len(vecs))), vecs)
+        idx.add_batch_arrays(list(range(lo, lo + len(vecs))), vecs)
     q = rng.normal(size=(1, dim)).astype(np.float32)
     idx.search_batch(q, 16)  # sync + compile
     lat = []
@@ -375,7 +375,7 @@ def suite_knn_churn(n_docs: int = 250_000) -> None:
         for j in range(base, base + 1000):
             idx.remove(j)
         vecs = rng.normal(size=(1000, dim)).astype(np.float32)
-        idx.add_batch(list(range(base, base + 1000)), vecs)
+        idx.add_batch_arrays(list(range(base, base + 1000)), vecs)
         t0 = time.perf_counter()
         idx.search_batch(q, 16)
         lat.append((time.perf_counter() - t0) * 1e3)

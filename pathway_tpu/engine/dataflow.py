@@ -1503,6 +1503,21 @@ class ExternalIndexNode(Node):
         payloads = [p for _, p, _ in adds]
         if self.data_embed is not None:
             payloads = self.data_embed(payloads)
+        import sys
+
+        _jax = sys.modules.get("jax")  # never import jax for pure-ETL graphs
+        if (
+            _jax is not None
+            and isinstance(payloads, _jax.Array)
+            and hasattr(self.index, "add_batch_device")
+        ):
+            # device-resident ingest: the embedder's jit output stays in
+            # HBM and is scattered straight into the index matrix — no
+            # device->host->device bounce between encode and index-add
+            self.index.add_batch_device(
+                [k for k, _, _ in adds], payloads, [m for _, _, m in adds]
+            )
+            return
         items = [
             (key, payload, metadata)
             for (key, _, metadata), payload in zip(adds, payloads)

@@ -81,6 +81,18 @@ class AbstractKnn(InnerIndex):
             vecs = embed(texts)
             return [np.asarray(v, np.float32) for v in vecs]
 
+        if hasattr(self.embedder, "encode_device"):
+            # ingest path stays in HBM: the encoder's jit output feeds
+            # the index scatter directly (engine _index_add routes jax
+            # arrays to add_batch_device)
+            enc = self.embedder
+
+            def data_embed(payloads):
+                texts = [p if isinstance(p, str) else str(p) for p in payloads]
+                return enc.encode_device(texts)
+
+            return data_embed, batch_embed
+
         return batch_embed, batch_embed
 
 

@@ -231,7 +231,6 @@ class VectorStoreServer:
             text=this.data["text"].as_str()
         )
 
-        batch_embed = self._batch_embed
         if self.index_factory is not None:
             factory = self.index_factory
             knn_index = factory.build_index(
@@ -240,12 +239,17 @@ class VectorStoreServer:
                 metadata_column=chunked_docs.data["metadata"],
             )
         else:
+            # hand the index the original embedder object (not the
+            # batch-callable adapter) so the factory can detect
+            # encode_device and keep ingest embeddings in HBM
             knn_index = default_usearch_knn_document_index(
                 chunked_docs.text,
                 chunked_docs,
                 dimensions=self.embedding_dimension,
                 metadata_column=chunked_docs.data["metadata"],
-                embedder=batch_embed,
+                embedder=self.embedder
+                if hasattr(self.embedder, "encode_device")
+                else self._batch_embed,
             )
 
         parsed_docs_stats = parsed_docs + parsed_docs.select(
