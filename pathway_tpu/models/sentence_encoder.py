@@ -162,32 +162,54 @@ class SentenceEncoder:
             out[group] = np.asarray(emb)[:ng]
         return out
 
-    def encode_device(self, texts: Sequence[str]):
+    def encode_device(self, texts: Sequence[str], pad_to: int | None = None):
         """texts -> embeddings as a DEVICE-resident [n, dim] jax array
         in input order. The streaming pipeline's TPU-native hot path:
         embeddings feed the on-device KNN index directly, so they never
         round-trip through host memory (on tunneled/remote devices the
         host link would dominate end-to-end rate). Token ids ship as
         int16 and masks are built on device from lengths — halves the
-        host->device bytes on the ingest path."""
+        host->device bytes on the ingest path.
+
+        ``pad_to``: pad the output to [pad_to, dim] (extra rows zero)
+        AND keep every intermediate shape at its bucket size, so
+        varying batch sizes hit a bounded set of compiled programs —
+        streaming epochs have arbitrary sizes and must not recompile
+        the ingest chain per size."""
         import jax.numpy as jnp
 
         if not len(texts):
-            return jnp.zeros((0, self.dim), jnp.float32)
+            return jnp.zeros((pad_to or 0, self.dim), jnp.float32)
         texts = ["" if t is None else str(t) for t in texts]
         m = self.tokenizer.batch_encode_matrix(texts, self.max_seq_len)
         if m is None:
-            return jnp.asarray(self.encode(texts))
+            embs = jnp.asarray(self.encode(texts))
+            if pad_to and pad_to > embs.shape[0]:
+                embs = jnp.concatenate(
+                    [embs, jnp.zeros((pad_to - embs.shape[0], self.dim), jnp.float32)]
+                )
+            return embs
         ids_mat, lens = m
+        n_out = pad_to or len(lens)
         packed = self._pack_uniform(ids_mat, lens)
         if packed is None:
             pending = self._matrix_groups(ids_mat, lens)
-            embs = jnp.concatenate([emb[:ng] for _, ng, emb in pending], axis=0)
-            order = np.concatenate([group for group, _, _ in pending])
+            if pad_to:
+                # keep full bucket-shaped group outputs; rows past each
+                # group's real count scatter out of bounds and drop
+                embs = jnp.concatenate([emb for _, _, emb in pending], axis=0)
+                order = np.full((int(embs.shape[0]),), n_out, np.int64)
+                off = 0
+                for group, ng, emb in pending:
+                    order[off : off + ng] = group
+                    off += int(emb.shape[0])
+            else:
+                embs = jnp.concatenate([emb[:ng] for _, ng, emb in pending], axis=0)
+                order = np.concatenate([group for group, _, _ in pending])
         else:
             order, embs = packed
-        out = jnp.zeros((len(lens), self.dim), jnp.float32)
-        return out.at[jnp.asarray(order)].set(embs.astype(jnp.float32))
+        out = jnp.zeros((n_out, self.dim), jnp.float32)
+        return out.at[jnp.asarray(order)].set(embs.astype(jnp.float32), mode="drop")
 
     def _pack_uniform(self, ids_mat: np.ndarray, lens: np.ndarray):
         """Single-dispatch path when every bucket group shares one

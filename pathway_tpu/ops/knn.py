@@ -330,13 +330,19 @@ class DeviceKnnIndex:
         array, e.g. the encoder's jit output). One fused scatter
         dispatch; the vectors never visit the host. Host mirror rows go
         stale and are re-fetched only if a full re-upload is ever
-        needed (``_upload_full``)."""
+        needed (``_upload_full``).
+
+        ``dev_vectors`` may have MORE rows than ``keys`` — producers
+        pad batches to bucket sizes (encode_device ``pad_to``) so that
+        streaming epochs of arbitrary size reuse a bounded set of
+        compiled scatter programs; the pad rows scatter out of bounds
+        and drop."""
         n = len(keys)
         if n == 0:
             return
         if self._full or self._dev_matrix is None:
             # cold start: no resident matrix to scatter into yet
-            self.add_batch_arrays(keys, np.asarray(dev_vectors), metadatas)
+            self.add_batch_arrays(keys, np.asarray(dev_vectors)[:n], metadatas)
             return
         for key in keys:
             if key in self._slot_of:
@@ -344,10 +350,13 @@ class DeviceKnnIndex:
         while len(self._free) < n:
             self._grow()
         if self._full:  # mesh growth falls back to a host re-upload
-            self.add_batch_arrays(keys, np.asarray(dev_vectors), metadatas)
+            self.add_batch_arrays(keys, np.asarray(dev_vectors)[:n], metadatas)
             return
         self._flush_pending()
-        slots = np.asarray([self._free.pop() for _ in range(n)], np.int32)
+        nv = int(dev_vectors.shape[0])
+        n_rows = self._dev_matrix.shape[0]
+        slots = np.full((nv,), n_rows, np.int32)  # pad rows drop
+        slots[:n] = [self._free.pop() for _ in range(n)]
         self._dev_matrix, self._dev_valid, self._dev_bias = _scatter_dev_fn()(
             self._dev_matrix,
             self._dev_valid,
@@ -357,9 +366,10 @@ class DeviceKnnIndex:
             l2=self.metric == "l2",
             normalize=self.metric == "cos",
         )
-        self._valid_host[slots] = True
+        real = slots[:n]
+        self._valid_host[real] = True
         self._host_stale = True
-        for i, (slot, key) in enumerate(zip(slots, keys)):
+        for i, (slot, key) in enumerate(zip(real, keys)):
             self._keys[int(slot)] = key
             self._slot_of[key] = int(slot)
             if metadatas is not None and metadatas[i] is not None:
@@ -495,14 +505,11 @@ class DeviceKnnIndex:
             norms = np.linalg.norm(q, axis=1, keepdims=True)
             q = q / np.maximum(norms, 1e-12)
         self._sync()
-        need_filter = filter_fns is not None and any(f is not None for f in filter_fns)
-        fetch = min(_k_bucket(4 * k if need_filter else k), self.capacity)
         fn = _topk_fn(self.metric)
-        results: list[list[tuple[Any, float]] | None] = [None] * len(q)
-        todo = list(range(len(q)))
-        while todo:
+
+        def dispatch(todo, fetch):
             if _pallas_eligible(self.metric, fetch, self.mesh):
-                scores, idx = _pallas_topk(
+                return _pallas_topk(
                     self.metric,
                     self._dev_matrix,
                     self._dev_valid,
@@ -511,8 +518,20 @@ class DeviceKnnIndex:
                     bias=self._dev_bias,
                     mesh=self.mesh,
                 )
-            else:
-                scores, idx = fn(self._dev_matrix, self._dev_valid, q[todo], fetch)
+            return fn(self._dev_matrix, self._dev_valid, q[todo], fetch)
+
+        return self._assemble(len(q), k, filter_fns, dispatch)
+
+    def _assemble(self, q_n, k, filter_fns, dispatch):
+        """Shared result assembly: run ``dispatch(todo, fetch)`` for the
+        outstanding queries, map slots to keys, apply metadata filters,
+        and refetch exponentially deeper when filters starve a query."""
+        need_filter = filter_fns is not None and any(f is not None for f in filter_fns)
+        fetch = min(_k_bucket(4 * k if need_filter else k), self.capacity)
+        results: list[list[tuple[Any, float]] | None] = [None] * q_n
+        todo = list(range(q_n))
+        while todo:
+            scores, idx = dispatch(todo, fetch)
             scores = np.asarray(scores)
             idx = np.asarray(idx)
             next_todo = []
@@ -540,6 +559,86 @@ class DeviceKnnIndex:
             else:
                 todo = []
         return [r if r is not None else [] for r in results]
+
+    # --- fused text query path (single-dispatch RAG) ---
+
+    def attach_encoder(self, encoder) -> None:
+        """Enable the fused text-query path: ``encoder`` is a
+        SentenceEncoder-like object (``module``/``params``/``tokenizer``).
+        Queries arriving as raw strings then run tokenize -> encode ->
+        score -> top-k as ONE jit dispatch — on a tunneled or remote
+        device the per-dispatch link latency dominates the RAG query
+        budget, so collapsing embed+search from 2-3 round trips to one
+        is the difference between ~500ms and the <50ms SLO
+        (BASELINE.md config 3; VERDICT r2 Weak #3)."""
+        self._encoder = encoder
+        self._fused_jit = None
+
+    def search_texts_batch(
+        self,
+        texts: list[str],
+        k: int,
+        filter_fns: list[Callable | None] | None = None,
+    ) -> list[list[tuple[Any, float]]]:
+        """Raw text queries -> (key, score) lists via the fused
+        single-dispatch kernel. Falls back to encode + search_batch if
+        no encoder is attached or tokenization needs the slow path."""
+        enc = getattr(self, "_encoder", None)
+        if len(self._slot_of) == 0 or len(texts) == 0:
+            return [[] for _ in range(len(texts))]
+        texts = ["" if t is None else str(t) for t in texts]
+        if enc is None:
+            raise RuntimeError("search_texts_batch requires attach_encoder()")
+        m = enc.tokenizer.batch_encode_matrix(texts, enc.max_seq_len)
+        if m is None:  # non-ascii/no-native fallback: two dispatches
+            return self.search_batch(np.asarray(enc.encode(texts)), k, filter_fns)
+        ids_mat, lens = m
+        self._sync()
+        if self._fused_jit is None:
+            import jax
+            import jax.numpy as jnp
+            from functools import partial
+
+            module = enc.module
+
+            @partial(jax.jit, static_argnames=("k", "l2"))
+            def fused(params, ids, lens, matrix, valid, k, l2):
+                mask = jnp.arange(ids.shape[1])[None, :] < lens[:, None]
+                emb = module.apply(params, ids, mask)  # [q, dim], L2-normed
+                scores = emb @ matrix.T
+                if l2:
+                    sq = jnp.sum(matrix * matrix, axis=1)
+                    scores = 2.0 * scores - sq[None, :] - 1.0  # |emb|=1
+                scores = jnp.where(valid[None, :], scores, _NEG)
+                return jax.lax.top_k(scores, k)
+
+            self._fused_jit = fused
+
+        from ..models.batching import DEFAULT_SEQ_BUCKETS, bucket
+
+        n = len(texts)
+        L = min(bucket(int(lens.max()), DEFAULT_SEQ_BUCKETS), ids_mat.shape[1])
+        qb = _k_bucket(n)
+        ids = np.zeros((qb, L), ids_mat.dtype)
+        ids[:n] = ids_mat[:, :L]
+        lens_p = np.zeros((qb,), lens.dtype)
+        lens_p[:n] = lens
+
+        def dispatch(todo, fetch):
+            # the fused kernel scores every query each pass; refills
+            # (rare, filter starvation) just deepen fetch for all
+            vals, idx = self._fused_jit(
+                enc.params,
+                ids,
+                lens_p,
+                self._dev_matrix,
+                self._dev_valid,
+                k=min(fetch, self.capacity),
+                l2=self.metric == "l2",
+            )
+            return np.asarray(vals)[todo], np.asarray(idx)[todo]
+
+        return self._assemble(n, k, filter_fns, dispatch)
 
     def search_one(self, query, k: int, filter_fn: Callable | None = None):
         return self.search_batch(np.asarray(query)[None, :], k, [filter_fn])[0]

@@ -11,6 +11,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+import numpy as np
+
 from .data_index import InnerIndex
 from .retrievers import InnerIndexFactory
 
@@ -27,6 +29,18 @@ class _HybridEngineIndex:
         payloads = [p for _, p, _ in items]
         for sub, (de, _) in zip(self.subs, self.embeds):
             sub_payloads = de(payloads) if de is not None else payloads
+            if type(sub_payloads).__module__.split(".")[0] not in ("builtins", "numpy"):
+                # device-embedder output (jax array, possibly padded to
+                # a bucket size): keep it in HBM when the sub-index can
+                # take it; otherwise one bulk fetch, not per-row
+                if hasattr(sub, "add_batch_device"):
+                    sub.add_batch_device(
+                        [k for k, _, _ in items],
+                        sub_payloads,
+                        [m for _, _, m in items],
+                    )
+                    continue
+                sub_payloads = np.asarray(sub_payloads)[: len(items)]
             for (key, _, meta), p in zip(items, sub_payloads):
                 sub.add(key, p, meta)
 

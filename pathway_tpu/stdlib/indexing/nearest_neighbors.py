@@ -19,7 +19,7 @@ from typing import Any, Callable
 import numpy as np
 
 from ...internals.expression import ColumnExpression, ColumnReference
-from ...ops.knn import DeviceKnnIndex
+from ...ops.knn import DeviceKnnIndex, _k_bucket as _pow2_bucket
 from .data_index import DataIndex, InnerIndex
 from .retrievers import InnerIndexFactory
 
@@ -52,16 +52,34 @@ def normalize_embedder(embedder: Callable | None) -> Callable | None:
 
 
 class _VectorPayloadIndex(DeviceKnnIndex):
-    """DeviceKnnIndex accepting tuple/list/ndarray payloads."""
+    """DeviceKnnIndex accepting tuple/list/ndarray payloads — and raw
+    text payloads when a fused encoder is attached (single-dispatch
+    tokenize->encode->score->top-k query path)."""
 
     def add(self, key, payload, metadata=None):
         super().add(key, _as_vector(payload), metadata)
 
     def search_batch(self, payloads, k, filter_fns=None):
-        if not payloads:
+        if not len(payloads):
             return []
+        if getattr(self, "_encoder", None) is not None:
+            probe = next((p for p in payloads if p is not None), None)
+            if probe is None or isinstance(probe, str):
+                # fused config: queries arrive as raw text (None -> "")
+                return self.search_texts_batch(
+                    ["" if p is None else p for p in payloads], k, filter_fns
+                )
         q = np.stack([_as_vector(p) for p in payloads])
         return super().search_batch(q, k, filter_fns)
+
+
+def fused_query_encoder(embedder) -> Any | None:
+    """The SentenceEncoder behind ``embedder`` when its internals
+    (module/params/tokenizer) are exposed for the fused query path."""
+    enc = getattr(embedder, "_encoder", embedder)
+    if all(hasattr(enc, a) for a in ("module", "params", "tokenizer", "max_seq_len")):
+        return enc
+    return None
 
 
 @dataclass(frozen=True)
@@ -70,6 +88,11 @@ class AbstractKnn(InnerIndex):
     reserved_space: int = 1024
     metric: str = "cos"
     embedder: Callable | None = None
+
+    # device-index classes (DeviceKnnIndex-backed) opt in to the
+    # HBM-resident ingest + fused text-query paths; host-side tiers
+    # (LshKnn) must keep the embed-on-host contract
+    _device_backed = False
 
     def _embed_fns(self):
         if self.embedder is None:
@@ -81,19 +104,46 @@ class AbstractKnn(InnerIndex):
             vecs = embed(texts)
             return [np.asarray(v, np.float32) for v in vecs]
 
-        if hasattr(self.embedder, "encode_device"):
+        if self._device_backed and hasattr(self.embedder, "encode_device"):
             # ingest path stays in HBM: the encoder's jit output feeds
             # the index scatter directly (engine _index_add routes jax
-            # arrays to add_batch_device)
+            # arrays to add_batch_device); batches pad to bucket sizes
+            # so streaming epochs reuse a bounded set of compiled
+            # programs
             enc = self.embedder
+            import inspect
+
+            try:
+                _has_pad = "pad_to" in inspect.signature(enc.encode_device).parameters
+            except (TypeError, ValueError):
+                _has_pad = False
 
             def data_embed(payloads):
                 texts = [p if isinstance(p, str) else str(p) for p in payloads]
+                if _has_pad:
+                    return enc.encode_device(texts, pad_to=_pow2_bucket(len(texts)))
                 return enc.encode_device(texts)
+
+            if fused_query_encoder(self.embedder) is not None:
+                # queries stay raw text: the index runs the fused
+                # single-dispatch tokenize->encode->score->top-k path
+                return data_embed, None
 
             return data_embed, batch_embed
 
         return batch_embed, batch_embed
+
+    def _make_device_index(self):
+        dim, metric, res = self.dimensions, self.metric, self.reserved_space
+        enc = fused_query_encoder(self.embedder) if self.embedder else None
+
+        def make():
+            idx = _VectorPayloadIndex(dim=dim, metric=metric, reserved_space=max(64, res))
+            if enc is not None:
+                idx.attach_encoder(enc)
+            return idx
+
+        return make
 
 
 @dataclass(frozen=True)
@@ -102,12 +152,10 @@ class BruteForceKnn(AbstractKnn):
     BruteForceKnn :170 / Rust brute_force_knn_integration.rs:22)."""
 
     auxiliary_space: int = 0
+    _device_backed = True
 
     def _index_factory(self):
-        dim, metric, res = self.dimensions, self.metric, self.reserved_space
-        return lambda: _VectorPayloadIndex(
-            dim=dim, metric=metric, reserved_space=max(64, res)
-        )
+        return self._make_device_index()
 
 
 @dataclass(frozen=True)
@@ -118,12 +166,10 @@ class UsearchKnn(AbstractKnn):
     connectivity: int = 0
     expansion_add: int = 0
     expansion_search: int = 0
+    _device_backed = True
 
     def _index_factory(self):
-        dim, metric, res = self.dimensions, self.metric, self.reserved_space
-        return lambda: _VectorPayloadIndex(
-            dim=dim, metric=metric, reserved_space=max(64, res)
-        )
+        return self._make_device_index()
 
 
 class _LshIndex:

@@ -72,10 +72,10 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             return [e for e in embs]
         return self._encoder.encode([str(input)])[0]
 
-    def encode_device(self, texts):
+    def encode_device(self, texts, pad_to: int | None = None):
         """Batch ingest surface: texts -> DEVICE-resident [n, dim] jax
         array (no host round-trip; feeds the on-device KNN index)."""
-        return self._encoder.encode_device(texts)
+        return self._encoder.encode_device(texts, pad_to=pad_to)
 
     def get_embedding_dimension(self, **kwargs) -> int:
         return self._encoder.dim

@@ -1,0 +1,68 @@
+"""Fused single-dispatch RAG pipeline (ops/fused_rag.py) — the TPU
+replacement for the reference's 3-stage query path (embedders.py:270 ->
+usearch_integration.rs:53 -> rerankers.py:186)."""
+
+import numpy as np
+import pytest
+
+from pathway_tpu.models.sentence_encoder import CrossEncoderScorer, SentenceEncoder
+from pathway_tpu.ops.fused_rag import FusedRagPipeline
+
+
+@pytest.fixture(scope="module")
+def enc():
+    return SentenceEncoder(max_batch=64)
+
+
+def test_retrieval_only_exact_match(enc):
+    p = FusedRagPipeline(enc, None, reserved_space=128)
+    docs = [f"passage {i} about topic {i % 7}" for i in range(40)]
+    p.add_docs(list(range(40)), docs)
+    r = p.query("passage 3 about topic 3", k=1, k_retrieve=8)
+    assert r[0][0] == 3
+
+
+def test_rerank_returns_k(enc):
+    p = FusedRagPipeline(enc, CrossEncoderScorer(), reserved_space=128, doc_seq_len=48)
+    docs = [f"passage {i} about topic {i % 7}" for i in range(30)]
+    p.add_docs(list(range(30)), docs)
+    r = p.query("passage 12 about topic 5", k=5, k_retrieve=16)
+    assert len(r) == 5
+    assert len({k for k, _ in r}) == 5  # distinct docs
+
+
+def test_incremental_adds_and_removes(enc):
+    p = FusedRagPipeline(enc, None, reserved_space=64)
+    p.add_docs(list(range(20)), [f"doc number {i}" for i in range(20)])
+    p.query("doc number 1", k=1)  # resident
+    p.add_docs([100], ["an unmistakably unique zebra document"])
+    r = p.query("an unmistakably unique zebra document", k=1)
+    assert r[0][0] == 100
+    p.remove_docs([100])
+    r = p.query("an unmistakably unique zebra document", k=1)
+    assert r[0][0] != 100
+
+
+def test_growth_past_reserved_space(enc):
+    p = FusedRagPipeline(enc, None, reserved_space=64)
+    docs = [f"growing corpus item {i} flavor {i % 11}" for i in range(300)]
+    p.add_docs(list(range(300)), docs)
+    r = p.query("growing corpus item 250 flavor 8", k=1, k_retrieve=8)
+    assert r[0][0] == 250
+
+
+def test_query_async_matches_sync(enc):
+    p = FusedRagPipeline(enc, None, reserved_space=64)
+    p.add_docs(list(range(10)), [f"async path doc {i}" for i in range(10)])
+    sync = p.query("async path doc 4", k=3, k_retrieve=8)
+    hits = p.resolve(*p.query_async("async path doc 4", k=3, k_retrieve=8), k=3)
+    assert [k for k, _ in sync] == [k for k, _ in hits]
+
+
+def test_empty_and_missing(enc):
+    p = FusedRagPipeline(enc, None, reserved_space=64)
+    assert p.query_batch([], 3) == []
+    assert p.query("anything", 3) == []  # empty index
+    p.add_docs([1], ["only doc"])
+    r = p.query("only doc", k=5, k_retrieve=8)
+    assert [k for k, _ in r] == [1]  # padding slots filtered out

@@ -294,3 +294,45 @@ def test_device_resident_ingest():
         rows = run_table(res.select(text=res.text))
     assert list(rows.values())[0] == (("bbb",),)
     assert calls["device"] >= 1, "ingest fell back to the host path"
+
+
+def test_lsh_with_device_embedder_stays_host():
+    """Regression (r3 review): the fused/device routing must not leak
+    into host-side tiers — LshKnn with a device-capable embedder used
+    to receive raw query strings and crash in _as_vector."""
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    emb = SentenceTransformerEmbedder(max_batch_size=16)
+    docs = _docs()
+    index = LshKnnFactory(dimensions=384, embedder=emb).build_index(docs.text, docs)
+    queries = pw.debug.table_from_markdown(
+        """
+      | query
+    9 | aaa
+    """
+    )
+    res = index.query_as_of_now(queries.query, number_of_matches=1)
+    rows = run_table(res.select(text=res.text))
+    assert len(list(rows.values())[0][0]) == 1
+
+
+def test_fused_query_none_payload():
+    """Regression (r3 review): a NULL query value first in the epoch
+    batch must not crash the fused text path."""
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    emb = SentenceTransformerEmbedder(max_batch_size=16)
+    docs = _docs()
+    index = BruteForceKnnFactory(dimensions=384, embedder=emb).build_index(
+        docs.text, docs
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+      | query
+    8 |
+    9 | aaa
+    """
+    ).select(query=pw.if_else(pw.this.query == "", None, pw.this.query))
+    res = index.query_as_of_now(queries.query, number_of_matches=1)
+    rows = run_table(res.select(text=res.text))
+    assert len(rows) == 2
