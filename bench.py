@@ -79,21 +79,34 @@ def bench_device_scan() -> float:
     return R * B / dt, n_chips
 
 
-def bench_framework_path() -> float:
+_CHUNK_WORDS = (
+    "streaming dataflow engines maintain incremental state across epochs "
+    "so that retractions and late data revise previously emitted results "
+    "without recomputing the whole pipeline from scratch every time"
+).split()
+
+
+def _realistic_chunks(n: int, words: int = 130) -> list[str]:
+    """Documents at TokenCountSplitter-scale chunk lengths (~128-256
+    wordpieces — VERDICT r2 Weak #5: S=32 snippets flatter the rate)."""
+    out = []
+    for i in range(n):
+        body = " ".join(_CHUNK_WORDS[(i + j) % len(_CHUNK_WORDS)] for j in range(words))
+        out.append(f"chunk {i} variant {i % 977}: {body}")
+    return out
+
+
+def bench_framework_path(words: int = 130, n: int = 32768) -> float:
     """Strings -> device-resident embeddings through the embedder's
-    ``encode_device`` ingest surface. Embeddings stay on device (they
-    feed the on-device KNN index in the streaming pipeline); only a
-    checksum returns, so the tunnel's slow host link doesn't masquerade
-    as framework overhead."""
+    ``encode_device`` ingest surface, at realistic chunk lengths
+    (~150 wordpieces, the TokenCountSplitter regime). Embeddings stay
+    on device (they feed the on-device KNN index in the streaming
+    pipeline); only a checksum returns, so the tunnel's slow host link
+    doesn't masquerade as framework overhead."""
     from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
 
-    emb = SentenceTransformerEmbedder(max_batch_size=16384)
-    n = 131072
-    texts = [
-        f"stream document {i} carrying a handful of short words for "
-        f"the ingest path number {i % 977}"
-        for i in range(n)
-    ]
+    emb = SentenceTransformerEmbedder(max_batch_size=4096)
+    texts = _realistic_chunks(n, words)
     s = np.asarray(emb.encode_device(texts).sum())  # compile + warm
     t0 = time.perf_counter()
     out = emb.encode_device(texts)
@@ -104,20 +117,27 @@ def bench_framework_path() -> float:
 
 
 def main() -> None:
+    # the SLO suite runs first so every BASELINE.md config lands in the
+    # round's bench record (VERDICT r2 Weak #5: report them all, every
+    # round); the headline stays the LAST line for the driver
+    run_suite()
     raw_eps, n_chips = bench_device_scan()
     fw_eps = bench_framework_path()
-    per_chip = raw_eps / n_chips
+    fw_per_chip = fw_eps / n_chips
     print(
         json.dumps(
             {
                 "metric": "minilm_l6_embeddings_per_sec",
-                "value": round(raw_eps, 1),
+                "value": round(fw_eps, 1),
                 "unit": "embeddings/s",
-                "vs_baseline": round(per_chip / 62500.0, 4),
-                "mode": "device-scan",
-                "framework_path_eps": round(fw_eps, 1),
-                "framework_vs_raw": round(fw_eps / raw_eps, 4),
-                "framework_mode": "strings->device-resident embeddings",
+                "vs_baseline": round(fw_per_chip / 62500.0, 4),
+                "mode": "framework path: strings -> device-resident "
+                "embeddings at ~150-wordpiece chunks (TokenCountSplitter "
+                "regime), via the C++ batched tokenizer + bucketed "
+                "scanned encoder",
+                "device_scan_eps": round(raw_eps, 1),
+                "device_scan_mode": "jit lax.scan, synthetic S=32 ids — "
+                "upper bound, not the headline",
             }
         )
     )

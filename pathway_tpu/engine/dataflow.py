@@ -305,6 +305,12 @@ class InputSession:
             self._committed_offsets = dict(self._offsets)
         self.node.graph.wake()
 
+    def pending(self) -> bool:
+        """Committed batches waiting to be drained (the multi-process
+        coordinator polls worker-side partitioned sources with this)."""
+        with self._lock:
+            return bool(self._committed)
+
     def close(self) -> None:
         with self._lock:
             if self._pending:

@@ -20,10 +20,16 @@ from ..internals.universe import Universe
 from ..internals.parse_graph import G
 
 
-def make_key(names: list[str], pk: list[str] | None, values: dict, seq: list[int]) -> int:
+def make_key(
+    names: list[str], pk: list[str] | None, values: dict, seq: list[int], salt=None
+) -> int:
     if pk:
         return int(ref_scalar(*[values.get(n) for n in pk]))
     seq[0] += 1
+    if salt is not None:
+        # partitioned sources generate keys on several processes at
+        # once: the per-process salt keeps the auto key spaces disjoint
+        return int(ref_scalar("__auto__", salt, seq[0]))
     return int(ref_scalar("__auto__", seq[0]))
 
 
@@ -51,13 +57,24 @@ def coerce_to_schema(values: dict, dtypes: dict[str, dt.DType]) -> tuple:
 
 
 class StreamingContext:
-    """Handed to reader threads: typed insert/remove + commit."""
+    """Handed to reader threads: typed insert/remove + commit.
+
+    ``process_id``/``n_processes`` identify this reader's slice of a
+    multi-process cluster: partition-aware readers (kafka partitions,
+    nats queue groups, pubsub subscriptions) read only their share on
+    their owning process — the reference's ``parallel_readers`` mode
+    (/root/reference/src/engine/graph.rs:943-950) — instead of funneling
+    everything through process 0."""
 
     def __init__(self, session: df.InputSession, schema: type[Schema]):
         self.session = session
         self.dtypes = schema.dtypes()
         self.names = list(self.dtypes.keys())
         self.pk = schema.primary_key_columns()
+        import os
+
+        self.process_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0") or 0)
+        self.n_processes = int(os.environ.get("PATHWAY_PROCESSES", "1") or 1)
         # lazy: offsets are restored from the persistence log after
         # construction but before reader threads start
         self._seq: list[int] | None = None
@@ -81,7 +98,7 @@ class StreamingContext:
 
     def insert(self, values: dict, offsets: dict | None = None) -> None:
         seq = self._seq_counter()
-        key = make_key(self.names, self.pk, values, seq)
+        key = make_key(self.names, self.pk, values, seq, getattr(self, "_key_salt", None))
         row = coerce_to_schema(values, self.dtypes)
         # the seq bookmark (and any caller offsets) lands in the same
         # locked append as the row: a concurrent autocommit tick must not
@@ -95,7 +112,9 @@ class StreamingContext:
             self._deletions[key] = row
 
     def remove(self, values: dict) -> None:
-        key = make_key(self.names, self.pk, values, self._seq_counter())
+        key = make_key(
+            self.names, self.pk, values, self._seq_counter(), getattr(self, "_key_salt", None)
+        )
         if self.pk:
             self.session.upsert(key, None)
         else:
@@ -129,11 +148,19 @@ def input_table_from_reader(
     autocommit_duration_ms: int | None = 1500,
     persistent_id: str | None = None,
     supports_offsets: bool = False,
+    parallel_readers: bool = False,
 ) -> Table:
     """Create an input Table whose rows are produced by `reader(ctx)`
     running on a named thread (reference reader threads mod.rs:447).
     With ``persistent_id`` set and a persistence config on the run, the
-    source's committed batches are logged for checkpoint/recovery."""
+    source's committed batches are logged for checkpoint/recovery.
+
+    ``parallel_readers``: the reader is partition-aware (it honors
+    ``ctx.process_id``/``ctx.n_processes``) — in a multi-process run
+    EVERY process starts its own reader thread and feeds its local
+    shard, the reference's partitioned-source mode
+    (/root/reference/src/engine/graph.rs:943-950); otherwise the source
+    reads on process 0 only and rows are forwarded by key shard."""
 
     dtypes = schema.dtypes()
 
@@ -141,7 +168,20 @@ def input_table_from_reader(
         node = df.SessionSourceNode(engine)
         node.persistent_id = persistent_id
         node.supports_offsets = supports_offsets
+        node.parallel_readers = parallel_readers
         ctx = StreamingContext(node.session, schema)
+        if parallel_readers and ctx.n_processes > 1:
+            if persistent_id is not None:
+                # input logs + offsets live on process 0 only: worker-fed
+                # batches would be replayed from operator snapshots AND
+                # re-read by the restarted worker reader -> double ingest
+                raise NotImplementedError(
+                    "persistent_id with parallel_readers in a multi-process "
+                    "run is not supported yet: worker-side input is not "
+                    "persisted. Drop parallel_readers (single-reader mode "
+                    "is persistent) or run single-process."
+                )
+            ctx._key_salt = ctx.process_id
 
         def run():
             try:
@@ -150,6 +190,7 @@ def input_table_from_reader(
                 ctx.close()
 
         t = threading.Thread(target=run, name=f"pathway_tpu:connector-{name}", daemon=True)
+        t.pathway_parallel_reader = parallel_readers
         engine.connector_threads.append(t)
         return node
 

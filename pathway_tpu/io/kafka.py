@@ -50,12 +50,20 @@ def read(
     format: str = "json",
     autocommit_duration_ms: int | None = 1500,
     name: str = "kafka",
+    parallel_readers: bool = False,
     _consumer=None,
     **kwargs,
 ) -> Table:
     """Stream a Kafka topic. ``_consumer`` injects a fake for tests: an
     iterable of (key_bytes, value_bytes) message pairs — the stream
-    closes when it is exhausted (a real consumer polls forever)."""
+    closes when it is exhausted (a real consumer polls forever).
+
+    ``parallel_readers``: in a multi-process run every process reads
+    its own share of the topic's partitions (the reference's
+    partitioned-source mode, graph.rs:943-950) instead of funneling
+    through process 0. Real consumers rely on consumer-group partition
+    assignment (set a shared ``group.id``); the injected fake is split
+    round-robin by message index."""
     if schema is None:
         if format == "raw":
             schema = schema_builder(
@@ -66,7 +74,13 @@ def read(
 
     def reader(ctx: StreamingContext) -> None:
         if _consumer is not None:
-            for _key, value in _consumer:
+            for i, (_key, value) in enumerate(_consumer):
+                if (
+                    parallel_readers
+                    and ctx.n_processes > 1
+                    and i % ctx.n_processes != ctx.process_id
+                ):
+                    continue  # another process owns this partition slice
                 _emit(ctx, value, format, schema)
             ctx.commit()
             return
@@ -91,7 +105,11 @@ def read(
                 pass
 
     return input_table_from_reader(
-        schema, reader, name=name, autocommit_duration_ms=autocommit_duration_ms
+        schema,
+        reader,
+        name=name,
+        autocommit_duration_ms=autocommit_duration_ms,
+        parallel_readers=parallel_readers,
     )
 
 

@@ -49,6 +49,7 @@ def read(
     autocommit_duration_ms: int | None = 1500,
     name: str = "nats",
     persistent_id: str | None = None,
+    parallel_readers: bool = False,
     _subscription=None,
     **kwargs,
 ) -> Table:
@@ -72,10 +73,17 @@ def read(
 
     def reader(ctx: StreamingContext) -> None:
         if _subscription is not None:
-            for payload in _subscription:
+            for i, payload in enumerate(_subscription):
+                if (
+                    parallel_readers
+                    and ctx.n_processes > 1
+                    and i % ctx.n_processes != ctx.process_id
+                ):
+                    continue  # another process's queue-group share
                 emit(ctx, payload)
             ctx.commit()
             return
+        # real NATS: queue groups split the subject across processes
         _run_async_subscriber(uri, topic, lambda p: emit(ctx, p))
 
     return input_table_from_reader(
@@ -84,6 +92,7 @@ def read(
         name=name,
         autocommit_duration_ms=autocommit_duration_ms,
         persistent_id=persistent_id,
+        parallel_readers=parallel_readers,
     )
 
 

@@ -7,36 +7,46 @@ engine shards of a P*T-shard world. Every process runs the SAME user
 program, so node ids line up across processes (exactly like in-process
 replica shards).
 
-Topology: a coordinator star instead of timely's full TCP mesh —
-process 0 (which also owns sources, sinks, and persistence) listens on
-127.0.0.1:PATHWAY_FIRST_PORT; workers connect and run bulk-synchronous
+Topology: a coordinator star for control flow plus, at P>2, a direct
+worker<->worker data mesh (timely's all-pairs channels, reference
+external/timely config.rs:62-86). Process 0 (which owns sinks and
+persistence) listens on 127.0.0.1:PATHWAY_FIRST_PORT; worker pid p
+listens for its peers on first_port+p. Epochs run bulk-synchronous
 rounds:
 
-    ROUND(t, frontier, mail, watermarks)
-        worker: apply frontier hooks + watermarks, deliver mail, run
-        its local fixpoint, reply (mail grouped by dest process, local
-        watermarks, activity flag)
+    POLL          (only with partitioned sources) any worker input?
+    ROUND(t, frontier, feed, mail, watermarks)
+        worker: apply frontier hooks + watermarks, on feed drain its
+        partitioned sources into the epoch, deliver mail, then run
+        sweep/exchange iterations: local fixpoint, mail for peers goes
+        DIRECTLY over the mesh (one frame to and from every peer, in
+        global pair order — no circular wait), mail for p0 rides the
+        reply
     TIME_END(t)   close the epoch everywhere (sinks only fire on p0)
     SNAPSHOT / RESTORE   whole-cluster operator snapshots
     END           on_end hooks, shutdown
 
-Mail that a worker produces for another worker relays through the
-coordinator on the next round; rounds repeat until a full round moves
-no mail, no watermarks, and every process is quiescent. This mirrors
-the reference's frontier agreement, simplified to totally-ordered
-epochs (SURVEY §7: bulk-synchronous micro-epochs per commit tick). The
-data plane of the TPU build (embedders, KNN) scales on the
-jax.sharding.Mesh; this layer scales the host-side dataflow the way
-the reference's TCP cluster does.
+Rounds repeat until a full round moves no mail, no watermarks, and
+every process is quiescent. This mirrors the reference's frontier
+agreement, simplified to totally-ordered epochs (SURVEY §7:
+bulk-synchronous micro-epochs per commit tick). The data plane of the
+TPU build (embedders, KNN) scales on the jax.sharding.Mesh; this layer
+scales the host-side dataflow the way the reference's TCP cluster does.
 
-Workers suppress sink callbacks and never start connector reader
-threads — sources are read on process 0 and exchanged by key shard
-(the reference's single-reader + forward mode, graph.rs:943).
+Sources: single-reader sources are read on process 0 and exchanged by
+key shard (the reference's forward mode, graph.rs:943); sources built
+with ``parallel_readers=True`` start a reader on EVERY process, each
+reading its own partition slice (graph.rs:943-950 partitioned mode).
+Workers suppress sink callbacks — delivery stays on process 0.
+Worker-side input is not persisted yet; persistent_id +
+parallel_readers is rejected at build time.
 
 Trust boundary: after an authenticated JSON handshake, frames are
 pickled (rows may hold arbitrary python values), so a peer that knows
 the cluster token can execute code — exactly the trust level of the
-spawning user. `pathway spawn` generates a random per-cluster token in
+spawning user. This applies to the coordinator connection AND the
+worker<->worker mesh links (both token-checked before any pickle
+frame). `pathway spawn` generates a random per-cluster token in
 PATHWAY_CLUSTER_TOKEN; manual launches must set it themselves (there
 is deliberately no fallback — a guessable token would be an RCE door
 on multi-user hosts).
@@ -167,6 +177,8 @@ class CoordinatorCluster(ShardCluster):
         # relay buffer: worker→worker mail waiting for the next round
         self._relay: dict[int, dict[int, list]] = {}
         self._epoch_frontier: Any = None
+        self._poll_replies: dict[int, dict] | None = None
+        self._last_poll = 0.0
 
     # -- protocol helpers --
 
@@ -193,9 +205,36 @@ class CoordinatorCluster(ShardCluster):
         epoch (run() applies it locally via _frontier_hooks)."""
         self._epoch_frontier = frontier
 
+    def _has_partitioned_sources(self) -> bool:
+        # every process builds the same graph, so the coordinator's own
+        # sources tell whether ANY process runs partitioned readers —
+        # without them there is nothing to poll (and no protocol
+        # traffic racing against worker shutdown)
+        return bool(_partitioned_sources(self))
+
+    def _poll_cached(self) -> dict[int, dict]:
+        # rate-limited: polling every idle cycle steals GIL time from
+        # the very reader threads the poll is waiting on
+        now = _wall.monotonic()
+        if self._poll_replies is None or now - self._last_poll >= 0.1:
+            self._last_poll = now
+            self._poll_replies = self._broadcast({"op": "poll"})
+        return self._poll_replies
+
+    def _remote_input_pending(self) -> bool:
+        if not self._has_partitioned_sources():
+            return False
+        return any(r.get("pending") for r in self._poll_cached().values())
+
+    def _remote_sources_closed(self) -> bool:
+        if not self._has_partitioned_sources():
+            return True
+        return all(r.get("closed", True) for r in self._poll_cached().values())
+
     def _sweep(self, time) -> None:
         frontier = self._epoch_frontier
         self._epoch_frontier = None
+        feed = True  # workers drain their partitioned sources once per epoch
         while True:
             self._sweep_local(time)
             outbound = _group_by_process(self.drain_remote_mail(), self.threads)
@@ -212,12 +251,14 @@ class CoordinatorCluster(ShardCluster):
                     "op": "round",
                     "t": time,
                     "frontier": frontier,
+                    "feed": feed,
                     "mail": outbound.get(pid, {}),
                     "wm": wm,
                 }
                 for pid in self._conns
             }
             frontier = None  # applied once per epoch
+            feed = False
             replies = self._round_all(msgs)
             got_mail = False
             wm_changed = False
@@ -236,6 +277,9 @@ class CoordinatorCluster(ShardCluster):
                 break
         self._broadcast({"op": "time_end", "t": time})
         self._time_end_all(time)
+        # the feed round consumed worker input: a cached pending=True
+        # would spin empty epochs until the cache expired
+        self._poll_replies = None
 
     # -- persistence across processes --
 
@@ -300,6 +344,110 @@ def _graph_sig(engine: df.EngineGraph) -> str:
     return h.hexdigest()
 
 
+class PeerMesh:
+    """Direct worker<->worker TCP links (timely's all-pairs channels,
+    reference external/timely config.rs:62-86) so re-key mail moves in
+    one hop instead of relaying through the coordinator. Pair (i, j),
+    i<j: j connects to i's listener on first_port+i; both sides check
+    the cluster token before any pickle frame. Exchange is one frame to
+    and from every peer per iteration, in global pair order (i sends
+    first in its pairs with higher pids) — consistent ordering, so no
+    circular wait."""
+
+    def __init__(self, pid: int, processes: int, first_port: int, token: str, retries: int = 120):
+        self.pid = pid
+        self.peers: dict[int, socket.socket] = {}
+        others = [j for j in range(1, processes) if j != pid]
+        srv = None
+        higher = [j for j in others if j > pid]
+        if higher:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("127.0.0.1", first_port + pid))
+            srv.listen(len(higher))
+            srv.settimeout(60.0)
+        try:
+            for j in [j for j in others if j < pid]:
+                conn = None
+                for _ in range(retries):
+                    try:
+                        conn = socket.create_connection(
+                            ("127.0.0.1", first_port + j), timeout=5.0
+                        )
+                        break
+                    except OSError:
+                        _wall.sleep(0.25)
+                if conn is None:
+                    raise ConnectionError(f"cannot reach peer worker {j}")
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _send_json(conn, {"op": "peer", "pid": pid, "token": token})
+                ack = _recv_json(conn)
+                if ack.get("op") != "peer_ok" or not hmac.compare_digest(
+                    str(ack.get("token", "")), token
+                ):
+                    raise ConnectionError(f"peer {j} failed token check")
+                self.peers[j] = conn
+            while len(self.peers) < len(others):
+                conn, _ = srv.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    hello = _recv_json(conn)
+                except (ConnectionError, ValueError):
+                    conn.close()
+                    continue
+                if hello.get("op") != "peer" or not hmac.compare_digest(
+                    str(hello.get("token", "")), token
+                ):
+                    conn.close()
+                    continue
+                _send_json(conn, {"op": "peer_ok", "token": token})
+                self.peers[hello["pid"]] = conn
+        finally:
+            if srv is not None:
+                srv.close()
+
+    def exchange(self, outbound: dict[int, dict]) -> dict[int, list]:
+        """Send one mail frame to every peer (empty allowed), receive
+        one from each; returns merged inbound {shard: box}."""
+        inbound: dict[int, list] = {}
+        for j in sorted(self.peers):
+            conn = self.peers[j]
+            if self.pid < j:
+                _send(conn, outbound.get(j, {}))
+                got = _recv(conn)
+            else:
+                got = _recv(conn)
+                _send(conn, outbound.get(j, {}))
+            for shard, box in got.items():
+                inbound.setdefault(shard, []).extend(box)
+        return inbound
+
+    def close(self) -> None:
+        for conn in self.peers.values():
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+
+def _partitioned_sources(cluster: ShardCluster):
+    return [
+        s
+        for s in cluster.engines[0].session_sources
+        if getattr(s, "parallel_readers", False)
+    ]
+
+
+def _feed_partitioned(cluster: ShardCluster, t) -> bool:
+    fed = False
+    for s in _partitioned_sources(cluster):
+        b = s.session.drain()
+        if b:
+            s.feed_batch(b, t)
+            fed = True
+    return fed
+
+
 def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 120) -> None:
     """Worker process main loop (PATHWAY_PROCESS_ID > 0): serve rounds
     until the coordinator says END."""
@@ -331,6 +479,13 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
     assert welcome.get("op") == "welcome"
     if not hmac.compare_digest(str(welcome.get("token", "")), token):
         raise ConnectionError("coordinator failed token check")
+    processes = cluster.world // cluster.n
+    mesh = PeerMesh(pid, processes, first_port, token) if processes > 2 else None
+    # partitioned sources read their slice HERE: start only the readers
+    # flagged parallel (single-reader sources stay on process 0)
+    for th in cluster.engines[0].connector_threads:
+        if getattr(th, "pathway_parallel_reader", False):
+            th.start()
     try:
         while True:
             msg = _recv(sock)
@@ -342,17 +497,46 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                     for e in cluster.engines:
                         e.current_time = t
                         e._frontier_hooks(msg["frontier"])
+                if msg.get("feed"):
+                    had |= _feed_partitioned(cluster, t)
                 had |= cluster.post_mail(msg["mail"])
                 had |= cluster.apply_watermarks(msg["wm"])
-                cluster._sweep_local(t)
-                out = _group_by_process(cluster.drain_remote_mail(), cluster.n)
+                p0_mail: dict[int, dict] = {}
+                sent_peer = got_peer = False
+                # two exchange iterations: mail produced by the first
+                # sweep reaches its peer and is swept in THIS round
+                for _it in range(2 if mesh is not None else 1):
+                    cluster._sweep_local(t)
+                    out = _group_by_process(cluster.drain_remote_mail(), cluster.n)
+                    if mesh is not None:
+                        peer_out = {p: b for p, b in out.items() if p != 0}
+                        sent_peer |= any(peer_out.values())
+                        inbound = mesh.exchange(peer_out)
+                        if inbound:
+                            cluster.post_mail(inbound)
+                            got_peer = True
+                        out = {0: out.get(0, {})} if out.get(0) else {}
+                    for dest_pid, boxes in out.items():
+                        dst = p0_mail.setdefault(dest_pid, {})
+                        for shard, box in boxes.items():
+                            dst.setdefault(shard, []).extend(box)
                 _send(
                     sock,
                     {
                         "op": "reply",
-                        "mail": out,
+                        "mail": p0_mail,
                         "wm": cluster.watermark_map(),
-                        "active": had or bool(out),
+                        "active": had or bool(p0_mail) or sent_peer or got_peer,
+                    },
+                )
+            elif op == "poll":
+                srcs = _partitioned_sources(cluster)
+                _send(
+                    sock,
+                    {
+                        "op": "poll_reply",
+                        "pending": any(s.session.pending() for s in srcs),
+                        "closed": all(s.session.closed for s in srcs),
                     },
                 )
             elif op == "time_end":
@@ -388,4 +572,6 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
             pass
         raise
     finally:
+        if mesh is not None:
+            mesh.close()
         sock.close()

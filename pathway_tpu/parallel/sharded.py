@@ -415,16 +415,24 @@ class ShardCluster:
                         if b:
                             session_batches.append((s, b))
 
+            remote_pending = False
             if scripted_t is None and not session_batches:
-                if all(
-                    s.session.closed
-                    for s in primary.session_sources
-                    if not s.is_error_log
-                ):
-                    break
-                primary._wake.wait(timeout=0.05)
-                primary._wake.clear()
-                continue
+                # partitioned sources read on worker processes may hold
+                # input even when process 0 is idle
+                remote_pending = self._remote_input_pending()
+                if not remote_pending:
+                    if (
+                        all(
+                            s.session.closed
+                            for s in primary.session_sources
+                            if not s.is_error_log
+                        )
+                        and self._remote_sources_closed()
+                    ):
+                        break
+                    primary._wake.wait(timeout=0.05)
+                    primary._wake.clear()
+                    continue
 
             t = scripted_t if scripted_t is not None else last_time + 1
             if session_batches and scripted_t is not None:
@@ -517,6 +525,12 @@ class ShardCluster:
 
     def _finish_remote(self) -> None:
         pass
+
+    def _remote_input_pending(self) -> bool:
+        return False
+
+    def _remote_sources_closed(self) -> bool:
+        return True
 
     def stop(self) -> None:
         self._stop = True

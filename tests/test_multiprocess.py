@@ -257,3 +257,169 @@ def test_two_process_two_threads_wordcount(wc_input):
     with open(multi + ".0") as f:
         got = f.read()
     assert got == expect
+
+
+KAFKA_PART_PROGRAM = textwrap.dedent(
+    """
+    import json, os, time
+    import pathway_tpu as pw
+
+    N = int(os.environ["KP_N"])
+    PID = os.environ.get("PATHWAY_PROCESS_ID", "0")
+
+    class Timed:
+        def __init__(self, msgs):
+            self.msgs = msgs
+        def __iter__(self):
+            t0 = time.perf_counter()
+            for m in self.msgs:
+                yield m
+            dt = time.perf_counter() - t0
+            with open(os.environ["KP_STATS"] + "." + PID, "w") as f:
+                json.dump({"pid": PID, "ingest_s": dt}, f)
+
+    # realistic event payloads: parse cost dominates iteration overhead
+    msgs = [
+        (None, json.dumps({
+            "word": ["cat", "dog", "bird"][i % 3], "i": i,
+            "ts": f"2026-07-30T12:{i % 60:02d}:{(i * 7) % 60:02d}Z",
+            "session": f"sess-{i % 1000:04d}-{i % 17}",
+            "payload": "x" * 120 + str(i),
+            "score": i * 0.125, "flags": [i % 2 == 0, i % 3 == 0],
+            "nested": {"a": i % 10, "b": str(i % 100), "c": [i, i + 1]},
+        }).encode())
+        for i in range(N)
+    ]
+
+    class S(pw.Schema):
+        word: str
+        i: int
+
+    t = pw.io.kafka.read(
+        {}, "topic", schema=S, format="json",
+        parallel_readers=True, _consumer=Timed(msgs),
+        autocommit_duration_ms=100,
+    )
+    if os.environ.get("KP_SINK") == "null":
+        # isolate reader bandwidth: rows die at a local filter so no
+        # downstream or cross-process work competes with the readers
+        pw.io.null.write(t.filter(pw.this.i < 0))
+    else:
+        c = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+        out = os.environ["WC_OUT"] + "." + PID
+        pw.io.jsonlines.write(c, out)
+    pw.run(monitoring_level="none")
+    """
+)
+
+
+def _spawn_prog(tmp_path, program: str, processes: int, tag: str, extra_env=None) -> str:
+    prog = tmp_path / f"prog_{tag}.py"
+    prog.write_text(program)
+    out = str(tmp_path / f"out_{tag}.csv")
+    env = dict(os.environ)
+    env.update(
+        WC_IN=str(tmp_path / "in"),
+        WC_OUT=out,
+        JAX_PLATFORMS="cpu",
+        PATHWAY_THREADS="1",
+        PATHWAY_PROCESSES=str(processes),
+        PATHWAY_FIRST_PORT=str(_free_port()),
+        PATHWAY_CLUSTER_TOKEN="test-cluster-secret",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    env.update(extra_env or {})
+    procs = []
+    for pid in range(processes):
+        e = dict(env)
+        e["PATHWAY_PROCESS_ID"] = str(pid)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(prog)],
+                env=e,
+                cwd=str(tmp_path),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    for p in procs:
+        try:
+            outp, errp = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"rc={p.returncode}\n{errp[-4000:]}"
+    return out
+
+
+def test_partitioned_kafka_reads_scale(tmp_path):
+    """Partitioned source mode (reference graph.rs:943-950
+    parallel_readers): each process reads ITS share of the topic, so
+    2-process aggregate ingest bandwidth is ~2x one reader — VERDICT r2
+    item 5 asks >=1.8x. Correctness: the 2-process wordcount equals the
+    single-process one."""
+    # -- correctness: the 2-process result equals the single-process one
+    n = 9000
+    stats1 = str(tmp_path / "stats1")
+    stats2 = str(tmp_path / "stats2")
+    single = _spawn_prog(
+        tmp_path, KAFKA_PART_PROGRAM, 1, "kp1", {"KP_N": str(n), "KP_STATS": stats1}
+    )
+    multi = _spawn_prog(
+        tmp_path, KAFKA_PART_PROGRAM, 2, "kp2", {"KP_N": str(n), "KP_STATS": stats2}
+    )
+    # worker-read rows may land an epoch later than process 0's share,
+    # so compare the NET final state, not the raw update log
+    assert _net_counts(multi + ".0") == _net_counts(single + ".0") == {
+        "cat": 3000,
+        "dog": 3000,
+        "bird": 3000,
+    }
+
+    # -- bandwidth: reader-isolated (null sink) ingest time. Wall-clock
+    # scaling needs real cores: on a single-CPU host two parsers just
+    # time-share, so only the ownership proof above applies there.
+    if len(os.sched_getaffinity(0)) < 2:
+        pytest.skip("host has one CPU: partitioned readers cannot run in parallel")
+    n = 60000
+    stats3 = str(tmp_path / "stats3")
+    stats4 = str(tmp_path / "stats4")
+    _spawn_prog(
+        tmp_path,
+        KAFKA_PART_PROGRAM,
+        1,
+        "kp3",
+        {"KP_N": str(n), "KP_STATS": stats3, "KP_SINK": "null"},
+    )
+    _spawn_prog(
+        tmp_path,
+        KAFKA_PART_PROGRAM,
+        2,
+        "kp4",
+        {"KP_N": str(n), "KP_STATS": stats4, "KP_SINK": "null"},
+    )
+    with open(stats3 + ".0") as f:
+        t1 = json.load(f)["ingest_s"]
+    times = []
+    for pid in (0, 1):
+        with open(stats4 + f".{pid}") as f:
+            times.append(json.load(f)["ingest_s"])
+    # aggregate bandwidth vs the slowest reader of the 2-proc run
+    speedup = t1 / max(times)
+    assert speedup >= 1.8, f"partitioned ingest speedup {speedup:.2f}x < 1.8x (t1={t1:.3f}s, t2={times})"
+
+
+def test_three_process_peer_mesh_wordcount(wc_input):
+    """P=3 engages the direct worker<->worker mesh (PeerMesh): output
+    must still match the single-process run and sinks stay on p0."""
+    tmp = wc_input
+    single = _spawn(tmp, processes=1, threads=1, tag="mesh_s")
+    multi = _spawn(tmp, processes=3, threads=1, tag="mesh_m")
+    with open(single + ".0") as f:
+        expect = f.read()
+    with open(multi + ".0") as f:
+        got = f.read()
+    assert got == expect
+    assert not os.path.exists(multi + ".1") and not os.path.exists(multi + ".2")
