@@ -40,6 +40,81 @@ class ObjectStoreClient(Protocol):
         """-> the object's raw bytes."""
 
 
+class ObjectCache:
+    """Disk-backed object cache keyed by (key, version) — the rebuild
+    of the reference's CachedObjectStorage
+    (/root/reference/src/persistence/cached_object_storage.rs:1-377):
+    fetched objects persist across restarts and re-scans, so an
+    unchanged object is never downloaded twice. Layout: one
+    ``<blake2b(key)>.bin`` blob + ``.meta`` JSON ({key, version}) per
+    object under ``root``."""
+
+    def __init__(self, root: str):
+        import os
+
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _paths(self, key: str) -> tuple[str, str]:
+        import hashlib
+        import os
+
+        h = hashlib.blake2b(key.encode(), digest_size=16).hexdigest()
+        return os.path.join(self.root, h + ".bin"), os.path.join(self.root, h + ".meta")
+
+    def get(self, key: str, version: Any) -> bytes | None:
+        import os
+
+        blob, meta = self._paths(key)
+        try:
+            with open(meta) as f:
+                m = json.load(f)
+            if m.get("key") != key or m.get("version") != _jsonable(version):
+                return None
+            with open(blob, "rb") as f:
+                return f.read()
+        except (OSError, ValueError):
+            return None
+
+    def put(self, key: str, version: Any, payload: bytes) -> None:
+        import os
+
+        blob, meta = self._paths(key)
+        # invalidate meta FIRST: a crash between the blob replace and
+        # the meta write must leave a cache miss, never an old meta
+        # pointing at new bytes (served as the old version if the
+        # object later reverts)
+        try:
+            os.remove(meta)
+        except OSError:
+            pass
+        tmp = blob + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, blob)
+        tmpm = meta + ".tmp"
+        with open(tmpm, "w") as f:
+            json.dump({"key": key, "version": _jsonable(version)}, f)
+        os.replace(tmpm, meta)
+
+    def drop(self, key: str) -> None:
+        import os
+
+        for p in self._paths(key):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+def _jsonable(version: Any):
+    # round-trip so compare sees what a reload sees (tuples -> lists)
+    try:
+        return json.loads(json.dumps(version))
+    except (TypeError, ValueError):
+        return repr(version)
+
+
 def rows_from_payload(
     payload: bytes,
     format: str,
@@ -99,13 +174,30 @@ def read_object_store(
     name: str = "object_store",
     persistent_id: str | None = None,
     poll_interval_s: float = _POLL_INTERVAL_S,
+    object_cache: str | ObjectCache | None = None,
     **kwargs,
 ) -> Table:
     """Build an input table over an ObjectStoreClient.
 
     ``client_factory()`` is called on the reader thread (so slow client
     construction/auth never blocks graph building).
+
+    ``object_cache``: directory (or ObjectCache) persisting fetched
+    objects by version — restarts and re-scans skip downloads of
+    unchanged objects entirely (reference cached_object_storage.rs).
     """
+    cache = ObjectCache(object_cache) if isinstance(object_cache, str) else object_cache
+
+    def fetch(client, key: str, version: Any) -> bytes:
+        if cache is not None:
+            hit = cache.get(key, version)
+            if hit is not None:
+                return hit
+        payload = client.get_object(key)
+        if cache is not None:
+            cache.put(key, version, payload)
+        return payload
+
     if schema is None:
         schema = default_schema(format, with_metadata)
     elif with_metadata and "_metadata" not in schema.column_names():
@@ -117,7 +209,7 @@ def read_object_store(
         client = client_factory()
         rows: list[dict] = []
         for key, version in sorted(client.list_objects()):
-            payload = client.get_object(key)
+            payload = fetch(client, key, version)
             rows.extend(
                 rows_from_payload(
                     payload, format, with_metadata, {"path": key}, **kwargs
@@ -142,7 +234,7 @@ def read_object_store(
                     continue
                 old_n = old[1] if old is not None else 0
                 rows = rows_from_payload(
-                    client.get_object(key),
+                    fetch(client, key, version),
                     format,
                     with_metadata,
                     {"path": key},
@@ -161,6 +253,8 @@ def read_object_store(
                     for i in range(old_n):
                         ctx.upsert_keyed((key, i), None)
                     ctx.set_offset(key, None)
+                    if cache is not None:
+                        cache.drop(key)
                     changed = True
             if changed:
                 ctx.commit()

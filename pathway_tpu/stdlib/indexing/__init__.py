@@ -17,6 +17,11 @@ from .nearest_neighbors import (
     UsearchKnnFactory,
 )
 from .retrievers import AbstractRetrieverFactory, InnerIndexFactory
+from .sorting import (
+    build_sorted_index,
+    retrieve_prev_next_values,
+    sort_from_index,
+)
 from .vector_document_index import (
     VectorDocumentIndex,
     default_brute_force_knn_document_index,
@@ -51,6 +56,9 @@ __all__ = [
     "default_usearch_knn_document_index",
     "default_lsh_knn_document_index",
     "default_full_text_document_index",
+    "build_sorted_index",
+    "sort_from_index",
+    "retrieve_prev_next_values",
     "_INDEX_REPLY",
     "_SCORE",
 ]

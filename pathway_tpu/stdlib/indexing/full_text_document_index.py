@@ -1,0 +1,8 @@
+"""Default full-text document index — dedicated module for parity with
+the reference layout (/root/reference/python/pathway/stdlib/indexing/
+full_text_document_index.py:1-26); the BM25-backed constructor lives in
+vector_document_index alongside the other defaults."""
+
+from .vector_document_index import default_full_text_document_index
+
+__all__ = ["default_full_text_document_index"]

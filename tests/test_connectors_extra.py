@@ -138,3 +138,58 @@ def _bytes_schema():
         data: bytes
 
     return S
+
+
+def test_object_cache_zero_redownloads_across_restart(tmp_path):
+    """Cached object storage (reference cached_object_storage.rs:1-377):
+    a restart re-lists but never re-downloads unchanged objects; a
+    changed object is fetched once; deletions evict."""
+    import pathway_tpu as pw
+    from pathway_tpu.io._object_store import ObjectCache
+
+    class CountingDrive:
+        def __init__(self, objects):
+            self.objects = dict(objects)
+            self.gets = 0
+
+        def list_objects(self):
+            return [(k, f"v{len(v)}") for k, v in self.objects.items()]
+
+        def get_object(self, key):
+            self.gets += 1
+            return self.objects[key]
+
+    cache_dir = str(tmp_path / "objcache")
+    objs = {"a.txt": b"alpha\n", "b.txt": b"beta\n"}
+
+    def run_once(client):
+        t = pw.io.gdrive.read(
+            "folder", mode="static", format="plaintext", _client=client,
+            object_cache=cache_dir,
+        )
+        out = []
+        pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: out.append(row["data"]))
+        pw.run(monitoring_level="none")
+        pw.clear_graph()
+        return sorted(out)
+
+    c1 = CountingDrive(objs)
+    assert run_once(c1) == ["alpha", "beta"]
+    assert c1.gets == 2  # cold cache: both fetched
+
+    # restart: fresh client + fresh graph, same cache dir
+    c2 = CountingDrive(objs)
+    assert run_once(c2) == ["alpha", "beta"]
+    assert c2.gets == 0, "unchanged objects were re-downloaded"
+
+    # changed object: exactly one fetch
+    c3 = CountingDrive({**objs, "b.txt": b"beta2!\n"})
+    assert run_once(c3) == ["alpha", "beta2!"]
+    assert c3.gets == 1
+
+    # eviction drops the cached blob
+    cache = ObjectCache(cache_dir)
+    cache.drop("a.txt")
+    c4 = CountingDrive(objs)
+    run_once(c4)
+    assert c4.gets == 2  # a.txt refetched (evicted) + b.txt (version changed back)

@@ -1,0 +1,138 @@
+"""Sorted-index oracles (stdlib/indexing/sorting.py) — parity with
+reference sorting.py:53+ semantics under insertion/retraction."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing import (
+    build_sorted_index,
+    retrieve_prev_next_values,
+    sort_from_index,
+)
+
+from .utils import run_table
+
+
+def _keys_table(rows: str):
+    return pw.debug.table_from_markdown(rows)
+
+
+def test_build_sorted_index_structure():
+    nodes = _keys_table(
+        """
+      | key
+    1 | 5
+    2 | 1
+    3 | 9
+    4 | 3
+    5 | 7
+    """
+    )
+    out = build_sorted_index(nodes)
+    index, oracle = out["index"], out["oracle"]
+    rows = run_table(
+        index.select(key=pw.this.key, left=pw.this.left, right=pw.this.right, parent=pw.this.parent)
+    )
+    assert len(rows) == 5
+    by_key = {r[0]: r for r in rows.values()}
+    # exactly one root; every non-root's parent points into the table
+    roots = [r for r in rows.values() if r[3] is None]
+    assert len(roots) == 1
+    ids = set(rows.keys())
+    for _key, left, right, parent in rows.values():
+        for p in (left, right, parent):
+            assert p is None or p in ids
+    # BST invariant: left subtree keys < node key < right subtree keys
+    key_of = {k: v[0] for k, v in rows.items()}
+    for k, (key, left, right, _p) in rows.items():
+        if left is not None:
+            assert key_of[left] < key
+        if right is not None:
+            assert key_of[right] > key
+
+
+def test_sort_from_index_order_and_instances():
+    nodes = pw.debug.table_from_markdown(
+        """
+      | key | instance
+    1 | 5   | 0
+    2 | 1   | 0
+    3 | 9   | 1
+    4 | 3   | 0
+    5 | 7   | 1
+    """
+    )
+    out = build_sorted_index(nodes, instance=nodes.instance)
+    pn = sort_from_index(out["index"])
+    joined = nodes.select(key=pw.this.key, inst=pw.this.instance) + pn
+    rows = run_table(joined)
+    # reconstruct each instance chain: follow next from the head
+    by_id = dict(rows.items())
+    for inst, expect in ((0, [1, 3, 5]), (1, [7, 9])):
+        heads = [
+            k
+            for k, (key, i, prev, nxt) in rows.items()
+            if i == inst and prev is None
+        ]
+        assert len(heads) == 1
+        chain = []
+        cur = heads[0]
+        while cur is not None:
+            chain.append(by_id[cur][0])
+            cur = by_id[cur][3]
+        assert chain == expect
+
+
+def test_sorted_index_incremental_retraction():
+    """Streamed inserts + a retraction: the treap and prev/next chain
+    reflect the final state (reference streaming-semantics model)."""
+    nodes = pw.debug.table_from_markdown(
+        """
+      | key | __time__ | __diff__
+    1 | 5   | 2        | 1
+    2 | 1   | 2        | 1
+    3 | 9   | 4        | 1
+    1 | 5   | 6        | -1
+    4 | 2   | 6        | 1
+    """
+    )
+    out = build_sorted_index(nodes)
+    pn = sort_from_index(out["index"])
+    joined = nodes.select(key=pw.this.key) + pn
+    rows = run_table(joined)
+    keys = sorted(r[0] for r in rows.values())
+    assert keys == [1, 2, 9]
+    by_id = dict(rows.items())
+    heads = [k for k, (key, prev, nxt) in rows.items() if prev is None]
+    chain, cur = [], heads[0]
+    while cur is not None:
+        chain.append(by_id[cur][0])
+        cur = by_id[cur][2]
+    assert chain == [1, 2, 9]
+
+
+def test_retrieve_prev_next_values():
+    # ordered chain 1->2->3->4 with values only at 1 and 4
+    tbl = pw.debug.table_from_markdown(
+        """
+      | value | pos
+    1 | 10    | 1
+    2 |       | 2
+    3 |       | 3
+    4 | 40    | 4
+    """
+    ).select(
+        value=pw.if_else(pw.this.value == 0, None, pw.this.value),
+        pos=pw.this.pos,
+    )
+    srt = build_sorted_index(tbl.select(key=pw.this.pos))
+    pn = sort_from_index(srt["index"])
+    ordered = tbl.select(pw.this.value) + pn
+    got = retrieve_prev_next_values(ordered)
+    rows = run_table(ordered.select(v=pw.this.value) + got)
+    vals = {k: v for k, v in rows.items()}
+    by_value = {v[0]: k for k, v in rows.items()}
+    id10, id40 = by_value[10], by_value[40]
+    for k, (v, pv, nv) in vals.items():
+        if v is not None:
+            assert pv == k and nv == k  # self-inclusive seed
+        else:
+            assert vals[pv][0] == 10 and vals[nv][0] == 40
