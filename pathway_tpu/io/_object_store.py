@@ -88,12 +88,16 @@ class ObjectCache:
             os.remove(meta)
         except OSError:
             pass
-        tmp = blob + ".tmp"
-        with open(tmp, "wb") as f:
+        import tempfile
+
+        # unique tmp names: concurrent writers sharing a cache dir must
+        # never truncate each other's in-flight blob
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
             f.write(payload)
         os.replace(tmp, blob)
-        tmpm = meta + ".tmp"
-        with open(tmpm, "w") as f:
+        fdm, tmpm = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+        with os.fdopen(fdm, "w") as f:
             json.dump({"key": key, "version": _jsonable(version)}, f)
         os.replace(tmpm, meta)
 

@@ -168,8 +168,9 @@ class CLIPEncoder:
         inputs go in few large dispatches so per-dispatch link
         overheads amortize (VERDICT r2 Weak #8: the serial
         upload/compute/fetch loop ran at 22 img/s). ``max_batch`` is an
-        honest cap: memory-bounded deployments can lower it."""
-        step = self.max_batch
+        honest cap: memory-bounded deployments can lower it (values
+        above the largest bucket clamp so padding stays effective)."""
+        step = min(self.max_batch, self._BATCH_BUCKETS[-1])
         pending = []
         for lo in range(0, len(images), step):
             batch = images[lo : lo + step]
@@ -217,7 +218,7 @@ class CLIPEncoder:
                 toks = self.tokenizer.encode(texts[i] or "", L)
                 ids[j, : len(toks)] = toks
                 mask[j, : len(toks)] = True
-            B = bucket(len(group), (1, 8, 16, 32, 64, 128))
+            B = bucket(len(group), self._BATCH_BUCKETS)
             if B > len(group):
                 ids = np.concatenate([ids, np.zeros((B - len(group), L), np.int32)])
                 mask = np.concatenate([mask, np.zeros((B - len(group), L), bool)])

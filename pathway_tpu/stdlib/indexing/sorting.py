@@ -161,7 +161,11 @@ def _prev_next_from_tree(items) -> tuple:
 def sort_from_index(index: Table, oracle: Table | None = None) -> Table:
     """prev/next pointers in key order from a left/right/parent tree
     (reference sorting.py:137). Grouped per instance when the index
-    carries one, so a change re-traverses only its own tree."""
+    carries one, so a change re-traverses only its own tree.
+
+    ``oracle`` is accepted for reference-signature parity only — the
+    traversal finds roots from the parent pointers itself (the
+    reference's sort_from_index ignores its oracle too)."""
     inst = (
         index.instance if "instance" in index.column_names() else 0
     )

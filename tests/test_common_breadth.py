@@ -1,0 +1,283 @@
+"""Table-op + expression breadth, modeled on the reference's
+test_common.py / test_expressions coverage style: many small
+assertions over the whole DSL surface, each comparing against a
+directly-constructed expected table."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+
+from .utils import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+    run_table,
+)
+
+
+def _t3():
+    return T(
+        """
+      | a | b | s
+    1 | 1 | 1.5 | x
+    2 | 2 | 2.5 | yy
+    3 | 3 | 3.5 | zzz
+    """
+    )
+
+
+# ---- arithmetic / comparison / boolean expressions ----------------------
+
+
+def test_arithmetic_operators():
+    t = _t3()
+    r = t.select(
+        add=pw.this.a + 1,
+        sub=pw.this.a - 1,
+        mul=pw.this.a * 3,
+        div=pw.this.b / 0.5,
+        fdiv=pw.this.a // 2,
+        mod=pw.this.a % 2,
+        pow_=pw.this.a**2,
+        neg=-pw.this.a,
+    )
+    rows = sorted(run_table(r).values())
+    assert rows == [
+        (2, 0, 3, 3.0, 0, 1, 1, -1),
+        (3, 1, 6, 5.0, 1, 0, 4, -2),
+        (4, 2, 9, 7.0, 1, 1, 9, -3),
+    ]
+
+
+def test_comparison_and_boolean():
+    t = _t3()
+    r = t.select(
+        lt=pw.this.a < 2,
+        le=pw.this.a <= 2,
+        eq=pw.this.a == 2,
+        ne=pw.this.a != 2,
+        both=(pw.this.a > 1) & (pw.this.a < 3),
+        either=(pw.this.a == 1) | (pw.this.a == 3),
+        inv=~(pw.this.a == 1),
+    )
+    rows = sorted(run_table(r).values())
+    assert rows == [
+        (False, False, False, True, False, True, True),
+        (False, True, True, False, True, False, True),
+        (True, True, False, True, False, True, False),
+    ]
+
+
+def test_if_else_coalesce_require():
+    t = T(
+        """
+      | a | b
+    1 | 1 |
+    2 |   | 5
+    """
+    ).select(
+        a=pw.if_else(pw.this.a == 0, None, pw.this.a),
+        b=pw.if_else(pw.this.b == 0, None, pw.this.b),
+    )
+    r = t.select(
+        pick=pw.coalesce(pw.this.a, pw.this.b, 0),
+        gated=pw.require(pw.this.a, pw.this.b),  # None unless b non-null
+        branch=pw.if_else(pw.this.a.is_none(), -1, 1),
+    )
+    assert sorted(run_table(r).values(), key=repr) == sorted(
+        [(1, None, 1), (5, None, -1)], key=repr
+    )
+
+
+def test_str_namespace_breadth():
+    t = _t3()
+    r = t.select(
+        up=pw.this.s.str.upper(),
+        ln=pw.this.s.str.len(),
+        rev=pw.this.s.str.reversed(),
+        sub=pw.this.s.str.slice(0, 2),
+        has=pw.this.s.str.count("z"),
+        rep=pw.this.s.str.replace("y", "Y"),
+        sw=pw.this.s.str.startswith("z"),
+    )
+    rows = sorted(run_table(r).values())
+    assert rows == [
+        ("X", 1, "x", "x", 0, "x", False),
+        ("YY", 2, "yy", "yy", 0, "YY", False),
+        ("ZZZ", 3, "zzz", "zz", 3, "zzz", True),
+    ]
+
+
+def test_num_namespace():
+    t = T(
+        """
+      | x
+    1 | -2.7
+    2 | 3.2
+    """
+    )
+    r = t.select(
+        ab=pw.this.x.num.abs(),
+        rd=pw.this.x.num.round(),
+        fl=pw.apply_with_type(lambda v: int(v // 1), int, pw.this.x),
+    )
+    rows = sorted(run_table(r).values())
+    assert rows == [(2.7, -3.0, -3), (3.2, 3.0, 3)]
+
+
+def test_cast_and_as():
+    t = _t3()
+    r = t.select(
+        f=pw.cast(float, pw.this.a),
+        i=pw.cast(int, pw.this.b),
+        s2=pw.apply_with_type(str, str, pw.this.a),
+    )
+    rows = sorted(run_table(r).values())
+    assert rows == [(1.0, 1, "1"), (2.0, 2, "2"), (3.0, 3, "3")]  # cast truncates
+
+
+# ---- table ops ----------------------------------------------------------
+
+
+def test_rename_and_without():
+    t = _t3()
+    r = t.rename(aa=pw.this.a).without(pw.this.s)
+    state = run_table(r)
+    assert sorted(state.values()) == [(1, 1.5), (2, 2.5), (3, 3.5)]
+
+
+def test_ix_and_ix_ref():
+    t = _t3()
+    idx = T(
+        """
+      | n
+    9 | 1
+    """
+    )
+    # ix by explicit pointer column is covered in indexing tests; here
+    # ix_ref addresses by value-derived keys
+    keyed = t.with_id_from(pw.this.a)
+    r = idx.select(got=keyed.ix_ref(pw.this.n).s)
+    assert list(run_table(r).values()) == [("x",)]
+
+
+def test_with_id_from_and_reindex():
+    t = _t3()
+    k = t.with_id_from(pw.this.s)
+    rows = run_table(k)
+    assert len(rows) == 3
+    # deterministic: same derivation yields identical ids
+    k2 = t.with_id_from(pw.this.s)
+    assert set(run_table(k2).keys()) == set(rows.keys())
+
+
+def test_concat_duplicate_keys_raises_at_run():
+    t = _t3()
+    dup = t.concat(t.select(pw.this.a, pw.this.b, pw.this.s))
+    with pytest.raises(Exception, match="duplicate key"):
+        run_table(dup)
+
+
+def test_groupby_multiple_keys():
+    t = T(
+        """
+      | g | h | v
+    1 | a | 1 | 10
+    2 | a | 2 | 20
+    3 | a | 1 | 30
+    4 | b | 1 | 40
+    """
+    )
+    r = t.groupby(pw.this.g, pw.this.h).reduce(
+        pw.this.g, pw.this.h, s=pw.reducers.sum(pw.this.v)
+    )
+    assert sorted(run_table(r).values()) == [
+        ("a", 1, 40),
+        ("a", 2, 20),
+        ("b", 1, 40),
+    ]
+
+
+def test_join_select_this_disambiguation():
+    left = T(
+        """
+      | k | v
+    1 | a | 1
+    """
+    )
+    right = T(
+        """
+      | k | v
+    7 | a | 2
+    """
+    )
+    j = left.join(right, left.k == right.k).select(
+        lv=left.v, rv=right.v, k=left.k
+    )
+    assert list(run_table(j).values()) == [(1, 2, "a")]
+
+
+def test_flatten_preserves_other_columns():
+    t = T(
+        """
+      | tag
+    1 | ab
+    """
+    ).select(tag=pw.this.tag, parts=pw.apply_with_type(lambda s: tuple(s), pw.ANY, pw.this.tag))
+    r = t.flatten(pw.this.parts)
+    assert sorted(run_table(r.select(pw.this.parts, pw.this.tag)).values()) == [
+        ("a", "ab"),
+        ("b", "ab"),
+    ]
+
+
+def test_difference_update_rows_roundtrip():
+    t = _t3()
+    sub = t.filter(pw.this.a >= 2)
+    rest = t.difference(sub)
+    back = rest.concat(sub)
+    assert_table_equality_wo_index(back.select(pw.this.a), t.select(pw.this.a))
+
+
+def test_empty_table_ops():
+    t = _t3().filter(pw.this.a > 100)
+    r = t.select(b=pw.this.a + 1)
+    assert run_table(r) == {}
+    g = t.groupby(pw.this.s).reduce(pw.this.s, n=pw.reducers.count())
+    assert run_table(g) == {}
+
+
+# ---- error routing ------------------------------------------------------
+
+
+def test_division_by_zero_routes_error():
+    t = T(
+        """
+      | a | d
+    1 | 1 | 0
+    2 | 4 | 2
+    """
+    )
+    r = t.select(q=pw.fill_error(pw.this.a // pw.this.d, -1))
+    rows = sorted(run_table(r).values())
+    assert rows == [(-1,), (2,)]
+
+
+def test_apply_exception_is_error_value():
+    t = T(
+        """
+      | a
+    1 | 0
+    2 | 2
+    """
+    )
+
+    def boom(x):
+        if x == 0:
+            raise ValueError("zero")
+        return 10 // x
+
+    r = t.select(v=pw.fill_error(pw.apply_with_type(boom, int, pw.this.a), -7))
+    assert sorted(run_table(r).values()) == [(-7,), (5,)]

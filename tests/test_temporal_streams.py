@@ -1,0 +1,293 @@
+"""Temporal operators under scripted streams — the reference's
+`_stream` test variants (python/pathway/tests/temporal/
+test_windows_stream.py, test_interval_join_stream.py): every window
+kind and temporal join exercised with multi-epoch arrival, late data,
+retractions, and behavior cutoffs."""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib import temporal
+
+from .utils import T, assert_stream_equality, run_table
+
+
+def _by(rows, names, *cols):
+    idx = [names.index(c) for c in cols]
+    return sorted(tuple(r[i] for i in idx) for r in rows.values())
+
+
+def _state(table):
+    from pathway_tpu.debug import _run_capture
+
+    cap, names = _run_capture(table)
+    return cap.state, names
+
+
+# ---- windows under streaming arrival ------------------------------------
+
+
+def test_tumbling_window_updates_across_epochs():
+    t = T(
+        """
+      | t | v  | __time__ | __diff__
+    1 | 1 | 10 | 2        | 1
+    2 | 2 | 20 | 4        | 1
+    3 | 5 | 30 | 6        | 1
+    2 | 2 | 20 | 8        | -1
+    """
+    )
+    res = t.windowby(pw.this.t, window=temporal.tumbling(duration=4)).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+        n=pw.reducers.count(),
+    )
+    state, names = _state(res)
+    assert _by(state, names, "start", "total", "n") == [(0, 10, 1), (4, 30, 1)]
+
+
+def test_tumbling_window_stream_emits_revisions():
+    t = T(
+        """
+      | t | v  | __time__ | __diff__
+    1 | 1 | 10 | 2        | 1
+    2 | 2 | 20 | 4        | 1
+    """
+    )
+    res = t.windowby(pw.this.t, window=temporal.tumbling(duration=4)).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    assert_stream_equality(
+        res,
+        [((0, 10), 2, 1), ((0, 10), 4, -1), ((0, 30), 4, 1)],
+    )
+
+
+def test_sliding_window_membership_stream():
+    t = T(
+        """
+      | t | v | __time__ | __diff__
+    1 | 3 | 1 | 2        | 1
+    1 | 3 | 4 | -1
+    """.replace("1 | 3 | 4 | -1", "1 | 3 | 1 | 4        | -1")
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.sliding(hop=2, duration=4)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    # t=3 belongs to windows starting at 0 and 2; both retract fully
+    assert_stream_equality(
+        res,
+        [((0, 1), 2, 1), ((2, 1), 2, 1), ((0, 1), 4, -1), ((2, 1), 4, -1)],
+    )
+
+
+def test_session_window_merge_on_late_bridge():
+    """Two separate sessions MERGE when a bridging row arrives later —
+    the hardest session-window update case."""
+    t = T(
+        """
+      | t  | v | __time__ | __diff__
+    1 | 1  | 1 | 2        | 1
+    2 | 10 | 2 | 2        | 1
+    3 | 5  | 4 | 4        | 1
+    """
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.session(max_gap=5)
+    ).reduce(
+        n=pw.reducers.count(),
+        total=pw.reducers.sum(pw.this.v),
+    )
+    state, names = _state(res)
+    # after the bridge at t=5: one session [1,10] with all three rows
+    assert _by(state, names, "n", "total") == [(3, 7)]
+
+
+def test_session_window_splits_on_retraction():
+    t = T(
+        """
+      | t  | v | __time__ | __diff__
+    1 | 1  | 1 | 2        | 1
+    2 | 5  | 2 | 2        | 1
+    3 | 9  | 4 | 2        | 1
+    2 | 5  | 2 | 4        | -1
+    """
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.session(max_gap=5)
+    ).reduce(n=pw.reducers.count())
+    state, names = _state(res)
+    # bridge retracted: 1 and 9 stay one session only if gap <= 5 (8 > 5)
+    assert _by(state, names, "n") == [(1,), (1,)]
+
+
+def test_intervals_over_stream():
+    t = T(
+        """
+      | t | v | __time__ | __diff__
+    1 | 1 | 1 | 2        | 1
+    2 | 3 | 2 | 2        | 1
+    3 | 7 | 4 | 4        | 1
+    """
+    )
+    probes = T(
+        """
+      | at | __time__ | __diff__
+    7 | 4  | 2        | 1
+    """
+    )
+    res = t.windowby(
+        pw.this.t,
+        window=temporal.intervals_over(
+            at=probes.at, lower_bound=-3, upper_bound=0
+        ),
+    ).reduce(
+        at=pw.this._pw_window_end,  # upper_bound=0: end == probe location
+        total=pw.reducers.sum(pw.this.v),
+    )
+    state, names = _state(res)
+    # probe at 4 covers [1, 4]: rows t=1 and t=3
+    assert _by(state, names, "at", "total") == [(4, 3)]
+
+
+# ---- behaviors: Buffer/Forget/Freeze under late data --------------------
+
+
+def test_common_behavior_delay_buffers_emission():
+    """delay=d holds rows until the watermark passes start+d (BufferNode)."""
+    t = T(
+        """
+      | t | v  | __time__
+    1 | 1 | 10 | 0
+    2 | 2 | 20 | 2
+    3 | 9 | 30 | 4
+    """
+    )
+    res = t.windowby(
+        pw.this.t,
+        window=temporal.tumbling(duration=4),
+        behavior=temporal.common_behavior(delay=4),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    state, names = _state(res)
+    got = dict(_by(state, names, "start", "total"))
+    assert got.get(0) == 30  # both rows arrived before release: one emission
+
+
+def test_common_behavior_keep_results_false_drops_closed_windows():
+    t = T(
+        """
+      | t  | v  | __time__
+    1 | 1  | 10 | 0
+    2 | 9  | 20 | 2
+    3 | 20 | 30 | 4
+    """
+    )
+    res = t.windowby(
+        pw.this.t,
+        window=temporal.tumbling(duration=4),
+        behavior=temporal.common_behavior(cutoff=2, keep_results=False),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    state, names = _state(res)
+    starts = [s for s, _ in _by(state, names, "start", "total")]
+    assert 0 not in starts  # closed window swept from output
+    assert 20 in starts
+
+
+# ---- temporal join edge cases -------------------------------------------
+
+
+def test_interval_join_boundary_inclusive():
+    left = T(
+        """
+      | t | __time__ | __diff__
+    1 | 5 | 2        | 1
+    """
+    )
+    right = T(
+        """
+      | t | v | __time__ | __diff__
+    7 | 3 | 1 | 2        | 1
+    8 | 7 | 2 | 2        | 1
+    9 | 2 | 3 | 2        | 1
+    """
+    )
+    r = left.interval_join(
+        right, left.t, right.t, temporal.interval(-2, 2)
+    ).select(lt=left.t, rv=right.v)
+    rows = run_table(r)
+    # [-2, 2] inclusive: right at 3 and 7 match, 2 does not
+    assert sorted(rows.values()) == [(5, 1), (5, 2)]
+
+
+def test_interval_join_late_right_revises():
+    left = T(
+        """
+      | t | __time__ | __diff__
+    1 | 5 | 2        | 1
+    """
+    )
+    right = T(
+        """
+      | t | v | __time__ | __diff__
+    7 | 4 | 1 | 6        | 1
+    """
+    )
+    r = temporal.interval_join_left(
+        left, right, left.t, right.t, temporal.interval(-1, 1)
+    ).select(lt=left.t, rv=right.v)
+    assert_stream_equality(
+        r,
+        [((5, None), 2, 1), ((5, None), 6, -1), ((5, 1), 6, 1)],
+    )
+
+
+def test_asof_join_direction_and_retraction():
+    left = T(
+        """
+      | t | __time__ | __diff__
+    1 | 5 | 2        | 1
+    """
+    )
+    right = T(
+        """
+      | t | v | __time__ | __diff__
+    7 | 3 | 1 | 2        | 1
+    8 | 4 | 2 | 4        | 1
+    8 | 4 | 2 | 6        | -1
+    """
+    )
+    r = left.asof_join(right, left.t, right.t).select(lt=left.t, rv=right.v)
+    rows = run_table(r)
+    # after the t=4 retraction the nearest earlier right row is t=3 again
+    assert sorted(rows.values()) == [(5, 1)]
+
+
+def test_window_join_streamed():
+    left = T(
+        """
+      | t | a | __time__ | __diff__
+    1 | 1 | x | 2        | 1
+    """
+    )
+    right = T(
+        """
+      | t | b | __time__ | __diff__
+    7 | 2 | y | 4        | 1
+    8 | 6 | z | 4        | 1
+    """
+    )
+    r = left.window_join(
+        right, left.t, right.t, temporal.tumbling(duration=4)
+    ).select(a=left.a, b=right.b)
+    rows = run_table(r)
+    assert sorted(rows.values()) == [("x", "y")]
