@@ -242,6 +242,30 @@ class ColumnReference(ColumnExpression):
     def name(self):
         return self._name
 
+    def __call__(self, *args):
+        """Call a column of callables per row (pw.method columns:
+        ``table.select(r=table.c(10))``, reference MethodColumn)."""
+        from . import expression as _e
+
+        name = self._name
+
+        def call_cell(f, *a):
+            if callable(f):
+                return f(*a)
+            if f is None:
+                return None  # missing method cell (e.g. outer join)
+            raise TypeError(
+                f"column {name!r} holds {type(f).__name__}, not a "
+                "callable — only pw.method columns can be called"
+            )
+
+        # method cells read the transformer's CURRENT state, so the map
+        # is non-deterministic: the engine must replay memoized outputs
+        # on retraction instead of recomputing against newer state
+        return ApplyExpression(
+            call_cell, None, (self,) + args, {}, deterministic=False
+        )
+
     def _column_with_expression_cls(self, cls, *args, **kwargs):
         return cls(self, *args, **kwargs)
 

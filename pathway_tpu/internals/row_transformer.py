@@ -22,7 +22,12 @@ Usage (reference-compatible surface):
 
     result = compute_lengths(linked_list=my_table).linked_list
 
-Unsupported (reference-legacy, rarely used): pw.method columns.
+pw.method attributes are supported both as callables inside other
+attributes (``self.c(x)``) and as METHOD COLUMNS: ``result.c`` holds a
+per-row bound callable, and ``result.select(r=result.c(10))`` calls it
+per row (reference Method machinery, row_transformer.py:254 +
+complex_columns.rs). Method cells snapshot as (which, key, name)
+sentinels and re-bind to the restored node.
 """
 
 from __future__ import annotations
@@ -57,6 +62,11 @@ class _Attribute(_OutputAttribute):
     output column (reference pw.attribute)."""
 
 
+class _MethodAttribute(_OutputAttribute):
+    """Callable attribute: materializes as a column of per-row bound
+    callables (reference pw.method, Method row_transformer.py:254)."""
+
+
 def input_attribute(type: Any = None):  # noqa: A002 - reference signature
     return _InputAttribute()
 
@@ -69,12 +79,8 @@ def attribute(fn: Callable) -> _Attribute:
     return _Attribute(fn)
 
 
-def method(fn: Callable):
-    raise NotImplementedError(
-        "pw.method columns are not supported in this build (legacy "
-        "reference machinery); expose the computation as an "
-        "output_attribute or a pw.udf instead"
-    )
+def method(fn: Callable) -> "_MethodAttribute":
+    return _MethodAttribute(fn)
 
 
 class ClassArg:
@@ -86,6 +92,7 @@ class ClassArg:
         cls._inputs = {}
         cls._outputs = {}
         cls._computed = {}
+        cls._methods = {}
         for base in reversed(cls.__mro__):
             for name, v in vars(base).items():
                 if isinstance(v, _InputAttribute):
@@ -93,6 +100,8 @@ class ClassArg:
                     cls._inputs[name] = v
                 elif isinstance(v, _Attribute):
                     cls._computed[name] = v
+                elif isinstance(v, _MethodAttribute):
+                    cls._methods[name] = v
                 elif isinstance(v, _OutputAttribute):
                     cls._outputs[name] = v
         cls._input_index = {n: i for i, n in enumerate(cls._inputs)}
@@ -157,6 +166,11 @@ class _EvalContext:
             if row is None:
                 raise KeyError(f"{arg}[{key:#x}] not present")
             return row[cls._input_index[name]]
+        m = cls._methods.get(name)
+        if m is not None:
+            import functools
+
+            return functools.partial(m.fn, _RowRef(self, arg, key))
         fn_holder = cls._outputs.get(name) or cls._computed.get(name)
         if fn_holder is None:
             raise AttributeError(f"{arg} has no attribute {name!r}")
@@ -176,6 +190,56 @@ class _EvalContext:
         return value
 
 
+class BoundMethod:
+    """A pw.method cell: calling it evaluates the method against the
+    transformer's CURRENT state (reference MethodColumn semantics).
+    Equality includes the transformer's state version, so any input
+    change re-emits method rows and downstream consumers recompute
+    (methods may read ANY row, so this is the sound invalidation)."""
+
+    __slots__ = ("_node", "_which", "_key", "_name", "_ver")
+
+    def __init__(self, node, which: str, key: int, name: str):
+        self._node = node
+        self._which = which
+        self._key = key
+        self._name = name
+        self._ver = getattr(node, "state_ver", 0) if node is not None else -1
+
+    def __call__(self, *args):
+        if self._node is None:
+            raise RuntimeError(
+                f"pw.method cell {self._which}.{self._name} was detached "
+                "from its transformer (serialized across a process or "
+                "snapshot boundary); call it inside the producing process"
+            )
+        ctx = _EvalContext(self._node.spec, self._node.states)
+        return ctx.resolve(self._which, self._key, self._name)(*args)
+
+    def _binding(self):
+        return (self._which, self._key, self._name, self._ver)
+
+    def __eq__(self, other):
+        return isinstance(other, BoundMethod) and self._binding() == other._binding()
+
+    def __hash__(self):
+        return hash(self._binding())
+
+    def __reduce__(self):
+        # method cells can leak into downstream nodes' pickled state
+        # (operator snapshots, cross-process rows): serialize the
+        # binding, never the node (it holds locks/threads)
+        return (_detached_method, (self._which, self._key, self._name))
+
+    def __repr__(self):
+        return f"<pw.method {self._which}.{self._name} @ {self._key:#x}>"
+
+
+def _detached_method(which, key, name):
+    m = BoundMethod(None, which, key, name)
+    return m
+
+
 class _RowTransformerNode(df.Node):
     """Engine node computing one class arg's output attributes. Inputs:
     every class arg's table (port per arg); recomputes affected rows'
@@ -190,7 +254,31 @@ class _RowTransformerNode(df.Node):
         self.arg_order = arg_order
         self.states: dict[str, dict[int, tuple]] = {n: {} for n in arg_order}
         self.emitted: dict[int, tuple] = {}
-        self._snap_attrs = ("states", "emitted")
+        self.state_ver = 0
+
+    def snapshot_state(self):
+        def enc(v):
+            if isinstance(v, BoundMethod):
+                return ("__pw_method__",) + v._binding()
+            return v
+
+        return {
+            "states": self.states,
+            "emitted": {
+                k: tuple(enc(v) for v in row) for k, row in self.emitted.items()
+            },
+        }
+
+    def restore_state(self, state) -> None:
+        def dec(v):
+            if isinstance(v, tuple) and len(v) == 4 and v[0] == "__pw_method__":
+                return BoundMethod(self, v[1], v[2], v[3])
+            return v
+
+        self.states = state["states"]
+        self.emitted = {
+            k: tuple(dec(v) for v in row) for k, row in state["emitted"].items()
+        }
 
     def route_owner(self, key, row, port, n_shards):
         return 0  # cross-row pointer chasing needs the whole state
@@ -206,19 +294,25 @@ class _RowTransformerNode(df.Node):
                 changed = True
         if not changed:
             return
+        self.state_ver += 1
         ctx = _EvalContext(self.spec, self.states)
         cls = self.spec.args[self.which]
         out_names = list(cls._outputs)
+        method_names = list(cls._methods)
         updates: list = []
         live = self.states[self.which]
         for key in live:
             try:
-                row = tuple(ctx.resolve(self.which, key, n) for n in out_names)
+                row = tuple(
+                    ctx.resolve(self.which, key, n) for n in out_names
+                ) + tuple(
+                    BoundMethod(self, self.which, key, n) for n in method_names
+                )
             except Exception as exc:
                 # per-row failure (dangling pointer, user bug): route it
                 # like every other operator — abort, or ERROR cells + log
                 self.graph.report_row_error(self, exc)
-                row = tuple(ERROR for _ in out_names)
+                row = tuple(ERROR for _ in out_names + method_names)
             old = self.emitted.get(key)
             if old is not None and rows_equal(old, row):
                 continue
@@ -255,7 +349,10 @@ class Transformer:
         ]
         out = {}
         for which, cls in self.args.items():
-            cols = {n: Column(dt_mod.ANY) for n in cls._outputs}
+            cols = {
+                n: Column(dt_mod.ANY)
+                for n in list(cls._outputs) + list(cls._methods)
+            }
             op = LogicalOp(
                 "row_transformer",
                 ins,

@@ -93,13 +93,27 @@ class ObjectCache:
         # unique tmp names: concurrent writers sharing a cache dir must
         # never truncate each other's in-flight blob
         fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
-        with os.fdopen(fd, "wb") as f:
-            f.write(payload)
-        os.replace(tmp, blob)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, blob)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         fdm, tmpm = tempfile.mkstemp(dir=self.root, suffix=".tmp")
-        with os.fdopen(fdm, "w") as f:
-            json.dump({"key": key, "version": _jsonable(version)}, f)
-        os.replace(tmpm, meta)
+        try:
+            with os.fdopen(fdm, "w") as f:
+                json.dump({"key": key, "version": _jsonable(version)}, f)
+            os.replace(tmpm, meta)
+        except BaseException:
+            try:
+                os.unlink(tmpm)
+            except OSError:
+                pass
+            raise
 
     def drop(self, key: str) -> None:
         import os

@@ -8,11 +8,15 @@ reader launches it per sync, forwards RECORD payloads into the engine,
 and persists the latest STATE blob through the connector-offset channel
 so incremental syncs resume across restarts.
 
-Execution: the reference installs connectors from PyPI into a venv or
-runs their docker image; in this sandboxed build the connector command
-is supplied explicitly (``executable=[...]`` argv or a Python
-``source=`` callable yielding protocol messages) — the record/state
-machinery is identical.
+Execution (the serverless runtime, reference
+third_party/airbyte_serverless/sources.py): a connector resolves to
+- an explicit ``executable=[...]`` argv or Python ``source=`` callable,
+- ``docker run --rm -i --volume <tmp>:<tmp> <image>`` when the config
+  names a ``docker_image`` and docker is available
+  (DockerAirbyteSource :88), or
+- a per-connector virtualenv with ``airbyte-<name>`` pip-installed
+  once and cached (VenvAirbyteSource :137) when
+  ``enforce_method="pypi"`` or docker is absent.
 """
 
 from __future__ import annotations
@@ -30,17 +34,90 @@ from ..internals.table import Table
 from ._connector import StreamingContext, input_table_from_reader
 
 
-def _messages_from_executable(argv: list[str], config: dict, state: Any):
+def _docker_argv(image: str, mount_dir: str, env_vars: dict | None = None) -> list[str]:
+    """``docker run`` argv for a connector image; the sync tempdir is
+    volume-mounted at the same path so --config/--state resolve inside
+    the container (reference DockerAirbyteSource sources.py:88-111)."""
+    argv = ["docker", "run", "--rm", "-i", "--volume", f"{mount_dir}:{mount_dir}"]
+    for k, v in (env_vars or {}).items():
+        argv += ["-e", f"{k}={v}"]
+    return argv + [image]
+
+
+def _venv_executable(
+    connector_name: str, cache_dir: str | None = None, tag: str = ""
+) -> str:
+    """Install ``airbyte-<connector>`` into a cached per-connector venv
+    and return its console-script path (reference VenvAirbyteSource
+    sources.py:137-170 — same pip contract, but the venv is cached
+    under ~/.cache instead of rebuilt per run)."""
+    import os
+    import subprocess as sp
+    import venv as _venv
+
+    root = cache_dir or os.path.join(
+        os.path.expanduser("~"), ".cache", "pathway_tpu", "airbyte_venvs"
+    )
+    # cache keyed by (name, docker tag): a version bump in the config
+    # reinstalls instead of silently reusing the first-ever install
+    # (PyPI versions don't map to docker tags — reference sources.py:26
+    # — so the install itself stays unpinned, but never goes stale
+    # against a changed config)
+    vdir = os.path.join(root, f"{connector_name}@{tag or 'latest'}")
+    exe = os.path.join(vdir, "bin", connector_name)
+    if os.path.exists(exe):
+        return exe
+    os.makedirs(root, exist_ok=True)
+    _venv.create(vdir, with_pip=True)
+    pip = os.path.join(vdir, "bin", "pip")
+    proc = sp.run(
+        [pip, "install", f"airbyte-{connector_name}"],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0 or not os.path.exists(exe):
+        raise RuntimeError(
+            f"installing airbyte-{connector_name} into a venv failed "
+            f"(rc={proc.returncode}): {proc.stderr[-1000:]}"
+        )
+    return exe
+
+
+def _resolve_source_spec(
+    config: dict, enforce_method: str | None, env_vars: dict | None
+):
+    """Reference-style config: {source: {docker_image: ..., config:
+    {...}}} -> (argv_factory, connector_config). Python-implemented
+    connectors run from a pip venv; anything else through docker."""
+    import shutil
+
+    spec = config.get("source", config)
+    image = spec.get("docker_image")
+    if image is None:
+        return None, None
+    connector_config = spec.get("config") or {}
+    name, _, tag = image.removeprefix("airbyte/").partition(":")
+    if enforce_method == "pypi" or (
+        enforce_method != "docker" and shutil.which("docker") is None
+    ):
+        exe = _venv_executable(name, tag=tag)
+        return (lambda td: [exe]), connector_config
+    return (lambda td: _docker_argv(image, td, env_vars)), connector_config
+
+
+def _messages_from_executable(argv, config: dict, state: Any):
     """Run one sync of an Airbyte connector subprocess, yielding parsed
-    protocol messages."""
+    protocol messages. ``argv`` is a list or a callable(tempdir) ->
+    list (docker needs the tempdir mounted)."""
     import os
     import tempfile
 
     with tempfile.TemporaryDirectory() as td:
+        cmd_prefix = list(argv(td) if callable(argv) else argv)
         cfg_path = os.path.join(td, "config.json")
         with open(cfg_path, "w") as f:
             json.dump(config, f)
-        cmd = list(argv) + ["read", "--config", cfg_path]
+        cmd = cmd_prefix + ["read", "--config", cfg_path]
         if state is not None:
             state_path = os.path.join(td, "state.json")
             with open(state_path, "w") as f:
@@ -73,7 +150,7 @@ def _messages_from_executable(argv: list[str], config: dict, state: Any):
         if completed and proc.returncode not in (0, None):
             err = proc.stderr.read() if proc.stderr else ""
             raise RuntimeError(
-                f"airbyte connector {argv[0]!r} exited with code "
+                f"airbyte connector {cmd_prefix[0]!r} exited with code "
                 f"{proc.returncode}: {err[-2000:]}"
             )
 
@@ -101,11 +178,18 @@ def read(
         with open(config_file_path) as f:
             config = yaml.safe_load(f)
     if source is None and executable is None:
-        raise NotImplementedError(
-            "airbyte.read: connector auto-install (PyPI venv / docker) is "
-            "unavailable in this build; pass executable=[...] (connector "
-            "argv) or source=callable yielding Airbyte protocol messages"
+        # serverless runtime: resolve docker_image -> docker run argv,
+        # or a cached pip venv for Python-implemented connectors
+        executable, connector_config = _resolve_source_spec(
+            config, kwargs.pop("enforce_method", None), kwargs.pop("env_vars", None)
         )
+        if executable is None:
+            raise ValueError(
+                "airbyte.read: provide executable=[...] argv, "
+                "source=callable, or a config with source.docker_image "
+                "(resolved via docker or a pip venv)"
+            )
+        config = connector_config
     wanted = set(streams) if streams else None
 
     schema = schema_builder(

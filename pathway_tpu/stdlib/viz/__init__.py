@@ -1,30 +1,29 @@
-"""pw.stdlib.viz (reference stdlib/viz/): table repr + plotting hooks."""
+"""pw.stdlib.viz (reference stdlib/viz/): live table repr + plotting.
+
+Attaches ``Table.show`` / ``Table.plot`` / ``_repr_mimebundle_`` the
+way the reference does (table_viz.py, plotting.py)."""
 
 from __future__ import annotations
 
 from ...internals.table import Table
+from .plotting import LivePlotView, plot
+from .table_viz import LiveTableView, show
 
 
 def table_viz(table: Table, **kwargs):
-    """Return a pandas styler for notebook display."""
-    from ...debug import table_to_pandas
-
-    df = table_to_pandas(table)
+    """Back-compat helper: a pandas styler / view for notebook display."""
+    view = LiveTableView(table)
+    df = view.to_pandas()
     try:
         return df.style
     except Exception:
         return df
 
 
-def plot(table: Table, plotting_function=None, sorting_col=None):
-    from ...debug import table_to_pandas
+# explicit methods only: a bare `t` in a notebook must NOT run the
+# graph or register subscriptions as a repr side effect — users call
+# t.show() / t.plot() deliberately (they run/subscribe, documented)
+Table.show = show
+Table.plot = plot
 
-    df = table_to_pandas(table)
-    if sorting_col:
-        df = df.sort_values(sorting_col)
-    if plotting_function is None:
-        return df.plot()
-    return plotting_function(df)
-
-
-__all__ = ["plot", "table_viz"]
+__all__ = ["LivePlotView", "LiveTableView", "plot", "show", "table_viz"]

@@ -10,7 +10,7 @@ import pytest
 
 import pathway_tpu as pw
 from pathway_tpu.internals.graph_runner import GraphRunner
-from .utils import run_table
+from .utils import T, run_table
 
 
 def _linked_list(values):
@@ -180,6 +180,124 @@ def test_cycle_detection():
     pw.clear_graph()
 
 
-def test_method_unsupported():
-    with pytest.raises(NotImplementedError):
-        pw.method(lambda self: 1)
+def test_method_returns_attribute():
+    m = pw.method(lambda self: 1)
+    from pathway_tpu.internals.row_transformer import _MethodAttribute
+
+    assert isinstance(m, _MethodAttribute)
+
+
+def test_method_column_called_in_select():
+    """pw.method columns (reference row_transformer.py:254 Method +
+    tests/test_transformers.py:288): the column holds per-row bound
+    callables; calling it in a select evaluates per row."""
+
+    @pw.transformer
+    class foo_transformer:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.output_attribute
+            def b(self) -> int:
+                return self.a * 10
+
+            @pw.method
+            def c(self, arg) -> int:
+                return (self.a + self.b) * arg
+
+    t = T(
+        """
+      | a
+    1 | 1
+    2 | 2
+    3 | 3
+    """
+    )
+    mt = foo_transformer(table=t).table
+    r = mt.select(ret=mt.c(10))
+    assert sorted(run_table(r).values()) == [(110,), (220,), (330,)]
+
+
+def test_method_called_from_output_attribute():
+    """self.c(x) inside another attribute (reference
+    test_transformers.py:253 test_call_self_method)."""
+
+    @pw.transformer
+    class foo_transformer:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.output_attribute
+            def b(self) -> int:
+                return self.a + self.c(self.a)
+
+            @pw.method
+            def c(self, arg) -> int:
+                return self.a * arg
+
+    t = T(
+        """
+      | a
+    1 | 1
+    """
+    )
+    mt = foo_transformer(table=t).table
+    assert list(run_table(mt.select(ret=mt.b)).values()) == [(2,)]
+
+
+def test_method_column_streams_with_state():
+    """Method cells evaluate against CURRENT transformer state: a later
+    epoch's input update changes what an earlier-bound method returns."""
+
+    @pw.transformer
+    class foo_transformer:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.method
+            def scaled(self, k) -> int:
+                return self.a * k
+
+    t = T(
+        """
+      | a | __time__ | __diff__
+    1 | 1 | 2        | 1
+    2 | 5 | 4        | 1
+    """
+    )
+    mt = foo_transformer(table=t).table
+    r = mt.select(ret=mt.scaled(3))
+    assert sorted(run_table(r).values()) == [(3,), (15,)]
+
+
+def test_method_column_invalidates_on_state_change():
+    """Regression (r3 review): a state update that only method cells
+    observe must re-emit the method rows so downstream selects
+    recompute — method cells read ANY row, so every input change
+    invalidates them."""
+
+    @pw.transformer
+    class foo_transformer:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.method
+            def plus_peer_sum(self, k) -> int:
+                # reads every row: state changes invisible to outputs
+                total = 0
+                for key in list(self.transformer.table._ctx.states["table"]):
+                    total += self.transformer.table[pw.Pointer(key)].a
+                return total * k
+
+    t = T(
+        """
+      | a | __time__ | __diff__
+    1 | 1 | 2        | 1
+    2 | 4 | 4        | 1
+    """
+    )
+    mt = foo_transformer(table=t).table
+    r = mt.select(ret=mt.plus_peer_sum(10))
+    rows = run_table(r)
+    # final state: both rows see the FULL final sum (1+4)*10
+    assert sorted(rows.values()) == [(50,), (50,)]
