@@ -96,6 +96,35 @@ def _realistic_chunks(n: int, words: int = 130) -> list[str]:
     return out
 
 
+def bench_chip_peak_probe() -> float:
+    """Sustained bf16 matmul rate of the attached chip (4096^3, 16
+    chained) — context for vs_baseline: the per-chip target assumes a
+    full v5e-class part, while tunneled/virtualized chips may sustain a
+    fraction of that regardless of framework quality."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.ones((4096, 4096), jnp.bfloat16)
+    b = jnp.ones((4096, 4096), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        # carry-dependent operand (no loop hoisting) and a full-product
+        # reduction (no slice-of-dot simplification): XLA must run all
+        # 16 matmuls end to end
+        def body(c, _):
+            out = (a + c.astype(jnp.bfloat16)) @ b
+            return jnp.sum(out, dtype=jnp.float32) * jnp.float32(1e-12), None
+
+        return jax.lax.scan(body, jnp.float32(0), None, length=16)[0]
+
+    np.asarray(mm(a, b))
+    t0 = time.perf_counter()
+    np.asarray(mm(a, b))
+    dt = time.perf_counter() - t0
+    return round(2 * 4096**3 * 16 / dt / 1e12, 1)
+
+
 def bench_framework_path(words: int = 130, n: int = 32768) -> float:
     """Strings -> device-resident embeddings through the embedder's
     ``encode_device`` ingest surface, at realistic chunk lengths
@@ -124,6 +153,7 @@ def main() -> None:
     raw_eps, n_chips = bench_device_scan()
     fw_eps = bench_framework_path()
     fw_per_chip = fw_eps / n_chips
+    peak = bench_chip_peak_probe()
     print(
         json.dumps(
             {
@@ -138,6 +168,10 @@ def main() -> None:
                 "device_scan_eps": round(raw_eps, 1),
                 "device_scan_mode": "jit lax.scan, synthetic S=32 ids — "
                 "upper bound, not the headline",
+                "chip_peak_probe_tflops": peak,
+                "chip_peak_note": "sustained bf16 4096^3 matmul on the "
+                "attached chip; the 62.5k/chip target assumes ~200 TFLOPs "
+                "(full v5e) — vs_baseline scales with this probe",
             }
         )
     )

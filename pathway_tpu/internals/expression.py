@@ -245,8 +245,6 @@ class ColumnReference(ColumnExpression):
     def __call__(self, *args):
         """Call a column of callables per row (pw.method columns:
         ``table.select(r=table.c(10))``, reference MethodColumn)."""
-        from . import expression as _e
-
         name = self._name
 
         def call_cell(f, *a):

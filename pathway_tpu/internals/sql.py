@@ -160,7 +160,8 @@ class _Parser:
 
     _CLAUSE_KWS = frozenset(
         {"from", "where", "group", "having", "order", "limit",
-         "join", "inner", "left", "right", "full", "outer", "on", "as"}
+         "join", "inner", "left", "right", "full", "outer", "on", "as",
+         "union", "intersect", "all"}
     )
 
     def _parse_table_ref(self):
@@ -432,6 +433,28 @@ def sql(query: str, **tables: Table) -> Table:
     """
     parser = _Parser(query, tables)
     result = parser.parse_select()
+    # set operations between SELECTs (reference sql.py:336 _union /
+    # :352 _intersect): UNION ALL = concat; UNION/INTERSECT distinct
+    def distinct(t: Table) -> Table:
+        cols = [t[c] for c in t.column_names()]
+        return t.groupby(*cols).reduce(*cols)
+
+    def intersect_chain(left: Table) -> Table:
+        # INTERSECT binds tighter than UNION (standard SQL precedence)
+        while parser.accept_kw("intersect"):
+            right = parser.parse_select()
+            left = distinct(left).intersect(distinct(right))
+        return left
+
+    result = intersect_chain(result)
+    while True:
+        if parser.accept_kw("union") is None:
+            break
+        all_ = parser.accept_kw("all") is not None
+        right = intersect_chain(parser.parse_select())
+        result = result.concat_reindex(right)
+        if not all_:
+            result = distinct(result)
     if parser.peek()[0] != "eof":
         raise ValueError(
             f"SQL: unsupported trailing syntax at {parser.peek()[1]!r}"

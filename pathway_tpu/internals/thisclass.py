@@ -28,6 +28,15 @@ class ThisMetaclass(type):
             raise AttributeError(name)
         return ColumnReference(cls, name)
 
+    def __iter__(cls):
+        # `*pw.this` has no column list until desugaring; without this
+        # guard, star-unpacking falls back to __getitem__ with growing
+        # integer indexes and spins forever
+        raise TypeError(
+            "pw.this cannot be unpacked: list the columns explicitly "
+            "(e.g. t.groupby(*[t[c] for c in t.column_names()]))"
+        )
+
     def __getitem__(cls, name):
         if isinstance(name, (list, tuple)):
             return [ColumnReference(cls, n if isinstance(n, str) else n._name) for n in name]

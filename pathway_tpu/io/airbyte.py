@@ -68,18 +68,37 @@ def _venv_executable(
     if os.path.exists(exe):
         return exe
     os.makedirs(root, exist_ok=True)
-    _venv.create(vdir, with_pip=True)
-    pip = os.path.join(vdir, "bin", "pip")
-    proc = sp.run(
-        [pip, "install", f"airbyte-{connector_name}"],
-        capture_output=True,
-        text=True,
-    )
-    if proc.returncode != 0 or not os.path.exists(exe):
-        raise RuntimeError(
-            f"installing airbyte-{connector_name} into a venv failed "
-            f"(rc={proc.returncode}): {proc.stderr[-1000:]}"
+    # install into a private tmp dir, rename into place when COMPLETE:
+    # concurrent processes (pathway spawn) must never observe a
+    # half-installed venv (same discipline as ObjectCache.put)
+    import tempfile
+
+    tmp = tempfile.mkdtemp(dir=root, prefix=f".{connector_name}.")
+    try:
+        _venv.create(tmp, with_pip=True)
+        pip = os.path.join(tmp, "bin", "pip")
+        proc = sp.run(
+            [pip, "install", f"airbyte-{connector_name}"],
+            capture_output=True,
+            text=True,
         )
+        tmp_exe = os.path.join(tmp, "bin", connector_name)
+        if proc.returncode != 0 or not os.path.exists(tmp_exe):
+            raise RuntimeError(
+                f"installing airbyte-{connector_name} into a venv failed "
+                f"(rc={proc.returncode}): {proc.stderr[-1000:]}"
+            )
+        try:
+            os.rename(tmp, vdir)
+        except OSError:
+            pass  # another process won the race with a complete venv
+    finally:
+        if os.path.isdir(tmp) and tmp != vdir:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    if not os.path.exists(exe):
+        raise RuntimeError(f"venv install for {connector_name} left no {exe}")
     return exe
 
 

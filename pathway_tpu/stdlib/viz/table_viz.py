@@ -172,6 +172,4 @@ def show(
     )
 
 
-def _repr_mimebundle_(self: Table, include=None, exclude=None):
-    view = show(self, snapshot=True)
-    return {"text/html": view._repr_html_(), "text/plain": repr(view)}
+
