@@ -159,6 +159,8 @@ def table_from_rows(
     is_stream: bool = False,
 ) -> Table:
     """rows: tuples of column values; when is_stream, trailing (time, diff)."""
+    from ..io._connector import coerce_to_schema
+
     dtypes = schema.dtypes()
     names = list(dtypes.keys())
     pk = schema.primary_key_columns()
@@ -169,6 +171,8 @@ def table_from_rows(
             data, time, diff = row[: len(names)], row[len(names)], row[len(names) + 1] if len(row) > len(names) + 1 else 1
         else:
             data, time, diff = row[: len(names)], 0, 1
+        # schema-driven coercion, same contract as the connector path
+        data = coerce_to_schema(dict(zip(names, data)), dtypes)
         if pk:
             key = ref_scalar(*[data[names.index(n)] for n in pk])
         else:

@@ -324,9 +324,11 @@ def dtype_from_type(t: Any) -> DType:
     if t is dict or t is list:
         return JSON
 
+    import types as _types
+
     origin = typing.get_origin(t)
     args = typing.get_args(t)
-    if origin is typing.Union:
+    if origin is typing.Union or origin is getattr(_types, "UnionType", None):
         non_none = [a for a in args if a is not type(None)]
         if len(non_none) == len(args):
             return ANY

@@ -187,6 +187,11 @@ class ColumnExpression:
     def _deps(self) -> list["ColumnExpression"]:
         return []
 
+    def _refresh_dtype(self) -> None:
+        """Recompute _dtype from (possibly rewritten) children — called
+        after pw.this references resolve to real table columns, so type
+        inference sees the concrete operand types."""
+
     def _repr_inner(self) -> str:
         return type(self).__name__
 
@@ -329,7 +334,12 @@ class ColumnBinaryOpExpression(ColumnExpression):
         self._op = op
         self._left = smart_wrap(left)
         self._right = smart_wrap(right)
-        self._dtype = _binary_result_type(op, self._left._dtype, self._right._dtype)
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
+        self._dtype = _binary_result_type(
+            self._op, self._left._dtype, self._right._dtype
+        )
 
     @property
     def _deps(self):
@@ -344,7 +354,14 @@ class ColumnUnaryOpExpression(ColumnExpression):
         super().__init__()
         self._op = op
         self._expr = smart_wrap(expr)
-        self._dtype = dt.BOOL if op == "~" and self._expr._dtype is dt.BOOL else self._expr._dtype
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
+        self._dtype = (
+            dt.BOOL
+            if self._op == "~" and self._expr._dtype is dt.BOOL
+            else self._expr._dtype
+        )
 
     @property
     def _deps(self):
@@ -396,8 +413,13 @@ class CastExpression(ColumnExpression):
         super().__init__()
         self._target = dt.wrap(target)
         self._expr = smart_wrap(expr)
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
         self._dtype = self._target
-        if dt.is_optional(self._expr._dtype) and not isinstance(self._target, dt.Optional):
+        if dt.is_optional(self._expr._dtype) and not isinstance(
+            self._target, dt.Optional
+        ):
             self._dtype = dt.Optional(self._target)
 
     @property
@@ -440,6 +462,9 @@ class UnwrapExpression(ColumnExpression):
     def __init__(self, expr: Any):
         super().__init__()
         self._expr = smart_wrap(expr)
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
         self._dtype = dt.unoptionalize(self._expr._dtype)
 
     @property
@@ -452,6 +477,9 @@ class FillErrorExpression(ColumnExpression):
         super().__init__()
         self._expr = smart_wrap(expr)
         self._replacement = smart_wrap(replacement)
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
         self._dtype = dt.lub(self._expr._dtype, self._replacement._dtype)
 
     @property
@@ -465,6 +493,9 @@ class IfElseExpression(ColumnExpression):
         self._if = smart_wrap(if_)
         self._then = smart_wrap(then)
         self._else = smart_wrap(else_)
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
         self._dtype = dt.lub(self._then._dtype, self._else._dtype)
 
     @property
@@ -476,6 +507,9 @@ class CoalesceExpression(ColumnExpression):
     def __init__(self, *args: Any):
         super().__init__()
         self._args = [smart_wrap(a) for a in args]
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
         result = self._args[-1]._dtype
         for a in reversed(self._args[:-1]):
             result = dt.lub(dt.unoptionalize(a._dtype), result)
@@ -494,6 +528,9 @@ class RequireExpression(ColumnExpression):
         super().__init__()
         self._val = smart_wrap(val)
         self._args = [smart_wrap(a) for a in args]
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
         self._dtype = dt.Optional(self._val._dtype)
 
     @property
@@ -520,6 +557,9 @@ class MakeTupleExpression(ColumnExpression):
     def __init__(self, *args: Any):
         super().__init__()
         self._args = [smart_wrap(a) for a in args]
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
         self._dtype = dt.Tuple(*[a._dtype for a in self._args])
 
     @property
@@ -534,7 +574,11 @@ class SequenceGetExpression(ColumnExpression):
         self._index = smart_wrap(index)
         self._default = smart_wrap(default)
         self._check_if_exists = check_if_exists
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
         base = self._expr._dtype
+        check_if_exists = self._check_if_exists
         if isinstance(base, dt.Tuple) and base.args is not Ellipsis and isinstance(self._index, ConstColumnExpression) and isinstance(self._index._val, int) and -len(base.args) <= self._index._val < len(base.args):
             self._dtype = base.args[self._index._val]
         elif isinstance(base, dt.List):
@@ -587,7 +631,10 @@ class ReducerExpression(ColumnExpression):
         self._args = [smart_wrap(a) for a in args]
         self._kwargs = kwargs
         self._return_dtype = return_dtype
-        self._dtype = return_dtype or self._infer()
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
+        self._dtype = self._return_dtype or self._infer()
 
     def _infer(self) -> dt.DType:
         name = self._reducer_name

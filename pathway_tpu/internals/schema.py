@@ -5,6 +5,8 @@ metaclass :~100+, column_definition, schema_from_types/pandas/dict)."""
 
 from __future__ import annotations
 
+import re
+
 import typing
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Mapping
@@ -169,7 +171,19 @@ def _dtype_from_str(ann: str) -> dt.DType:
         "Any": dt.ANY,
         "any": dt.ANY,
     }
-    return simple.get(ann.strip(), dt.ANY)
+    ann = ann.strip()
+    # PEP 604 / typing.Optional in string annotations (from __future__
+    # import annotations): "int | None", "Optional[int]"
+    if "|" in ann:
+        parts = [p.strip() for p in ann.split("|")]
+        non_none = [p for p in parts if p != "None"]
+        if len(non_none) == 1 and len(parts) == 2 and non_none[0] in simple:
+            return dt.Optional(simple[non_none[0]])
+        return dt.ANY
+    m = re.fullmatch(r"(?:typing\.)?Optional\[(\w+)\]", ann)
+    if m and m.group(1) in simple:
+        return dt.Optional(simple[m.group(1)])
+    return simple.get(ann, dt.ANY)
 
 
 class Schema(metaclass=SchemaMetaclass):

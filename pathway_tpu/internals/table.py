@@ -786,6 +786,8 @@ def _rewrite(expr: ColumnExpression, map_table: Callable):
         if kchanged:
             new._kwargs = nk
             changed = True
+    if changed:
+        new._refresh_dtype()
     return new if changed else expr
 
 

@@ -46,7 +46,7 @@ def _docker_argv(image: str, mount_dir: str, env_vars: dict | None = None) -> li
 
 def _venv_executable(
     connector_name: str, cache_dir: str | None = None, tag: str = ""
-) -> str:
+) -> list[str]:
     """Install ``airbyte-<connector>`` into a cached per-connector venv
     and return its console-script path (reference VenvAirbyteSource
     sources.py:137-170 — same pip contract, but the venv is cached
@@ -65,8 +65,12 @@ def _venv_executable(
     # against a changed config)
     vdir = os.path.join(root, f"{connector_name}@{tag or 'latest'}")
     exe = os.path.join(vdir, "bin", connector_name)
+    py = os.path.join(vdir, "bin", "python")
+    # invoke through the venv's interpreter: console-script shebangs
+    # point at the BUILD directory (we install into a tmp dir and
+    # rename into place), so direct execution would hit a dead path
     if os.path.exists(exe):
-        return exe
+        return [py, exe]
     os.makedirs(root, exist_ok=True)
     # install into a private tmp dir, rename into place when COMPLETE:
     # concurrent processes (pathway spawn) must never observe a
@@ -99,7 +103,7 @@ def _venv_executable(
             shutil.rmtree(tmp, ignore_errors=True)
     if not os.path.exists(exe):
         raise RuntimeError(f"venv install for {connector_name} left no {exe}")
-    return exe
+    return [py, exe]
 
 
 def _resolve_source_spec(
@@ -119,8 +123,8 @@ def _resolve_source_spec(
     if enforce_method == "pypi" or (
         enforce_method != "docker" and shutil.which("docker") is None
     ):
-        exe = _venv_executable(name, tag=tag)
-        return (lambda td: [exe]), connector_config
+        argv = _venv_executable(name, tag=tag)
+        return (lambda td: list(argv)), connector_config
     return (lambda td: _docker_argv(image, td, env_vars)), connector_config
 
 

@@ -1,7 +1,9 @@
-"""pw.stdlib.viz (reference stdlib/viz/): live table repr + plotting.
+"""pw.stdlib.viz (reference stdlib/viz/): live table views + plotting.
 
-Attaches ``Table.show`` / ``Table.plot`` / ``_repr_mimebundle_`` the
-way the reference does (table_viz.py, plotting.py)."""
+Attaches ``Table.show`` / ``Table.plot`` (reference table_viz.py,
+plotting.py). Unlike the reference, NO notebook repr hook is installed:
+rendering a bare table must never run the graph or register
+subscriptions as a side effect — call ``t.show()`` deliberately."""
 
 from __future__ import annotations
 
