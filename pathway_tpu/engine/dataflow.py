@@ -365,12 +365,26 @@ class SessionSourceNode(Node):
     def feed_replay(self, time) -> None:
         while self.replay_batches and self.replay_batches[0][0] == time:
             _, ups = self.replay_batches.pop(0)
-            for key, row, diff in ups:
-                if diff > 0:
-                    self.state[key] = row
-                else:
-                    self.state.pop(key, None)
-            self.emit(list(ups), time)
+            self._apply_replay(ups, time)
+
+    def flush_replay(self, time) -> bool:
+        """Emit ALL remaining recovered batches at ``time`` — the
+        multi-process worker path rebuilds state in one dedicated
+        replay round instead of per-logged-epoch feeding."""
+        fed = False
+        while self.replay_batches:
+            _, ups = self.replay_batches.pop(0)
+            self._apply_replay(ups, time)
+            fed = True
+        return fed
+
+    def _apply_replay(self, ups, time) -> None:
+        for key, row, diff in ups:
+            if diff > 0:
+                self.state[key] = row
+            else:
+                self.state.pop(key, None)
+        self.emit(list(ups), time)
 
     def feed_batch(self, raw: list[Update], time) -> list[Update]:
         out: list[Update] = []

@@ -606,12 +606,19 @@ class MethodCallExpression(ColumnExpression):
         self._fn = fn
         self._args = [smart_wrap(a) for a in args]
         self._propagate_none = propagate_none
-        if return_type is None:
+        self._return_type = return_type
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
+        if self._return_type is None:
             self._dtype = dt.ANY
-        else:
-            self._dtype = dt.wrap(return_type)
-            if propagate_none and any(dt.is_optional(a._dtype) and a._dtype is not dt.ANY for a in self._args):
-                self._dtype = dt.Optional(self._dtype)
+            return
+        self._dtype = dt.wrap(self._return_type)
+        if self._propagate_none and any(
+            dt.is_optional(a._dtype) and a._dtype is not dt.ANY
+            for a in self._args
+        ):
+            self._dtype = dt.Optional(self._dtype)
 
     @property
     def _deps(self):

@@ -171,16 +171,10 @@ def input_table_from_reader(
         node.parallel_readers = parallel_readers
         ctx = StreamingContext(node.session, schema)
         if parallel_readers and ctx.n_processes > 1:
-            if persistent_id is not None:
-                # input logs + offsets live on process 0 only: worker-fed
-                # batches would be replayed from operator snapshots AND
-                # re-read by the restarted worker reader -> double ingest
-                raise NotImplementedError(
-                    "persistent_id with parallel_readers in a multi-process "
-                    "run is not supported yet: worker-side input is not "
-                    "persisted. Drop parallel_readers (single-reader mode "
-                    "is persistent) or run single-process."
-                )
+            # each process logs its partition slice under its own
+            # persistence namespace (EnginePersistence proc-<pid>/) and
+            # recovers it on restart — reference per-worker storage,
+            # src/persistence/tracker.rs:49
             ctx._key_salt = ctx.process_id
 
         def run():

@@ -38,8 +38,12 @@ key shard (the reference's forward mode, graph.rs:943); sources built
 with ``parallel_readers=True`` start a reader on EVERY process, each
 reading its own partition slice (graph.rs:943-950 partitioned mode).
 Workers suppress sink callbacks — delivery stays on process 0.
-Worker-side input is not persisted yet; persistent_id +
-parallel_readers is rejected at build time.
+Partitioned sources persist per process: each worker logs its slice
+under its own EnginePersistence namespace (proc-<pid>/), recovers it on
+restart, and reports its replay frontier in the hello so the
+coordinator's epoch numbering continues past every process's logged
+times; cluster-wide operator snapshots carry worker state, and the
+RESTORE broadcast's snapshot time trims already-snapshotted replay.
 
 Trust boundary: after an authenticated JSON handshake, frames are
 pickled (rows may hold arbitrary python values), so a peer that knows
@@ -143,6 +147,7 @@ class CoordinatorCluster(ShardCluster):
         srv.listen(processes)
         srv.settimeout(accept_timeout)
         self._conns: dict[int, socket.socket] = {}
+        self._worker_frontiers: list[int] = []
         sig = _graph_sig(engines[0])
         token = cluster_token()
         try:
@@ -172,6 +177,9 @@ class CoordinatorCluster(ShardCluster):
                     raise RuntimeError("PATHWAY_THREADS differs across processes")
                 _send_json(conn, {"op": "welcome", "token": token})
                 self._conns[hello["pid"]] = conn
+                self._worker_frontiers.append(
+                    int(hello.get("replay_frontier", -1))
+                )
         finally:
             srv.close()
         # relay buffer: worker→worker mail waiting for the next round
@@ -221,6 +229,28 @@ class CoordinatorCluster(ShardCluster):
             self._poll_replies = self._broadcast({"op": "poll"})
         return self._poll_replies
 
+    def _setup_persistence(self) -> None:
+        super()._setup_persistence()
+        # epoch numbering must clear every process's logged times, not
+        # just process 0's
+        wf = max(self._worker_frontiers, default=-1)
+        for e in self.engines:
+            e.replay_frontier = max(e.replay_frontier, wf)
+        if wf >= 0:
+            # dedicated replay round AT the frontier: workers flush
+            # recovered batches, state rebuilds cluster-wide, and sinks
+            # (time <= replay_frontier) do not re-deliver
+            t = self.engines[0].replay_frontier
+            for e in self.engines:
+                e.current_time = t
+                e._frontier_hooks(t)
+            self._replay_only_feed = True
+            try:
+                self.set_epoch_frontier(t)
+                self._sweep(t)
+            finally:
+                self._replay_only_feed = False
+
     def _remote_input_pending(self) -> bool:
         if not self._has_partitioned_sources():
             return False
@@ -252,6 +282,7 @@ class CoordinatorCluster(ShardCluster):
                     "t": time,
                     "frontier": frontier,
                     "feed": feed,
+                    "replay_only": getattr(self, "_replay_only_feed", False),
                     "mail": outbound.get(pid, {}),
                     "wm": wm,
                 }
@@ -306,7 +337,7 @@ class CoordinatorCluster(ShardCluster):
         base = [(n.id, n.snapshot_signature()) for n in self.engines[0].nodes]
         return [(shard, nid, s) for shard in range(self.world) for nid, s in base]
 
-    def _restore_states(self, states: dict) -> None:
+    def _restore_states(self, states: dict, time: int = -1) -> None:
         local: dict = {}
         remote: dict[int, dict] = {}
         for (shard, nid), st in states.items():
@@ -315,7 +346,7 @@ class CoordinatorCluster(ShardCluster):
             else:
                 remote.setdefault(shard // self.threads, {})[(shard, nid)] = st
         for pid, conn in self._conns.items():
-            _send(conn, {"op": "restore", "states": remote.get(pid, {})})
+            _send(conn, {"op": "restore", "states": remote.get(pid, {}), "time": time})
             r = _recv(conn)
             assert r.get("op") == "ok"
 
@@ -438,12 +469,27 @@ def _partitioned_sources(cluster: ShardCluster):
     ]
 
 
-def _feed_partitioned(cluster: ShardCluster, t) -> bool:
+def _feed_partitioned(
+    cluster: ShardCluster, t, persistence=None, replay_only: bool = False
+) -> bool:
     fed = False
     for s in _partitioned_sources(cluster):
+        # recovered batches rebuild state in the coordinator's dedicated
+        # replay round (t == replay frontier, so sinks on process 0
+        # suppress the re-delivery exactly like process-0 replay)
+        fed |= s.flush_replay(t)
+        if replay_only:
+            continue
         b = s.session.drain()
         if b:
-            s.feed_batch(b, t)
+            resolved = s.feed_batch(b, t)
+            if (
+                persistence is not None
+                and s.persistent_id is not None
+                and resolved
+            ):
+                persistence.log_batch(s.persistent_id, t, resolved)
+                persistence.advance(s.persistent_id, t, s.last_offsets or {})
             fed = True
     return fed
 
@@ -451,6 +497,30 @@ def _feed_partitioned(cluster: ShardCluster, t) -> bool:
 def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 120) -> None:
     """Worker process main loop (PATHWAY_PROCESS_ID > 0): serve rounds
     until the coordinator says END."""
+    # worker-side persistence FIRST: the hello reports this process's
+    # replay frontier, so recovery must happen before connecting
+    wp = None
+    replay_frontier = -1
+    part_srcs = _partitioned_sources(cluster)
+    cfg = cluster.engines[0].persistence_config
+    if cfg is not None and part_srcs:
+        from ..engine.persistence import EnginePersistence
+
+        wp = EnginePersistence(cfg)
+        if getattr(cfg, "auto_persistent_ids", False):
+            for i, s_ in enumerate(part_srcs):
+                if s_.persistent_id is None and s_.supports_offsets:
+                    s_.persistent_id = f"auto_part_{i}"
+        for s_ in part_srcs:
+            if s_.persistent_id is None:
+                continue
+            if not s_.supports_offsets:
+                wp.reset_source(s_.persistent_id)
+                continue
+            batches, offsets, f = wp.recover_source(s_.persistent_id)
+            s_.replay_batches = list(batches)
+            s_.session.restore_offsets(offsets)
+            replay_frontier = max(replay_frontier, f)
     sock = None
     for _ in range(retries):
         try:
@@ -470,6 +540,7 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
             "threads": cluster.n,
             "sig": _graph_sig(cluster.engines[0]),
             "token": token,
+            "replay_frontier": replay_frontier,
         },
     )
     welcome = _recv_json(sock)
@@ -498,7 +569,9 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                         e.current_time = t
                         e._frontier_hooks(msg["frontier"])
                 if msg.get("feed"):
-                    had |= _feed_partitioned(cluster, t)
+                    had |= _feed_partitioned(
+                        cluster, t, wp, replay_only=msg.get("replay_only", False)
+                    )
                 had |= cluster.post_mail(msg["mail"])
                 had |= cluster.apply_watermarks(msg["wm"])
                 p0_mail: dict[int, dict] = {}
@@ -553,11 +626,22 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
             elif op == "restore":
                 for (shard, nid), st in msg["states"].items():
                     cluster.engines[shard - cluster.base].nodes[nid].restore_state(st)
+                t0 = msg.get("time")
+                if t0 is not None:
+                    # rows at or before the snapshot are inside the
+                    # restored operator state: replaying them again
+                    # would double-ingest
+                    for s_ in _partitioned_sources(cluster):
+                        s_.replay_batches = [
+                            (tt, ups) for tt, ups in s_.replay_batches if tt > t0
+                        ]
                 _send(sock, {"op": "ok"})
             elif op == "end":
                 for e in cluster.engines:
                     for n in e.nodes:
                         n.on_end()
+                if wp is not None:
+                    wp.close()
                 return
             elif op == "fatal":
                 raise RuntimeError(msg["error"])

@@ -298,7 +298,7 @@ class ShardCluster:
                 data = pickle.loads(blob)
                 sig = self._cluster_signature()
                 if data.get("sig") == sig:
-                    self._restore_states(data["states"])
+                    self._restore_states(data["states"], t0)
                     for s in primary.session_sources:
                         s.replay_batches = [
                             (tt, ups) for tt, ups in s.replay_batches if tt > t0
@@ -328,7 +328,7 @@ class ShardCluster:
             for n in e.nodes
         ]
 
-    def _restore_states(self, states: dict) -> None:
+    def _restore_states(self, states: dict, time: int = -1) -> None:
         for (shard, nid), st in states.items():
             self.engines[shard].nodes[nid].restore_state(st)
 
