@@ -229,13 +229,12 @@ class CoordinatorCluster(ShardCluster):
             self._poll_replies = self._broadcast({"op": "poll"})
         return self._poll_replies
 
+    def _remote_replay_frontier(self) -> int:
+        return max(self._worker_frontiers, default=-1)
+
     def _setup_persistence(self) -> None:
         super()._setup_persistence()
-        # epoch numbering must clear every process's logged times, not
-        # just process 0's
         wf = max(self._worker_frontiers, default=-1)
-        for e in self.engines:
-            e.replay_frontier = max(e.replay_frontier, wf)
         if wf >= 0:
             # dedicated replay round AT the frontier: workers flush
             # recovered batches, state rebuilds cluster-wide, and sinks
@@ -321,7 +320,7 @@ class CoordinatorCluster(ShardCluster):
                 s = n.snapshot_state()
                 if s is not None:
                     states[(shard, n.id)] = s
-        for pid, r in self._broadcast({"op": "snapshot"}).items():
+        for pid, r in self._broadcast({"op": "snapshot", "t": int(t)}).items():
             states.update(r["states"])
         blob = pickle.dumps(
             {"sig": self._cluster_signature(), "time": int(t), "states": states},
@@ -470,7 +469,11 @@ def _partitioned_sources(cluster: ShardCluster):
 
 
 def _feed_partitioned(
-    cluster: ShardCluster, t, persistence=None, replay_only: bool = False
+    cluster: ShardCluster,
+    t,
+    persistence=None,
+    replay_only: bool = False,
+    pending_advance: dict | None = None,
 ) -> bool:
     fed = False
     for s in _partitioned_sources(cluster):
@@ -489,7 +492,12 @@ def _feed_partitioned(
                 and resolved
             ):
                 persistence.log_batch(s.persistent_id, t, resolved)
-                persistence.advance(s.persistent_id, t, s.last_offsets or {})
+                # the ADVANCE (offset cursor) flushes only when the
+                # epoch CLOSES: advancing at feed time would mark rows
+                # consumed that a mid-epoch crash never delivered —
+                # same ordering as the single-process path
+                if pending_advance is not None:
+                    pending_advance[s.persistent_id] = (t, s.last_offsets or {})
             fed = True
     return fed
 
@@ -505,22 +513,10 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
     cfg = cluster.engines[0].persistence_config
     if cfg is not None and part_srcs:
         from ..engine.persistence import EnginePersistence
+        from .sharded import recover_sources
 
         wp = EnginePersistence(cfg)
-        if getattr(cfg, "auto_persistent_ids", False):
-            for i, s_ in enumerate(part_srcs):
-                if s_.persistent_id is None and s_.supports_offsets:
-                    s_.persistent_id = f"auto_part_{i}"
-        for s_ in part_srcs:
-            if s_.persistent_id is None:
-                continue
-            if not s_.supports_offsets:
-                wp.reset_source(s_.persistent_id)
-                continue
-            batches, offsets, f = wp.recover_source(s_.persistent_id)
-            s_.replay_batches = list(batches)
-            s_.session.restore_offsets(offsets)
-            replay_frontier = max(replay_frontier, f)
+        replay_frontier = recover_sources(wp, part_srcs, cfg, auto_prefix="auto_part")
     sock = None
     for _ in range(retries):
         try:
@@ -557,6 +553,7 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
     for th in cluster.engines[0].connector_threads:
         if getattr(th, "pathway_parallel_reader", False):
             th.start()
+    pending_advance: dict = {}
     try:
         while True:
             msg = _recv(sock)
@@ -570,7 +567,11 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                         e._frontier_hooks(msg["frontier"])
                 if msg.get("feed"):
                     had |= _feed_partitioned(
-                        cluster, t, wp, replay_only=msg.get("replay_only", False)
+                        cluster,
+                        t,
+                        wp,
+                        replay_only=msg.get("replay_only", False),
+                        pending_advance=pending_advance,
                     )
                 had |= cluster.post_mail(msg["mail"])
                 had |= cluster.apply_watermarks(msg["wm"])
@@ -614,6 +615,10 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                 )
             elif op == "time_end":
                 cluster._time_end_all(msg["t"])
+                if wp is not None and pending_advance:
+                    for sid, (at, offs) in pending_advance.items():
+                        wp.advance(sid, at, offs)
+                    pending_advance.clear()
                 _send(sock, {"op": "ok"})
             elif op == "snapshot":
                 states = {}
@@ -622,6 +627,21 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                         s = n.snapshot_state()
                         if s is not None:
                             states[(cluster.base + i, n.id)] = s
+                # cluster-wide operator snapshots cover worker state, so
+                # this process's input logs compact at the same point
+                # (p0 compacts its own in _compact_inputs)
+                if (
+                    wp is not None
+                    and getattr(cfg, "compact_inputs_on_snapshot", False)
+                ):
+                    wp.compact_inputs(
+                        [
+                            s_.persistent_id
+                            for s_ in part_srcs
+                            if s_.persistent_id is not None
+                        ],
+                        msg.get("t", -1),
+                    )
                 _send(sock, {"op": "states", "states": states})
             elif op == "restore":
                 for (shard, nid), st in msg["states"].items():

@@ -31,6 +31,34 @@ from typing import Callable
 from ..engine import dataflow as df
 
 
+def recover_sources(persistence, sources, cfg, auto_prefix: str = "auto") -> int:
+    """Shared source-recovery pass (process 0 AND worker processes):
+    assign auto ids, reset offset-unaware logs, restore offsets +
+    replay batches; returns the max recovered frontier."""
+    mode = str(getattr(cfg, "persistence_mode", "batch") or "batch").lower()
+    record_mode = "record" in mode
+    if getattr(cfg, "auto_persistent_ids", False) or record_mode:
+        for i, s in enumerate(sources):
+            if s.persistent_id is not None or s.is_error_log:
+                continue
+            if record_mode or s.supports_offsets:
+                s.persistent_id = f"{auto_prefix}_{i}"
+    frontier = -1
+    for s in sources:
+        if s.persistent_id is None:
+            continue
+        if not s.supports_offsets:
+            # offset-unaware reader: run() re-produces all input, so
+            # replaying a stale log on top would double it — reset
+            persistence.reset_source(s.persistent_id)
+            continue
+        batches, offsets, f = persistence.recover_source(s.persistent_id)
+        s.replay_batches = list(batches)
+        s.session.restore_offsets(offsets)
+        frontier = max(frontier, f)
+    return frontier
+
+
 class ShardCluster:
     """Owns a contiguous slice of the global shard space — all of it in
     a single-process run (base=0, world=n), or this process's T shards
@@ -245,30 +273,12 @@ class ShardCluster:
             )
         p = EnginePersistence(cfg)
         self._persistence = p
-        record_mode = "record" in mode
-        if getattr(cfg, "auto_persistent_ids", False) or record_mode:
-            for i, s in enumerate(primary.session_sources):
-                if s.persistent_id is not None or s.is_error_log:
-                    continue
-                # mirror the single-worker rules (engine _setup_persistence):
-                # batch recovery only suits offset-aware readers; record
-                # mode captures everything
-                if record_mode or s.supports_offsets:
-                    s.persistent_id = f"auto_{i}"
-        frontier = -1
-        for s in primary.session_sources:
-            if s.persistent_id is None:
-                continue
-            if not s.supports_offsets:
-                # offset-unaware reader: run() re-produces all input, so
-                # replaying a stale log on top would double it — reset
-                # (no speedrun in the sharded path, so this is all modes)
-                p.reset_source(s.persistent_id)
-                continue
-            batches, offsets, f = p.recover_source(s.persistent_id)
-            s.replay_batches = list(batches)
-            s.session.restore_offsets(offsets)
-            frontier = max(frontier, f)
+        frontier = recover_sources(p, primary.session_sources, cfg)
+        # worker processes may have logged epochs past process 0's own
+        # frontier: snapshot recovery below must see the GLOBAL maximum
+        # or it rejects (and deletes) snapshots taken at trailing
+        # worker-only epochs
+        frontier = max(frontier, self._remote_replay_frontier())
         for e in self.engines:
             e.replay_frontier = frontier
         all_persistent = all(
@@ -528,6 +538,9 @@ class ShardCluster:
 
     def _remote_input_pending(self) -> bool:
         return False
+
+    def _remote_replay_frontier(self) -> int:
+        return -1
 
     def _remote_sources_closed(self) -> bool:
         return True
