@@ -1,0 +1,128 @@
+"""pw.iterate fixpoint breadth (reference internals tests for iterate:
+collatz, connected components, iteration_limit, multi-table bodies)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+
+from .utils import T, run_table
+
+
+def test_iterate_collatz_steps():
+    """The reference's doc example: steps to reach 1."""
+
+    def step(t):
+        return t.select(
+            n=pw.if_else(
+                pw.this.n == 1,
+                1,
+                pw.if_else(pw.this.n % 2 == 0, pw.this.n // 2, 3 * pw.this.n + 1),
+            ),
+            steps=pw.if_else(pw.this.n == 1, pw.this.steps, pw.this.steps + 1),
+        )
+
+    t = T(
+        """
+      | n  | steps
+    1 | 6  | 0
+    2 | 27 | 0
+    3 | 1  | 0
+    """
+    )
+    res = pw.iterate(step, t=t)
+    rows = {r[0] or r[1]: r for r in run_table(res).values()}
+    by_steps = sorted(r[1] for r in run_table(res).values())
+    assert by_steps == [0, 8, 111]  # 6 -> 8 steps, 27 -> 111 steps
+
+
+def test_iterate_min_propagation_components():
+    """Connected components by min-label propagation over an edge list
+    (constant within the fixpoint)."""
+
+    def step(labels, edges):
+        joined = edges.join(labels, edges.dst == labels.id_val).select(
+            src=edges.src, lbl=labels.lbl
+        )
+        best = joined.groupby(pw.this.src).reduce(
+            src=pw.this.src, m=pw.reducers.min(pw.this.lbl)
+        )
+        m = best.ix_ref(pw.this.id_val, optional=True).m
+        cand = pw.coalesce(m, pw.this.lbl)
+        updated = labels.select(
+            id_val=pw.this.id_val,
+            lbl=pw.if_else(cand < pw.this.lbl, cand, pw.this.lbl),
+        )
+        return dict(labels=updated)
+
+    labels = T(
+        """
+      | id_val | lbl
+    1 | 1      | 1
+    2 | 2      | 2
+    3 | 3      | 3
+    4 | 4      | 4
+    """
+    )
+    edges = T(
+        """
+      | src | dst
+    7 | 2   | 1
+    8 | 3   | 2
+    9 | 1   | 2
+    """
+    )
+    res = pw.iterate(step, labels=labels, edges=edges).labels
+    rows = sorted(run_table(res).values())
+    # component {1,2,3} converges to label 1; node 4 isolated
+    assert rows == [(1, 1), (2, 1), (3, 1), (4, 4)]
+
+
+def test_iterate_iteration_limit():
+    def step(t):
+        return t.select(n=pw.this.n * 2)
+
+    t = T(
+        """
+      | n
+    1 | 1
+    """
+    )
+    res = pw.iterate(step, iteration_limit=3, t=t)
+    ((n,),) = run_table(res).values()
+    assert n == 8  # exactly 3 doublings, no fixpoint
+
+
+def test_iterate_rejects_mismatched_columns():
+    def step(t):
+        return t.select(other=pw.this.n)
+
+    t = T(
+        """
+      | n
+    1 | 1
+    """
+    )
+    with pytest.raises(ValueError, match="column"):
+        pw.iterate(step, t=t)
+
+
+def test_iterate_streamed_input_refixes():
+    """A later epoch's input change re-runs the fixpoint incrementally."""
+
+    def step(t):
+        # saturate at 10: value grows toward the cap
+        return t.select(n=pw.if_else(pw.this.n < 10, pw.this.n + 1, pw.this.n))
+
+    t = T(
+        """
+      | n | __time__ | __diff__
+    1 | 1 | 2        | 1
+    2 | 3 | 4        | 1
+    1 | 1 | 6        | -1
+    """
+    )
+    res = pw.iterate(step, t=t)
+    rows = sorted(run_table(res).values())
+    assert rows == [(10,)]  # only row 2 remains, saturated
