@@ -32,9 +32,9 @@ def test_iterate_collatz_steps():
     """
     )
     res = pw.iterate(step, t=t)
-    rows = {r[0] or r[1]: r for r in run_table(res).values()}
-    by_steps = sorted(r[1] for r in run_table(res).values())
-    assert by_steps == [0, 8, 111]  # 6 -> 8 steps, 27 -> 111 steps
+    rows = list(run_table(res).values())
+    assert all(r[0] == 1 for r in rows)  # every chain reached 1
+    assert sorted(r[1] for r in rows) == [0, 8, 111]  # 6 -> 8, 27 -> 111
 
 
 def test_iterate_min_propagation_components():
