@@ -1,0 +1,159 @@
+"""Reducer breadth under streams: custom accumulators (udf_reducer),
+stateful_many/single, ndarray reducers, earliest/latest ordering, and
+per-group retraction behavior (reference custom_reducers.py +
+reduce.rs coverage)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+
+from .utils import T, run_table
+
+
+def test_udf_reducer_custom_accumulator_with_retraction():
+    class StdDevAcc(pw.BaseCustomAccumulator):
+        def __init__(self, cnt, s, s2):
+            self.cnt, self.s, self.s2 = cnt, s, s2
+
+        @classmethod
+        def from_row(cls, row):
+            (v,) = row
+            return cls(1, v, v * v)
+
+        def update(self, other):
+            self.cnt += other.cnt
+            self.s += other.s
+            self.s2 += other.s2
+
+        def retract(self, other):
+            self.cnt -= other.cnt
+            self.s -= other.s
+            self.s2 -= other.s2
+
+        def compute_result(self) -> float:
+            mean = self.s / self.cnt
+            return self.s2 / self.cnt - mean * mean
+
+    stddev = pw.reducers.udf_reducer(StdDevAcc)
+    t = T(
+        """
+      | g | v | __time__ | __diff__
+    1 | a | 2 | 2        | 1
+    2 | a | 4 | 2        | 1
+    3 | a | 9 | 4        | 1
+    3 | a | 9 | 6        | -1
+    """
+    )
+    r = t.groupby(pw.this.g).reduce(pw.this.g, var=stddev(pw.this.v))
+    ((g, var),) = run_table(r).values()
+    assert g == "a" and var == pytest.approx(1.0)  # {2,4}: mean 3, var 1
+
+
+def test_stateful_single_running_max():
+    def mx(state, value):
+        return value if state is None or value > state else state
+
+    t = T(
+        """
+      | g | v | __time__
+    1 | a | 3 | 2
+    2 | a | 7 | 4
+    3 | a | 5 | 6
+    """
+    )
+    r = t.groupby(pw.this.g).reduce(
+        pw.this.g, m=pw.reducers.stateful_single(mx)(pw.this.v)
+    )
+    ((_, m),) = run_table(r).values()
+    assert m == 7
+
+
+def test_stateful_many_batch_folding():
+    def fold(state, rows):
+        # rows arrive as (count, row_tuple) pairs (reference
+        # custom_reducers.stateful_many contract)
+        total = state or 0
+        for cnt, row in rows:
+            total += row[0] * cnt
+        return total
+
+    t = T(
+        """
+      | g | v | __time__ | __diff__
+    1 | a | 5 | 2        | 1
+    2 | a | 3 | 4        | 1
+    """
+    )
+    r = t.groupby(pw.this.g).reduce(
+        pw.this.g, s=pw.reducers.stateful_many(fold)(pw.this.v)
+    )
+    ((_, s),) = run_table(r).values()
+    assert s == 8
+
+
+def test_ndarray_reducer():
+    t = T(
+        """
+      | g | v
+    1 | a | 1
+    2 | a | 2
+    3 | b | 5
+    """
+    )
+    r = t.groupby(pw.this.g).reduce(
+        pw.this.g, arr=pw.reducers.ndarray(pw.this.v)
+    )
+    rows = {v[0]: np.sort(np.asarray(v[1])) for v in run_table(r).values()}
+    assert rows["a"].tolist() == [1, 2] and rows["b"].tolist() == [5]
+
+
+def test_earliest_latest_follow_epoch_order():
+    t = T(
+        """
+      | g | v | __time__
+    1 | a | 10 | 2
+    2 | a | 20 | 4
+    3 | a | 30 | 6
+    """
+    )
+    r = t.groupby(pw.this.g).reduce(
+        pw.this.g,
+        first=pw.reducers.earliest(pw.this.v),
+        last=pw.reducers.latest(pw.this.v),
+    )
+    ((_, first, last),) = run_table(r).values()
+    assert (first, last) == (10, 30)
+
+
+def test_unique_reducer_errors_on_conflict():
+    t = T(
+        """
+      | g | v
+    1 | a | 1
+    2 | a | 2
+    """
+    )
+    r = t.groupby(pw.this.g).reduce(
+        pw.this.g, u=pw.fill_error(pw.reducers.unique(pw.this.v), -1)
+    )
+    ((_, u),) = run_table(r).values()
+    assert u == -1  # conflicting values -> ERROR -> filled
+
+
+def test_sorted_tuple_skip_nones():
+    t = T(
+        """
+      | g | v
+    1 | a | 3
+    2 | a |
+    3 | a | 1
+    """
+    ).select(g=pw.this.g, v=pw.if_else(pw.this.v == 0, None, pw.this.v))
+    r = t.groupby(pw.this.g).reduce(
+        pw.this.g, tup=pw.reducers.sorted_tuple(pw.this.v, skip_nones=True)
+    )
+    ((_, tup),) = run_table(r).values()
+    assert tup == (1, 3)
