@@ -8,8 +8,6 @@ import io
 import json
 from contextlib import redirect_stdout
 
-import pytest
-
 import pathway_tpu as pw
 
 from .utils import T, run_table
@@ -40,8 +38,14 @@ def test_markdown_scripted_stream_compute_and_print_update_stream():
     with redirect_stdout(buf):
         pw.debug.compute_and_print_update_stream(t)
     out = buf.getvalue()
-    # three changes visible with time and diff columns
-    assert out.count("1") >= 3 and "-1" in out
+    lines = [l for l in out.splitlines() if l.strip() and "|" not in l.split()[0:1]]
+    # the three changes appear with their times and signs
+    assert "5" in out and "-1" in out
+    import re
+
+    # retraction of value 1 at time 4 and insertion of 5 at time 4
+    assert re.search(r"1\s*\|\s*4\s*\|\s*-1", out), out
+    assert re.search(r"5\s*\|\s*4\s*\|\s*1", out), out
 
 
 def test_table_from_pandas_roundtrip():
@@ -107,4 +111,8 @@ def test_compute_and_print_sorted_by_id(capsys):
     )
     pw.debug.compute_and_print(t)
     out = capsys.readouterr().out
-    assert "10" in out and "20" in out and "| v" in out.replace("  ", " ")
+    assert "| v" in out.replace("  ", " ")
+    # rows print sorted by row id (the displayed pointer strings)
+    body = [l for l in out.splitlines() if l.startswith("^")]
+    ids = [l.split("|")[0].strip() for l in body]
+    assert len(ids) == 2 and ids == sorted(ids), out
