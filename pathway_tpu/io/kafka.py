@@ -16,23 +16,53 @@ from ..internals.table import Table
 from ._connector import StreamingContext, input_table_from_reader, add_output_sink
 
 
-def _get_consumer(rdkafka_settings: dict, topic: str):
+def _get_consumer(
+    rdkafka_settings: dict,
+    topic,
+    start_from_timestamp_ms: int | None = None,
+):
+    topics = [topic] if isinstance(topic, str) else list(topic)
     try:
-        from confluent_kafka import Consumer  # type: ignore
+        from confluent_kafka import Consumer, TopicPartition  # type: ignore
 
         consumer = Consumer(rdkafka_settings)
-        consumer.subscribe([topic])
+
+        def on_assign(cons, partitions):
+            # seek to the first offset at/after the requested timestamp
+            # (reference start_from_timestamp_ms semantics)
+            if start_from_timestamp_ms is None:
+                return
+            for p in partitions:
+                p.offset = start_from_timestamp_ms
+            try:
+                offs = cons.offsets_for_times(partitions, timeout=10.0)
+                cons.assign(offs)
+            except Exception:
+                cons.assign(partitions)
+
+        consumer.subscribe(topics, on_assign=on_assign)
         return ("confluent", consumer)
     except ImportError:
         pass
     try:
         from kafka import KafkaConsumer  # type: ignore
 
+        sec = {
+            k_py: rdkafka_settings[k_rd]
+            for k_rd, k_py in (
+                ("security.protocol", "security_protocol"),
+                ("sasl.mechanism", "sasl_mechanism"),
+                ("sasl.username", "sasl_plain_username"),
+                ("sasl.password", "sasl_plain_password"),
+            )
+            if k_rd in rdkafka_settings
+        }
         consumer = KafkaConsumer(
-            topic,
+            *topics,
             bootstrap_servers=rdkafka_settings.get("bootstrap.servers"),
             group_id=rdkafka_settings.get("group.id"),
             auto_offset_reset=rdkafka_settings.get("auto.offset.reset", "earliest"),
+            **sec,
         )
         return ("kafka-python", consumer)
     except ImportError:
@@ -42,49 +72,156 @@ def _get_consumer(rdkafka_settings: dict, topic: str):
     )
 
 
+class _Msg:
+    """Normalized message view over fake tuples and real client objects."""
+
+    __slots__ = ("key", "value", "topic", "partition", "offset", "timestamp_ms")
+
+    def __init__(self, key, value, topic=None, partition=None, offset=None, timestamp_ms=None):
+        self.key = key
+        self.value = value
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+        self.timestamp_ms = timestamp_ms
+
+
+def _normalize_fake(i: int, m) -> _Msg:
+    if isinstance(m, dict):
+        return _Msg(
+            m.get("key"),
+            m.get("value"),
+            m.get("topic"),
+            m.get("partition", 0),
+            m.get("offset", i),
+            m.get("timestamp_ms"),
+        )
+    parts = tuple(m)
+    key, value = parts[0], parts[1]
+    topic = parts[2] if len(parts) > 2 else None
+    partition = parts[3] if len(parts) > 3 else 0
+    offset = parts[4] if len(parts) > 4 else i
+    ts = parts[5] if len(parts) > 5 else None
+    return _Msg(key, value, topic, partition, offset, ts)
+
+
+def _json_pointer(doc, pointer: str):
+    """RFC 6901 JSON Pointer lookup (reference json_field_paths)."""
+    if pointer in ("", None):
+        return doc
+    cur = doc
+    for tok in pointer.lstrip("/").split("/"):
+        tok = tok.replace("~1", "/").replace("~0", "~")
+        if isinstance(cur, list):
+            # out-of-range / non-numeric tokens resolve to None (a
+            # malformed message must not kill the reader thread)
+            try:
+                cur = cur[int(tok)]
+            except (ValueError, IndexError):
+                return None
+        elif isinstance(cur, dict):
+            cur = cur.get(tok)
+        else:
+            return None
+        if cur is None:
+            return None
+    return cur
+
+
 def read(
     rdkafka_settings: dict,
-    topic: str | None = None,
+    topic: str | list[str] | None = None,
     *,
     schema: type[Schema] | None = None,
     format: str = "json",
     autocommit_duration_ms: int | None = 1500,
+    json_field_paths: dict[str, str] | None = None,
+    autogenerate_key: bool = False,
+    with_metadata: bool = False,
+    start_from_timestamp_ms: int | None = None,
     name: str = "kafka",
     parallel_readers: bool = False,
+    persistent_id: str | None = None,
     _consumer=None,
     **kwargs,
 ) -> Table:
-    """Stream a Kafka topic. ``_consumer`` injects a fake for tests: an
-    iterable of (key_bytes, value_bytes) message pairs — the stream
-    closes when it is exhausted (a real consumer polls forever).
+    """Stream Kafka topic(s) — reference surface
+    (/root/reference/python/pathway/io/kafka/__init__.py:27):
 
-    ``parallel_readers``: in a multi-process run every process reads
-    its own share of the topic's partitions (the reference's
-    partitioned-source mode, graph.rs:943-950) instead of funneling
-    through process 0. Real consumers rely on consumer-group partition
-    assignment (set a shared ``group.id``); the injected fake is split
-    round-robin by message index."""
+    - ``format``: "raw" (bytes), "plaintext" (utf-8 str), or "json"
+      (payload parsed into schema columns).
+    - ``topic`` may be a single name or a list (real consumers
+      subscribe to all; fakes carrying a topic field are filtered).
+    - ``json_field_paths``: column -> RFC 6901 JSON Pointer into the
+      payload (``{"rating": "/pet/ratings/0"}``).
+    - ``autogenerate_key``: for raw/plaintext, synthesize keys instead
+      of using the message key.
+    - ``with_metadata``: adds a ``_metadata`` JSON column with
+      ``topic``/``partition``/``offset``/``timestamp_millis``.
+    - ``start_from_timestamp_ms``: start at the given UNIX millis —
+      confluent consumers SEEK via offsets_for_times on assignment;
+      other paths filter client-side, and messages without a broker
+      timestamp pass through.
+    - ``parallel_readers``: in a multi-process run every process reads
+      its own partition share (graph.rs:943-950) — consumer groups for
+      real clients, round-robin for the injected fake.
+
+    ``_consumer`` injects a fake: an iterable of (key, value[, topic,
+    partition, offset, timestamp_ms]) tuples or dicts."""
+    topics = [topic] if isinstance(topic, str) or topic is None else list(topic)
     if schema is None:
         if format == "raw":
-            schema = schema_builder(
-                {"data": ColumnDefinition(dtype=dt.BYTES)}, name="KafkaRaw"
-            )
+            cols = {"data": ColumnDefinition(dtype=dt.BYTES)}
+        elif format == "plaintext":
+            cols = {"data": ColumnDefinition(dtype=dt.STR)}
         else:
             raise ValueError("kafka.read requires schema= for json format")
+        if with_metadata:
+            cols["_metadata"] = ColumnDefinition(dtype=dt.JSON)
+        schema = schema_builder(cols, name="KafkaRaw")
+    elif with_metadata and "_metadata" not in schema.column_names():
+        cols = dict(schema.columns())
+        cols["_metadata"] = ColumnDefinition(dtype=dt.JSON)
+        schema = schema_builder(cols, name=schema.__name__)
+
+    wanted_topics = {t for t in topics if t is not None}
+
+    def emit(ctx: StreamingContext, msg: _Msg) -> None:
+        if wanted_topics and msg.topic is not None and msg.topic not in wanted_topics:
+            return
+        if (
+            start_from_timestamp_ms is not None
+            and msg.timestamp_ms is not None
+            and msg.timestamp_ms < start_from_timestamp_ms
+        ):
+            return
+        _emit(
+            ctx,
+            msg,
+            format,
+            schema,
+            json_field_paths=json_field_paths,
+            with_metadata=with_metadata,
+            autogenerate_key=autogenerate_key,
+        )
 
     def reader(ctx: StreamingContext) -> None:
         if _consumer is not None:
-            for i, (_key, value) in enumerate(_consumer):
+            for i, raw in enumerate(_consumer):
                 if (
                     parallel_readers
                     and ctx.n_processes > 1
                     and i % ctx.n_processes != ctx.process_id
                 ):
                     continue  # another process owns this partition slice
-                _emit(ctx, value, format, schema)
+                emit(ctx, _normalize_fake(i, raw))
             ctx.commit()
             return
-        kind, consumer = _get_consumer(rdkafka_settings, topic)
+        kind, consumer = _get_consumer(
+            rdkafka_settings,
+            [t for t in topics if t is not None],
+            start_from_timestamp_ms,
+        )
         try:
             if kind == "confluent":
                 while True:
@@ -94,10 +231,31 @@ def read(
                         continue
                     if msg.error():
                         continue
-                    _emit(ctx, msg.value(), format, schema)
+                    ts = msg.timestamp()
+                    emit(
+                        ctx,
+                        _Msg(
+                            msg.key(),
+                            msg.value(),
+                            msg.topic(),
+                            msg.partition(),
+                            msg.offset(),
+                            ts[1] if ts and ts[0] else None,
+                        ),
+                    )
             else:
                 for msg in consumer:
-                    _emit(ctx, msg.value, format, schema)
+                    emit(
+                        ctx,
+                        _Msg(
+                            msg.key,
+                            msg.value,
+                            msg.topic,
+                            msg.partition,
+                            msg.offset,
+                            getattr(msg, "timestamp", None),
+                        ),
+                    )
         finally:
             try:
                 consumer.close()
@@ -110,17 +268,76 @@ def read(
         name=name,
         autocommit_duration_ms=autocommit_duration_ms,
         parallel_readers=parallel_readers,
+        persistent_id=persistent_id,
     )
 
 
-def _emit(ctx: StreamingContext, payload: bytes, format: str, schema) -> None:
+def read_from_upstash(
+    endpoint: str,
+    username: str,
+    password: str,
+    topic: str,
+    **kwargs,
+) -> Table:
+    """Upstash-hosted Kafka (reference kafka/__init__.py:396): SASL
+    over TLS with the given credentials."""
+    settings = {
+        "bootstrap.servers": endpoint,
+        "security.protocol": "SASL_SSL",
+        "sasl.mechanism": "SCRAM-SHA-256",
+        "sasl.username": username,
+        "sasl.password": password,
+        "group.id": kwargs.pop("group_id", "pathway-upstash"),
+        "auto.offset.reset": "earliest",
+    }
+    return read(settings, topic, **kwargs)
+
+
+def _emit(
+    ctx: StreamingContext,
+    msg: _Msg,
+    format: str,
+    schema,
+    *,
+    json_field_paths: dict[str, str] | None = None,
+    with_metadata: bool = False,
+    autogenerate_key: bool = False,
+) -> None:
+    from ..engine.value import Json as _Json
+
+    payload = msg.value
     if format == "raw":
-        ctx.insert({"data": payload})
+        rec = {"data": payload if isinstance(payload, bytes) else str(payload).encode()}
+    elif format == "plaintext":
+        rec = {
+            "data": payload.decode(errors="replace")
+            if isinstance(payload, bytes)
+            else str(payload)
+        }
     else:
         try:
-            rec = json.loads(payload)
+            doc = json.loads(payload)
         except (ValueError, TypeError):
             return
+        if json_field_paths:
+            rec = dict(doc) if isinstance(doc, dict) else {}
+            for col, pointer in json_field_paths.items():
+                rec[col] = _json_pointer(doc, pointer)
+        else:
+            rec = doc if isinstance(doc, dict) else {}
+    if with_metadata:
+        meta = {
+            "topic": msg.topic,
+            "partition": msg.partition,
+            "offset": msg.offset,
+        }
+        if msg.timestamp_ms is not None:
+            meta["timestamp_millis"] = msg.timestamp_ms
+        rec["_metadata"] = _Json(meta)
+    if format in ("raw", "plaintext") and not autogenerate_key and msg.key is not None:
+        key = msg.key if isinstance(msg.key, bytes) else str(msg.key).encode()
+        ctx.upsert_keyed((key,), rec)
+    else:
         ctx.insert(rec)
 
 

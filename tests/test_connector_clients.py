@@ -521,3 +521,82 @@ def test_psql_formatters():
 def test_bson_formatter():
     f = BsonFormatter(["a"])
     assert f.format({"a": (1, 2)}, 3, 1) == {"a": [1, 2], "time": 3, "diff": 1}
+
+
+def test_kafka_formats_and_json_pointers():
+    """Reference kafka surface (kafka/__init__.py:27): plaintext format,
+    json_field_paths as RFC 6901 pointers, and message-key upserts."""
+    import pathway_tpu as pw
+    from tests.utils import run_table
+
+    msgs = [
+        (None, json.dumps({"pet": {"name": "rex", "ratings": [9, 7]}}).encode()),
+        (None, json.dumps({"pet": {"name": "ada", "ratings": [10]}}).encode()),
+    ]
+
+    class S(pw.Schema):
+        name: str
+        rating: int
+
+    t = pw.io.kafka.read(
+        {}, "pets", schema=S, format="json",
+        json_field_paths={"name": "/pet/name", "rating": "/pet/ratings/0"},
+        _consumer=msgs,
+    )
+    rows = sorted(run_table(t).values())
+    assert rows == [("ada", 10), ("rex", 9)]
+    pw.clear_graph()
+
+    # plaintext + message keys: same key upserts (replaces), not appends
+    msgs2 = [
+        (b"k1", b"first"),
+        (b"k2", b"other"),
+        (b"k1", b"second"),
+    ]
+    t2 = pw.io.kafka.read({}, "t", format="plaintext", _consumer=msgs2)
+    rows2 = sorted(v[0] for v in run_table(t2).values())
+    assert rows2 == ["other", "second"]
+    pw.clear_graph()
+
+    # autogenerate_key: all three rows retained
+    t3 = pw.io.kafka.read(
+        {}, "t", format="plaintext", autogenerate_key=True, _consumer=msgs2
+    )
+    assert len(run_table(t3)) == 3
+    pw.clear_graph()
+
+
+def test_kafka_metadata_topics_and_timestamp_filter():
+    import pathway_tpu as pw
+    from tests.utils import run_table
+
+    msgs = [
+        {"key": b"a", "value": b"x", "topic": "keep", "partition": 2,
+         "offset": 5, "timestamp_ms": 1000},
+        {"key": b"b", "value": b"y", "topic": "drop", "timestamp_ms": 2000},
+        {"key": b"c", "value": b"z", "topic": "keep", "timestamp_ms": 500},
+    ]
+    t = pw.io.kafka.read(
+        {}, ["keep"], format="plaintext", with_metadata=True,
+        start_from_timestamp_ms=900, _consumer=msgs,
+    )
+    rows = list(run_table(t).values())
+    # topic filter drops "drop"; timestamp filter drops the 500ms one
+    assert len(rows) == 1
+    data, meta = rows[0]
+    assert data == "x"
+    assert meta.value["topic"] == "keep" and meta.value["partition"] == 2
+    assert meta.value["offset"] == 5 and meta.value["timestamp_millis"] == 1000
+    pw.clear_graph()
+
+
+def test_kafka_read_from_upstash_builds_sasl_settings():
+    import pathway_tpu as pw
+    from tests.utils import run_table
+
+    t = pw.io.kafka.read_from_upstash(
+        "ep:9092", "user", "pass", "topic",
+        format="plaintext", autogenerate_key=True,
+        _consumer=[(None, b"hello")],
+    )
+    assert [v[0] for v in run_table(t).values()] == ["hello"]

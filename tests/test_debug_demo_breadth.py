@@ -38,7 +38,6 @@ def test_markdown_scripted_stream_compute_and_print_update_stream():
     with redirect_stdout(buf):
         pw.debug.compute_and_print_update_stream(t)
     out = buf.getvalue()
-    lines = [l for l in out.splitlines() if l.strip() and "|" not in l.split()[0:1]]
     # the three changes appear with their times and signs
     assert "5" in out and "-1" in out
     import re
