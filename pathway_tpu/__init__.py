@@ -118,20 +118,149 @@ from . import engine
 
 from .internals import universes
 
+# ---- reference top-level parity (python/pathway/__init__.py __all__) ----
+import datetime as _datetime
+
+DateTimeNaive = _datetime.datetime
+DateTimeUtc = _datetime.datetime
+Duration = _datetime.timedelta
+
+from .internals import dtype as _dt
+
+Type = _dt.DType
+from .internals.schema import SchemaProperties
+from .internals.table import (
+    GroupedTable as GroupedJoinResult,
+    JoinResult,
+    JoinResult as AsofJoinResult,
+    JoinResult as IntervalJoinResult,
+    JoinResult as OuterJoinResult,
+    JoinResult as WindowJoinResult,
+    Table as TableLike,
+    Table as Joinable,
+)
+from .internals.udfs import UDF as UDFSync, UDF as UDFAsync
+from .internals import udfs as asynchronous
+from .stdlib import viz  # attaches Table.show/plot (reference-style)
+
+
+class PersistenceMode:
+    """Reference api.PersistenceMode names; the engine takes the same
+    values as persistence_config.persistence_mode strings."""
+
+    BATCH = "batch"
+    PERSISTING = "persisting"
+    OPERATOR_PERSISTING = "operator_persisting"
+    SPEEDRUN_REPLAY = "speedrun_replay"
+
+
+class TableSlice:
+    """table.slice proxy (reference internals/table_slice.py)."""
+
+    def __init__(self, table, names):
+        self._table = table
+        self._names = list(names)
+
+    def __iter__(self):
+        return iter(ColumnReference(self._table, n) for n in self._names)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ColumnReference(self._table, name)
+
+    def __getitem__(self, name):
+        return ColumnReference(self._table, name)
+
+    def keys(self):
+        return list(self._names)
+
+
+def assert_table_has_schema(
+    table, schema, *, allow_superset: bool = True, ignore_primary_keys: bool = True
+) -> None:
+    """Runtime schema check (reference assert_table_has_schema):
+    verifies column presence AND dtypes (ANY on either side passes —
+    inference may legitimately widen)."""
+    want = schema.dtypes()
+    have = {n: c.dtype for n, c in table._columns.items()}
+    missing = [n for n in want if n not in have]
+    if missing:
+        raise AssertionError(f"table lacks columns {missing}; has {list(have)}")
+    if not allow_superset and set(have) != set(want):
+        raise AssertionError(
+            f"table has extra columns {sorted(set(have) - set(want))}"
+        )
+    for n, wt in want.items():
+        ht = have[n]
+        if wt is _dt.ANY or ht is _dt.ANY:
+            continue
+        if _dt.unoptionalize(ht) is not _dt.unoptionalize(wt) and ht != wt:
+            raise AssertionError(
+                f"column {n!r} has dtype {ht}, schema wants {wt}"
+            )
+
+
+def table_transformer(func=None, **kw):
+    """Decorator parity (reference table_transformer): runtime
+    type-checking is advisory here; the function is returned as-is."""
+    if func is None:
+        return lambda f: f
+    return func
+
+
+def udf_async(fn=None, **kwargs):
+    """Deprecated alias of @pw.udf for async functions (reference
+    udf_async)."""
+    if fn is None:
+        return lambda f: udf(f, **kwargs)
+    return udf(fn, **kwargs)
+
+
+def enable_interactive_mode() -> None:
+    """Reference enable_interactive_mode: viz hooks are attached on
+    import here, so this is a no-op confirmation."""
+
+
+def join(left, other, *on, **kw):
+    return left.join(other, *on, **kw)
+
+
+def join_inner(left, other, *on, **kw):
+    return left.join(other, *on, **kw)
+
+
+def join_left(left, other, *on, **kw):
+    return left.join_left(other, *on, **kw)
+
+
+def join_right(left, other, *on, **kw):
+    return left.join_right(other, *on, **kw)
+
+
+def join_outer(left, other, *on, **kw):
+    return left.join_outer(other, *on, **kw)
+
+
+def groupby(table, *args, **kw):
+    return table.groupby(*args, **kw)
+
+
+from .stdlib import temporal as window  # pw.window.tumbling(...) namespace
+
 
 def __getattr__(name):
-    if name == "Duration":
-        import datetime
-
-        return datetime.timedelta
-    if name == "DateTimeNaive" or name == "DateTimeUtc":
-        import datetime
-
-        return datetime.datetime
     raise AttributeError(f"module 'pathway_tpu' has no attribute {name!r}")
 
 
 __all__ = [
+    "AsofJoinResult", "DateTimeNaive", "DateTimeUtc", "Duration",
+    "GroupedJoinResult", "IntervalJoinResult", "Joinable", "JoinResult",
+    "OuterJoinResult", "PersistenceMode", "SchemaProperties", "TableLike",
+    "TableSlice", "Type", "UDFAsync", "UDFSync", "WindowJoinResult",
+    "assert_table_has_schema", "asynchronous", "enable_interactive_mode",
+    "groupby", "join", "join_inner", "join_left", "join_outer",
+    "join_right", "table_transformer", "udf_async", "viz", "window",
     "ANY", "BOOL", "BYTES", "DATE_TIME_NAIVE", "DATE_TIME_UTC", "DURATION",
     "FLOAT", "INT", "STR", "AsyncTransformer", "BaseCustomAccumulator",
     "ColumnDefinition", "ColumnExpression", "ColumnReference", "GroupedTable",

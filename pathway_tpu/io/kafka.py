@@ -32,12 +32,15 @@ def _get_consumer(
             # (reference start_from_timestamp_ms semantics)
             if start_from_timestamp_ms is None:
                 return
-            for p in partitions:
-                p.offset = start_from_timestamp_ms
+            lookup = [
+                TopicPartition(p.topic, p.partition, start_from_timestamp_ms)
+                for p in partitions
+            ]
             try:
-                offs = cons.offsets_for_times(partitions, timeout=10.0)
-                cons.assign(offs)
+                cons.assign(cons.offsets_for_times(lookup, timeout=10.0))
             except Exception:
+                # keep the ORIGINAL assignment (timestamps are not
+                # offsets; seeking to one lands out of range)
                 cons.assign(partitions)
 
         consumer.subscribe(topics, on_assign=on_assign)
@@ -52,6 +55,7 @@ def _get_consumer(
             for k_rd, k_py in (
                 ("security.protocol", "security_protocol"),
                 ("sasl.mechanism", "sasl_mechanism"),
+                ("sasl.mechanisms", "sasl_mechanism"),  # librdkafka plural
                 ("sasl.username", "sasl_plain_username"),
                 ("sasl.password", "sasl_plain_password"),
             )
@@ -113,11 +117,14 @@ def _json_pointer(doc, pointer: str):
     for tok in pointer.lstrip("/").split("/"):
         tok = tok.replace("~1", "/").replace("~0", "~")
         if isinstance(cur, list):
-            # out-of-range / non-numeric tokens resolve to None (a
-            # malformed message must not kill the reader thread)
+            # RFC 6901: only unsigned decimal tokens index arrays; any
+            # malformed token resolves to None (and must not kill the
+            # reader thread)
+            if not tok.isdigit():
+                return None
             try:
                 cur = cur[int(tok)]
-            except (ValueError, IndexError):
+            except IndexError:
                 return None
         elif isinstance(cur, dict):
             cur = cur.get(tok)
@@ -306,6 +313,13 @@ def _emit(
     from ..engine.value import Json as _Json
 
     payload = msg.value
+    if payload is None:
+        # Kafka tombstone: delete the keyed row (compacted-topic
+        # semantics); without a key there is nothing to delete
+        if format in ("raw", "plaintext") and not autogenerate_key and msg.key is not None:
+            key = msg.key if isinstance(msg.key, bytes) else str(msg.key).encode()
+            ctx.upsert_keyed((key,), None)
+        return
     if format == "raw":
         rec = {"data": payload if isinstance(payload, bytes) else str(payload).encode()}
     elif format == "plaintext":
