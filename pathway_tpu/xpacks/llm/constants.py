@@ -1,0 +1,3 @@
+"""Shared model-name constants (reference xpacks/llm/constants.py)."""
+
+DEFAULT_VISION_MODEL = "gpt-4o"

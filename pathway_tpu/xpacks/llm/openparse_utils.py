@@ -1,0 +1,39 @@
+"""openparse ingestion pipelines (reference
+xpacks/llm/openparse_utils.py:49-409: SimpleIngestionPipeline,
+PageChunker, SamePageIngestionPipeline, PyMuDocumentParser, ingest).
+
+The reference module imports the optional ``openparse`` package at top
+level; these names materialize lazily and raise the same actionable
+ImportError when it is absent (it is not bundled with this build).
+"""
+
+from __future__ import annotations
+
+_NAMES = (
+    "LLMArgs",
+    "SimpleIngestionPipeline",
+    "PageChunker",
+    "SamePageIngestionPipeline",
+    "PyMuDocumentParser",
+    "ingest",
+)
+
+
+def __getattr__(name: str):
+    if name in _NAMES:
+        try:
+            import openparse  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                f"{name} requires the 'openparse' package (and its pdf "
+                "stack); install it to use openparse ingestion pipelines"
+            ) from e
+        raise NotImplementedError(
+            f"{name}: openparse is present but the TPU-native pipeline "
+            "for it is not wired; use OpenParse in xpacks.llm.parsers "
+            "for openparse-based chunking"
+        )
+    raise AttributeError(name)
+
+
+__all__ = list(_NAMES)

@@ -53,3 +53,29 @@ def test_reference_top_level_export_parity():
         n for n in ref_names if not hasattr(pw, n)
     )
     assert missing == [], f"missing top-level names: {missing}"
+
+
+def test_reference_namespace_module_parity():
+    """Every reference io/stdlib/xpacks.llm submodule resolves here."""
+    import importlib
+    import os
+
+    for name, refpath in [
+        ("io", "/root/reference/python/pathway/io"),
+        ("stdlib", "/root/reference/python/pathway/stdlib"),
+        ("xpacks.llm", "/root/reference/python/pathway/xpacks/llm"),
+    ]:
+        if not os.path.isdir(refpath):
+            continue
+        missing = []
+        for entry in sorted(os.listdir(refpath)):
+            base = entry[:-3] if entry.endswith(".py") else entry
+            if base.startswith("_") or base in ("tests", "py.typed", "README.md"):
+                continue
+            if not (entry.endswith(".py") or os.path.isdir(os.path.join(refpath, entry))):
+                continue
+            try:
+                importlib.import_module(f"pathway_tpu.{name}.{base}")
+            except ImportError:
+                missing.append(base)
+        assert missing == [], f"pathway_tpu.{name} missing modules: {missing}"
