@@ -36,4 +36,8 @@ def __getattr__(name: str):
     raise AttributeError(name)
 
 
+def __dir__():
+    return sorted(set(globals()) | set(_NAMES))
+
+
 __all__ = list(_NAMES)
