@@ -79,8 +79,10 @@ def test_reference_namespace_module_parity():
             target = f"pathway_tpu.{name}.{base}"
             try:
                 importlib.import_module(target)
-            except ModuleNotFoundError as e:
-                # a missing TRANSITIVE dep is a different failure than a
-                # missing module — report it distinctly
-                missing.append(base if e.name == target else f"{base} ({e!r})")
+            except ImportError as e:
+                # a missing TRANSITIVE dep (or broken import) is a
+                # different failure than a missing module — report it
+                # distinctly, but keep scanning the rest
+                ename = getattr(e, "name", None)
+                missing.append(base if ename == target else f"{base} ({e!r})")
         assert missing == [], f"pathway_tpu.{name} missing modules: {missing}"

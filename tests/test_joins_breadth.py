@@ -4,11 +4,9 @@ under streams (reference test_joins.py / test_common.py coverage)."""
 
 from __future__ import annotations
 
-import pytest
-
 import pathway_tpu as pw
 
-from .utils import T, assert_table_equality_wo_index, run_table
+from .utils import T, run_table
 
 
 def test_multi_key_join():

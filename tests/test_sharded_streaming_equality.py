@@ -1,0 +1,106 @@
+"""Worker-count invariance under SCRIPTED STREAMS: the reference's
+PATHWAY_THREADS CI matrix applied to multi-epoch pipelines with
+retractions — every operator family must produce identical final state
+at 1 and 4 workers."""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib import temporal
+
+from .test_sharded import assert_same_result
+
+STREAM = """
+  | g | v | __time__ | __diff__
+1 | a | 1 | 2        | 1
+2 | b | 2 | 2        | 1
+3 | a | 3 | 4        | 1
+4 | c | 4 | 4        | 1
+2 | b | 2 | 6        | -1
+5 | a | 5 | 6        | 1
+3 | a | 3 | 8        | -1
+"""
+
+
+def _stream():
+    return pw.debug.table_from_markdown(STREAM)
+
+
+def test_streamed_groupby_invariant_across_workers():
+    def build():
+        t = _stream()
+        return t.groupby(pw.this.g).reduce(
+            pw.this.g,
+            s=pw.reducers.sum(pw.this.v),
+            n=pw.reducers.count(),
+            tup=pw.reducers.sorted_tuple(pw.this.v),
+        )
+
+    assert_same_result(build)
+
+
+def test_streamed_join_invariant_across_workers():
+    def build():
+        left = _stream()
+        right = pw.debug.table_from_markdown(
+            """
+          | g | w | __time__ | __diff__
+        7 | a | 10 | 2       | 1
+        8 | b | 20 | 4       | 1
+        9 | c | 30 | 6       | 1
+        8 | b | 20 | 8       | -1
+        """
+        )
+        return left.join(right, left.g == right.g).select(
+            g=left.g, v=left.v, w=right.w
+        )
+
+    assert_same_result(build)
+
+
+def test_streamed_window_invariant_across_workers():
+    def build():
+        t = pw.debug.table_from_markdown(
+            """
+          | t | v | __time__ | __diff__
+        1 | 1 | 1 | 2        | 1
+        2 | 3 | 2 | 4        | 1
+        3 | 5 | 3 | 6        | 1
+        2 | 3 | 2 | 8        | -1
+        """
+        )
+        return t.windowby(
+            pw.this.t, window=temporal.tumbling(duration=4)
+        ).reduce(
+            start=pw.this._pw_window_start,
+            total=pw.reducers.sum(pw.this.v),
+        )
+
+    assert_same_result(build)
+
+
+def test_streamed_distinct_and_flatten_invariant():
+    def build():
+        t = _stream()
+        parts = t.select(
+            g=pw.this.g,
+            ps=pw.apply_with_type(lambda v: tuple(range(v)), pw.ANY, pw.this.v),
+        )
+        flat = parts.flatten(pw.this.ps)
+        return flat.groupby(pw.this.g, pw.this.ps).reduce(
+            pw.this.g, pw.this.ps, n=pw.reducers.count()
+        )
+
+    assert_same_result(build)
+
+
+def test_streamed_sorting_index_invariant():
+    from pathway_tpu.stdlib.indexing import build_sorted_index, sort_from_index
+
+    def build():
+        t = _stream()
+        nodes = t.select(key=pw.this.v)
+        pn = sort_from_index(build_sorted_index(nodes)["index"])
+        return nodes.select(pw.this.key) + pn
+
+    assert_same_result(build)
