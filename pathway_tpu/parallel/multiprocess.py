@@ -305,8 +305,12 @@ class CoordinatorCluster(ShardCluster):
                 wm_changed |= bool(r["active"])
             if not (sent_any or got_mail or wm_changed or any(e._dirty for e in self.engines)):
                 break
-        self._broadcast({"op": "time_end", "t": time})
+        # process 0's sinks flush the epoch FIRST; only then do workers
+        # advance their input-offset cursors (time_end) — the reverse
+        # order loses the epoch's output if the cluster dies in between
+        # (workers would resume past input that was never delivered)
         self._time_end_all(time)
+        self._broadcast({"op": "time_end", "t": time})
         # the feed round consumed worker input: a cached pending=True
         # would spin empty epochs until the cache expired
         self._poll_replies = None
