@@ -154,26 +154,7 @@ class PersistenceMode:
     SPEEDRUN_REPLAY = "speedrun_replay"
 
 
-class TableSlice:
-    """table.slice proxy (reference internals/table_slice.py)."""
-
-    def __init__(self, table, names):
-        self._table = table
-        self._names = list(names)
-
-    def __iter__(self):
-        return iter(ColumnReference(self._table, n) for n in self._names)
-
-    def __getattr__(self, name):
-        if name.startswith("_"):
-            raise AttributeError(name)
-        return ColumnReference(self._table, name)
-
-    def __getitem__(self, name):
-        return ColumnReference(self._table, name)
-
-    def keys(self):
-        return list(self._names)
+from .internals.table_slice import TableSlice
 
 
 def assert_table_has_schema(

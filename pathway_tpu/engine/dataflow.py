@@ -1774,6 +1774,11 @@ class EngineGraph:
         self._async_loop = None
         self._stop = False
         self.connector_threads: list[threading.Thread] = []
+        # fatal reader-thread failures (name, exc): the run loop raises
+        # instead of treating the dead session as clean EOF (reference:
+        # a panicking reader thread propagates via catch_unwind,
+        # dataflow.rs:5679-5694)
+        self.connector_failures: list[tuple[str, BaseException]] = []
         # checkpoint/recovery (engine/persistence.py); epochs at or below
         # replay_frontier are recovered state: rebuilt, not re-emitted
         self.persistence_config = None
@@ -2030,6 +2035,7 @@ class EngineGraph:
             self._threads_started = True
         last_time = -1
         while not self._stop:
+            self._raise_connector_failure()
             # next scripted time: static sources + recovery replay queues
             times = [s.next_time() for s in self.static_sources]
             replay_pending = False
@@ -2089,6 +2095,10 @@ class EngineGraph:
             if monitoring_callback is not None:
                 monitoring_callback(self)
 
+        if not self._stop:
+            # a failure recorded as the loop exited (reader appended just
+            # before closing its session) must not look like clean EOF
+            self._raise_connector_failure()
         # final snapshot BEFORE the end-of-input flush: the flush assumes
         # input is over, which a restarted run cannot know
         if (
@@ -2129,6 +2139,11 @@ class EngineGraph:
         if self._threads_started:
             for t in self.connector_threads:
                 t.join(timeout=5.0)
+
+    def _raise_connector_failure(self) -> None:
+        if self.connector_failures:
+            name, exc = self.connector_failures[0]
+            raise EngineError(f"connector {name!r} failed: {exc}") from exc
 
     def stop(self):
         self._stop = True

@@ -584,6 +584,18 @@ class GraphRunner:
         ]
         return Lowered(node, out_names)
 
+    def _lower_remove_errors(self, table: Table, op: LogicalOp) -> Lowered:
+        """Drop rows holding ERROR in any column (reference
+        table.py:2491 remove_errors / column.py FilterOutValueContext)."""
+        base = self.lower(op.inputs[0])
+        fnode = df.FilterNode(
+            self.engine,
+            lambda key, row: not any(v is ERROR for v in row),
+            name="RemoveErrors",
+        )
+        fnode.connect(base.node)
+        return Lowered(fnode, base.names)
+
     def _lower_filter(self, table: Table, op: LogicalOp) -> Lowered:
         base = op.inputs[0]
         pred_expr = op.params["expr"]

@@ -37,7 +37,7 @@ class License:
     def check_entitlement(self, feature: str) -> None:
         """Raise when a gated feature is unavailable in this tier
         (reference license.rs:55)."""
-        gated = {"xpack-spatial", "enterprise-connectors"}
+        gated = {"xpack-spatial", "enterprise-connectors", "xpack-sharepoint"}
         if feature in gated and self.tier != "enterprise":
             raise LicenseError(
                 f"feature {feature!r} requires an enterprise license"

@@ -379,6 +379,59 @@ class Table:
             exprs[n] = DeclareTypeExpression(t, ColumnReference(self, n))
         return self.select(**exprs)
 
+    def update_id_type(self, id_type, *, id_append_only: bool | None = None) -> "Table":
+        """Declare the type of ``self.id`` (reference table.py:2003). The
+        engine keys rows by 128-bit pointers regardless, so this is a
+        schema-level declaration: it validates the type is a Pointer and
+        re-registers the table with the declared id dtype."""
+        wrapped = dt.wrap(id_type)
+        if not isinstance(wrapped, dt.Pointer):
+            raise TypeError(
+                f"update_id_type() expects a Pointer type, got {wrapped!r}"
+            )
+        out = self.copy()
+        out._id_dtype = wrapped
+        if id_append_only is not None:
+            out._id_append_only = id_append_only
+        return out
+
+    @property
+    def slice(self) -> "TableSlice":
+        """A manipulable collection of references to this table's columns
+        (reference table.py:468 / table_slice.py)."""
+        from .table_slice import TableSlice
+
+        return TableSlice(
+            {n: ColumnReference(self, n) for n in self._columns}, self
+        )
+
+    def with_prefix(self, prefix: str) -> "Table":
+        """Rename all columns by prepending ``prefix`` (reference
+        table.py:1850)."""
+        return self.rename_by_dict({n: prefix + n for n in self._columns})
+
+    def with_suffix(self, suffix: str) -> "Table":
+        """Rename all columns by appending ``suffix`` (reference
+        table.py:1872)."""
+        return self.rename_by_dict({n: n + suffix for n in self._columns})
+
+    def remove_errors(self) -> "Table":
+        """Filter out rows in which any column holds the ERROR value
+        (reference table.py:2491). Use with
+        ``pw.run(terminate_on_error=False)``."""
+        cols = {n: Column(c.dtype) for n, c in self._columns.items()}
+        op = LogicalOp("remove_errors", [self], {})
+        return Table(
+            cols, self._universe.subset(), op, name=f"{self._name}.remove_errors"
+        )
+
+    def live(self):
+        """An interactively updating view of this table (reference
+        table.py:2565; experimental there too)."""
+        from .interactive import LiveTable
+
+        return LiveTable.from_table(self)
+
     # ---- re-keying ----
 
     def with_id(self, new_index: ColumnReference) -> "Table":

@@ -1,0 +1,134 @@
+"""TableSlice — a manipulable collection of column references.
+
+Rebuild of /root/reference/python/pathway/internals/table_slice.py:16-153:
+``table.slice`` yields a mapping-like view of the table's columns that
+supports ``without``/``rename``/``with_prefix``/``with_suffix``/
+``__getitem__`` and re-anchoring through ``ix``/``ix_ref``.  Slices are
+consumed by ``select``/``with_columns`` star-expansion the same way the
+table itself is (iterating yields ColumnReferences).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator, Mapping
+
+from .expression import ColumnReference
+from .thisclass import ThisMetaclass, this
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .table import Table
+
+
+class TableSlice:
+    """Collection of references to Table columns, created by
+    ``Table.slice`` (or by slicing ``pw.this``).  Supports basic column
+    manipulation; iterating yields the column references so a slice can
+    be splatted into ``select``.
+
+    >>> import pathway_tpu as pw
+    >>> t1 = pw.debug.table_from_markdown('''
+    ... age | owner | pet
+    ... 10  | Alice | dog
+    ... 9   | Bob   | dog
+    ... ''')
+    >>> t1.slice.without("age").with_suffix("_col")
+    TableSlice({'owner_col': <table>.owner, 'pet_col': <table>.pet})
+    """
+
+    def __init__(self, mapping: Mapping[str, ColumnReference], table: "Table"):
+        self._mapping = dict(mapping)
+        self._table = table
+
+    def __iter__(self) -> Iterator[ColumnReference]:
+        return iter(self._mapping.values())
+
+    def __repr__(self):
+        body = ", ".join(f"{k!r}: <table>.{v._name}" for k, v in self._mapping.items())
+        return "TableSlice({" + body + "})"
+
+    def keys(self):
+        return self._mapping.keys()
+
+    def __getitem__(self, arg):
+        if isinstance(arg, (ColumnReference, str)):
+            return self._mapping[self._normalize(arg)]
+        return TableSlice({self._normalize(k): self[k] for k in arg}, self._table)
+
+    def __getattr__(self, name: str) -> ColumnReference:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        from .table import Table
+
+        if hasattr(Table, name) and name != "id":
+            raise ValueError(
+                f"{name!r} is a method name. It is discouraged to use it as a"
+                f" column name. If you really want to use it, use [{name!r}]."
+            )
+        mapping = self.__dict__.get("_mapping", {})
+        if name not in mapping:
+            raise AttributeError(f"Column name {name!r} not found in {self!r}.")
+        return mapping[name]
+
+    def without(self, *cols) -> "TableSlice":
+        mapping = dict(self._mapping)
+        for col in cols:
+            colname = self._normalize(col)
+            if colname not in mapping:
+                raise KeyError(f"Column name {colname!r} not found in a {self}.")
+            mapping.pop(colname)
+        return TableSlice(mapping, self._table)
+
+    def rename(self, rename_dict: Mapping) -> "TableSlice":
+        normalized = {
+            self._normalize(old): self._normalize(new)
+            for old, new in rename_dict.items()
+        }
+        mapping = dict(self._mapping)
+        for old in normalized:
+            if old not in mapping:
+                raise KeyError(f"Column name {old!r} not found in a {self}.")
+            mapping.pop(old)
+        for old, new in normalized.items():
+            mapping[new] = self._mapping[old]
+        return TableSlice(mapping, self._table)
+
+    def with_prefix(self, prefix: str) -> "TableSlice":
+        return self.rename({name: prefix + name for name in self.keys()})
+
+    def with_suffix(self, suffix: str) -> "TableSlice":
+        return self.rename({name: name + suffix for name in self.keys()})
+
+    def ix(self, expression, *, optional: bool = False, context=None) -> "TableSlice":
+        applied = self._table.ix(expression, optional=optional, context=context)
+        return TableSlice(
+            {name: applied[ref._name] for name, ref in self._mapping.items()},
+            self._table,
+        )
+
+    def ix_ref(self, *args, optional: bool = False, context=None) -> "TableSlice":
+        applied = self._table.ix_ref(*args, optional=optional, context=context)
+        return TableSlice(
+            {name: applied[ref._name] for name, ref in self._mapping.items()},
+            self._table,
+        )
+
+    @property
+    def slice(self) -> "TableSlice":
+        return self
+
+    def _normalize(self, arg) -> str:
+        if isinstance(arg, ColumnReference):
+            tab = arg._table
+            if isinstance(tab, ThisMetaclass):
+                if tab is not this:
+                    raise ValueError(
+                        f"TableSlice expects {arg._name!r} or this.{arg._name}"
+                        " argument as column reference."
+                    )
+            elif tab is not self._table:
+                raise ValueError(
+                    "TableSlice method arguments should refer to table of which"
+                    " the slice was created."
+                )
+            return arg._name
+        return arg

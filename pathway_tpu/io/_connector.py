@@ -180,6 +180,12 @@ def input_table_from_reader(
         def run():
             try:
                 reader(ctx)
+            except Exception as exc:
+                # record BEFORE close(): the engine loop must see the
+                # failure when it sees the closed session, or a crashed
+                # reader looks like clean EOF
+                engine.connector_failures.append((name, exc))
+                engine.wake()
             finally:
                 ctx.close()
 

@@ -398,6 +398,7 @@ class ShardCluster:
         primary._threads_started = True
         last_time = -1
         while not (self._stop or primary._stop):
+            primary._raise_connector_failure()
             times = [s.next_time() for s in primary.static_sources]
             replay_pending = False
             for s in primary.session_sources:
@@ -479,6 +480,8 @@ class ShardCluster:
             if monitoring_callback is not None:
                 monitoring_callback(primary)
 
+        if not (self._stop or primary._stop):
+            primary._raise_connector_failure()
         # final snapshot BEFORE the end-of-input flush (the flush assumes
         # input is over, which a restarted run cannot know)
         if (

@@ -87,7 +87,9 @@ def test_airbyte_requires_runtime_or_source():
 
 def test_sharepoint_gated_by_license():
     with pytest.raises(pw.LicenseError):
-        pw.xpacks.connectors.sharepoint.read("https://example.sharepoint.com/site")
+        pw.xpacks.connectors.sharepoint.read(
+            "https://example.sharepoint.com/site", root_path="Docs"
+        )
 
 
 def test_live_table_snapshot():

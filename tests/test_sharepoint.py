@@ -1,0 +1,299 @@
+"""SharePoint connector (P30) — scanner diffs, size limits, retries,
+static + streaming modes, all on an injectable fake Office365 client.
+
+Mirrors the reference connector's behavior
+(/root/reference/python/pathway/xpacks/connectors/sharepoint/__init__.py:84-229):
+snapshot diffing against stored metadata, deletion retraction,
+STATUS_SIZE_LIMIT_EXCEEDED payload skipping, bounded retry on scan
+failure.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.xpacks.connectors.sharepoint import (
+    STATUS_DOWNLOADED,
+    STATUS_SIZE_LIMIT_EXCEEDED,
+    _EntryMeta,
+    _Scanner,
+)
+
+
+class FakeFile:
+    def __init__(self, path, content, modified_at=100, created_at=50):
+        self.path = path
+        self._content = content
+        self.size = len(content)
+        self.created_at = created_at
+        self.modified_at = modified_at
+        self.reads = 0
+
+    def read(self):
+        self.reads += 1
+        return self._content
+
+
+class FakeContext:
+    def __init__(self, files):
+        self.files = list(files)
+        self.scans = 0
+
+    def list_files(self, root_path, recursive):
+        self.scans += 1
+        return list(self.files)
+
+
+@pytest.fixture(autouse=True)
+def _enterprise_license():
+    pw.set_license_key("enterprise-test")
+    yield
+    pw.set_license_key(None)
+
+
+def test_sharepoint_gated_by_license():
+    pw.set_license_key(None)
+    with pytest.raises(pw.LicenseError):
+        pw.xpacks.connectors.sharepoint.read(
+            "https://example.sharepoint.com/sites/S", root_path="Docs"
+        )
+
+
+def test_scanner_snapshot_diff_and_deletions():
+    f1 = FakeFile("/sites/S/Docs/a.txt", b"alpha")
+    f2 = FakeFile("/sites/S/Docs/b.txt", b"beta")
+    ctx = FakeContext([f1, f2])
+    stored: dict = {}
+    scanner = _Scanner(ctx, "Docs", True, stored)
+
+    updated, deleted = scanner.get_snapshot_diff()
+    assert sorted(m.path for _, m in updated) == [f1.path, f2.path]
+    assert deleted == []
+
+    # unchanged second scan: nothing re-downloaded
+    updated, deleted = scanner.get_snapshot_diff()
+    assert updated == [] and deleted == []
+    assert f1.reads == 1 and f2.reads == 1
+
+    # modify one, delete the other
+    f1.modified_at = 200
+    ctx.files = [f1]
+    updated, deleted = scanner.get_snapshot_diff()
+    assert [m.path for _, m in updated] == [f1.path]
+    assert deleted == [f2.path]
+    assert f1.reads == 2
+
+
+def test_scanner_partial_failure_does_not_lose_updates():
+    """A payload fetch failing mid-scan must not mark earlier files of
+    the same scan as ingested — the retry must re-emit them."""
+
+    class FlakyFile(FakeFile):
+        def __init__(self, *a):
+            super().__init__(*a)
+            self.fail_next = True
+
+        def read(self):
+            if self.fail_next:
+                self.fail_next = False
+                raise ConnectionError("transient")
+            return super().read()
+
+    good = FakeFile("/s/a", b"A")
+    flaky = FlakyFile("/s/b", b"B")
+    stored: dict = {}
+    scanner = _Scanner(FakeContext([good, flaky]), "s", True, stored)
+    with pytest.raises(ConnectionError):
+        scanner.get_snapshot_diff()
+    assert stored == {}, "failed scan leaked metadata"
+    updated, deleted = scanner.get_snapshot_diff()
+    assert sorted(m.path for _, m in updated) == ["/s/a", "/s/b"]
+
+
+def test_scanner_size_limit_skips_payload():
+    small = FakeFile("/s/a", b"ok")
+    big = FakeFile("/s/b", b"x" * 1000)
+    scanner = _Scanner(FakeContext([small, big]), "s", True, {}, object_size_limit=10)
+    updated, _ = scanner.get_snapshot_diff()
+    by_path = {m.path: (payload, m) for payload, m in updated}
+    assert by_path["/s/a"][0] == b"ok"
+    assert by_path["/s/a"][1].status == STATUS_DOWNLOADED
+    assert by_path["/s/b"][0] == b""
+    assert by_path["/s/b"][1].status == STATUS_SIZE_LIMIT_EXCEEDED
+    assert big.reads == 0  # oversized content never fetched
+
+
+def test_entry_meta_url_and_dict():
+    f = FakeFile("/sites/S/Docs/a b.txt", b"x")
+    meta = _EntryMeta(f, base_url="https://company.sharepoint.com")
+    d = meta.as_dict()
+    assert d["url"] == "https://company.sharepoint.com/sites/S/Docs/a%20b.txt"
+    assert d["path"] == f.path and d["size"] == 1
+    assert d["status"] == STATUS_DOWNLOADED
+    # equality ignores seen_at/status (change detection key)
+    meta2 = _EntryMeta(f)
+    assert meta == meta2
+    f.modified_at = 999
+    assert meta != _EntryMeta(f)
+
+
+def test_sharepoint_static_read_e2e():
+    files = [
+        FakeFile("/sites/S/Docs/a.txt", b"alpha"),
+        FakeFile("/sites/S/Docs/b.txt", b"beta"),
+    ]
+    t = pw.xpacks.connectors.sharepoint.read(
+        "https://company.sharepoint.com/sites/S",
+        root_path="Shared Documents/Docs",
+        mode="static",
+        with_metadata=True,
+        _context_factory=lambda: FakeContext(files),
+    )
+    rows = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: rows.append(
+            (row["data"], row["_metadata"].value["path"], is_addition)
+        ),
+    )
+    pw.run(monitoring_level="none")
+    assert sorted(rows) == [
+        (b"alpha", "/sites/S/Docs/a.txt", True),
+        (b"beta", "/sites/S/Docs/b.txt", True),
+    ]
+
+
+def test_sharepoint_static_retries_then_succeeds():
+    calls = {"n": 0}
+    good = FakeContext([FakeFile("/s/a", b"data")])
+
+    def factory():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ConnectionError("auth flake")
+        return good
+
+    t = pw.xpacks.connectors.sharepoint.read(
+        "https://x.sharepoint.com/sites/S",
+        root_path="Docs",
+        mode="static",
+        refresh_interval=0,
+        max_failed_attempts_in_row=5,
+        _context_factory=factory,
+    )
+    rows = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: rows.append(row["data"])
+    )
+    pw.run(monitoring_level="none")
+    assert rows == [b"data"]
+    assert calls["n"] == 3
+
+
+def test_sharepoint_abort_after_max_failures():
+    """A reader that exhausts max_failed_attempts_in_row must fail the
+    run (EngineError), not end as a clean empty table."""
+    from pathway_tpu.engine.dataflow import EngineError
+
+    def factory():
+        raise ConnectionError("bad credentials")
+
+    t = pw.xpacks.connectors.sharepoint.read(
+        "https://x.sharepoint.com/sites/S",
+        root_path="Docs",
+        mode="static",
+        refresh_interval=0,
+        max_failed_attempts_in_row=3,
+        _context_factory=factory,
+    )
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: None)
+    with pytest.raises(EngineError, match="sharepoint.*failed"):
+        pw.run(monitoring_level="none")
+
+
+def test_sharepoint_recovery_retracts_downtime_deletions(tmp_path):
+    """Restart from a checkpoint: unchanged files are not re-downloaded,
+    files deleted while the pipeline was down are retracted."""
+    f1 = FakeFile("/s/a.txt", b"one")
+    f2 = FakeFile("/s/b.txt", b"two")
+
+    def run_once(files, events):
+        ctx = FakeContext(files)
+        t = pw.xpacks.connectors.sharepoint.read(
+            "https://x.sharepoint.com/sites/S",
+            root_path="Docs",
+            mode="static",
+            persistent_id="sp1",
+            _context_factory=lambda: ctx,
+        )
+        pw.io.subscribe(
+            t,
+            on_change=lambda key, row, time, is_addition: events.append(
+                (row["data"], is_addition)
+            ),
+        )
+        pw.run(
+            monitoring_level="none",
+            persistence_config=pw.persistence.Config.simple_config(
+                pw.persistence.Backend.filesystem(str(tmp_path / "snap"))
+            ),
+        )
+        pw.clear_graph()
+        return ctx
+
+    ev1: list = []
+    run_once([f1, f2], ev1)
+    assert sorted(ev1) == [(b"one", True), (b"two", True)]
+    assert f1.reads == 1 and f2.reads == 1
+
+    # b.txt deleted during downtime; restart
+    ev2: list = []
+    run_once([f1], ev2)
+    assert f1.reads == 1, "unchanged file was re-downloaded after recovery"
+    assert (b"two", False) in ev2, "downtime deletion was not retracted"
+    assert (b"one", True) not in ev2, "recovered row was re-delivered"
+
+
+def test_sharepoint_streaming_updates_and_deletions():
+    f1 = FakeFile("/s/a.txt", b"one")
+    ctx = FakeContext([f1])
+    t = pw.xpacks.connectors.sharepoint.read(
+        "https://x.sharepoint.com/sites/S",
+        root_path="Docs",
+        mode="streaming",
+        refresh_interval=0.05,
+        autocommit_duration_ms=50,
+        _context_factory=lambda: ctx,
+    )
+    events = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (row["data"], is_addition)
+        ),
+    )
+
+    runner = GraphRunner()
+    for spec in list(pw.parse_graph.subscriptions):
+        runner.subscribe(spec["table"], on_change=spec.get("on_change"))
+
+    def mutate():
+        time.sleep(0.6)
+        ctx.files = [FakeFile("/s/b.txt", b"two")]  # add b, delete a
+        time.sleep(0.6)
+        runner.engine.stop()
+
+    th = threading.Thread(target=mutate, daemon=True)
+    th.start()
+    runner.run()
+    th.join(timeout=10)
+
+    assert (b"one", True) in events
+    assert (b"two", True) in events
+    assert (b"one", False) in events  # deletion retracts
+    assert (b"two", False) not in events

@@ -1,0 +1,156 @@
+"""Table.slice / TableSlice and the table-API stragglers.
+
+Mirrors the reference semantics of
+/root/reference/python/pathway/internals/table_slice.py:16-153 and
+table.py with_prefix:1850 / with_suffix:1872 / update_id_type:2003 /
+remove_errors:2491 / live:2565.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.value import Error
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.internals.table_slice import TableSlice
+
+from .utils import T, assert_table_equality_wo_index
+
+
+def _pets():
+    return T(
+        """
+          | age | owner | pet
+        1 | 10  | Alice | dog
+        2 | 9   | Bob   | dog
+        3 | 8   | Alice | cat
+        """
+    )
+
+
+def test_slice_keys_and_iter():
+    t = _pets()
+    s = t.slice
+    assert isinstance(s, TableSlice)
+    assert list(s.keys()) == ["age", "owner", "pet"]
+    refs = list(s)
+    assert [r._name for r in refs] == ["age", "owner", "pet"]
+    assert all(r._table is t for r in refs)
+
+
+def test_slice_getitem_str_and_ref_and_list():
+    t = _pets()
+    s = t.slice
+    assert s["age"]._name == "age"
+    assert s[t.age]._name == "age"
+    assert s[pw.this.age]._name == "age"
+    sub = s[["age", "pet"]]
+    assert isinstance(sub, TableSlice)
+    assert list(sub.keys()) == ["age", "pet"]
+
+
+def test_slice_getattr_rejects_method_names():
+    s = _pets().slice
+    assert s.age._name == "age"
+    with pytest.raises(ValueError, match="method name"):
+        s.select
+    with pytest.raises(AttributeError, match="not found"):
+        s.nonexistent
+
+
+def test_slice_without_and_rename():
+    s = _pets().slice
+    assert list(s.without("age").keys()) == ["owner", "pet"]
+    assert list(s.without(pw.this.age, "pet").keys()) == ["owner"]
+    with pytest.raises(KeyError):
+        s.without("missing")
+    renamed = s.rename({"age": "years"})
+    assert list(renamed.keys()) == ["owner", "pet", "years"]
+    assert renamed["years"]._name == "age"  # still refers to source column
+
+
+def test_slice_prefix_suffix():
+    s = _pets().slice
+    assert list(s.with_prefix("u_").keys()) == ["u_age", "u_owner", "u_pet"]
+    assert list(s.with_suffix("_c").keys()) == ["age_c", "owner_c", "pet_c"]
+    # chained, as in the reference docstring
+    assert list(s.without("age").with_suffix("_col").keys()) == [
+        "owner_col",
+        "pet_col",
+    ]
+
+
+def test_slice_rejects_foreign_table_refs():
+    t1, t2 = _pets(), _pets()
+    with pytest.raises(ValueError, match="of which the slice was created"):
+        t1.slice.without(t2.age)
+    with pytest.raises(ValueError, match="column reference"):
+        t1.slice.without(pw.left.age)
+
+
+def test_slice_splat_into_select():
+    t = _pets()
+    r = t.select(*t.slice.without("age"))
+    assert_table_equality_wo_index(
+        r,
+        T(
+            """
+              | owner | pet
+            1 | Alice | dog
+            2 | Bob   | dog
+            3 | Alice | cat
+            """
+        ),
+    )
+
+
+def test_slice_of_slice_property():
+    s = _pets().slice
+    assert s.slice is s
+
+
+def test_table_with_prefix_suffix():
+    t = _pets()
+    assert_table_equality_wo_index(
+        t.with_prefix("u_"),
+        T(
+            """
+              | u_age | u_owner | u_pet
+            1 | 10    | Alice   | dog
+            2 | 9     | Bob     | dog
+            3 | 8     | Alice   | cat
+            """
+        ),
+    )
+    assert t.with_suffix("_x").column_names() == ["age_x", "owner_x", "pet_x"]
+
+
+def test_update_id_type():
+    t = _pets()
+    out = t.update_id_type(pw.Pointer)
+    assert out.column_names() == t.column_names()
+    with pytest.raises(TypeError):
+        t.update_id_type(int)
+
+
+def test_remove_errors():
+    t = T(
+        """
+          | a  | b
+        1 | 10 | 2
+        2 | 7  | 0
+        3 | 9  | 3
+        """
+    )
+    res = t.select(
+        a=pw.this.a, q=pw.apply(lambda a, b: a // b, pw.this.a, pw.this.b)
+    )
+    cleaned = res.remove_errors()
+    runner = GraphRunner()
+    runner.engine.terminate_on_error = False
+    cap, names = runner.capture(cleaned)
+    runner.run()
+    rows = sorted(cap.state.values())
+    assert rows == [(9, 3), (10, 5)]
+    assert not any(any(v is Error for v in row) for row in rows)
