@@ -51,6 +51,12 @@ KIND_OPSNAP = 3
 # it; recovery into a CHANGED program must fail loudly, not silently
 # compute from a partial log
 KIND_COMPACT = 4
+# reader offsets captured at FEED time (not yet finalized): recovery may
+# promote the frontier to such an epoch when process 0's delivered
+# marker proves its output reached the sinks — closing the
+# duplicate-delivery window between p0's sink flush and the worker's
+# ADVANCE (exactly-once across the whole crash window)
+KIND_FEED = 5
 
 _PY_MAGIC = b"PWPYLOG1"
 
@@ -409,6 +415,8 @@ class EnginePersistence:
         self.events = getattr(backend, "events", None)
         self.config = config
         self._s3: S3LogStorage | None = None
+        self._base_root = self.root  # process-0 namespace (delivered marker)
+        self._s3_base_prefix: str | None = None
         if self.kind == "filesystem":
             # one namespace per process of the topology — parallel hosts
             # must not share log files (reference WorkerPersistentStorage,
@@ -423,6 +431,7 @@ class EnginePersistence:
         elif self.kind == "s3":
             # reference src/persistence/backends/s3.rs:34
             bucket, prefix = self._parse_s3_root(backend)
+            self._s3_base_prefix = prefix
             pid = os.environ.get("PATHWAY_PROCESS_ID")
             if pid and pid != "0":
                 prefix = f"{prefix}/proc-{pid}"
@@ -492,10 +501,16 @@ class EnginePersistence:
 
     # -- engine API --
 
-    def recover_source(self, source_id: str):
+    def recover_source(self, source_id: str, delivered_frontier: int = -1):
         """Read a source's log. Returns ``(batches, offsets, frontier)``:
         time-ordered finalized update batches, the reader offsets at the
-        last ADVANCE, and the finalized frontier (-1 when fresh)."""
+        last ADVANCE, and the finalized frontier (-1 when fresh).
+
+        ``delivered_frontier``: process 0's durable record of the last
+        epoch whose output reached the sinks. Epochs this worker fed
+        (KIND_FEED present) but never ADVANCEd are promoted to finalized
+        when they are at or below it — they were delivered, so replaying
+        them as fresh input would deliver twice."""
         import pickle
 
         reader = self._open_reader(source_id)
@@ -503,6 +518,7 @@ class EnginePersistence:
             return [], {}, -1
         by_time: dict[int, list] = {}
         offsets: dict = {}
+        feed_offsets: dict[int, dict] = {}
         frontier = -1
         compacted_to = -1
         try:
@@ -513,10 +529,16 @@ class EnginePersistence:
                 elif kind == KIND_ADVANCE:
                     frontier = max(frontier, time)
                     offsets = pickle.loads(blob)
+                elif kind == KIND_FEED:
+                    feed_offsets[time] = pickle.loads(blob)
                 elif kind == KIND_COMPACT:
                     compacted_to = max(compacted_to, time)
         finally:
             reader.close()
+        for t in sorted(feed_offsets):
+            if frontier < t <= delivered_frontier:
+                frontier = t
+                offsets = feed_offsets[t]
         self.compacted_to[source_id] = compacted_to
         batches = sorted((t, ups) for t, ups in by_time.items() if t <= frontier)
         # Compact the log down to exactly the finalized records before any
@@ -671,12 +693,21 @@ class EnginePersistence:
             keep.append(rec)
         bucket[:] = keep
 
-    def log_batch(self, source_id: str, time: int, updates: list) -> None:
+    def log_batch(
+        self, source_id: str, time: int, updates: list, offsets: dict | None = None
+    ) -> None:
         import pickle
 
         w = self.writer_for(source_id)
         for key, row, diff in updates:
             w.append(KIND_DATA, time, key, pickle.dumps((row, diff), protocol=4))
+        if offsets is not None:
+            # feed-time offsets: durable BEFORE process 0 can deliver the
+            # epoch, so a crash between p0's sink flush and this worker's
+            # ADVANCE leaves enough on disk to finalize the epoch on
+            # recovery (see recover_source delivered_frontier)
+            w.append(KIND_FEED, time, 0, pickle.dumps(offsets or {}, protocol=4))
+            w.flush()
 
     def advance(self, source_id: str, time: int, offsets: dict) -> None:
         import pickle
@@ -686,6 +717,58 @@ class EnginePersistence:
         w.flush()
 
     OPS_SOURCE = "__operators__"
+    DELIVERED_SOURCE = "__delivered__"
+
+    def mark_delivered(self, time: int) -> None:
+        """Process 0 only: durably record that sinks flushed epoch
+        ``time`` — written after the sink flush, before workers are told
+        to advance their offset cursors. Workers consult this marker on
+        recovery (``delivered_frontier``) to finalize fed-but-unadvanced
+        epochs instead of re-delivering them."""
+        w = self.writer_for(self.DELIVERED_SOURCE)
+        w.append(KIND_ADVANCE, int(time), 0, b"")
+        w.flush()
+        self._delivered_appends = getattr(self, "_delivered_appends", 0) + 1
+        if self._delivered_appends >= 4096:
+            # bound the marker log: only the max time matters
+            old = self._writers.pop(self.DELIVERED_SOURCE, None)
+            if old is not None:
+                old.close()
+            self._replace_single_record(
+                self.DELIVERED_SOURCE, (KIND_ADVANCE, int(time), 0, b"")
+            )
+            self._delivered_appends = 0
+
+    def delivered_frontier(self) -> int:
+        """Last epoch process 0 durably delivered (-1 when none). Read
+        from the process-0 namespace so worker processes see it too."""
+        reader = self._open_reader_base(self.DELIVERED_SOURCE)
+        if reader is None:
+            return -1
+        frontier = -1
+        try:
+            for kind, time, _key, _blob in reader:
+                if kind == KIND_ADVANCE:
+                    frontier = max(frontier, time)
+        finally:
+            reader.close()
+        return frontier
+
+    def _open_reader_base(self, source_id: str):
+        """Open a source log in the PROCESS-0 namespace regardless of
+        this process's own proc-<pid> namespace."""
+        if self.kind == "mock":
+            return MemoryLogReader(self._mock_bucket(source_id), source_id)
+        if self.kind == "s3":
+            assert self._s3 is not None
+            base = S3LogStorage(
+                self._s3.client, self._s3.bucket, self._s3_base_prefix or ""
+            )
+            return _ListReader(base.read_records(_safe_id(source_id)))
+        path = os.path.join(
+            self._base_root, "streams", _safe_id(source_id) + ".bin"
+        )
+        return sniff_log_reader(path)
 
     def _replace_single_record(
         self, source_id: str, record: tuple[int, int, int, bytes] | None

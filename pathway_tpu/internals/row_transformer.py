@@ -32,6 +32,7 @@ sentinels and re-bind to the restored node.
 
 from __future__ import annotations
 
+import weakref
 from types import SimpleNamespace
 from typing import Any, Callable
 
@@ -197,23 +198,34 @@ class BoundMethod:
     change re-emits method rows and downstream consumers recompute
     (methods may read ANY row, so this is the sound invalidation)."""
 
-    __slots__ = ("_node", "_which", "_key", "_name", "_ver")
+    __slots__ = ("_node", "_spec_name", "_which", "_key", "_name", "_ver")
 
-    def __init__(self, node, which: str, key: int, name: str):
+    def __init__(self, node, which: str, key: int, name: str, spec_name: str | None = None):
         self._node = node
+        self._spec_name = (
+            spec_name if spec_name is not None else (node.spec.name if node is not None else None)
+        )
         self._which = which
         self._key = key
         self._name = name
         self._ver = getattr(node, "state_ver", 0) if node is not None else -1
 
     def __call__(self, *args):
-        if self._node is None:
-            raise RuntimeError(
-                f"pw.method cell {self._which}.{self._name} was detached "
-                "from its transformer (serialized across a process or "
-                "snapshot boundary); call it inside the producing process"
-            )
-        ctx = _EvalContext(self._node.spec, self._node.states)
+        node = self._node
+        if node is None:
+            # a cell restored from another operator's snapshot (or sent
+            # cross-process) re-binds lazily against the live transformer
+            # node of this process
+            node = _LIVE_TRANSFORMER_NODES.get((self._spec_name, self._which))
+            if node is None:
+                raise RuntimeError(
+                    f"pw.method cell {self._which}.{self._name} was detached "
+                    "from its transformer (serialized across a process or "
+                    "snapshot boundary) and no live transformer node named "
+                    f"{self._spec_name!r} exists in this process"
+                )
+            self._node = node
+        ctx = _EvalContext(node.spec, node.states)
         return ctx.resolve(self._which, self._key, self._name)(*args)
 
     def _binding(self):
@@ -228,16 +240,25 @@ class BoundMethod:
     def __reduce__(self):
         # method cells can leak into downstream nodes' pickled state
         # (operator snapshots, cross-process rows): serialize the
-        # binding, never the node (it holds locks/threads)
-        return (_detached_method, (self._which, self._key, self._name))
+        # binding, never the node (it holds locks/threads); the restored
+        # cell re-binds lazily via _LIVE_TRANSFORMER_NODES on first call
+        return (_detached_method, (self._spec_name, self._which, self._key, self._name))
 
     def __repr__(self):
         return f"<pw.method {self._which}.{self._name} @ {self._key:#x}>"
 
 
-def _detached_method(which, key, name):
-    m = BoundMethod(None, which, key, name)
-    return m
+def _detached_method(spec_name, which, key, name):
+    return BoundMethod(None, which, key, name, spec_name=spec_name)
+
+
+#: live transformer nodes of this process, keyed by (transformer name,
+#: class-arg name) — detached BoundMethods (restored from snapshots of
+#: OTHER operators' state) resolve against this at call time. Weak so a
+#: torn-down graph doesn't pin its nodes.
+_LIVE_TRANSFORMER_NODES: "weakref.WeakValueDictionary[tuple, Any]" = (
+    weakref.WeakValueDictionary()
+)
 
 
 class _RowTransformerNode(df.Node):
@@ -255,11 +276,12 @@ class _RowTransformerNode(df.Node):
         self.states: dict[str, dict[int, tuple]] = {n: {} for n in arg_order}
         self.emitted: dict[int, tuple] = {}
         self.state_ver = 0
+        _LIVE_TRANSFORMER_NODES[(spec.name, which)] = self
 
     def snapshot_state(self):
         def enc(v):
             if isinstance(v, BoundMethod):
-                return ("__pw_method__",) + v._binding()
+                return ("__pw_method__", v._which, v._key, v._name)
             return v
 
         return {

@@ -81,11 +81,30 @@ def _venv_executable(
     try:
         _venv.create(tmp, with_pip=True)
         pip = os.path.join(tmp, "bin", "pip")
+        # pin to the tag when it parses as a PyPI version: a config
+        # pinned to an older tag must not silently run the newest
+        # release (docker-style tags like 'dev' don't map to versions,
+        # so those stay unpinned). NOTE: this path installs from PyPI
+        # over the network at reader start — air-gapped deployments
+        # should use docker_image or a pre-built venv instead.
+        import re
+
+        requirement = f"airbyte-{connector_name}"
+        if re.fullmatch(r"\d+(\.\d+)*([a-zA-Z0-9.+-]*)", tag or ""):
+            requirement += f"=={tag}"
         proc = sp.run(
-            [pip, "install", f"airbyte-{connector_name}"],
+            [pip, "install", requirement],
             capture_output=True,
             text=True,
         )
+        if proc.returncode != 0 and requirement.endswith(f"=={tag}"):
+            # docker tags don't always exist on PyPI — fall back to
+            # unpinned rather than failing a previously-working config
+            proc = sp.run(
+                [pip, "install", f"airbyte-{connector_name}"],
+                capture_output=True,
+                text=True,
+            )
         tmp_exe = os.path.join(tmp, "bin", connector_name)
         if proc.returncode != 0 or not os.path.exists(tmp_exe):
             raise RuntimeError(

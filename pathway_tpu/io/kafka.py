@@ -37,7 +37,17 @@ def _get_consumer(
                 for p in partitions
             ]
             try:
-                cons.assign(cons.offsets_for_times(lookup, timeout=10.0))
+                resolved = cons.offsets_for_times(lookup, timeout=10.0)
+                # offsets_for_times returns offset=-1 for partitions with
+                # no message at/after the timestamp; assigning -1 falls
+                # back to auto.offset.reset (commonly 'earliest') and
+                # replays history — start those at the end instead
+                from confluent_kafka import OFFSET_END  # type: ignore
+
+                for tp in resolved:
+                    if tp.offset < 0:
+                        tp.offset = OFFSET_END
+                cons.assign(resolved)
             except Exception:
                 # keep the ORIGINAL assignment (timestamps are not
                 # offsets; seeking to one lands out of range)

@@ -45,6 +45,14 @@ coordinator's epoch numbering continues past every process's logged
 times; cluster-wide operator snapshots carry worker state, and the
 RESTORE broadcast's snapshot time trims already-snapshotted replay.
 
+Exactly-once across the crash window: workers append the epoch's batch
+plus feed-time offsets (KIND_FEED) durably BEFORE replying to the feed
+round; process 0 flushes sinks, then durably marks the epoch delivered
+(mark_delivered), then tells workers to ADVANCE. Recovery finalizes any
+fed-but-unadvanced epoch at or below the delivered marker (replay, no
+re-delivery) and trims epochs above it (re-read, delivered once) — so
+no crash position loses or duplicates an epoch.
+
 Trust boundary: after an authenticated JSON handshake, frames are
 pickled (rows may hold arbitrary python values), so a peer that knows
 the cluster token can execute code — exactly the trust level of the
@@ -310,6 +318,12 @@ class CoordinatorCluster(ShardCluster):
         # order loses the epoch's output if the cluster dies in between
         # (workers would resume past input that was never delivered)
         self._time_end_all(time)
+        if self._persistence is not None:
+            # durable delivered marker between the sink flush and the
+            # workers' ADVANCE: a crash in that window must finalize the
+            # epoch on recovery (workers promote fed-but-unadvanced
+            # epochs at or below this marker), never re-deliver it
+            self._persistence.mark_delivered(int(time))
         self._broadcast({"op": "time_end", "t": time})
         # the feed round consumed worker input: a cached pending=True
         # would spin empty epochs until the cache expired
@@ -495,7 +509,13 @@ def _feed_partitioned(
                 and s.persistent_id is not None
                 and resolved
             ):
-                persistence.log_batch(s.persistent_id, t, resolved)
+                # feed-time offsets ride in the same flushed append as
+                # the batch (KIND_FEED): if p0 delivers this epoch and
+                # the cluster dies before our ADVANCE, recovery promotes
+                # the epoch to finalized instead of re-delivering it
+                persistence.log_batch(
+                    s.persistent_id, t, resolved, offsets=s.last_offsets or {}
+                )
                 # the ADVANCE (offset cursor) flushes only when the
                 # epoch CLOSES: advancing at feed time would mark rows
                 # consumed that a mid-epoch crash never delivered —
@@ -520,7 +540,13 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
         from .sharded import recover_sources
 
         wp = EnginePersistence(cfg)
-        replay_frontier = recover_sources(wp, part_srcs, cfg, auto_prefix="auto_part")
+        replay_frontier = recover_sources(
+            wp,
+            part_srcs,
+            cfg,
+            auto_prefix="auto_part",
+            delivered_frontier=wp.delivered_frontier(),
+        )
     sock = None
     for _ in range(retries):
         try:

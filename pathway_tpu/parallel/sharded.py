@@ -31,10 +31,17 @@ from typing import Callable
 from ..engine import dataflow as df
 
 
-def recover_sources(persistence, sources, cfg, auto_prefix: str = "auto") -> int:
+def recover_sources(
+    persistence, sources, cfg, auto_prefix: str = "auto", delivered_frontier: int = -1
+) -> int:
     """Shared source-recovery pass (process 0 AND worker processes):
     assign auto ids, reset offset-unaware logs, restore offsets +
-    replay batches; returns the max recovered frontier."""
+    replay batches; returns the max recovered frontier.
+
+    ``delivered_frontier``: process 0's durable delivered marker —
+    worker processes pass it so epochs they fed (and p0 delivered) but
+    never ADVANCEd finalize instead of re-delivering
+    (persistence.recover_source)."""
     mode = str(getattr(cfg, "persistence_mode", "batch") or "batch").lower()
     record_mode = "record" in mode
     if getattr(cfg, "auto_persistent_ids", False) or record_mode:
@@ -52,7 +59,9 @@ def recover_sources(persistence, sources, cfg, auto_prefix: str = "auto") -> int
             # replaying a stale log on top would double it — reset
             persistence.reset_source(s.persistent_id)
             continue
-        batches, offsets, f = persistence.recover_source(s.persistent_id)
+        batches, offsets, f = persistence.recover_source(
+            s.persistent_id, delivered_frontier=delivered_frontier
+        )
         s.replay_batches = list(batches)
         s.session.restore_offsets(offsets)
         frontier = max(frontier, f)

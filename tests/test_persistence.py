@@ -599,3 +599,71 @@ def test_python_fallback_forced(tmp_path, monkeypatch):
     ev2: list = []
     _wordcount_run(in_dir, backend, ev2)
     assert ev2 == []
+
+
+def test_delivered_marker_finalizes_fed_epoch(tmp_path, monkeypatch):
+    """Crash window between process 0's sink flush and a worker's
+    ADVANCE: the worker fed+logged epoch 5 (KIND_FEED offsets) but never
+    advanced. With p0's delivered marker at >=5, recovery promotes the
+    epoch to finalized (replayed as state, reader resumes past it) —
+    without it, the epoch is trimmed and the reader re-reads (the
+    pre-marker at-least-once behavior)."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+    cfg = pw.persistence.Config.simple_config(backend)
+
+    # worker namespace: fed epoch 5, crash before ADVANCE
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    wp = eng_persist.EnginePersistence(cfg)
+    wp.log_batch("src", 3, [(1, ("seen",), 1)])
+    wp.advance("src", 3, {"cursor": 10})
+    wp.log_batch("src", 5, [(2, ("window",), 1)], offsets={"cursor": 20})
+    wp.close()
+
+    # process 0 delivered epoch 5 before the cluster died
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    p0 = eng_persist.EnginePersistence(cfg)
+    p0.mark_delivered(5)
+    p0.close()
+
+    # worker recovery consults the marker: epoch 5 is finalized
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    wp2 = eng_persist.EnginePersistence(cfg)
+    delivered = wp2.delivered_frontier()
+    assert delivered == 5
+    batches, offsets, frontier = wp2.recover_source(
+        "src", delivered_frontier=delivered
+    )
+    assert frontier == 5
+    assert offsets == {"cursor": 20}, "feed-time offsets were not adopted"
+    assert [t for t, _ in batches] == [3, 5]
+    wp2.close()
+
+
+def test_without_delivered_marker_fed_epoch_is_trimmed(tmp_path, monkeypatch):
+    """Same crash, but p0 never delivered epoch 5 (marker at 3): the fed
+    epoch must be trimmed and the reader offsets revert, so the input is
+    re-read and delivered exactly once."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+    cfg = pw.persistence.Config.simple_config(backend)
+
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    wp = eng_persist.EnginePersistence(cfg)
+    wp.log_batch("src", 3, [(1, ("seen",), 1)])
+    wp.advance("src", 3, {"cursor": 10})
+    wp.log_batch("src", 5, [(2, ("window",), 1)], offsets={"cursor": 20})
+    wp.close()
+
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    p0 = eng_persist.EnginePersistence(cfg)
+    p0.mark_delivered(3)
+    p0.close()
+
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    wp2 = eng_persist.EnginePersistence(cfg)
+    batches, offsets, frontier = wp2.recover_source(
+        "src", delivered_frontier=wp2.delivered_frontier()
+    )
+    assert frontier == 3
+    assert offsets == {"cursor": 10}
+    assert [t for t, _ in batches] == [3]
+    wp2.close()

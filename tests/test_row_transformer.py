@@ -301,3 +301,85 @@ def test_method_column_invalidates_on_state_change():
     rows = run_table(r)
     # final state: both rows see the FULL final sum (1+4)*10
     assert sorted(rows.values()) == [(50,), (50,)]
+
+
+def test_bound_method_pickle_rebinds_to_live_node():
+    """A BoundMethod pickled out of another operator's snapshotted state
+    (or sent cross-process) must re-bind to the live transformer node on
+    restore, not come back permanently broken."""
+    import pickle
+
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    @pw.transformer
+    class rebind_transformer:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.method
+            def c(self, arg) -> int:
+                return self.a * arg
+
+    t = T(
+        """
+      | a
+    1 | 7
+    """
+    )
+    mt = rebind_transformer(table=t).table
+    runner = GraphRunner()
+    cap, names = runner.capture(mt)
+    runner.run()
+    (row,) = cap.state.values()
+    method_cell = row[names.index("c")]
+    assert method_cell(10) == 70
+
+    # round-trip through pickle, as downstream operator snapshots do
+    restored = pickle.loads(pickle.dumps(method_cell))
+    assert restored._node is None
+    assert restored(10) == 70, "detached method did not re-bind"
+
+
+def test_transformer_node_snapshot_restores_method_cells():
+    """The owning node's own snapshot/restore round-trips method cells
+    back into callable BoundMethods (the enc/dec marker formats must
+    agree)."""
+    from pathway_tpu.internals.graph_runner import GraphRunner
+    from pathway_tpu.internals.row_transformer import BoundMethod, _RowTransformerNode
+
+    @pw.transformer
+    class snap_transformer:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.method
+            def c(self, arg) -> int:
+                return self.a + arg
+
+    t = T(
+        """
+      | a
+    1 | 5
+    """
+    )
+    mt = snap_transformer(table=t).table
+    runner = GraphRunner()
+    cap, names = runner.capture(mt)
+    runner.run()
+    node = next(
+        n for n in runner.engine.nodes if isinstance(n, _RowTransformerNode)
+    )
+    state = node.snapshot_state()
+    assert not any(
+        isinstance(v, BoundMethod) for row in state["emitted"].values() for v in row
+    ), "snapshot leaked live BoundMethods"
+    node.emitted = {}
+    node.restore_state(state)
+    cells = [
+        v
+        for row in node.emitted.values()
+        for v in row
+        if isinstance(v, BoundMethod)
+    ]
+    assert cells, "restore did not rebuild BoundMethod cells"
+    assert cells[0](1) == 6
