@@ -4,7 +4,15 @@ Rebuild of /root/reference/python/pathway/io/http/_server.py (805 LoC:
 PathwayWebserver :329, RestServerSubject :490, rest_connector :624 with
 the response_writer that resolves per-key asyncio events :778-804)."""
 
+from ._docs import EndpointDocumentation, EndpointExamples
 from ._server import PathwayWebserver, rest_connector
 from ._client import read, write
 
-__all__ = ["PathwayWebserver", "read", "rest_connector", "write"]
+__all__ = [
+    "EndpointDocumentation",
+    "EndpointExamples",
+    "PathwayWebserver",
+    "read",
+    "rest_connector",
+    "write",
+]

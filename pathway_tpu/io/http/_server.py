@@ -24,6 +24,12 @@ from ...internals.schema import Schema, schema_builder, ColumnDefinition
 from ...internals.table import Table
 from ...internals.parse_graph import G
 from .._connector import StreamingContext, input_table_from_reader
+from ._docs import (
+    EndpointDocumentation,
+    EndpointExamples,
+    _LoggingContext,
+    validate_payload,
+)
 
 try:
     from aiohttp import web
@@ -63,7 +69,8 @@ class PathwayWebserver:
     def add_route(self, route: str, methods: list[str], handler, schema_doc: dict | None = None):
         for m in methods:
             self._app.router.add_route(m, route, handler)
-        self._openapi[route] = schema_doc or {}
+        # merge: several connectors may share a route with distinct methods
+        self._openapi.setdefault(route, {}).update(schema_doc or {})
 
     def start(self):
         if self._thread is not None:
@@ -102,23 +109,42 @@ def rest_connector(
     route: str = "/",
     methods: list[str] = ("POST",),
     schema: type[Schema] | None = None,
+    format: str = "custom",
     autocommit_duration_ms: int | None = 50,
     keep_queries: bool = False,
     delete_completed_queries: bool = True,
     request_validator=None,
-    documentation=None,
+    validate_schema: bool | None = None,
+    documentation: EndpointDocumentation | None = None,
 ) -> tuple[Table, Any]:
     """Expose an HTTP endpoint as an input table. Returns
     (query_table, response_writer); call response_writer(result_table)
-    where result_table has a `result` column and query keys."""
+    where result_table has a `result` column and query keys.
+
+    ``format``: ``"custom"`` decodes a JSON body into schema columns;
+    ``"raw"`` feeds the request body as text into the ``query`` column.
+    ``documentation``: EndpointDocumentation rendered into ``/_schema``
+    (per-route OpenAPI with examples, reference _server.py:125).
+    ``validate_schema``: answer 400 for payloads that don't match the
+    schema (missing required fields, scalar type mismatches); defaults
+    to on for ``custom``-format endpoints with an explicit schema.
+    Every request logs one structured JSON access record (reference
+    :403-420)."""
     if webserver is None:
         assert host is not None and port is not None
         webserver = PathwayWebserver(host, port)
+    if format not in ("custom", "raw"):
+        raise ValueError(f"unknown format {format!r}; expected 'custom' or 'raw'")
+    if documentation is None:
+        documentation = EndpointDocumentation()
 
+    explicit_schema = schema is not None
     if schema is None:
         schema = schema_builder(
             {"query": ColumnDefinition(dtype=dt.JSON)}, name="RestSchema"
         )
+    if validate_schema is None:
+        validate_schema = format == "custom" and explicit_schema
     dtypes = schema.dtypes()
     names = list(dtypes.keys())
 
@@ -128,26 +154,41 @@ def rest_connector(
     started = threading.Event()
 
     async def handler(request):
+        qid = str(uuid.uuid4())
+        log_ctx = _LoggingContext(request, qid)
+
+        def respond(data, status=200):
+            log_ctx.log_response(status)
+            return web.json_response(data, status=status)
+
         if request.method == "GET":
             payload = dict(request.rel_url.query)
+        elif format == "raw":
+            payload = {"query": await request.text()}
         else:
             try:
                 payload = await request.json()
             except (ValueError, json.JSONDecodeError):
                 text = await request.text()
                 payload = {"query": text}
+        if validate_schema:
+            problem = validate_payload(payload, schema)
+            if problem is not None:
+                return respond({"error": problem}, status=400)
         if request_validator is not None:
             try:
                 request_validator(payload)
             except Exception as e:
-                return web.json_response({"error": str(e)}, status=400)
+                return respond({"error": str(e)}, status=400)
 
-        qid = str(uuid.uuid4())
         values: dict[str, Any] = {}
         for n in names:
             if n == "id":
                 continue
             v = payload.get(n)
+            props = schema.columns().get(n)
+            if v is None and props is not None and props.has_default_value:
+                v = props.default_value
             if dt.unoptionalize(dtypes[n]) is dt.JSON and not isinstance(v, Json):
                 v = Json(v)
             values[n] = v
@@ -159,14 +200,14 @@ def rest_connector(
         started.wait(timeout=30)
         ctx = ctx_holder.get("ctx")
         if ctx is None:
-            return web.json_response({"error": "pipeline not running"}, status=503)
+            return respond({"error": "pipeline not running"}, status=503)
         row = tuple(values.get(n) for n in names)
         ctx.session.insert(key, row)
         ctx.session.commit()
         try:
             result = await asyncio.wait_for(fut, timeout=120)
         except asyncio.TimeoutError:
-            return web.json_response({"error": "timeout"}, status=504)
+            return respond({"error": "timeout"}, status=504)
         finally:
             with pending_lock:
                 pending.pop(key, None)
@@ -174,9 +215,12 @@ def rest_connector(
             result = result.value
         from ..fs import _jsonable
 
-        return web.json_response(_jsonable(result))
+        return respond(_jsonable(result))
 
-    webserver.add_route(route, list(methods), handler)
+    docs: dict = {}
+    for m in methods:
+        docs.update(documentation.generate_docs(format, m, schema))
+    webserver.add_route(route, list(methods), handler, schema_doc=docs)
 
     def reader(ctx: StreamingContext) -> None:
         ctx_holder["ctx"] = ctx

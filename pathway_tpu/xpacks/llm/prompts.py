@@ -216,3 +216,22 @@ def prompt_query_rewrite(query: str, *additional_args: str) -> str:
         f"{(chr(10) + extra) if extra else ''}\n\n"
         f"Query: {query}\nRewritten query:"
     )
+
+
+# vision-parsing prompts (reference prompts.py:435-447)
+DEFAULT_JSON_TABLE_PARSE_PROMPT = (
+    "Describe the table in the image as a JSON object, keeping every "
+    "value, unit and metric; use clear column and row names. If the "
+    "image holds no table, answer 'No table.'."
+)
+
+DEFAULT_MD_TABLE_PARSE_PROMPT = (
+    "Describe the table in the image as a markdown table, keeping every "
+    "value, unit and metric; use clear column and row names. If the "
+    "image holds no table, answer 'No table.'."
+)
+
+DEFAULT_IMAGE_PARSE_PROMPT = (
+    "Describe the image in detail. Spell out any text it contains, and "
+    "keep tabular information formatted as a table."
+)

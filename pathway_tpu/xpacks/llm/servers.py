@@ -34,7 +34,7 @@ class BaseRestServer:
         route: str,
         schema: type[Schema],
         handler: Callable[[Table], Table],
-        documentation: dict | None = None,
+        documentation=None,  # EndpointDocumentation
         **additional_endpoint_kwargs,
     ) -> None:
         """Wire one endpoint: requests → handler table → responses."""
@@ -117,6 +117,15 @@ class BaseRestServer:
         _run()
 
 
+def _docs(summary: str, tags: list[str], example: dict | None = None):
+    from ...io.http import EndpointDocumentation, EndpointExamples
+
+    examples = None
+    if example is not None:
+        examples = EndpointExamples().add_example("default", summary, example)
+    return EndpointDocumentation(summary=summary, tags=tags, examples=examples)
+
+
 class DocumentStoreServer(BaseRestServer):
     """Endpoints: /v1/retrieve, /v1/statistics, /v1/inputs
     (reference servers.py:92)."""
@@ -128,16 +137,23 @@ class DocumentStoreServer(BaseRestServer):
             "/v1/retrieve",
             document_store.RetrieveQuerySchema,
             document_store.retrieve_query,
+            documentation=_docs(
+                "Retrieve the closest documents for a query",
+                ["document-store"],
+                {"query": "what is pathway", "k": 3},
+            ),
         )
         self.serve(
             "/v1/statistics",
             document_store.StatisticsQuerySchema,
             document_store.statistics_query,
+            documentation=_docs("Index statistics", ["document-store"]),
         )
         self.serve(
             "/v1/inputs",
             document_store.InputsQuerySchema,
             document_store.inputs_query,
+            documentation=_docs("List indexed input documents", ["document-store"]),
         )
 
 
@@ -152,27 +168,42 @@ class QARestServer(BaseRestServer):
             "/v1/retrieve",
             rag_question_answerer.RetrieveQuerySchema,
             rag_question_answerer.retrieve,
+            documentation=_docs(
+                "Retrieve the closest documents for a query",
+                ["rag"],
+                {"query": "what is pathway", "k": 3},
+            ),
         )
         self.serve(
             "/v1/statistics",
             rag_question_answerer.StatisticsQuerySchema,
             rag_question_answerer.statistics,
+            documentation=_docs("Index statistics", ["rag"]),
         )
         self.serve(
             "/v1/pw_list_documents",
             rag_question_answerer.InputsQuerySchema,
             rag_question_answerer.list_documents,
+            documentation=_docs("List indexed input documents", ["rag"]),
         )
         self.serve(
             "/v1/pw_ai_answer",
             rag_question_answerer.AnswerQuerySchema,
             rag_question_answerer.answer_query,
+            documentation=_docs(
+                "Answer a question over the indexed documents",
+                ["rag"],
+                {"prompt": "What is Pathway?"},
+            ),
         )
         # v2-style alias
         self.serve(
             "/v2/answer",
             rag_question_answerer.AnswerQuerySchema,
             rag_question_answerer.answer_query,
+            documentation=_docs(
+                "Answer a question over the indexed documents", ["rag"]
+            ),
         )
 
 
@@ -185,9 +216,15 @@ class QASummaryRestServer(QARestServer):
             "/v1/pw_ai_summary",
             rag_question_answerer.SummarizeQuerySchema,
             rag_question_answerer.summarize_query,
+            documentation=_docs(
+                "Summarize a list of texts",
+                ["rag"],
+                {"text_list": ["first text", "second text"]},
+            ),
         )
         self.serve(
             "/v2/summarize",
             rag_question_answerer.SummarizeQuerySchema,
             rag_question_answerer.summarize_query,
+            documentation=_docs("Summarize a list of texts", ["rag"]),
         )

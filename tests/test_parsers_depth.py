@@ -1,0 +1,618 @@
+"""Parser depth (P22): ParseUnstructured chunking modes, PypdfParser
+cleanup, ImageParser/SlideParser schema extraction, openparse pipelines.
+
+The optional packages (unstructured, pypdf, pdf2image, openparse) are
+not installed in CI; tests fake them in sys.modules with minimal shims,
+which exercises exactly the repo-side logic the reference tests cover
+(/root/reference/python/pathway/xpacks/llm/tests/test_parsers.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import types
+
+import pytest
+
+import pathway_tpu as pw
+
+
+# ---------------------------------------------------------------- fakes
+
+
+class FakeElementMeta:
+    def __init__(self, d):
+        self._d = dict(d)
+
+    def to_dict(self):
+        return dict(self._d)
+
+    @property
+    def page_number(self):
+        return self._d.get("page_number")
+
+
+class FakeElement:
+    def __init__(self, text, meta=None, category=None):
+        self._text = text
+        self.metadata = FakeElementMeta(meta or {})
+        if category is not None:
+            self.category = category
+        self.applied = []
+
+    def __str__(self):
+        return self._text
+
+    def apply(self, fn):
+        self.applied.append(fn)
+        self._text = fn(self._text)
+
+
+@pytest.fixture
+def fake_unstructured(monkeypatch):
+    elements: list = []
+    mod = types.ModuleType("unstructured")
+    part = types.ModuleType("unstructured.partition")
+    auto = types.ModuleType("unstructured.partition.auto")
+
+    def partition(file=None, **kwargs):
+        auto.last_kwargs = kwargs
+        return elements
+
+    auto.partition = partition
+    mod.partition = part
+    part.auto = auto
+    monkeypatch.setitem(sys.modules, "unstructured", mod)
+    monkeypatch.setitem(sys.modules, "unstructured.partition", part)
+    monkeypatch.setitem(sys.modules, "unstructured.partition.auto", auto)
+    return elements
+
+
+class FakePage:
+    def __init__(self, text, page_number):
+        self._text = text
+        self.page_number = page_number
+
+    def extract_text(self):
+        return self._text
+
+
+@pytest.fixture
+def fake_pypdf(monkeypatch):
+    pages: list = []
+    mod = types.ModuleType("pypdf")
+
+    class PdfReader:
+        def __init__(self, stream=None, **kw):
+            self.pages = pages
+
+    mod.PdfReader = PdfReader
+    monkeypatch.setitem(sys.modules, "pypdf", mod)
+    return pages
+
+
+def _fake_vision_llm(responses):
+    """A chat UDF double: returns queued responses, records messages."""
+    calls = []
+
+    @pw.udf
+    async def chat(messages, **kwargs):
+        calls.append(messages)
+        return responses[min(len(calls) - 1, len(responses) - 1)]
+
+    chat.calls = calls
+    return chat
+
+
+# ------------------------------------------------------- ParseUnstructured
+
+
+def _mk_unstructured_parser(**kw):
+    from pathway_tpu.xpacks.llm.parsers import ParseUnstructured
+
+    return ParseUnstructured(**kw)
+
+
+def test_unstructured_mode_elements(fake_unstructured):
+    fake_unstructured.extend(
+        [
+            FakeElement("Title", {"page_number": 1}, category="Title"),
+            FakeElement("Body text", {"page_number": 1}, category="NarrativeText"),
+        ]
+    )
+    parser = _mk_unstructured_parser(mode="elements")
+    docs = parser.__wrapped__(b"...")
+    assert [t for t, _ in docs] == ["Title", "Body text"]
+    assert docs[0][1]["category"] == "Title"
+
+
+def test_unstructured_mode_paged_combines_metadata(fake_unstructured):
+    fake_unstructured.extend(
+        [
+            FakeElement(
+                "A", {"page_number": 1, "links": ["l1"], "languages": ["en"]}
+            ),
+            FakeElement(
+                "B",
+                {
+                    "page_number": 1,
+                    "links": ["l2"],
+                    "languages": ["de"],
+                    "coordinates": (0, 0),
+                    "category_depth": 2,
+                },
+            ),
+            FakeElement("C", {"page_number": 2}),
+        ]
+    )
+    parser = _mk_unstructured_parser(mode="paged")
+    docs = parser.__wrapped__(b"...")
+    assert len(docs) == 2
+    page1_text, page1_meta = docs[0]
+    assert page1_text == "A\n\nB\n\n"
+    assert page1_meta["links"] == ["l1", "l2"]
+    assert sorted(page1_meta["languages"]) == ["de", "en"]
+    # element-specific fields are dropped from merged chunks
+    assert "coordinates" not in page1_meta and "category_depth" not in page1_meta
+    assert docs[1][0] == "C\n\n"
+
+
+def test_unstructured_mode_single_merges_all(fake_unstructured):
+    fake_unstructured.extend(
+        [
+            FakeElement("A", {"links": ["x"], "languages": ["en"], "filename": "f"}),
+            FakeElement("B", {"links": [], "languages": ["en"]}),
+        ]
+    )
+    parser = _mk_unstructured_parser(mode="single")
+    docs = parser.__wrapped__(b"...")
+    assert docs[0][0] == "A\n\nB"
+    assert docs[0][1]["filename"] == "f"
+    assert docs[0][1]["languages"] == ["en"]
+
+
+def test_unstructured_call_time_overrides_and_unknown_args(fake_unstructured):
+    fake_unstructured.append(FakeElement("A", {"page_number": 1}))
+    parser = _mk_unstructured_parser(mode="single")
+    docs = parser.__wrapped__(b"...", mode="elements")
+    assert docs[0][0] == "A"  # override applied
+    with pytest.raises(ValueError, match="Unknown arguments"):
+        parser.__wrapped__(b"...", bogus=1)
+    with pytest.raises(ValueError, match="mode"):
+        _mk_unstructured_parser(mode="nonsense")
+
+
+def test_unstructured_post_processors_apply(fake_unstructured):
+    fake_unstructured.append(FakeElement("hello", {}))
+    parser = _mk_unstructured_parser(mode="single", post_processors=[str.upper])
+    docs = parser.__wrapped__(b"...")
+    assert docs[0][0] == "HELLO"
+
+
+def test_unstructured_kwargs_forward_to_partition(fake_unstructured):
+    import unstructured.partition.auto as auto
+
+    fake_unstructured.append(FakeElement("A", {}))
+    parser = _mk_unstructured_parser(mode="single", strategy="hi_res")
+    parser.__wrapped__(b"...")
+    assert auto.last_kwargs == {"strategy": "hi_res"}
+
+
+# ------------------------------------------------------------ PypdfParser
+
+
+def test_pypdf_parser_pages_and_cleanup(fake_pypdf):
+    from pathway_tpu.xpacks.llm.parsers import PypdfParser
+
+    fake_pypdf.extend(
+        [
+            FakePage("First line\ncontinues here.\nNew Paragraph", 0),
+            FakePage("Second   page", 1),
+        ]
+    )
+    parser = PypdfParser()
+    docs = parser.__wrapped__(b"...")
+    assert len(docs) == 2
+    text0, meta0 = docs[0]
+    # soft wrap before a lowercase letter unwraps; capitalized line keeps \n
+    assert "First line continues here." in text0
+    assert "\nNew Paragraph" in text0
+    assert meta0 == {"page_number": 0}
+    assert docs[1][0] == "Second page"
+
+    raw = PypdfParser(apply_text_cleanup=False)
+    docs_raw = raw.__wrapped__(b"...")
+    assert docs_raw[1][0] == "Second   page"
+
+
+# ------------------------------------------------------------ ImageParser
+
+
+def _png_bytes(w=4, h=4):
+    from io import BytesIO
+
+    from PIL import Image
+
+    buf = BytesIO()
+    Image.new("RGB", (w, h), (255, 0, 0)).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_image_parser_describes(monkeypatch):
+    from pathway_tpu.xpacks.llm.parsers import ImageParser
+
+    llm = _fake_vision_llm(["a red square"])
+    parser = ImageParser(llm=llm)
+    docs = asyncio.run(parser.__wrapped__(_png_bytes()))
+    assert docs == [("a red square", {})]
+    # the llm received a vision-style message with the b64 payload
+    (messages,) = llm.calls
+    content = messages.value[0]["content"]
+    assert content[0]["type"] == "text"
+    assert content[1]["image_url"]["url"].startswith("data:image/jpeg;base64,")
+
+
+def test_image_parser_schema_extraction():
+    from pydantic import BaseModel
+
+    from pathway_tpu.xpacks.llm.parsers import ImageParser
+
+    class Invoice(BaseModel):
+        vendor: str
+        total: float
+
+    llm = _fake_vision_llm(
+        ["an invoice", json.dumps({"vendor": "ACME", "total": 12.5})]
+    )
+    parser = ImageParser(
+        llm=llm, detail_parse_schema=Invoice, include_schema_in_text=True
+    )
+    docs = asyncio.run(parser.__wrapped__(_png_bytes()))
+    (text, meta) = docs[0]
+    assert text.startswith("an invoice\n")
+    assert json.loads(text.split("\n", 1)[1]) == {"vendor": "ACME", "total": 12.5}
+    assert meta["vendor"] == "ACME" and meta["total"] == 12.5
+
+
+def test_image_parser_schema_required_for_include_flag():
+    from pathway_tpu.xpacks.llm.parsers import ImageParser
+
+    with pytest.raises(ValueError, match="include_schema_in_text"):
+        ImageParser(llm=_fake_vision_llm(["x"]), include_schema_in_text=True)
+
+
+def test_maybe_downscale():
+    from PIL import Image
+
+    from pathway_tpu.xpacks.llm._parser_utils import maybe_downscale
+
+    big = Image.new("RGB", (4000, 2000))
+    small = maybe_downscale(big, max_image_size=1024, downsize_horizontal_width=400)
+    assert small.size == (400, 200)
+    untouched = maybe_downscale(big, max_image_size=10**9, downsize_horizontal_width=400)
+    assert untouched.size == (4000, 2000)
+
+
+# ------------------------------------------------------------ SlideParser
+
+
+@pytest.fixture
+def fake_pdf2image(monkeypatch):
+    from PIL import Image
+
+    mod = types.ModuleType("pdf2image")
+    state = {"fail_fmt": False}
+
+    def convert_from_bytes(contents, fmt=None, size=None, **kw):
+        if fmt is not None and state["fail_fmt"]:
+            raise RuntimeError("bad fmt")
+        return [Image.new("RGB", size or (32, 32)) for _ in range(2)]
+
+    mod.convert_from_bytes = convert_from_bytes
+    monkeypatch.setitem(sys.modules, "pdf2image", mod)
+    return state
+
+
+def test_slide_parser_pages(fake_pdf2image):
+    from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+    llm = _fake_vision_llm(["slide one", "slide two"])
+    parser = SlideParser(llm=llm, run_mode="sequential")
+    docs = asyncio.run(parser.__wrapped__(b"%PDF-1.4 fake"))
+    assert [t for t, _ in docs] == ["slide one", "slide two"]
+    for idx, (_t, meta) in enumerate(docs):
+        assert meta["image_page"] == idx
+        assert meta["tot_pages"] == 2
+        assert isinstance(meta["b64_image"], str) and meta["b64_image"]
+
+
+def test_slide_parser_format_fallback(fake_pdf2image):
+    from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+    fake_pdf2image["fail_fmt"] = True  # first convert (with fmt) raises
+    llm = _fake_vision_llm(["s1", "s2"])
+    parser = SlideParser(llm=llm)
+    docs = asyncio.run(parser.__wrapped__(b"%PDF-1.4 fake"))
+    assert len(docs) == 2
+
+
+def test_slide_parser_detects_pptx(fake_pdf2image):
+    import io
+    import zipfile
+
+    from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("ppt/slides/slide1.xml", "<x/>")
+    assert SlideParser._is_pptx(buf.getvalue())
+    assert not SlideParser._is_pptx(b"%PDF-1.4")
+    assert not SlideParser._is_pptx(b"PK\x03\x04 not a zip really")
+
+
+# --------------------------------------------------------------- openparse
+
+
+def _install_fake_openparse(monkeypatch):
+    """Minimal openparse shim: Node/elements, pipelines, tables.parse."""
+    op = types.ModuleType("openparse")
+
+    class Node:
+        def __init__(self, elements=()):
+            self.elements = tuple(elements)
+
+        def model_dump(self):
+            return {"text": " ".join(e.text for e in self.elements)}
+
+        @property
+        def text(self):
+            return self.model_dump()["text"]
+
+    class ProcessingStep:
+        def process(self, nodes):
+            raise NotImplementedError
+
+    class IngestionPipeline:
+        transformations: list = []
+
+        def run(self, nodes):
+            for t in self.transformations:
+                nodes = t.process(nodes)
+            return nodes
+
+    class DocumentParser:
+        def __init__(self, processing_pipeline=None, table_args=None):
+            self.processing_pipeline = processing_pipeline or IngestionPipeline()
+            self.table_args = table_args
+            self._verbose = False
+
+        @staticmethod
+        def _elems_to_nodes(elems):
+            return [Node(elements=(e,)) for e in elems]
+
+    class ParsedDocument:
+        def __init__(self, nodes=None, **kw):
+            self.nodes = list(nodes or [])
+            self.meta = kw
+
+    class Bbox:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    class TableElement:
+        def __init__(self, bbox=None, text=""):
+            self.bbox, self.text = bbox, text
+            self.page = getattr(bbox, "page", 0)
+
+    class _Elem:
+        def __init__(self, text, page):
+            self.text, self.page = text, page
+
+    class Pdf:
+        def __init__(self, file=None):
+            self.file = file
+            self.num_pages = 1
+            self.file_metadata = {}
+
+    # submodules
+    processing = types.ModuleType("openparse.processing")
+    processing.IngestionPipeline = IngestionPipeline
+    processing.ProcessingStep = ProcessingStep
+    processing.CombineNodesSpatially = type(
+        "CombineNodesSpatially",
+        (ProcessingStep,),
+        {
+            "__init__": lambda self, **kw: None,
+            "process": lambda self, nodes: nodes,
+        },
+    )
+    basic = types.ModuleType("openparse.processing.basic_transforms")
+    for name in (
+        "CombineBullets",
+        "CombineHeadingsWithClosestText",
+        "RemoveFullPageStubs",
+        "RemoveMetadataElements",
+        "RemoveNodesBelowNTokens",
+        "RemoveRepeatedElements",
+        "RemoveTextInsideTables",
+    ):
+        setattr(
+            basic,
+            name,
+            type(
+                name,
+                (ProcessingStep,),
+                {
+                    "__init__": lambda self, **kw: None,
+                    "process": lambda self, nodes: nodes,
+                },
+            ),
+        )
+    schemas = types.ModuleType("openparse.schemas")
+    schemas.Bbox, schemas.Node, schemas.ParsedDocument, schemas.TableElement = (
+        Bbox,
+        Node,
+        ParsedDocument,
+        TableElement,
+    )
+    tables = types.ModuleType("openparse.tables")
+
+    class PyMuPDFArgs:
+        def __init__(self, **kw):
+            self.kw = kw
+
+        def model_dump(self):
+            return dict(self.kw)
+
+    class TableTransformersArgs(PyMuPDFArgs):
+        pass
+
+    class UnitableArgs(PyMuPDFArgs):
+        pass
+
+    tables.PyMuPDFArgs = PyMuPDFArgs
+    tables.TableTransformersArgs = TableTransformersArgs
+    tables.UnitableArgs = UnitableArgs
+    tables_parse = types.ModuleType("openparse.tables.parse")
+    tables_parse.PyMuPDFArgs = PyMuPDFArgs
+    tables_parse.TableTransformersArgs = TableTransformersArgs
+    tables_parse.UnitableArgs = UnitableArgs
+    tables_parse._ingest_with_pymupdf = lambda doc, args, verbose=False: [
+        TableElement(bbox=Bbox(page=0), text="pymupdf-table")
+    ]
+    tables_parse._ingest_with_table_transformers = (
+        lambda doc, args, verbose=False: []
+    )
+    tables_parse._ingest_with_unitable = lambda doc, args, verbose=False: []
+    text_mod = types.ModuleType("openparse.text")
+    text_mod.ingest = lambda doc, parsing_method=None: [
+        _Elem("hello", 0),
+        _Elem("world", 1),
+    ]
+    pdf_mod = types.ModuleType("openparse.pdf")
+    pdf_mod.Pdf = Pdf
+    consts = types.ModuleType("openparse.consts")
+    consts.COORDINATE_SYSTEM = "bottom-left"
+
+    op.processing = processing
+    op.schemas = schemas
+    op.tables = tables
+    op.text = text_mod
+    op.pdf = pdf_mod
+    op.consts = consts
+    op.Pdf = Pdf
+    op.DocumentParser = DocumentParser
+    op.Node = Node
+
+    for name, mod in {
+        "openparse": op,
+        "openparse.processing": processing,
+        "openparse.processing.basic_transforms": basic,
+        "openparse.schemas": schemas,
+        "openparse.tables": tables,
+        "openparse.tables.parse": tables_parse,
+        "openparse.text": text_mod,
+        "openparse.pdf": pdf_mod,
+        "openparse.consts": consts,
+    }.items():
+        monkeypatch.setitem(sys.modules, name, mod)
+    return op
+
+
+@pytest.fixture
+def fresh_openparse_utils():
+    """Purge openparse_utils' lazy-class cache so the names re-resolve
+    against the fake (or absent) openparse of this test. A plain reload
+    is not enough: reload reuses the module dict, so previously built
+    classes (bound to a previous test's fake) would survive."""
+    import pathway_tpu.xpacks.llm.openparse_utils as opu
+
+    def clear():
+        for name in opu._LAZY_NAMES:
+            opu.__dict__.pop(name, None)
+
+    clear()
+    yield opu
+    clear()
+
+
+def test_openparse_utils_importerror_without_package(fresh_openparse_utils):
+    opu = fresh_openparse_utils
+    assert "openparse" not in sys.modules or sys.modules["openparse"] is not None
+    with pytest.raises(ImportError, match="openparse"):
+        opu.SimpleIngestionPipeline
+    # non-lazy names always work
+    args = opu.LLMArgs(llm=None)
+    assert args.parsing_algorithm == "llm"
+    with pytest.raises(Exception):
+        opu.LLMArgs(unexpected_field=1)
+
+
+def test_openparse_pipelines_with_fake_package(monkeypatch, fresh_openparse_utils):
+    op = _install_fake_openparse(monkeypatch)
+    opu = fresh_openparse_utils
+
+    # SimpleIngestionPipeline constructs with the documented transform chain
+    pipeline = opu.SimpleIngestionPipeline()
+    assert len(pipeline.transformations) == 11
+
+    # PageChunker merges node elements by page
+    class E:
+        def __init__(self, text, page):
+            self.text, self.page = text, page
+
+    n1 = op.Node(elements=(E("a", 0), E("b", 1)))
+    n2 = op.Node(elements=(E("c", 0),))
+    merged = opu.PageChunker().process([n1, n2])
+    by_text = sorted(n.text for n in merged)
+    assert by_text == ["a c", "b"]
+
+    same_page = opu.SamePageIngestionPipeline()
+    out = same_page.run([n1, n2])
+    assert sorted(n.text for n in out) == ["a c", "b"]
+
+
+def test_openparse_table_args_dispatch(monkeypatch, fresh_openparse_utils):
+    _install_fake_openparse(monkeypatch)
+    opu = fresh_openparse_utils
+    assert type(opu._table_args_dict_to_model({"parsing_algorithm": "pymupdf"})).__name__ == "PyMuPDFArgs"
+    assert isinstance(
+        opu._table_args_dict_to_model({"parsing_algorithm": "llm"}), opu.LLMArgs
+    )
+    with pytest.raises(ValueError, match="Unsupported"):
+        opu._table_args_dict_to_model({"parsing_algorithm": "nope"})
+
+
+def test_openparse_pymu_document_parser(monkeypatch, fresh_openparse_utils):
+    op = _install_fake_openparse(monkeypatch)
+    opu = fresh_openparse_utils
+    parser = opu.PyMuDocumentParser(
+        table_args={"parsing_algorithm": "pymupdf"},
+        processing_pipeline=opu.SamePageIngestionPipeline(),
+    )
+    doc = op.Pdf(file=None)
+    parsed = parser.parse(doc)
+    texts = sorted(n.text for n in parsed.nodes)
+    # page 0 merges the text elem with the pymupdf table elem; page 1 alone
+    assert texts == ["hello pymupdf-table", "world"]
+
+
+def test_openparse_parser_udf_end_to_end(monkeypatch, fresh_openparse_utils):
+    """parsers.OpenParse over the fake package: chunks come back."""
+    _install_fake_openparse(monkeypatch)
+    import pathway_tpu.xpacks.llm.parsers as parsers_mod
+
+    parser = parsers_mod.OpenParse(
+        table_args={"parsing_algorithm": "pymupdf"},
+        processing_pipeline="merge_same_page",
+    )
+    docs = asyncio.run(parser.__wrapped__(b"%PDF fake"))
+    assert sorted(t for t, _ in docs) == ["hello pymupdf-table", "world"]
+    with pytest.raises(ValueError, match="processing_pipeline"):
+        parsers_mod.OpenParse(processing_pipeline="bogus")
+    with pytest.raises(ValueError, match="Image parsing"):
+        parsers_mod.OpenParse(
+            parse_images=True, image_args={"parsing_algorithm": "pymupdf"}
+        )
