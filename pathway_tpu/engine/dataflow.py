@@ -1814,9 +1814,16 @@ class EngineGraph:
         it to the error sessions and return the ERROR value to store in
         the failing cell (reference error routing, engine/error.rs +
         internals/errors.py)."""
+        user = getattr(origin, "user_frame", None)
         if self.terminate_on_error:
+            where = (
+                f"\nOccurred here:\n    Line: {user.line}\n"
+                f"    File: {user.filename}:{user.line_number}"
+                if user is not None
+                else ""
+            )
             raise EngineError(
-                f"error in operator {origin.name} (id {origin.id}): {exc!r}"
+                f"error in operator {origin.name} (id {origin.id}): {exc!r}{where}"
             ) from exc
         import traceback
 
@@ -1827,6 +1834,11 @@ class EngineGraph:
             if frame
             else None
         )
+        if user is not None:
+            # the user's build-time call site rides along the runtime
+            # frame (reference trace.py user frames in error logs)
+            trace = dict(trace or {})
+            trace["user_frame"] = user.as_dict()
         from .value import Json as _Json
 
         self._error_seq += 1

@@ -275,6 +275,10 @@ class GraphRunner:
         if handler is None:
             raise NotImplementedError(f"no lowering for operator kind {op.kind!r}")
         low = handler(table, op)
+        # engine errors point at the user's build-time call site
+        # (reference internals/trace.py trace frames)
+        if getattr(low.node, "user_frame", None) is None:
+            low.node.user_frame = getattr(op, "trace", None)
         self.lowered[table._id] = low
         return low
 

@@ -1,23 +1,97 @@
 """Monitoring dashboard + stats.
 
 Rebuild of /root/reference/python/pathway/internals/monitoring.py (rich
-console dashboard :56) and the engine-side ProberStats
-(src/engine/graph.rs:523-567)."""
+console dashboard :56-273) and the engine-side ProberStats
+(src/engine/graph.rs:523-567): a ``StatsMonitor`` collects per-epoch
+operator/connector stats from the engine; ``LiveDashboard`` renders them
+as the reference's PROGRESS DASHBOARD — a connectors table (messages in
+the last minibatch / last minute / since start), an operators table
+(latency to wall clock), and a LOGS panel capturing the root logger —
+refreshed live via ``rich.live.Live``.
+"""
 
 from __future__ import annotations
 
+import contextlib
 import enum
+import logging
+import os
 import sys
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 
 class MonitoringLevel(enum.Enum):
-    AUTO = enum.auto()
-    AUTO_ALL = enum.auto()
-    NONE = enum.auto()
-    IN_OUT = enum.auto()
-    ALL = enum.auto()
+    """Verbosity of the monitoring dashboard (reference :228-258)."""
+
+    AUTO = enum.auto()  #: IN_OUT in an interactive terminal, NONE otherwise
+    AUTO_ALL = enum.auto()  #: ALL in an interactive terminal, NONE otherwise
+    NONE = enum.auto()  #: no monitoring
+    IN_OUT = enum.auto()  #: connectors + input/output latency
+    ALL = enum.auto()  #: per-operator latency too
+
+    @classmethod
+    def coerce(cls, value) -> "MonitoringLevel":
+        if isinstance(value, cls):
+            return value
+        if value is None or value is False:
+            return cls.NONE
+        if isinstance(value, str):
+            try:
+                return cls[value.upper()]
+            except KeyError:
+                raise ValueError(f"unknown monitoring_level {value!r}")
+        raise ValueError(f"unknown monitoring_level {value!r}")
+
+    def resolve(self) -> "MonitoringLevel":
+        if self in (MonitoringLevel.AUTO, MonitoringLevel.AUTO_ALL):
+            if not sys.stderr.isatty():
+                return MonitoringLevel.NONE
+            return (
+                MonitoringLevel.IN_OUT
+                if self is MonitoringLevel.AUTO
+                else MonitoringLevel.ALL
+            )
+        return self
+
+
+@dataclass
+class ConnectorStats:
+    """Per-source counters (reference ConnectorMonitor,
+    src/connectors/monitoring.rs:237)."""
+
+    name: str = ""
+    num_messages_recently_committed: int = 0
+    num_messages_from_start: int = 0
+    finished: bool = False
+    #: (wall_time, cumulative_count) samples for the last-minute window
+    history: deque = field(default_factory=lambda: deque(maxlen=512))
+
+    def num_messages_in_last_minute(self, now: float) -> int:
+        cutoff = now - 60.0
+        base = 0
+        for ts, count in self.history:
+            if ts < cutoff:
+                base = count
+            else:
+                break
+        return self.num_messages_from_start - base
+
+
+@dataclass
+class OperatorEntry:
+    name: str = ""
+    rows_in: int = 0
+    rows_out: int = 0
+    #: wall time of the last observed output change (None = initializing)
+    last_change: float | None = None
+    done: bool = False
+
+    def latency_ms(self, now: float) -> int | None:
+        if self.last_change is None:
+            return None
+        return max(0, int((now - self.last_change) * 1000))
 
 
 @dataclass
@@ -25,22 +99,28 @@ class StatsSnapshot:
     time: int = 0
     rows_in: int = 0
     rows_out: int = 0
-    operators: dict = field(default_factory=dict)
+    operators: dict = field(default_factory=dict)  # "id:name" -> (in, out)
 
 
 class StatsMonitor:
     """Collects per-epoch operator stats from the engine; optionally
-    renders a live rich dashboard."""
+    feeds a live rich dashboard (set via ``attach_dashboard``)."""
 
     def __init__(self, render: bool = False, interval: float = 1.0):
         self.render = render
         self.interval = interval
         self._last_render = 0.0
         self.snapshot = StatsSnapshot()
+        self.connectors: dict[int, ConnectorStats] = {}
+        self.operators: dict[int, OperatorEntry] = {}
+        self.dashboard: "LiveDashboard | None" = None
         # wall-clock of the last observed input/output row-count change,
         # for the latency gauges (reference telemetry.rs:41-45)
         self._last_in_change = time.monotonic()
         self._last_out_change = time.monotonic()
+
+    def attach_dashboard(self, dashboard: "LiveDashboard") -> None:
+        self.dashboard = dashboard
 
     def input_latency_ms(self, now: float | None = None) -> int:
         now = time.monotonic() if now is None else now
@@ -51,36 +131,225 @@ class StatsMonitor:
         return int((now - self._last_out_change) * 1000)
 
     def update(self, engine) -> None:
+        now = time.monotonic()
         snap = StatsSnapshot(time=engine.current_time)
         for node in engine.nodes:
-            snap.operators[f"{node.id}:{node.name}"] = (
-                node.stats.rows_in,
-                node.stats.rows_out,
-            )
-            snap.rows_in += node.stats.rows_in
-            snap.rows_out += node.stats.rows_out
-        now = time.monotonic()
+            rows_in, rows_out = node.stats.rows_in, node.stats.rows_out
+            snap.operators[f"{node.id}:{node.name}"] = (rows_in, rows_out)
+            snap.rows_in += rows_in
+            snap.rows_out += rows_out
+            entry = self.operators.get(node.id)
+            if entry is None:
+                entry = self.operators[node.id] = OperatorEntry(name=node.name)
+            if rows_out != entry.rows_out or rows_in != entry.rows_in:
+                entry.last_change = now
+            entry.rows_in, entry.rows_out = rows_in, rows_out
+            if node.n_inputs == 0:
+                conn = self.connectors.get(node.id)
+                if conn is None:
+                    conn = self.connectors[node.id] = ConnectorStats(name=node.name)
+                delta = rows_out - conn.num_messages_from_start
+                if delta:
+                    conn.num_messages_recently_committed = delta
+                conn.num_messages_from_start = rows_out
+                conn.history.append((now, rows_out))
+                session = getattr(node, "session", None)
+                if session is not None:
+                    try:
+                        conn.finished = session.closed
+                    except Exception:
+                        pass
         if snap.rows_in != self.snapshot.rows_in:
             self._last_in_change = now
         if snap.rows_out != self.snapshot.rows_out:
             self._last_out_change = now
         self.snapshot = snap
-        if self.render and time.monotonic() - self._last_render > self.interval:
+        if self.dashboard is not None:
+            self.dashboard.refresh(self, now)
+        elif self.render and now - self._last_render > self.interval:
             self._render()
-            self._last_render = time.monotonic()
+            self._last_render = now
 
     def _render(self) -> None:  # pragma: no cover
         try:
             from rich.console import Console
-            from rich.table import Table as RichTable
 
-            console = Console(file=sys.stderr)
-            t = RichTable(title=f"pathway_tpu @ t={self.snapshot.time}")
-            t.add_column("operator")
-            t.add_column("rows in")
-            t.add_column("rows out")
-            for name, (rin, rout) in self.snapshot.operators.items():
-                t.add_row(name, str(rin), str(rout))
-            console.print(t)
+            Console(file=sys.stderr).print(build_dashboard(self, time.monotonic()))
         except Exception:
             pass
+
+
+# ------------------------------------------------------------ rich layer
+
+
+class ConsolePrintingToBuffer:
+    """A console stand-in that buffers records for the LOGS panel
+    (reference ConsolePrintingToBuffer :22)."""
+
+    def __init__(self):
+        from rich.console import Console
+
+        self._devnull = open(os.devnull, "w")
+        self._console = Console(file=self._devnull)
+        self.logs: list = []
+
+    def print(self, *records, **kwargs) -> None:
+        self.logs.extend(records)
+
+    def forget(self, num_records_to_remember: int) -> None:
+        self.logs = self.logs[-num_records_to_remember:]
+
+    def __getattr__(self, name):
+        return getattr(self._console, name)
+
+
+def _connectors_table(monitor: StatsMonitor, now: float):
+    from rich import box
+    from rich.table import Table
+
+    table = Table(box=box.SIMPLE)
+    table.add_column("connector", justify="left")
+    table.add_column("no. messages in the last minibatch", justify="right")
+    table.add_column("in the last minute", justify="right")
+    table.add_column("since start", justify="right")
+    for conn in monitor.connectors.values():
+        table.add_row(
+            conn.name,
+            "finished" if conn.finished else f"{conn.num_messages_recently_committed}",
+            f"{conn.num_messages_in_last_minute(now)}",
+            f"{conn.num_messages_from_start}",
+        )
+    return table
+
+
+def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
+    from rich import box
+    from rich.table import Table
+
+    caption = (
+        "Latency is measured as the difference between the time the "
+        "operator processed the data and the time pathway acquired it."
+    )
+    table = Table(caption=caption, box=box.SIMPLE)
+    table.add_column("operator", justify="left")
+    table.add_column(r"latency to wall clock \[ms]", justify="right")
+    table.add_column("rows out", justify="right")
+    table.add_row("input", f"{monitor.input_latency_ms(now)}", "")
+    if with_operators:
+        for entry in monitor.operators.values():
+            latency = entry.latency_ms(now)
+            table.add_row(
+                entry.name,
+                "initializing" if latency is None else f"{latency}",
+                f"{entry.rows_out}",
+            )
+    table.add_row("output", f"{monitor.output_latency_ms(now)}", "")
+    return table
+
+
+def build_dashboard(monitor: StatsMonitor, now: float, with_operators: bool = True):
+    """The PROGRESS DASHBOARD renderable (reference MonitoringOutput
+    :55-162): connectors beside operators."""
+    from rich import box
+    from rich.align import Align
+    from rich.layout import Layout
+    from rich.panel import Panel
+
+    layout = Layout(name="monitoring_inner")
+    layout.split_row(Layout(name="connectors"), Layout(name="operators"))
+    layout["connectors"].update(Align.center(_connectors_table(monitor, now)))
+    layout["operators"].update(
+        Align.center(_operators_table(monitor, now, with_operators))
+    )
+    return Panel(
+        layout,
+        title=f"PATHWAY PROGRESS DASHBOARD @ t={monitor.snapshot.time}",
+        box=box.MINIMAL,
+    )
+
+
+class LiveDashboard:
+    """Live-updating dashboard + LOGS panel (reference StatsMonitor
+    :165-189 + monitor_stats :191-227)."""
+
+    def __init__(self, with_operators: bool = True, console=None, screen: bool = True):
+        from rich.layout import Layout
+        from rich.logging import RichHandler
+
+        self.with_operators = with_operators
+        self.layout = Layout(name="root")
+        self.layout.split(
+            Layout(name="monitoring", ratio=2 if with_operators else 1),
+            Layout(name="logs"),
+        )
+        self.layout["monitoring"].update("")
+        self._log_buffer = ConsolePrintingToBuffer()
+        self.handler = RichHandler(console=self._log_buffer, show_path=False)
+        self._screen = screen
+        self._console = console
+        self._live = None
+        self._update_logs_panel()
+
+    def _update_logs_panel(self) -> None:
+        from rich import box
+        from rich.console import Group
+        from rich.panel import Panel
+
+        self._log_buffer.forget(32)
+        self.layout["logs"].update(
+            Panel(Group(*self._log_buffer.logs), title="LOGS", box=box.MINIMAL)
+        )
+
+    def start(self) -> None:
+        from rich.live import Live
+
+        logging.getLogger().addHandler(self.handler)
+        self._live = Live(
+            self.layout,
+            refresh_per_second=4,
+            screen=self._screen,
+            console=self._console,
+        )
+        self._live.start()
+
+    def stop(self) -> None:
+        if self._live is not None:
+            self._live.stop()
+            self._live = None
+        logging.getLogger().removeHandler(self.handler)
+
+    def refresh(self, monitor: StatsMonitor, now: float) -> None:
+        self.layout["monitoring"].update(
+            build_dashboard(monitor, now, self.with_operators)
+        )
+        self._update_logs_panel()
+
+
+@contextlib.contextmanager
+def monitor_stats(
+    monitoring_level,
+    *,
+    process_id: int = 0,
+    console=None,
+    screen: bool = True,
+):
+    """Yield a StatsMonitor wired per the monitoring level (reference
+    monitor_stats :191): NONE → plain collector without rendering;
+    IN_OUT/ALL on process 0 → live dashboard; worker processes stay
+    quiet."""
+    level = MonitoringLevel.coerce(monitoring_level).resolve()
+    monitor = StatsMonitor()
+    if level is MonitoringLevel.NONE or process_id != 0:
+        yield monitor
+        return
+    dashboard = LiveDashboard(
+        with_operators=level is MonitoringLevel.ALL,
+        console=console,
+        screen=screen,
+    )
+    monitor.attach_dashboard(dashboard)
+    dashboard.start()
+    try:
+        yield monitor
+    finally:
+        dashboard.stop()

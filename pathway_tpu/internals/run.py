@@ -4,6 +4,7 @@ Rebuild of /root/reference/python/pathway/internals/run.py (:12,:56)."""
 
 from __future__ import annotations
 
+import sys
 from typing import Any
 
 from .graph_runner import GraphRunner
@@ -72,12 +73,21 @@ def run(
             on_end=spec.get("on_end"),
         )
     monitor = None
-    if with_http_server or (
-        monitoring_level is not None and monitoring_level not in (False, "none")
-    ):
-        from .monitoring import StatsMonitor
+    dashboard = None
+    from .monitoring import LiveDashboard, MonitoringLevel, StatsMonitor
 
+    level = MonitoringLevel.coerce(monitoring_level).resolve()
+    if with_http_server or level is not MonitoringLevel.NONE:
         monitor = StatsMonitor()
+        if level in (MonitoringLevel.IN_OUT, MonitoringLevel.ALL) and pwcfg.process_id == 0:
+            # the reference's rich PROGRESS DASHBOARD (monitoring.py:56):
+            # live connectors/operators tables + a LOGS panel
+            dashboard = LiveDashboard(
+                with_operators=level is MonitoringLevel.ALL,
+                screen=sys.stderr.isatty(),
+            )
+            monitor.attach_dashboard(dashboard)
+            dashboard.start()
     http_server = None
     if with_http_server:
         # Prometheus endpoint on 20000 + process_id (reference
@@ -102,6 +112,8 @@ def run(
             else:
                 runner.run(monitoring_callback=monitor.update if monitor else None)
     finally:
+        if dashboard is not None:
+            dashboard.stop()
         if monitor is not None:
             telemetry.gauge("rows_in", monitor.snapshot.rows_in)
             telemetry.gauge("rows_out", monitor.snapshot.rows_out)

@@ -24,6 +24,7 @@ from .expression import (
 )
 from .schema import ColumnDefinition, Schema, SchemaMetaclass, schema_builder
 from .thisclass import ThisMetaclass, left as left_cls, right as right_cls, this as this_cls
+from .trace import trace_user_frame
 from .universe import Universe, universe_solver
 
 _table_ids = itertools.count()
@@ -40,13 +41,18 @@ class Column:
 class LogicalOp:
     """A node of the logical parse graph (reference internals/operator.py)."""
 
-    __slots__ = ("kind", "inputs", "params", "output")
+    __slots__ = ("kind", "inputs", "params", "output", "trace")
 
     def __init__(self, kind: str, inputs: list["Table"], params: dict):
         self.kind = kind
         self.inputs = inputs
         self.params = params
         self.output: "Table | None" = None
+        # the user's call site that built this operator (reference
+        # internals/trace.py) — surfaced in engine errors + error logs
+        from .trace import user_frame
+
+        self.trace = user_frame()
 
 
 class Table:
@@ -114,12 +120,14 @@ class Table:
 
     # ---- core relational ops ----
 
+    @trace_user_frame
     def select(self, *args: ColumnReference, **kwargs: Any) -> "Table":
         exprs = _named_exprs(self, args, kwargs)
         cols = {n: Column(e._dtype) for n, e in exprs.items()}
         op = LogicalOp("select", [self], {"exprs": exprs})
         return Table(cols, self._universe, op, name=f"{self._name}.select")
 
+    @trace_user_frame
     def with_columns(self, *args: ColumnReference, **kwargs: Any) -> "Table":
         exprs = _named_exprs(self, args, kwargs)
         all_exprs: dict[str, ColumnExpression] = {
@@ -148,6 +156,7 @@ class Table:
         op = LogicalOp("concat_columns", [self, other], {"exprs": exprs})
         return Table(cols, self._universe, op, name=f"{self._name}+")
 
+    @trace_user_frame
     def filter(self, filter_expression: ColumnExpression) -> "Table":
         expr = _resolve_this(smart_wrap(filter_expression), self)
         cols = {n: Column(c.dtype) for n, c in self._columns.items()}
@@ -166,6 +175,7 @@ class Table:
 
     # ---- groupby / reduce ----
 
+    @trace_user_frame
     def groupby(
         self,
         *args: ColumnReference,
@@ -184,6 +194,7 @@ class Table:
             id_from=id,
         )
 
+    @trace_user_frame
     def reduce(self, *args: ColumnReference, **kwargs: Any) -> "Table":
         return GroupedTable(self, [], sort_by=None, id_from=None).reduce(*args, **kwargs)
 
@@ -208,6 +219,7 @@ class Table:
 
     # ---- joins ----
 
+    @trace_user_frame
     def join(
         self,
         other: "Table",
@@ -237,6 +249,7 @@ class Table:
 
     # ---- set-like ops ----
 
+    @trace_user_frame
     def concat(self, *others: "Table") -> "Table":
         tables = [self, *others]
         cols = _common_columns(tables)
@@ -459,6 +472,7 @@ class Table:
 
     # ---- flatten / sort / misc ----
 
+    @trace_user_frame
     def flatten(self, to_flatten: ColumnReference, *, origin_id: str | None = None) -> "Table":
         ref = _resolve_this(to_flatten, self)
         assert isinstance(ref, ColumnReference)
@@ -485,6 +499,7 @@ class Table:
         )
         return Table(cols, Universe(), op, name=f"{self._name}.flatten")
 
+    @trace_user_frame
     def sort(
         self,
         key: ColumnExpression,
@@ -521,6 +536,7 @@ class Table:
 
     # ---- temporal sugar (stdlib.temporal) ----
 
+    @trace_user_frame
     def windowby(self, time_expr, *, window, behavior=None, instance=None, **kwargs):
         from ..stdlib.temporal import windowby as _windowby
 

@@ -1,0 +1,120 @@
+"""Build-time user trace frames.
+
+Rebuild of /root/reference/python/pathway/internals/trace.py: when the
+user builds an operator (``t.select(...)``, ``pw.io.kafka.read(...)``),
+the call site in THEIR code is captured; build errors re-raise with an
+"Occurred here" note pointing at that line, and runtime row errors
+carry it into the error-log tables — so a failing UDF names the user's
+source line, not an engine internal.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable
+
+_PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@dataclass(frozen=True)
+class Frame:
+    filename: str
+    line_number: int | None
+    line: str | None
+    function: str
+
+    def is_external(self) -> bool:
+        """A frame outside the pathway_tpu package (and not a decorator
+        shim) — i.e. the user's code."""
+        path = os.path.abspath(self.filename)
+        if path.startswith(_PACKAGE_DIR + os.sep):
+            return False
+        return "@beartype" not in self.filename
+
+    def is_marker(self) -> bool:
+        return self.function == "_pathway_trace_marker"
+
+    def as_dict(self) -> dict:
+        return {
+            "file": self.filename,
+            "line": self.line_number,
+            "line_text": self.line,
+            "function": self.function,
+        }
+
+
+@dataclass(frozen=True)
+class Trace:
+    frames: list[Frame]
+    user_frame: Frame | None
+
+    @staticmethod
+    def from_traceback() -> "Trace":
+        frames = [
+            Frame(
+                filename=e.filename,
+                line_number=e.lineno,
+                line=e.line,
+                function=e.name,
+            )
+            for e in traceback.extract_stack()[:-1]
+        ]
+        user_frame: Frame | None = None
+        for frame in frames:
+            if frame.is_marker():
+                break
+            if frame.is_external():
+                user_frame = frame
+        return Trace(frames=frames, user_frame=user_frame)
+
+
+def user_frame() -> Frame | None:
+    """The innermost user-code frame of the current stack (the call site
+    that is building the operator)."""
+    return Trace.from_traceback().user_frame
+
+
+def _format_frame(frame: Frame) -> str:
+    return (
+        "Occurred here:\n"
+        f"    Line: {frame.line}\n"
+        f"    File: {frame.filename}:{frame.line_number}"
+    )
+
+
+def add_pathway_trace_note(e: BaseException, frame: Frame) -> None:
+    note = _format_frame(frame)
+    e._pathway_trace_note = note  # type: ignore[attr-defined]
+    e.add_note(note)
+
+
+def _reraise_with_user_frame(e: Exception, trace: Trace | None = None) -> None:
+    tb = e.__traceback__
+    if tb is not None:
+        tb = tb.tb_next
+    e = e.with_traceback(tb)
+    if hasattr(e, "_pathway_trace_note"):
+        raise e
+    if trace is None:
+        trace = Trace.from_traceback()
+    if trace.user_frame is not None:
+        add_pathway_trace_note(e, trace.user_frame)
+    raise e
+
+
+def trace_user_frame(func: Callable) -> Callable:
+    """Decorator: exceptions raised while building an operator re-raise
+    annotated with the user's call site (reference trace.py
+    trace_user_frame)."""
+
+    @functools.wraps(func)
+    def _pathway_trace_marker(*args: Any, **kwargs: Any):
+        try:
+            return func(*args, **kwargs)
+        except Exception as e:
+            _reraise_with_user_frame(e)
+
+    return _pathway_trace_marker

@@ -1,0 +1,124 @@
+"""Build-time user trace frames (reference internals/trace.py): build
+errors and runtime row errors point at the USER's source line that
+created the operator, not an engine internal.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.dataflow import EngineError
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.internals.trace import Frame, Trace, trace_user_frame
+
+from .utils import T
+
+
+def test_build_error_carries_user_call_site():
+    t1 = T(
+        """
+          | a
+        1 | 1
+        """
+    )
+    t2 = T(
+        """
+          | b
+        1 | 2
+        """
+    )
+    with pytest.raises(Exception) as excinfo:
+        t1.concat(t2)  # MARKER-BUILD
+    notes = getattr(excinfo.value, "__notes__", [])
+    assert any("MARKER-BUILD" in n for n in notes), notes
+    assert any("test_trace_frames.py" in n for n in notes)
+
+
+def test_runtime_error_names_user_line_on_abort():
+    t = T(
+        """
+          | a  | b
+        1 | 10 | 0
+        """
+    )
+    res = t.select(q=pw.apply(lambda a, b: a // b, pw.this.a, pw.this.b))  # MARKER-RUNTIME
+    runner = GraphRunner()
+    cap, _ = runner.capture(res)
+    with pytest.raises(EngineError) as excinfo:
+        runner.run()
+    msg = str(excinfo.value)
+    assert "Occurred here" in msg
+    assert "MARKER-RUNTIME" in msg
+    assert "test_trace_frames.py" in msg
+
+
+def test_error_log_carries_user_frame():
+    t = T(
+        """
+          | a  | b
+        1 | 10 | 0
+        2 | 4  | 2
+        """
+    )
+    res = t.select(q=pw.apply(lambda a, b: a // b, pw.this.a, pw.this.b))  # MARKER-LOG
+    err_log = pw.global_error_log()
+    runner = GraphRunner()
+    runner.engine.terminate_on_error = False
+    cap, _ = runner.capture(res)
+    ecap, _ = runner.capture(err_log)
+    runner.run()
+    entries = list(ecap.state.values())
+    assert len(entries) == 1
+    _op_id, message, trace = entries[0]
+    assert "ZeroDivisionError" in message
+    user = trace.value["user_frame"]
+    assert user["file"].endswith("test_trace_frames.py")
+    assert "MARKER-LOG" in user["line_text"]
+    assert isinstance(user["line"], int)
+
+
+def test_user_frame_skips_package_frames():
+    tr = Trace.from_traceback()
+    assert tr.user_frame is not None
+    assert tr.user_frame.filename.endswith("test_trace_frames.py")
+    internal = Frame(
+        filename="/x/pathway_tpu/internals/table.py",
+        line_number=1,
+        line="x",
+        function="select",
+    )
+    # constructed path is outside the real package dir, so approximate:
+    # the real check uses the installed package location
+    import pathway_tpu.internals.table as table_mod
+
+    real_internal = Frame(
+        filename=table_mod.__file__, line_number=1, line="x", function="select"
+    )
+    assert not real_internal.is_external()
+    external = Frame(
+        filename=__file__, line_number=1, line="x", function="test"
+    )
+    assert external.is_external()
+    assert internal is not None  # silence lints
+
+
+def test_trace_user_frame_decorator_reraises_once():
+    @trace_user_frame
+    def build():
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError) as excinfo:
+        build()  # MARKER-DECOR
+    notes = getattr(excinfo.value, "__notes__", [])
+    assert any("MARKER-DECOR" in n for n in notes)
+    # re-raising through another decorated frame must not duplicate notes
+    @trace_user_frame
+    def outer():
+        build()
+
+    with pytest.raises(ValueError) as excinfo2:
+        outer()
+    notes2 = getattr(excinfo2.value, "__notes__", [])
+    assert len(notes2) == len([n for n in notes2])  # no crash; single note
+    assert sum("Occurred here" in n for n in notes2) == 1
