@@ -97,10 +97,10 @@ def _realistic_chunks(n: int, words: int = 130) -> list[str]:
 
 
 def bench_chip_peak_probe() -> float:
-    """Sustained bf16 matmul rate of the attached chip (4096^3, 16
-    chained) — context for vs_baseline: the per-chip target assumes a
-    full v5e-class part, while tunneled/virtualized chips may sustain a
-    fraction of that regardless of framework quality."""
+    """Sustained bf16 matmul rate of the attached chip (4096^3, 256
+    chained so the tunnel RTT amortizes to <5% — r3's 16-chain probe
+    mostly measured the link and under-reported the chip 8x) — context
+    for vs_baseline: the per-chip target assumes a full v5e-class part."""
     import jax
     import jax.numpy as jnp
 
@@ -111,37 +111,94 @@ def bench_chip_peak_probe() -> float:
     def mm(a, b):
         # carry-dependent operand (no loop hoisting) and a full-product
         # reduction (no slice-of-dot simplification): XLA must run all
-        # 16 matmuls end to end
+        # 256 matmuls end to end
         def body(c, _):
             out = (a + c.astype(jnp.bfloat16)) @ b
             return jnp.sum(out, dtype=jnp.float32) * jnp.float32(1e-12), None
 
-        return jax.lax.scan(body, jnp.float32(0), None, length=16)[0]
+        return jax.lax.scan(body, jnp.float32(0), None, length=256)[0]
 
     np.asarray(mm(a, b))
     t0 = time.perf_counter()
     np.asarray(mm(a, b))
     dt = time.perf_counter() - t0
-    return round(2 * 4096**3 * 16 / dt / 1e12, 1)
+    return round(2 * 4096**3 * 256 / dt / 1e12, 1)
 
 
-def bench_framework_path(words: int = 130, n: int = 32768) -> float:
+def _encoder_flops_per_token(seq: int) -> float:
+    """MiniLM-L6 forward FLOPs per (padded) token at padded length
+    ``seq``: qkv + attention scores/values + output proj + FFN, 6
+    layers, multiply-add = 2 FLOPs."""
+    d, interm, layers = 384, 1536, 6
+    per_layer = (
+        2 * d * 3 * d  # qkv projection
+        + 2 * 2 * seq * d  # scores + probs@V
+        + 2 * d * d  # output projection
+        + 2 * 2 * d * interm  # FFN in + out
+    )
+    return float(layers * per_layer)
+
+
+def bench_framework_path(words: int = 130, n: int = 32768):
     """Strings -> device-resident embeddings through the embedder's
     ``encode_device`` ingest surface, at realistic chunk lengths
     (~150 wordpieces, the TokenCountSplitter regime). Embeddings stay
     on device (they feed the on-device KNN index in the streaming
     pipeline); only a checksum returns, so the tunnel's slow host link
-    doesn't masquerade as framework overhead."""
+    doesn't masquerade as framework overhead.
+
+    Returns (emb/s, padded seq bucket, achieved model TFLOP/s)."""
+    from pathway_tpu.models.batching import DEFAULT_SEQ_BUCKETS, bucket
     from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
 
     emb = SentenceTransformerEmbedder(max_batch_size=4096)
     texts = _realistic_chunks(n, words)
+    ids_mat, lens = emb._encoder.tokenizer.batch_encode_matrix(
+        texts, emb._encoder.max_seq_len
+    )
+    seq = bucket(int(lens.max()), DEFAULT_SEQ_BUCKETS)
     s = np.asarray(emb.encode_device(texts).sum())  # compile + warm
     t0 = time.perf_counter()
     out = emb.encode_device(texts)
     s = np.asarray(out.sum())
     dt = time.perf_counter() - t0
     assert out.shape == (n, emb.get_embedding_dimension()) and np.isfinite(s)
+    tflops = n * seq * _encoder_flops_per_token(seq) / dt / 1e12
+    return n / dt, seq, round(tflops, 1)
+
+
+def bench_device_scan_bound(seq: int, n: int = 32768) -> float:
+    """The honest upper bound for the framework path: the SAME encoder
+    dispatch (jit lax.scan over B=4096 batches) on pre-staged synthetic
+    ids at the SAME padded length — no tokenizer, no packing, no
+    scatter. framework/bound is the framework overhead ratio."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.encoder import EncoderConfig, TextEncoder, init_params
+
+    cfg = EncoderConfig.minilm_l6()
+    module = TextEncoder(cfg)
+    params = init_params(module, cfg)
+    B = 4096
+    R = n // B
+
+    def run_all(p, ids, mask):
+        def body(carry, batch):
+            i, m = batch
+            return carry, jnp.sum(module.apply(p, i, m)[:, 0])
+
+        return jax.lax.scan(body, jnp.float32(0.0), (ids, mask))[1]
+
+    fn = jax.jit(run_all)
+    rng = np.random.default_rng(0)
+    ids = jax.device_put(rng.integers(999, 29000, (R, B, seq)).astype(np.int32))
+    mask = jax.device_put(np.ones((R, B, seq), bool))
+    np.asarray(fn(params, ids, mask))
+    t0 = time.perf_counter()
+    sums = np.asarray(fn(params, ids, mask))
+    dt = time.perf_counter() - t0
+    assert np.all(np.isfinite(sums))
     return n / dt
 
 
@@ -151,7 +208,8 @@ def main() -> None:
     # round); the headline stays the LAST line for the driver
     run_suite()
     raw_eps, n_chips = bench_device_scan()
-    fw_eps = bench_framework_path()
+    fw_eps, fw_seq, fw_tflops = bench_framework_path()
+    bound_eps = bench_device_scan_bound(fw_seq)
     fw_per_chip = fw_eps / n_chips
     peak = bench_chip_peak_probe()
     print(
@@ -164,14 +222,23 @@ def main() -> None:
                 "mode": "framework path: strings -> device-resident "
                 "embeddings at ~150-wordpiece chunks (TokenCountSplitter "
                 "regime), via the C++ batched tokenizer + bucketed "
-                "scanned encoder",
+                "scanned encoder with tokenize/compute overlap",
+                "achieved_tflops": fw_tflops,
+                "seq_bucket": fw_seq,
+                "device_scan_bound_eps": round(bound_eps, 1),
+                "vs_device_scan_bound": round(fw_eps / bound_eps, 3),
+                "bound_note": "bound = same jit scan dispatch on "
+                "pre-staged synthetic ids at the SAME padded length — "
+                "no tokenizer/packing/scatter; the ratio is the "
+                "framework overhead",
                 "device_scan_eps": round(raw_eps, 1),
                 "device_scan_mode": "jit lax.scan, synthetic S=32 ids — "
-                "upper bound, not the headline",
+                "short-snippet upper bound, not comparable to the "
+                "150-wordpiece headline",
                 "chip_peak_probe_tflops": peak,
-                "chip_peak_note": "sustained bf16 4096^3 matmul on the "
-                "attached chip; the 62.5k/chip target assumes ~200 TFLOPs "
-                "(full v5e) — vs_baseline scales with this probe",
+                "chip_peak_note": "sustained bf16 4096^3 matmul x256 "
+                "chained (RTT amortized); the 62.5k/chip target assumes "
+                "~200 TFLOPs peak (full v5e)",
             }
         )
     )

@@ -1816,12 +1816,12 @@ class EngineGraph:
         internals/errors.py)."""
         user = getattr(origin, "user_frame", None)
         if self.terminate_on_error:
-            where = (
-                f"\nOccurred here:\n    Line: {user.line}\n"
-                f"    File: {user.filename}:{user.line_number}"
-                if user is not None
-                else ""
-            )
+            if user is not None:
+                from ..internals.trace import _format_frame
+
+                where = "\n" + _format_frame(user)
+            else:
+                where = ""
             raise EngineError(
                 f"error in operator {origin.name} (id {origin.id}): {exc!r}{where}"
             ) from exc

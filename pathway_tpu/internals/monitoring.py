@@ -37,6 +37,8 @@ class MonitoringLevel(enum.Enum):
             return value
         if value is None or value is False:
             return cls.NONE
+        if value is True:
+            return cls.AUTO
         if isinstance(value, str):
             try:
                 return cls[value.upper()]
@@ -65,17 +67,33 @@ class ConnectorStats:
     num_messages_recently_committed: int = 0
     num_messages_from_start: int = 0
     finished: bool = False
-    #: (wall_time, cumulative_count) samples for the last-minute window
-    history: deque = field(default_factory=lambda: deque(maxlen=512))
+    #: (wall_time, cumulative_count) samples for the last-minute window;
+    #: appended at most ~4/s and aged out past 120s, so the window base
+    #: is never evicted by count (which would over-report an idle
+    #: connector's last-minute rate as its all-time total)
+    history: deque = field(default_factory=deque)
+
+    def observe(self, now: float, count: int) -> None:
+        if self.history and now - self.history[-1][0] < 0.25:
+            return
+        self.history.append((now, count))
+        while self.history and now - self.history[0][0] > 120.0:
+            self.history.popleft()
 
     def num_messages_in_last_minute(self, now: float) -> int:
         cutoff = now - 60.0
-        base = 0
+        base = None
         for ts, count in self.history:
             if ts < cutoff:
                 base = count
             else:
                 break
+        if base is None:
+            # no sample older than the window: either the pipeline is
+            # young (all messages are recent) or everything aged out
+            # (idle for >120s -> nothing recent)
+            oldest = self.history[0][0] if self.history else now
+            base = 0 if oldest >= cutoff else self.num_messages_from_start
         return self.num_messages_from_start - base
 
 
@@ -152,7 +170,7 @@ class StatsMonitor:
                 if delta:
                     conn.num_messages_recently_committed = delta
                 conn.num_messages_from_start = rows_out
-                conn.history.append((now, rows_out))
+                conn.observe(now, rows_out)
                 session = getattr(node, "session", None)
                 if session is not None:
                     try:
@@ -165,7 +183,11 @@ class StatsMonitor:
             self._last_out_change = now
         self.snapshot = snap
         if self.dashboard is not None:
-            self.dashboard.refresh(self, now)
+            # throttle: rebuilding the renderable tree every engine epoch
+            # would steal hot-loop time (Live paints at 4 fps anyway)
+            if now - self._last_render > min(self.interval, 0.25):
+                self.dashboard.refresh(self, now)
+                self._last_render = now
         elif self.render and now - self._last_render > self.interval:
             self._render()
             self._last_render = now
@@ -301,8 +323,13 @@ class LiveDashboard:
         )
 
     def start(self) -> None:
+        from rich.console import Console
         from rich.live import Live
 
+        if self._console is None:
+            # stderr, never stdout: a piped stdout must not receive the
+            # dashboard's ANSI escapes interleaved with program output
+            self._console = Console(file=sys.stderr)
         logging.getLogger().addHandler(self.handler)
         self._live = Live(
             self.layout,

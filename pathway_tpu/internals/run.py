@@ -72,54 +72,53 @@ def run(
             on_time_end=spec.get("on_time_end"),
             on_end=spec.get("on_end"),
         )
-    monitor = None
-    dashboard = None
-    from .monitoring import LiveDashboard, MonitoringLevel, StatsMonitor
+    import contextlib
+
+    from .monitoring import MonitoringLevel, monitor_stats
 
     level = MonitoringLevel.coerce(monitoring_level).resolve()
-    if with_http_server or level is not MonitoringLevel.NONE:
-        monitor = StatsMonitor()
-        if level in (MonitoringLevel.IN_OUT, MonitoringLevel.ALL) and pwcfg.process_id == 0:
-            # the reference's rich PROGRESS DASHBOARD (monitoring.py:56):
-            # live connectors/operators tables + a LOGS panel
-            dashboard = LiveDashboard(
-                with_operators=level is MonitoringLevel.ALL,
-                screen=sys.stderr.isatty(),
-            )
-            monitor.attach_dashboard(dashboard)
-            dashboard.start()
-    http_server = None
-    if with_http_server:
-        # Prometheus endpoint on 20000 + process_id (reference
-        # src/engine/http_server.rs:21)
-        from .http_monitoring import MonitoringHttpServer
+    need_monitor = with_http_server or level is not MonitoringLevel.NONE
+    # monitor_stats renders the reference's rich PROGRESS DASHBOARD
+    # (monitoring.py:56) at IN_OUT/ALL on process 0; NONE yields a plain
+    # collector (still wanted for the Prometheus endpoint)
+    mon_ctx = (
+        monitor_stats(
+            level, process_id=pwcfg.process_id, screen=sys.stderr.isatty()
+        )
+        if need_monitor
+        else contextlib.nullcontext(None)
+    )
+    with mon_ctx as monitor:
+        http_server = None
+        if with_http_server:
+            # Prometheus endpoint on 20000 + process_id (reference
+            # src/engine/http_server.rs:21)
+            from .http_monitoring import MonitoringHttpServer
 
-        http_server = MonitoringHttpServer(monitor)
-        http_server.start()
-    try:
-        with telemetry.span("graph_runner.run", workers=pwcfg.n_workers):
-            if processes > 1:
-                # reference CommunicationConfig::Cluster (config.rs:62-86):
-                # P processes × T threads; coordinator = process 0
-                if pwcfg.process_id == 0:
-                    runner.run_coordinator(
-                        processes,
-                        pwcfg.first_port,
-                        monitoring_callback=monitor.update if monitor else None,
-                    )
+            http_server = MonitoringHttpServer(monitor)
+            http_server.start()
+        try:
+            with telemetry.span("graph_runner.run", workers=pwcfg.n_workers):
+                if processes > 1:
+                    # reference CommunicationConfig::Cluster (config.rs:62-86):
+                    # P processes × T threads; coordinator = process 0
+                    if pwcfg.process_id == 0:
+                        runner.run_coordinator(
+                            processes,
+                            pwcfg.first_port,
+                            monitoring_callback=monitor.update if monitor else None,
+                        )
+                    else:
+                        runner.run_worker(processes, pwcfg.first_port, pwcfg.process_id)
                 else:
-                    runner.run_worker(processes, pwcfg.first_port, pwcfg.process_id)
-            else:
-                runner.run(monitoring_callback=monitor.update if monitor else None)
-    finally:
-        if dashboard is not None:
-            dashboard.stop()
-        if monitor is not None:
-            telemetry.gauge("rows_in", monitor.snapshot.rows_in)
-            telemetry.gauge("rows_out", monitor.snapshot.rows_out)
-        telemetry.flush()
-        if http_server is not None:
-            http_server.stop()
+                    runner.run(monitoring_callback=monitor.update if monitor else None)
+        finally:
+            if monitor is not None:
+                telemetry.gauge("rows_in", monitor.snapshot.rows_in)
+                telemetry.gauge("rows_out", monitor.snapshot.rows_out)
+            telemetry.flush()
+            if http_server is not None:
+                http_server.stop()
 
 
 def run_all(**kwargs: Any) -> None:

@@ -12,7 +12,10 @@ from __future__ import annotations
 
 import numpy as np
 
-DEFAULT_SEQ_BUCKETS = (16, 32, 64, 128, 256, 512)
+# 160/192 between 128 and 256: TokenCountSplitter-regime chunks
+# (~130-190 wordpieces) otherwise pad to 256 and waste ~40% of the
+# encoder FLOPs on pad tokens
+DEFAULT_SEQ_BUCKETS = (16, 32, 64, 128, 160, 192, 256, 512)
 DEFAULT_BATCH_BUCKETS = (1, 8, 32, 128, 256, 512, 1024)
 
 

@@ -87,7 +87,18 @@ def _xla_reference(qkv, key_mask, n_heads: int):
 def _fused_call(qkv, key_mask, n_heads: int, interpret: bool):
     b, s, three_d = qkv.shape
     d = three_d // 3
-    p = max(1, 128 // s)
+    # block packing (measured on v5e): short sequences pack to 256-row
+    # blocks (best at S=32: beats both 128 and 512); mid sizes
+    # (128 < S < 256) pack to ~512 rows so the per-head matmuls see
+    # 384-480 row tiles instead of MXU-starved 144-row ones; S >= 256
+    # runs one sequence per block. VMEM stays bounded: scores are
+    # rows^2 f32.
+    if s <= 128:
+        p = max(1, 256 // s)
+    elif s < 256:
+        p = max(1, 512 // s)
+    else:
+        p = 1
     rows = p * s
     pad = (-b) % p
     if pad:
@@ -96,8 +107,9 @@ def _fused_call(qkv, key_mask, n_heads: int, interpret: bool):
     bp = qkv.shape[0] // p
     tokens = qkv.reshape(bp * rows, three_d)
     kbias = jnp.where(key_mask, 0.0, KEY_OFF).astype(jnp.float32).reshape(bp, rows)
-    # Mosaic requires the last two block dims divisible by (8, 128):
-    # tile the per-group key bias to 8 sublanes
+    # tile the per-group key bias to 8 sublanes (Mosaic sublane tiling;
+    # non-128-multiple lane dims like rows=480 lower fine — Mosaic pads
+    # the lane dimension internally, verified on v5e)
     kbias = jnp.broadcast_to(kbias[:, None, :], (bp, 8, rows))
     out = pl.pallas_call(
         functools.partial(
@@ -134,21 +146,143 @@ def _bwd(n_heads, interpret, res, g):
 _fused_attention.defvjp(_fwd, _bwd)
 
 
-def attention(qkv, key_mask, *, n_heads: int, impl: str = "auto"):
+def attention(qkv, key_mask, *, n_heads: int, impl: str = "auto", segment_ids=None):
     """Multi-head self-attention on fused qkv.
 
     qkv: [B, S, 3*D] (q | k | v, heads minor within each), key_mask:
     [B, S] bool. Returns ctx [B, S, D]. impl: "fused" (pallas kernel),
     "xla" (reference chain), "interpret" (kernel in interpret mode, for
-    tests), or "auto" — the kernel on TPU when S fits a 128-row packed
-    block, XLA otherwise.
+    tests), or "auto" — the kernel on TPU when S fits a packed block,
+    XLA otherwise.
+
+    ``segment_ids``: [B, S] int32 — SEQUENCE PACKING mode: several
+    independent chunks share one row; a token attends exactly the
+    tokens with its segment id (-1 marks padding, which attends
+    nothing real). key_mask is ignored in this mode.
     """
     s = qkv.shape[1]
     fits = s <= 512 and qkv.shape[2] % (3 * n_heads) == 0
     if impl == "auto":
         impl = "fused" if (jax.default_backend() == "tpu" and fits) else "xla"
+    if segment_ids is not None:
+        if impl == "fused":
+            return _packed_attention(qkv, segment_ids, n_heads, False)
+        if impl == "interpret":
+            return _packed_attention(qkv, segment_ids, n_heads, True)
+        return _xla_packed_reference(qkv, segment_ids, n_heads)
     if impl == "fused":
         return _fused_attention(qkv, key_mask, n_heads, False)
     if impl == "interpret":
         return _fused_attention(qkv, key_mask, n_heads, True)
     return _xla_reference(qkv, key_mask, n_heads)
+
+
+# ------------------------- sequence-packed attention -------------------------
+
+
+def _seg_kernel(qkv_ref, seg_ref, segc_ref, out_ref, *, n_heads: int, scale: float):
+    """Same fused pattern as _kernel, but the block-diagonal structure
+    comes from explicit segment ids (chunks packed back-to-back in one
+    row) instead of fixed-length sequence strides. The q-side segment
+    column arrives pre-transposed (segc_ref) — an in-kernel (1, rows)
+    -> (rows, 1) transpose is a lane->sublane shuffle Mosaic does
+    slowly."""
+    rows = out_ref.shape[0]
+    d = out_ref.shape[1]
+    hd = d // n_heads
+    qkv = qkv_ref[...]
+    seg = seg_ref[0, 0:1, :]  # (1, rows) int32 — key side
+    segc = segc_ref[:, 0:1]  # (rows, 1) int32 — query side
+    bias = jnp.where(segc == seg, 0.0, BLOCK_OFF)  # attend iff same segment
+    parts = []
+    for i in range(n_heads):
+        qh = qkv[:, i * hd : (i + 1) * hd]
+        kh = qkv[:, d + i * hd : d + (i + 1) * hd]
+        vh = qkv[:, 2 * d + i * hd : 2 * d + (i + 1) * hd]
+        s = (
+            jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+            + bias
+        )
+        m = jnp.max(s, axis=1, keepdims=True)
+        e = jnp.exp(s - m)
+        p = (e / jnp.sum(e, axis=1, keepdims=True)).astype(qkv.dtype)
+        parts.append(
+            jnp.dot(p, vh, preferred_element_type=jnp.float32).astype(out_ref.dtype)
+        )
+    out_ref[...] = jnp.concatenate(parts, axis=1)
+
+
+def _xla_packed_reference(qkv, segment_ids, n_heads: int):
+    """XLA segment-packed attention (CPU path + backward)."""
+    b, s, three_d = qkv.shape
+    d = three_d // 3
+    hd = d // n_heads
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    fold = lambda t: t.reshape(b, s, n_heads, hd)
+    q, k, v = fold(q), fold(k), fold(v)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+    same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+    scores = jnp.where(same, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(qkv.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return ctx.reshape(b, s, d)
+
+
+def _packed_call(qkv, segment_ids, n_heads: int, interpret: bool):
+    b, s, three_d = qkv.shape
+    d = three_d // 3
+    p = max(1, 256 // s)
+    rows = p * s
+    pad = (-b) % p
+    if pad:
+        qkv = jnp.pad(qkv, ((0, pad), (0, 0), (0, 0)))
+        segment_ids = jnp.pad(segment_ids, ((0, pad), (0, 0)), constant_values=-1)
+    bp = qkv.shape[0] // p
+    tokens = qkv.reshape(bp * rows, three_d)
+    # contract: segment ids are unique ACROSS rows (callers use
+    # row * max_segs + local), so rows sharing a 256-token block can
+    # never attend each other. -1 pads of different rows do attend each
+    # other — garbage in padding positions, never read, never NaN.
+    seg_rows = segment_ids.reshape(bp, rows).astype(jnp.int32)
+    seg = jnp.broadcast_to(seg_rows[:, None, :], (bp, 8, rows))
+    # pre-transposed query-side copy, tiled to a 128-lane minor dim
+    segc = jnp.broadcast_to(
+        seg_rows.reshape(bp * rows, 1), (bp * rows, 128)
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _seg_kernel, n_heads=n_heads, scale=1.0 / math.sqrt(d // n_heads)
+        ),
+        grid=(bp,),
+        in_specs=[
+            pl.BlockSpec((rows, three_d), lambda i: (i, 0)),
+            pl.BlockSpec((1, 8, rows), lambda i: (i, 0, 0)),
+            pl.BlockSpec((rows, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bp * rows, d), qkv.dtype),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(tokens, seg, segc)
+    return out.reshape(bp * p, s, d)[:b]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _packed_attention(qkv, segment_ids, n_heads: int, interpret: bool):
+    return _packed_call(qkv, segment_ids, n_heads, interpret)
+
+
+def _packed_fwd(qkv, segment_ids, n_heads, interpret):
+    return _packed_call(qkv, segment_ids, n_heads, interpret), (qkv, segment_ids)
+
+
+def _packed_bwd(n_heads, interpret, res, g):
+    qkv, segment_ids = res
+    _, vjp = jax.vjp(lambda t: _xla_packed_reference(t, segment_ids, n_heads), qkv)
+    return (vjp(g)[0], None)
+
+
+_packed_attention.defvjp(_packed_fwd, _packed_bwd)
