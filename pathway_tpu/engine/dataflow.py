@@ -57,6 +57,19 @@ def consolidate(updates: list[Update]) -> list[Update]:
     """Merge updates per (key, row): sum diffs, drop zeros. Preserves
     retract-before-insert ordering per key. Large batches go through the
     C++ kernel (native/pathway_native.cc pn_consolidate)."""
+    # streaming fast path: batches of fresh inserts have all-distinct
+    # keys and nothing to merge — an int-set membership probe per row
+    # beats serializing every row for byte-grouping (~30% of epoch CPU
+    # on the 8-shard streaming bench)
+    keys = set()
+    distinct = True
+    for key, _row, diff in updates:
+        if diff != 1 or key in keys:
+            distinct = False
+            break
+        keys.add(key)
+    if distinct:
+        return updates
     if len(updates) >= 64:
         out = _native.consolidate_native(updates)
         if out is not None:
@@ -1749,6 +1762,63 @@ class AsyncApplyNode(Node):
                 if isinstance(res, BaseException):
                     # failed UDF: abort, or ERROR value + error-log entry
                     res = self.graph.report_row_error(self, res)
+                orow = row + (res,)
+                self.memo[key] = orow
+                out.append((key, orow, 1))
+        self.emit(out, time)
+
+
+class BatchApplyNode(Node):
+    """Columnar batch-UDF application: the whole epoch's rows go through
+    ONE call of the user's batch function (chunked to max_batch_size) —
+    no per-row coroutines/futures (the asyncio machinery costs more than
+    tiny model dispatches; measured ~2s of pure event-loop overhead per
+    30k rows on the CPU-mesh streaming bench). Same memo/retraction
+    semantics as AsyncApplyNode."""
+
+    def __init__(
+        self,
+        graph,
+        batch_fn: Callable,
+        row_args_fn: Callable,
+        max_batch_size: int,
+        name: str = "BatchApply",
+    ):
+        super().__init__(graph, name)
+        self.batch_fn = batch_fn  # (arg_list, ...) -> list of results
+        self.row_args_fn = row_args_fn  # (key, row) -> tuple of args
+        self.max_batch_size = max(1, int(max_batch_size))
+        self.memo: dict[int, tuple] = {}
+        self._snap_attrs = ("memo",)
+
+    def process(self, time):
+        updates = self.take()
+        if not updates:
+            return
+        out = []
+        pending = []
+        for key, row, diff in updates:
+            if diff < 0:
+                orow = self.memo.pop(key, None)
+                if orow is not None:
+                    out.append((key, orow, -1))
+            else:
+                pending.append((key, row))
+        for lo in range(0, len(pending), self.max_batch_size):
+            chunk = pending[lo : lo + self.max_batch_size]
+            arg_cols = list(zip(*[self.row_args_fn(k, r) for k, r in chunk]))
+            try:
+                results = self.batch_fn(*[list(c) for c in arg_cols])
+                if len(results) != len(chunk):
+                    raise ValueError(
+                        f"batch UDF returned {len(results)} results for "
+                        f"{len(chunk)} inputs"
+                    )
+            except Exception as exc:
+                # the whole chunk failed (same contract as the dynamic
+                # batcher: one exception fails every row of the batch)
+                results = [self.graph.report_row_error(self, exc)] * len(chunk)
+            for (key, row), res in zip(chunk, results):
                 orow = row + (res,)
                 self.memo[key] = orow
                 out.append((key, orow, 1))

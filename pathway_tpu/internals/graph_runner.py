@@ -457,12 +457,26 @@ class GraphRunner:
             fn = ae._fn
             width = layout.width
 
-            async def async_fn(key, row, _fn=fn, _afns=arg_fns, _kfns=kw_fns):
-                args = [f(key, row) for f in _afns]
-                kwargs = {k: f(key, row) for k, f in _kfns.items()}
-                return await _fn(*args, **kwargs)
+            from .udfs import _DynamicBatcher
 
-            anode = df.AsyncApplyNode(self.engine, async_fn)
+            if isinstance(fn, _DynamicBatcher) and not kw_fns:
+                # columnar fast path: a bare batch-executor UDF gets ONE
+                # call per epoch chunk instead of per-row coroutines
+                # (BatchApplyNode) — the verdict-r3 streaming hot path
+                def row_args(key, row, _afns=arg_fns):
+                    return tuple(f(key, row) for f in _afns)
+
+                anode = df.BatchApplyNode(
+                    self.engine, fn.batch_fn, row_args, fn.max_batch_size
+                )
+            else:
+
+                async def async_fn(key, row, _fn=fn, _afns=arg_fns, _kfns=kw_fns):
+                    args = [f(key, row) for f in _afns]
+                    kwargs = {k: f(key, row) for k, f in _kfns.items()}
+                    return await _fn(*args, **kwargs)
+
+                anode = df.AsyncApplyNode(self.engine, async_fn)
             anode.connect(node)
             node = anode
             async_slots[id(ae)] = layout.add_slot()

@@ -146,15 +146,42 @@ class SentenceEncoder:
             )
             ng = len(group)
             ids = np.take(ids_mat[:, :L], group, axis=0)
-            mask = np.arange(L)[None, :] < lens[group][:, None]
             if ng < batch:
                 bb = tuple(b for b in DEFAULT_BATCH_BUCKETS if b < batch) + (batch,)
                 B = max(bucket(ng, bb), ng)
                 if B > ng:
                     ids = np.pad(ids, ((0, B - ng), (0, 0)))
-                    mask = np.pad(mask, ((0, B - ng), (0, 0)))
-            pending.append((group, ng, self._run_padded(ids, mask)))
+            if self.mesh is None:
+                # same compiled program as the uniform fast path (one
+                # (B, L)-shaped jit serves every batch size) — distinct
+                # programs per path would each pay a slow remote compile
+                ln = np.zeros((ids.shape[0],), np.int32)
+                ln[:ng] = lens[group]
+                pending.append((group, ng, self._run_group(ids, ln)))
+            else:
+                mask = np.arange(ids.shape[1])[None, :] < np.concatenate(
+                    [lens[group], np.zeros(ids.shape[0] - ng, lens.dtype)]
+                )[:, None]
+                pending.append((group, ng, self._run_padded(ids, mask)))
         return pending
+
+    def _run_group(self, ids: np.ndarray, lens: np.ndarray):
+        """The one non-mesh compiled forward: (B, L) int ids + lengths
+        (mask built on device). Shared by _matrix_groups and
+        _pack_uniform so all ingest paths hit the same program cache."""
+        import jax
+        import jax.numpy as jnp
+
+        if getattr(self, "_fwd_group", None) is None:
+
+            def fwd_group(p, ids_, lens_):
+                mask = jnp.arange(ids_.shape[1])[None, :] < lens_[:, None]
+                return self.module.apply(p, ids_.astype(jnp.int32), mask)
+
+            self._fwd_group = jax.jit(fwd_group)
+        # int16 halves the host->device id bytes; only when ids fit
+        wire = np.int16 if self.cfg.vocab_size < 32768 else np.int32
+        return self._fwd_group(self.params, ids.astype(wire), lens.astype(np.int32))
 
     def _encode_matrix(self, ids_mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
         out = np.empty((len(lens), self.dim), np.float32)
@@ -374,9 +401,14 @@ class SentenceEncoder:
         return slot_to_chunk, embs
 
     def _pack_uniform(self, ids_mat: np.ndarray, lens: np.ndarray):
-        """Single-dispatch path when every bucket group shares one
-        (batch, seq) shape: all groups stacked into [G, B, L] int16 and
-        run through one jit'd lax.scan — one transfer, one dispatch."""
+        """Uniform-shape fast path: length-sorted groups all share one
+        (batch, seq) shape, so EVERY group runs the same compiled
+        program, dispatched async back-to-back (results stay on device;
+        nothing blocks until the caller consumes them). Per-group
+        dispatch instead of one lax.scan keeps the compiled-shape set
+        independent of the number of groups — streaming epochs of
+        arbitrary size must never recompile the ingest chain (a G=3
+        epoch once cost a 17s mid-run XLA compile)."""
         from .batching import DEFAULT_SEQ_BUCKETS, bucket
 
         if self.mesh is not None or self.cfg.vocab_size >= 32768:
@@ -395,19 +427,10 @@ class SentenceEncoder:
         ids = ids.reshape(G, B, L)
         ln = lens[order].reshape(G, B).astype(np.int32)
 
-        if getattr(self, "_fwd_scan", None) is None:
-
-            def fwd_scan(p, ids16, lens_):
-                def body(c, batch):
-                    i, l = batch
-                    mask = jnp.arange(i.shape[1])[None, :] < l[:, None]
-                    return c, self.module.apply(p, i.astype(jnp.int32), mask)
-
-                return jax.lax.scan(body, 0, (ids16, lens_))[1]
-
-            self._fwd_scan = jax.jit(fwd_scan)
-        embs = self._fwd_scan(self.params, ids, ln)  # (G, B, dim)
-        return order, embs.reshape(n, self.dim)
+        embs = jnp.concatenate(
+            [self._run_group(ids[g], ln[g]) for g in range(G)], axis=0
+        )  # (n, dim), device-resident
+        return order, embs
 
     def __call__(self, texts: Sequence[str]) -> np.ndarray:
         return self.encode(texts)

@@ -574,6 +574,49 @@ class DeviceKnnIndex:
         self._encoder = encoder
         self._fused_jit = None
 
+    def search_dispatch(self, queries: np.ndarray, k: int):
+        """Async half of a search: normalize, sync the index, and launch
+        the device top-k — returns DEVICE (scores, slots) arrays without
+        blocking or host result assembly. Pipelining callers (serving
+        layers, latency benchmarks) issue many dispatches back-to-back
+        and pay the host link once; ``search_resolve`` maps the arrays
+        to (key, score) lists."""
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        if self.metric == "cos":
+            norms = np.linalg.norm(q, axis=1, keepdims=True)
+            q = q / np.maximum(norms, 1e-12)
+        self._sync()
+        fetch = min(_k_bucket(k), self.capacity)
+        if _pallas_eligible(self.metric, fetch, self.mesh):
+            return _pallas_topk(
+                self.metric,
+                self._dev_matrix,
+                self._dev_valid,
+                q,
+                fetch,
+                bias=self._dev_bias,
+                mesh=self.mesh,
+            )
+        return _topk_fn(self.metric)(self._dev_matrix, self._dev_valid, q, fetch)
+
+    def search_resolve(self, scores, idx, k: int) -> list[list[tuple[Any, float]]]:
+        """Blocking half of ``search_dispatch``: slots -> (key, score)."""
+        scores = np.asarray(scores)
+        idx = np.asarray(idx)
+        out = []
+        for qi in range(scores.shape[0]):
+            row = []
+            for slot, score in zip(idx[qi], scores[qi]):
+                key = self._keys[int(slot)] if int(slot) < len(self._keys) else None
+                if key is not None:
+                    row.append((key, float(score)))
+                if len(row) == k:
+                    break
+            out.append(row)
+        return out
+
     def search_texts_batch(
         self,
         texts: list[str],

@@ -34,6 +34,7 @@ class KNNIndex:
         bucket_length: float = 10.0,
         distance_type: DistanceTypes = "euclidean",
         metadata: ColumnExpression | None = None,
+        reserved_space: int = 1024,
     ):
         self.data = data
         self.distance_type = distance_type
@@ -42,7 +43,7 @@ class KNNIndex:
             data_embedding,
             metadata,
             dimensions=n_dimensions,
-            reserved_space=1024,
+            reserved_space=reserved_space,
             metric=metric,
         )
 

@@ -336,3 +336,26 @@ def test_fused_query_none_payload():
     res = index.query_as_of_now(queries.query, number_of_matches=1)
     rows = run_table(res.select(text=res.text))
     assert len(rows) == 2
+
+
+def test_search_dispatch_resolve_roundtrip():
+    """Async search halves: dispatch returns device arrays; resolve maps
+    slots to keys identically to the blocking search."""
+    import numpy as np
+
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    rng = np.random.default_rng(0)
+    idx = DeviceKnnIndex(dim=16, metric="cos", reserved_space=128)
+    vecs = rng.normal(size=(100, 16)).astype(np.float32)
+    idx.add_batch_arrays([f"k{i}" for i in range(100)], vecs)
+    q = rng.normal(size=(3, 16)).astype(np.float32)
+    blocking = idx.search_batch(q, 5)
+    scores, slots = idx.search_dispatch(q, 5)
+    resolved = idx.search_resolve(scores, slots, 5)
+    assert [[k for k, _ in row] for row in resolved] == [
+        [k for k, _ in row] for row in blocking
+    ]
+    for brow, rrow in zip(blocking, resolved):
+        for (_, bs), (_, rs) in zip(brow, rrow):
+            assert abs(bs - rs) < 1e-5

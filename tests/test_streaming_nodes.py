@@ -408,3 +408,90 @@ def test_async_apply_stream():
 
     r = t.select(d=double(pw.this.v))
     assert _vals(run_table(r)) == [(2,), (4,)]
+
+
+def test_batch_udf_runs_columnar_batch_apply():
+    """A bare batch-executor UDF lowers to BatchApplyNode: ONE call per
+    epoch chunk, no per-row coroutines (r4 streaming hot path)."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.dataflow import BatchApplyNode
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    calls = []
+
+    def double_all(xs):
+        calls.append(len(xs))
+        return [x * 2 for x in xs]
+
+    udf = pw.udfs.udf(double_all, executor=pw.udfs.batch_executor(max_batch_size=1024))
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(a=int), rows=[(i,) for i in range(100)]
+    )
+    res = t.select(b=udf(pw.this.a))
+    runner = GraphRunner()
+    cap, _ = runner.capture(res)
+    assert any(
+        isinstance(n, BatchApplyNode) for n in runner.engine.nodes
+    ), "batch UDF did not lower to BatchApplyNode"
+    runner.run()
+    pw.clear_graph()
+    assert sorted(v[0] for v in cap.state.values()) == [i * 2 for i in range(100)]
+    assert calls == [100], calls  # one columnar call for the whole epoch
+
+
+def test_batch_apply_retraction_and_chunking():
+    """BatchApplyNode memoizes rows for retractions and chunks oversized
+    epochs to max_batch_size."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine import dataflow as df
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    calls = []
+
+    def tag(xs):
+        calls.append(len(xs))
+        return [f"v{x}" for x in xs]
+
+    udf = pw.udfs.udf(tag, executor=pw.udfs.batch_executor(max_batch_size=3))
+    t = pw.debug.table_from_markdown(
+        """
+          | a | __time__ | __diff__
+        1 | 1 | 2        | 1
+        2 | 2 | 2        | 1
+        3 | 3 | 2        | 1
+        4 | 4 | 2        | 1
+        1 | 1 | 4        | -1
+        """
+    )
+    res = t.select(b=udf(pw.this.a))
+    runner = GraphRunner()
+    cap, _ = runner.capture(res)
+    runner.run()
+    pw.clear_graph()
+    assert sorted(v[0] for v in cap.state.values()) == ["v2", "v3", "v4"]
+    # epoch of 4 rows chunked as 3 + 1
+    assert calls == [3, 1], calls
+
+
+def test_batch_apply_error_routes_per_row():
+    """A failing batch chunk yields ERROR cells + error-log entries with
+    terminate_on_error=False (same contract as the async batcher)."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.value import Error
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    def boom(xs):
+        raise RuntimeError("batch failed")
+
+    udf = pw.udfs.udf(boom, executor=pw.udfs.batch_executor(max_batch_size=8))
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(a=int), rows=[(1,), (2,)]
+    )
+    res = t.select(b=udf(pw.this.a))
+    runner = GraphRunner()
+    runner.engine.terminate_on_error = False
+    cap, _ = runner.capture(res)
+    runner.run()
+    pw.clear_graph()
+    vals = [v[0] for v in cap.state.values()]
+    assert all(isinstance(v, Error) for v in vals) and len(vals) == 2
