@@ -1136,14 +1136,11 @@ class GraphRunner:
         base_names = list(base._columns.keys())
         proj_idx = [layout.slots[(base._id, n)] for n in base_names]
 
-        if op.params.get("delay_threshold") is not None:
-            thr_fn = self.compile(op.params["delay_threshold"], layout)
-            b = df.BufferNode(
-                self.engine, thr_fn, time_fn,
-                flush_on_end=op.params.get("flush_on_end", True),
-            )
-            b.connect(node)
-            node = b
+        # forget/freeze FIRST, buffer last: their event-time watermark
+        # must advance from the raw arrival stream — behind a buffer
+        # they would only see released rows, so a late arrival could
+        # slip past a freeze whose watermark lags (reference
+        # time_column.rs applies ignore_late/freeze on the input side)
         if op.params.get("cutoff_threshold") is not None:
             thr_fn = self.compile(op.params["cutoff_threshold"], layout)
             f = df.ForgetNode(self.engine, thr_fn, time_fn)
@@ -1154,6 +1151,14 @@ class GraphRunner:
             fr = df.FreezeNode(self.engine, thr_fn, time_fn)
             fr.connect(node)
             node = fr
+        if op.params.get("delay_threshold") is not None:
+            thr_fn = self.compile(op.params["delay_threshold"], layout)
+            b = df.BufferNode(
+                self.engine, thr_fn, time_fn,
+                flush_on_end=op.params.get("flush_on_end", True),
+            )
+            b.connect(node)
+            node = b
         proj = df.ExprMapNode(
             self.engine, [_slot_getter(i) for i in proj_idx], name="BehaviorProj"
         )

@@ -104,6 +104,13 @@ def _rows_for_file(fpath: str, format: str, schema, with_metadata: bool, **kwarg
         with open(fpath, "r", newline="", errors="replace") as f:
             reader = _csv.DictReader(f, **{k: v for k, v in kwargs.items() if k in ("delimiter", "quotechar")})
             for rec in reader:
+                # strict field count (reference DsvParser data_format.rs
+                # errors on mismatched rows): DictReader marks short rows
+                # with None values and long rows under the None restkey
+                if rec.get(None) is not None or any(v is None for v in rec.values()):
+                    raise ValueError(
+                        f"csv row field count mismatch in {fpath!r}: {rec}"
+                    )
                 row = dict(rec)
                 if with_metadata:
                     row["_metadata"] = _metadata(fpath)
@@ -147,6 +154,11 @@ def read(
         schema = schema_builder(cols, name=schema.__name__)
 
     if mode == "static":
+        if not os.path.exists(path) and not _list_files(path, object_pattern):
+            # a static read of a nonexistent path is a configuration
+            # error, not an empty table (reference posix_like scanner
+            # errors); streaming mode may legitimately await creation
+            raise FileNotFoundError(f"fs.read: path does not exist: {path!r}")
         rows: list[dict] = []
         for fpath in _list_files(path, object_pattern):
             rows.extend(_rows_for_file(fpath, format, schema, with_metadata, **kwargs))

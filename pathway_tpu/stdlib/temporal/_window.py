@@ -285,9 +285,15 @@ def _apply_behavior(t5: Table, behavior: Behavior) -> Table:
             else:
                 params["cutoff_threshold"] = t5._pw_window_end + behavior.cutoff
     elif isinstance(behavior, ExactlyOnceBehavior):
+        # reference temporal_behavior.py:79: delay AND cutoff at
+        # window_end + shift — the window emits once when it closes and
+        # then FREEZES (late arrivals must not revise the emitted
+        # result; without the freeze this was at-least-once)
         shift = behavior.shift
         end = t5._pw_window_end
-        params["delay_threshold"] = end + shift if shift is not None else end
+        threshold = end + shift if shift is not None else end
+        params["delay_threshold"] = threshold
+        params["freeze_threshold"] = threshold
         params["flush_on_end"] = True
     cols = {n: Column(c.dtype) for n, c in t5._columns.items()}
     op = LogicalOp("temporal_behavior", [t5], params)

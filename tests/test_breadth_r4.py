@@ -1,0 +1,301 @@
+"""Round-4 breadth: connector failure-mode matrix, format edge cases,
+temporal streaming variants, and the multi-worker x persistence x
+restart cross-product (VERDICT r3 Next #9 — tests that fail on seeded
+mutations, mirroring the reference's per-backend failure suites and
+``_stream`` window variants)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.dataflow import EngineError
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+from .utils import T, assert_stream_equality, run_table
+
+
+# ------------------------------------------------- connector failure modes
+
+
+def _run_to_completion(table):
+    rows = []
+    pw.io.subscribe(
+        table, on_change=lambda key, row, time, is_addition: rows.append(row)
+    )
+    pw.run(monitoring_level="none")
+    pw.clear_graph()
+    return rows
+
+
+def test_python_subject_crash_mid_stream_fails_run():
+    """A subject that dies after emitting rows must fail the run, not
+    truncate the table silently."""
+
+    class Crashy(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(data="one")
+            self.commit()
+            raise OSError("source went away")
+
+    class S(pw.Schema):
+        data: str
+
+    t = pw.io.python.read(Crashy(), schema=S)
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: None)
+    with pytest.raises(EngineError, match="failed"):
+        pw.run(monitoring_level="none")
+    pw.clear_graph()
+
+
+def test_fs_read_missing_path_fails_run(tmp_path):
+    with pytest.raises(FileNotFoundError, match="does not exist"):
+        pw.io.plaintext.read(str(tmp_path / "nope" / "missing"), mode="static")
+    pw.clear_graph()
+
+
+def test_csv_malformed_row_routes_error(tmp_path):
+    """A row whose field count mismatches the header must not pass
+    silently: static reads surface the parse failure."""
+    p = tmp_path / "bad.csv"
+    p.write_text("a,b\n1,2\n3\n")
+
+    class S(pw.Schema):
+        a: int
+        b: int
+
+    with pytest.raises(Exception):
+        t = pw.io.csv.read(str(p), schema=S, mode="static")
+        _run_to_completion(t)
+    pw.clear_graph()
+
+
+def test_kafka_fake_consumer_error_fails_run():
+    """A kafka client erroring mid-poll aborts the run (reference:
+    reader errors propagate, connectors/mod.rs panics cross workers)."""
+
+    class ExplodingConsumer:
+        def __init__(self):
+            self.n = 0
+
+        def poll(self, timeout=None):
+            self.n += 1
+            if self.n > 2:
+                raise ConnectionError("broker lost")
+            return None
+
+    class S(pw.Schema):
+        data: str
+
+    t = pw.io.kafka.read(
+        rdkafka_settings={}, topic="t", schema=S, format="raw", _consumer=ExplodingConsumer()
+    )
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: None)
+    with pytest.raises(EngineError, match="failed"):
+        pw.run(monitoring_level="none")
+    pw.clear_graph()
+
+
+# ---------------------------------------------------- format edge cases
+
+
+def test_dsv_quoted_separator_and_unicode(tmp_path):
+    p = tmp_path / "q.csv"
+    p.write_text('a,b\n"x,y",Zürich\n"line\nbreak",ok\n')
+
+    class S(pw.Schema):
+        a: str
+        b: str
+
+    t = pw.io.csv.read(str(p), schema=S, mode="static")
+    rows = sorted(_run_to_completion(t), key=lambda r: r["b"])
+    assert rows[0]["a"] == "x,y" and rows[0]["b"] == "Zürich"
+    assert rows[1]["a"] == "line\nbreak"
+
+
+def test_jsonlines_nested_null_and_unicode(tmp_path):
+    p = tmp_path / "n.jsonl"
+    p.write_text(
+        json.dumps({"k": "α", "v": {"deep": [1, None, "ß"]}}) + "\n"
+        + json.dumps({"k": "b", "v": None}) + "\n"
+    )
+
+    class S(pw.Schema):
+        k: str
+        v: pw.Json | None
+
+    t = pw.io.jsonlines.read(str(p), schema=S, mode="static")
+    rows = {r["k"]: r["v"] for r in _run_to_completion(t)}
+    deep = rows["α"].value if hasattr(rows["α"], "value") else rows["α"]
+    assert deep == {"deep": [1, None, "ß"]}
+    b = rows["b"]
+    assert b is None or (hasattr(b, "value") and b.value is None)
+
+
+def test_csv_write_roundtrip_with_special_chars(tmp_path):
+    src = tmp_path / "in.jsonl"
+    src.write_text(json.dumps({"s": 'quote " comma, done', "n": 7}) + "\n")
+
+    class S(pw.Schema):
+        s: str
+        n: int
+
+    t = pw.io.jsonlines.read(str(src), schema=S, mode="static")
+    out = tmp_path / "out.csv"
+    pw.io.csv.write(t, str(out))
+    pw.run(monitoring_level="none")
+    pw.clear_graph()
+
+    t2 = pw.io.csv.read(str(out), schema=S, mode="static")
+    rows = _run_to_completion(t2)
+    assert rows[0]["s"] == 'quote " comma, done' and rows[0]["n"] == 7
+
+
+# ------------------------------------------- temporal streaming variants
+
+
+def test_asof_now_join_streamed_answers_once():
+    """asof_now queries answer against the right side AS OF arrival and
+    do not revise when the right side changes later (reference
+    _asof_now_join semantics)."""
+    left = T(
+        """
+          | q  | __time__ | __diff__
+        1 | 10 | 4        | 1
+        2 | 20 | 8        | 1
+        """
+    )
+    right = T(
+        """
+          | r  | __time__ | __diff__
+        1 | 1  | 2        | 1
+        1 | 1  | 6        | -1
+        1 | 2  | 6        | 1
+        """
+    )
+    res = left.asof_now_join(right).select(q=left.q, r=right.r)
+    assert_stream_equality(
+        res,
+        [
+            ((10, 1), 4, 1),  # q=10 saw r=1 (as of t=4)
+            ((20, 2), 8, 1),  # q=20 saw r=2; the earlier answer did NOT revise
+        ],
+    )
+
+
+def test_exactly_once_behavior_emits_single_final_result():
+    """exactly_once windows emit one final value per window and freeze:
+    late updates past the shift do not revise (reference
+    temporal_behavior.py ExactlyOnceBehavior)."""
+    t = T(
+        """
+          | t  | v | __time__ | __diff__
+        1 | 1  | 1 | 2        | 1
+        2 | 2  | 2 | 2        | 1
+        3 | 12 | 5 | 4        | 1
+        4 | 3  | 9 | 6        | 1
+        """
+    )
+    win = t.windowby(
+        pw.this.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.exactly_once_behavior(),
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    state = run_table(win)
+    sums = sorted(v[-1] for v in state.values())
+    # the late v=9 arrived after window [0,10) closed -> not included
+    assert sums == [3, 5], sums
+
+
+def test_sliding_window_instance_isolated_streams():
+    """windowby instance= keeps per-instance windows independent under
+    streamed arrival."""
+    t = T(
+        """
+          | who | t | v | __time__ | __diff__
+        1 | a   | 1 | 1 | 2        | 1
+        2 | b   | 1 | 5 | 2        | 1
+        3 | a   | 2 | 2 | 4        | 1
+        """
+    )
+    win = t.windowby(
+        pw.this.t,
+        window=pw.temporal.tumbling(duration=10),
+        instance=pw.this.who,
+    ).reduce(who=pw.this._pw_instance, s=pw.reducers.sum(pw.this.v))
+    state = run_table(win)
+    got = sorted((v[0], v[1]) for v in state.values())
+    assert got == [("a", 3), ("b", 5)]
+
+
+# ------------------- multi-worker x persistence x restart cross-product
+
+
+@pytest.fixture
+def _oneshot_fs(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_FS_ONESHOT", "1")
+
+
+@pytest.mark.parametrize("n_workers", [1, 4])
+def test_persistence_restart_matrix(tmp_path, n_workers, _oneshot_fs):
+    """The recovery contract must hold identically for 1 and 4 engine
+    shards: restart resumes from offsets, re-delivers nothing, and new
+    input still flows."""
+    in_dir = tmp_path / "in"
+    in_dir.mkdir()
+    (in_dir / "a.jsonl").write_text(
+        "".join(json.dumps({"w": w}) + "\n" for w in ["x", "y", "x"])
+    )
+
+    class S(pw.Schema):
+        w: str
+
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / f"p{n_workers}"))
+
+    def run_once(events):
+        t = pw.io.jsonlines.read(
+            str(in_dir), schema=S, mode="streaming", persistent_id="src"
+        )
+        counts = t.groupby(pw.this.w).reduce(
+            w=pw.this.w, n=pw.reducers.count()
+        )
+        pw.io.subscribe(
+            counts,
+            on_change=lambda key, row, time, is_addition: events.append(
+                (row["w"], row["n"], is_addition)
+            ),
+        )
+        os.environ["PATHWAY_THREADS"] = str(n_workers)
+        try:
+            pw.run(
+                monitoring_level="none",
+                persistence_config=pw.persistence.Config.simple_config(backend),
+            )
+        finally:
+            os.environ.pop("PATHWAY_THREADS", None)
+        pw.clear_graph()
+
+    ev1: list = []
+    run_once(ev1)
+    final1 = {}
+    for w, n, add in ev1:
+        if add:
+            final1[w] = n
+    assert final1 == {"x": 2, "y": 1}
+
+    # restart with no new input: nothing re-delivers
+    ev2: list = []
+    run_once(ev2)
+    assert ev2 == [], ev2
+
+    # new input after restart: only the delta flows, counts include old
+    (in_dir / "b.jsonl").write_text(json.dumps({"w": "x"}) + "\n")
+    ev3: list = []
+    run_once(ev3)
+    final3 = {w: n for w, n, add in ev3 if add}
+    assert final3 == {"x": 3}, ev3
