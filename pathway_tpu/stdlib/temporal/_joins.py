@@ -135,6 +135,30 @@ def _remap_on(cond, lmap: Table, rmap: Table, lorig: Table, rorig: Table):
     return _rewrite(cond, map_table)
 
 
+def _apply_side_behavior(t: Table, behavior):
+    """Apply a temporal behavior to one prepped join side: thresholds
+    are relative to the side's own event time ``_pw_t`` (reference
+    _interval_join.py behavior compilation -> forget/buffer on inputs)."""
+    from ...internals.table import Column, LogicalOp, Table as _Table
+    from .temporal_behavior import CommonBehavior
+
+    if not isinstance(behavior, CommonBehavior):
+        raise NotImplementedError(
+            "temporal joins support common_behavior(delay=, cutoff=)"
+        )
+    params: dict = {"time_expr": t._pw_t}
+    if behavior.delay is not None:
+        params["delay_threshold"] = t._pw_t + behavior.delay
+    if behavior.cutoff is not None:
+        key = "freeze_threshold" if behavior.keep_results else "cutoff_threshold"
+        params[key] = t._pw_t + behavior.cutoff
+    if len(params) == 1:
+        return t
+    cols = {n: Column(c.dtype) for n, c in t._columns.items()}
+    op = LogicalOp("temporal_behavior", [t], params)
+    return _Table(cols, t._universe.subset(), op, name=f"{t._name}.join_behavior")
+
+
 def interval_join(
     self: Table,
     other: Table,
@@ -152,6 +176,9 @@ def interval_join(
 
     l = _prep_side(self, self_time, on)
     r = _prep_side(other, other_time, on)
+    if behavior is not None:
+        l = _apply_side_behavior(l, behavior)
+        r = _apply_side_behavior(r, behavior)
     conds = [_remap_on(c, l, r, self, other) for c in on]
     if not conds:
         conds = [l.select(_pw_one=1)._pw_one == r.select(_pw_one=1)._pw_one]

@@ -299,3 +299,63 @@ def test_persistence_restart_matrix(tmp_path, n_workers, _oneshot_fs):
     run_once(ev3)
     final3 = {w: n for w, n, add in ev3 if add}
     assert final3 == {"x": 3}, ev3
+
+
+def test_interval_join_with_cutoff_behavior_drops_late():
+    """interval_join with a cutoff behavior: left rows arriving past the
+    cutoff are ignored (reference test_interval_join_stream.py)."""
+    left = T(
+        """
+          | t | v | __time__ | __diff__
+        1 | 1 | 1 | 2        | 1
+        2 | 9 | 2 | 4        | 1
+        3 | 1 | 3 | 8        | 1
+        """
+    )
+    right = T(
+        """
+          | t | w  | __time__ | __diff__
+        1 | 1 | 10 | 2        | 1
+        2 | 9 | 90 | 2        | 1
+        """
+    )
+    res = left.interval_join(
+        right,
+        pw.left.t,
+        pw.right.t,
+        pw.temporal.interval(0, 0),
+        behavior=pw.temporal.common_behavior(cutoff=2),
+    ).select(v=pw.left.v, w=pw.right.w)
+    state = run_table(res)
+    got = sorted((r[0], r[1]) for r in state.values())
+    # the late (t=1, v=3) row arrived when the watermark (9) was past
+    # t + cutoff = 3 -> dropped; the on-time rows joined
+    assert got == [(1, 10), (2, 90)], got
+
+
+def test_window_join_streamed_revision():
+    left = T(
+        """
+          | t | v | __time__ | __diff__
+        1 | 1 | 1 | 2        | 1
+        """
+    )
+    right = T(
+        """
+          | t | w | __time__ | __diff__
+        1 | 2 | 5 | 4        | 1
+        1 | 2 | 5 | 6        | -1
+        1 | 2 | 7 | 6        | 1
+        """
+    )
+    res = left.window_join(
+        right, pw.left.t, pw.right.t, pw.temporal.tumbling(duration=4)
+    ).select(v=pw.left.v, w=pw.right.w)
+    assert_stream_equality(
+        res,
+        [
+            ((1, 5), 4, 1),
+            ((1, 5), 6, -1),
+            ((1, 7), 6, 1),
+        ],
+    )

@@ -1,0 +1,225 @@
+"""Connector failure/recovery matrix (round 4): each injectable-client
+connector exercised through its failure modes — flaky clients,
+mid-stream disconnects, replay-after-failure — mirroring the
+reference's per-backend integration suites (SURVEY §4.3)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.dataflow import EngineError
+
+
+def _collect(table):
+    rows = []
+    pw.io.subscribe(
+        table, on_change=lambda key, row, time, is_addition: rows.append(row)
+    )
+    pw.run(monitoring_level="none")
+    pw.clear_graph()
+    return rows
+
+
+# ----------------------------------------------------------- object stores
+
+
+class FlakyS3:
+    """boto3-shaped; lists fine, the object fetch always fails."""
+
+    def __init__(self, objects):
+        self.objects = dict(objects)
+
+    def list_objects_v2(self, Bucket, Prefix, **kw):
+        return {
+            "Contents": [{"Key": k, "ETag": "1"} for k in sorted(self.objects)],
+            "IsTruncated": False,
+        }
+
+    def get_object(self, Bucket, Key):
+        raise ConnectionError(f"transient fetch failure: {Key}")
+
+
+def test_s3_static_read_transient_get_fails_loudly():
+    """Static reads have no retry loop: a failing fetch must surface,
+    not produce a partial table."""
+    with pytest.raises(ConnectionError):
+        pw.io.s3.read(
+            "s3://b/", format="plaintext", mode="static", _client=FlakyS3({"k": b"v\n"})
+        )
+    pw.clear_graph()
+
+
+class HalfDeadS3:
+    """boto3-shaped; first listing works, then the listing dies."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def list_objects_v2(self, Bucket, Prefix, **kw):
+        self.calls += 1
+        if self.calls > 1:
+            raise ConnectionError("listing failed")
+        return {
+            "Contents": [{"Key": "a.txt", "ETag": "1"}],
+            "IsTruncated": False,
+        }
+
+    def get_object(self, Bucket, Key):
+        import io
+
+        return {"Body": io.BytesIO(b"alpha\n")}
+
+def test_s3_streaming_listing_failure_fails_run():
+    t = pw.io.s3.read(
+        "s3://b/", format="plaintext", mode="streaming", _client=HalfDeadS3()
+    )
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: None)
+    with pytest.raises(EngineError, match="failed"):
+        pw.run(monitoring_level="none")
+    pw.clear_graph()
+
+
+# --------------------------------------------------------------- writers
+
+
+class DeadSink:
+    def __init__(self):
+        self.writes = 0
+
+    def write(self, *a, **kw):
+        self.writes += 1
+        raise IOError("sink gone")
+
+
+def test_elasticsearch_write_failure_surfaces():
+    """A failing sink client must not be swallowed."""
+
+    class ES:
+        def __init__(self):
+            self.ops = []
+
+        def bulk(self, operations=None, **kw):
+            raise ConnectionError("cluster red")
+
+        def index(self, **kw):
+            raise ConnectionError("cluster red")
+
+    t = pw.debug.table_from_rows(schema=pw.schema_from_types(a=int), rows=[(1,)])
+    pw.io.elasticsearch.write(t, "http://localhost", None, "idx", _client=ES())
+    with pytest.raises(Exception):
+        pw.run(monitoring_level="none")
+    pw.clear_graph()
+
+
+# ------------------------------------------------------- python subjects
+
+
+def test_subject_offsets_resume_skips_consumed(tmp_path):
+    """An offset-aware subject resumes from its bookmark after restart
+    and never re-emits consumed input (exactly-once source contract)."""
+
+    produced = ["a", "b", "c", "d"]
+
+    class Cursor(pw.io.python.ConnectorSubject):
+        supports_offsets = True
+
+        def run(self):
+            start = int(self.offsets.get("pos", 0))
+            for i in range(start, len(produced)):
+                self.next_with_offset("pos", i + 1, w=produced[i])
+            self.commit()
+
+    class S(pw.Schema):
+        w: str
+
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+
+    def run_once():
+        events = []
+        t = pw.io.python.read(Cursor(), schema=S, persistent_id="cur")
+        pw.io.subscribe(
+            t, on_change=lambda key, row, time, is_addition: events.append(row["w"])
+        )
+        pw.run(
+            monitoring_level="none",
+            persistence_config=pw.persistence.Config.simple_config(backend),
+        )
+        pw.clear_graph()
+        return events
+
+    assert sorted(run_once()) == ["a", "b", "c", "d"]
+    assert run_once() == []  # nothing re-delivered
+    produced.extend(["e"])
+    assert run_once() == ["e"]  # only the delta
+
+
+def test_subject_without_offsets_resets_cleanly(tmp_path):
+    """An offset-UNAWARE subject re-produces everything; recovery resets
+    the log so sinks see one copy, not two."""
+
+    class Naive(pw.io.python.ConnectorSubject):
+        def run(self):
+            for w in ["p", "q"]:
+                self.next(w=w)
+            self.commit()
+
+    class S(pw.Schema):
+        w: str
+
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+
+    def run_once():
+        events = []
+        t = pw.io.python.read(Naive(), schema=S, persistent_id="naive")
+        pw.io.subscribe(
+            t, on_change=lambda key, row, time, is_addition: events.append(row["w"])
+        )
+        pw.run(
+            monitoring_level="none",
+            persistence_config=pw.persistence.Config.simple_config(backend),
+        )
+        pw.clear_graph()
+        return sorted(events)
+
+    assert run_once() == ["p", "q"]
+    assert run_once() == ["p", "q"]  # re-produced once, never doubled
+
+
+# --------------------------------------------------------------- sqlite
+
+
+def test_sqlite_read_static(tmp_path):
+    import sqlite3
+
+    db = tmp_path / "d.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE users (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO users VALUES (?, ?)", [(1, "ada"), (2, "bob")])
+    conn.commit()
+    conn.close()
+
+    class S(pw.Schema):
+        id: int
+        name: str
+
+    t = pw.io.sqlite.read(str(db), "users", schema=S, mode="static")
+    rows = sorted((r["id"], r["name"]) for r in _collect(t))
+    assert rows == [(1, "ada"), (2, "bob")]
+
+
+def test_sqlite_missing_table_fails(tmp_path):
+    import sqlite3
+
+    db = tmp_path / "d.db"
+    sqlite3.connect(db).close()
+
+    class S(pw.Schema):
+        id: int
+
+    with pytest.raises(Exception):
+        t = pw.io.sqlite.read(str(db), "ghost", schema=S, mode="static")
+        _collect(t)
+    pw.clear_graph()

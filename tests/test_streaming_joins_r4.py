@@ -1,0 +1,203 @@
+"""Streaming join/groupby revision semantics under multi-epoch arrival
+and retraction — the reference's join `_stream` variants
+(python/pathway/tests/test_joins.py + compute_and_print_update_stream
+checks): every join mode must retract stale outputs and emit revised
+ones when either side changes."""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+
+from .utils import T, assert_stream_equality, run_table
+
+
+def _orders():
+    return T(
+        """
+          | item | qty | __time__ | __diff__
+        1 | a    | 1   | 2        | 1
+        2 | b    | 2   | 2        | 1
+        3 | a    | 3   | 4        | 1
+        """
+    )
+
+
+def _prices():
+    return T(
+        """
+          | item | price | __time__ | __diff__
+        1 | a    | 10    | 2        | 1
+        2 | b    | 20    | 4        | 1
+        1 | a    | 10    | 6        | -1
+        1 | a    | 11    | 6        | 1
+        """
+    )
+
+
+def test_inner_join_revises_on_right_update():
+    res = _orders().join(
+        _prices(), pw.left.item == pw.right.item
+    ).select(item=pw.left.item, qty=pw.left.qty, price=pw.right.price)
+    assert_stream_equality(
+        res,
+        [
+            (("a", 1, 10), 2, 1),
+            (("a", 3, 10), 4, 1),
+            (("b", 2, 20), 4, 1),
+            (("a", 1, 10), 6, -1),  # price revision retracts old outputs
+            (("a", 3, 10), 6, -1),
+            (("a", 1, 11), 6, 1),
+            (("a", 3, 11), 6, 1),
+        ],
+    )
+
+
+def test_left_join_fills_then_matches():
+    """A left row emitted with a None pad must retract the pad when its
+    match arrives later."""
+    res = _orders().join_left(
+        _prices(), pw.left.item == pw.right.item
+    ).select(item=pw.left.item, price=pw.right.price)
+    stream = [
+        u
+        for u in _capture_stream(res)
+        if u[0][0] == "b"  # focus the late-matching key
+    ]
+    assert (("b", None), 2, 1) in stream
+    assert (("b", None), 4, -1) in stream
+    assert (("b", 20), 4, 1) in stream
+
+
+def _capture_stream(table):
+    from .utils import table_to_stream
+
+    stream, _names = table_to_stream(table)
+    return [(tuple(row), time, diff) for _k, row, time, diff in stream]
+
+
+def test_groupby_count_revision_stream():
+    t = T(
+        """
+          | w | __time__ | __diff__
+        1 | x | 2        | 1
+        2 | x | 4        | 1
+        2 | x | 6        | -1
+        """
+    )
+    res = t.groupby(pw.this.w).reduce(w=pw.this.w, n=pw.reducers.count())
+    assert_stream_equality(
+        res,
+        [
+            (("x", 1), 2, 1),
+            (("x", 1), 4, -1),
+            (("x", 2), 4, 1),
+            (("x", 2), 6, -1),
+            (("x", 1), 6, 1),
+        ],
+    )
+
+
+def test_groupby_min_max_retraction_recomputes():
+    """Retracting the current extremum must resurface the runner-up
+    (full ReducerImpl path, not semigroup)."""
+    t = T(
+        """
+          | g | v  | __time__ | __diff__
+        1 | a | 5  | 2        | 1
+        2 | a | 9  | 2        | 1
+        2 | a | 9  | 4        | -1
+        """
+    )
+    res = t.groupby(pw.this.g).reduce(
+        g=pw.this.g, mx=pw.reducers.max(pw.this.v), mn=pw.reducers.min(pw.this.v)
+    )
+    state = run_table(res)
+    assert list(state.values()) == [("a", 5, 5)]
+
+
+def test_deduplicate_acceptor_streamed():
+    """pw.Table.deduplicate with an acceptor: only increasing values
+    replace the kept row (reference stdlib/stateful/deduplicate)."""
+    t = T(
+        """
+          | v  | __time__ | __diff__
+        1 | 5  | 2        | 1
+        2 | 3  | 4        | 1
+        3 | 8  | 6        | 1
+        """
+    )
+    res = t.deduplicate(
+        value=pw.this.v, acceptor=lambda new, old: new > old
+    )
+    assert_stream_equality(
+        res,
+        [
+            ((5,), 2, 1),
+            ((5,), 6, -1),  # 3 rejected at t=4; 8 replaces at t=6
+            ((8,), 6, 1),
+        ],
+    )
+
+
+def test_intersect_difference_streamed():
+    a = T(
+        """
+          | v | __time__ | __diff__
+        1 | 1 | 2        | 1
+        2 | 2 | 2        | 1
+        """
+    )
+    b = T(
+        """
+          | v | __time__ | __diff__
+        1 | 0 | 4        | 1
+        """
+    )
+    inter = a.intersect(b)
+    diff = a.difference(b)
+    inter_state = run_table(inter.copy())
+    # key 1 is in both universes once b's row lands
+    assert sorted(v[0] for v in inter_state.values()) == [1]
+    pw.clear_graph()
+
+    a2 = T(
+        """
+          | v | __time__ | __diff__
+        1 | 1 | 2        | 1
+        2 | 2 | 2        | 1
+        """
+    )
+    b2 = T(
+        """
+          | v | __time__ | __diff__
+        1 | 0 | 4        | 1
+        """
+    )
+    diff_state = run_table(a2.difference(b2))
+    assert sorted(v[0] for v in diff_state.values()) == [2]
+
+
+def test_update_cells_streamed_revision():
+    base = T(
+        """
+          | v  | __time__ | __diff__
+        1 | 10 | 2        | 1
+        2 | 20 | 2        | 1
+        """
+    )
+    patch = T(
+        """
+          | v  | __time__ | __diff__
+        1 | 99 | 4        | 1
+        """
+    )
+    res = base.update_cells(patch)
+    assert_stream_equality(
+        res,
+        [
+            ((10,), 2, 1),
+            ((20,), 2, 1),
+            ((10,), 4, -1),
+            ((99,), 4, 1),
+        ],
+    )
