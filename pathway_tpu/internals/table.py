@@ -673,7 +673,23 @@ class JoinResult:
                 exprs[a._name] = a
         for n, e in kwargs.items():
             exprs[n] = _resolve_join_this(smart_wrap(e), self)
-        cols = {n: Column(e._dtype) for n, e in exprs.items()}
+
+        # outer hows null-extend a side: columns read purely from that
+        # side become Optional (reference joins.py output typing)
+        null_left = self._how in ("right", "outer")
+        null_right = self._how in ("left", "outer")
+
+        def out_dtype(e: ColumnExpression) -> dt.DType:
+            d = e._dtype
+            if isinstance(e, ColumnReference):
+                if (e._table is self._right and null_right) or (
+                    e._table is self._left and null_left
+                ):
+                    if not isinstance(d, dt.Optional) and d is not dt.ANY:
+                        return dt.Optional(d)
+            return d
+
+        cols = {n: Column(out_dtype(e)) for n, e in exprs.items()}
         op = LogicalOp(
             "join_select",
             [self._left, self._right],

@@ -171,3 +171,34 @@ def test_schema_type_coercion_at_ingest():
     t = pw.debug.table_from_rows(S, [("3", "1.5", 7)])
     ((a, b, c),) = run_table(t).values()
     assert (a, b, c) == (3, 1.5, "7")
+
+
+def test_outer_join_columns_become_optional():
+    """Null-extended join sides type their columns Optional (reference
+    joins.py output typing)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import dtype as dt
+
+    t1 = pw.debug.table_from_markdown(
+        """
+          | a | b
+        1 | 1 | x
+        """
+    )
+    t2 = pw.debug.table_from_markdown(
+        """
+          | a | c
+        1 | 1 | 2.5
+        """
+    )
+    left = t1.join_left(t2, pw.left.a == pw.right.a).select(pw.left.b, c=pw.right.c)
+    assert left._columns["b"].dtype is dt.STR
+    assert left._columns["c"].dtype == dt.Optional(dt.FLOAT)
+    outer = t1.join_outer(t2, pw.left.a == pw.right.a).select(
+        b=pw.left.b, c=pw.right.c
+    )
+    assert outer._columns["b"].dtype == dt.Optional(dt.STR)
+    assert outer._columns["c"].dtype == dt.Optional(dt.FLOAT)
+    inner = t1.join(t2, pw.left.a == pw.right.a).select(pw.left.b, c=pw.right.c)
+    assert inner._columns["c"].dtype is dt.FLOAT
+    pw.clear_graph()
