@@ -1,0 +1,201 @@
+"""Behavioral coverage for public API names no other test touches —
+every name in ``pw.__all__`` should have at least one semantic check
+(not just an import), mirroring the reference's test_common.py breadth."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+
+from .utils import T, run_table
+
+
+def test_apply_async_and_fully_async():
+    t = T(
+        """
+          | a
+        1 | 2
+        2 | 3
+        """
+    )
+
+    async def double(x):
+        return x * 2
+
+    r = t.select(b=pw.apply_async(double, pw.this.a))
+    state = run_table(r)
+    assert sorted(v[0] for v in state.values()) == [4, 6]
+    pw.clear_graph()
+
+    t2 = T(
+        """
+          | a
+        1 | 5
+        """
+    )
+    r2 = t2.select(b=pw.apply_fully_async(double, pw.this.a))
+    # fully-async columns hold futures until awaited; await_futures
+    # materializes them
+    state2 = run_table(r2.await_futures())
+    vals = [v[0] for v in state2.values()]
+    assert vals == [10]
+
+
+def test_make_tuple_and_unpack_col():
+    t = T(
+        """
+          | a | b
+        1 | 1 | x
+        """
+    )
+    packed = t.select(tup=pw.make_tuple(pw.this.a, pw.this.b))
+    from pathway_tpu.stdlib.utils.col import unpack_col
+
+    unpacked = unpack_col(packed.tup, "a", "b")
+    state = run_table(unpacked)
+    assert list(state.values()) == [(1, "x")]
+
+
+def test_declare_type_and_cast():
+    from pathway_tpu.internals import dtype as dt
+
+    t = T(
+        """
+          | a
+        1 | 1
+        """
+    )
+    r = t.select(b=pw.declare_type(float, pw.this.a))
+    assert r._columns["b"].dtype is dt.FLOAT
+    r2 = t.select(c=pw.cast(float, pw.this.a))
+    state = run_table(r2)
+    assert list(state.values()) == [(1.0,)]
+
+
+def test_unsafe_make_pointer_and_wrap_py_object():
+    p = pw.unsafe_make_pointer(42)
+    assert int(p) == 42
+    obj = object()
+    w = pw.wrap_py_object(obj)
+    assert isinstance(w, pw.PyObjectWrapper)
+    assert w.value is obj
+
+
+def test_schema_from_csv(tmp_path):
+    f = tmp_path / "s.csv"
+    f.write_text("name,age,score\nada,30,1.5\n")
+    schema = pw.schema_from_csv(str(f))
+    hints = schema.typehints()
+    assert hints["name"] is str
+    assert hints["age"] is int
+    assert hints["score"] is float
+
+
+def test_assert_table_has_schema():
+    class S(pw.Schema):
+        a: int
+
+    t = T(
+        """
+          | a
+        1 | 1
+        """
+    )
+    pw.assert_table_has_schema(t, S)
+
+    class Wrong(pw.Schema):
+        a: str
+
+    with pytest.raises(AssertionError):
+        pw.assert_table_has_schema(t, Wrong)
+
+
+def test_iterate_universe_fixpoint():
+    """pw.iterate_universe: iterate where the row set itself changes
+    (reference iterate w/ universe changes)."""
+    t = T(
+        """
+          | v
+        1 | 16
+        2 | 3
+        """
+    )
+
+    def halve_big(t):
+        # keys stay stable across iterations (filter/select preserve
+        # them) so the fixpoint detector can converge
+        big = t.filter(pw.this.v > 4).select(v=pw.this.v // 2)
+        small = t.filter(pw.this.v <= 4)
+        return small.concat(big)
+
+    res = pw.iterate_universe(halve_big, t=t)
+    state = run_table(res.t if hasattr(res, "t") else res)
+    assert sorted(v[0] for v in state.values()) == [3, 4]
+
+
+def test_datetime_constants_roundtrip():
+    """DATE_TIME_NAIVE/UTC/DURATION type markers work in schemas and
+    the .dt namespace consumes their columns."""
+    import datetime
+
+    class S(pw.Schema):
+        ts: pw.DATE_TIME_NAIVE
+        dur: pw.DURATION
+
+    rows = [(datetime.datetime(2024, 5, 1, 12, 30), datetime.timedelta(hours=2))]
+    t = pw.debug.table_from_rows(schema=S, rows=rows)
+    r = t.select(
+        h=pw.this.ts.dt.hour(),
+        total_h=pw.this.dur.dt.hours(),
+    )
+    state = run_table(r)
+    assert list(state.values()) == [(12, 2)]
+
+
+def test_grouped_join_result_reduce():
+    """JoinResult.groupby-style reduce (GroupedJoinResult surface)."""
+    orders = T(
+        """
+          | item | qty
+        1 | a    | 1
+        2 | a    | 3
+        3 | b    | 2
+        """
+    )
+    prices = T(
+        """
+          | item | price
+        1 | a    | 10
+        2 | b    | 20
+        """
+    )
+    total = (
+        orders.join(prices, pw.left.item == pw.right.item)
+        .select(rev=pw.left.qty * pw.right.price)
+        .reduce(total=pw.reducers.sum(pw.this.rev))
+    )
+    state = run_table(total)
+    assert list(state.values()) == [(80,)]
+
+
+def test_pathway_config_and_monitoring_config():
+    cfg = pw.pathway_config
+    assert hasattr(cfg, "license_key")
+    pw.set_monitoring_config(server_endpoint=None)  # accepts and no-ops
+
+
+def test_udf_sync_async_aliases():
+    @pw.udf
+    def inc(x: int) -> int:
+        return x + 1
+
+    assert isinstance(inc, pw.UDFSync) or isinstance(inc, pw.UDF)
+    t = T(
+        """
+          | a
+        1 | 1
+        """
+    )
+    state = run_table(t.select(b=inc(pw.this.a)))
+    assert list(state.values()) == [(2,)]
