@@ -138,7 +138,16 @@ class StringNamespace:
         return _m("to_bytes", lambda s, e: s.encode(e), dt.BYTES, [self._expr, encoding])
 
     def to_string(self):
-        return _m("to_string", lambda s: s if isinstance(s, str) else str(s), dt.STR, [self._expr])
+        # bytes decode as utf-8 (the inverse of to_bytes), everything
+        # else stringifies
+        def fn(s):
+            if isinstance(s, str):
+                return s
+            if isinstance(s, bytes):
+                return s.decode("utf-8", errors="replace")
+            return str(s)
+
+        return _m("to_string", fn, dt.STR, [self._expr])
 
 
 def _try(fn, s):

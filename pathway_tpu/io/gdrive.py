@@ -37,17 +37,20 @@ class _GDriveClient:
 
     def list_objects(self):
         page_token = None
+        self.sizes: dict[str, int] = getattr(self, "sizes", {})
         while True:
             resp = (
                 self.service.files()
                 .list(
                     q=f"'{self.object_id}' in parents and trashed=false",
-                    fields="nextPageToken, files(id, name, md5Checksum, modifiedTime)",
+                    fields="nextPageToken, files(id, name, md5Checksum, modifiedTime, size)",
                     pageToken=page_token,
                 )
                 .execute()
             )
             for f in resp.get("files", []):
+                if "size" in f:
+                    self.sizes[f["id"]] = int(f["size"])
                 yield f["id"], f.get("md5Checksum") or f.get("modifiedTime")
             page_token = resp.get("nextPageToken")
             if not page_token:
@@ -55,6 +58,41 @@ class _GDriveClient:
 
     def get_object(self, key: str) -> bytes:
         return self.service.files().get_media(fileId=key).execute()
+
+
+class _SizeLimitedClient:
+    """Skip payloads over ``limit`` bytes (reference gdrive
+    object_size_limit semantics: the oversized object's row carries an
+    empty payload instead of the content). Uses the listing's size
+    metadata when the wrapped client exposes it (no download at all);
+    otherwise downloads and discards."""
+
+    def __init__(self, inner, limit: int):
+        self._inner = inner
+        self._limit = limit
+
+    def list_objects(self):
+        return self._inner.list_objects()
+
+    def get_object(self, key: str) -> bytes:
+        import logging
+
+        size = getattr(self._inner, "sizes", {}).get(key)
+        if size is not None and size > self._limit:
+            logging.info(
+                "gdrive: skipping %s (size %d > limit %d)", key, size, self._limit
+            )
+            return b""
+        payload = self._inner.get_object(key)
+        if len(payload) > self._limit:
+            logging.info(
+                "gdrive: skipping %s (downloaded %d > limit %d)",
+                key,
+                len(payload),
+                self._limit,
+            )
+            return b""
+        return payload
 
 
 def read(
@@ -72,16 +110,13 @@ def read(
     _client: Any = None,
     **kwargs,
 ) -> Table:
-    if object_size_limit is not None:
-        raise NotImplementedError(
-            "gdrive object_size_limit is not implemented yet; filter "
-            "oversized files on the Drive side or drop the argument"
-        )
-
     def client_factory():
-        if _client is not None:
-            return _client
-        return _GDriveClient(object_id, service_user_credentials_file)
+        client = _client if _client is not None else _GDriveClient(
+            object_id, service_user_credentials_file
+        )
+        if object_size_limit is not None:
+            client = _SizeLimitedClient(client, object_size_limit)
+        return client
 
     return read_object_store(
         client_factory,

@@ -223,3 +223,24 @@ def test_sqlite_missing_table_fails(tmp_path):
         t = pw.io.sqlite.read(str(db), "ghost", schema=S, mode="static")
         _collect(t)
     pw.clear_graph()
+
+
+def test_gdrive_object_size_limit_skips_payload():
+    class FakeDrive:
+        sizes = {"big": 1000}
+
+        def list_objects(self):
+            return [("small", 1), ("big", 1)]
+
+        def get_object(self, key):
+            return b"x" * (1000 if key == "big" else 4)
+
+    t = pw.io.gdrive.read(
+        "folder",
+        mode="static",
+        format="binary",
+        object_size_limit=100,
+        _client=FakeDrive(),
+    )
+    rows = sorted(_collect(t), key=lambda r: len(r["data"]))
+    assert [len(r["data"]) for r in rows] == [0, 4]  # big skipped, small kept

@@ -207,3 +207,156 @@ def test_cast_between_types():
     )
     (row,) = run_table(res).values()
     assert row == (3.0, "3", True)
+
+
+def test_str_namespace_full_matrix():
+    """Every .str method produces the python-string-equivalent result
+    (reference expressions/string.py parity, one row per method)."""
+    t = T(
+        """
+          | s
+        1 | __Mixed-Case_
+        """
+    )
+    s = "__Mixed-Case_"
+    r = t.select(
+        up=pw.this.s.str.upper(),
+        low=pw.this.s.str.lower(),
+        cap=pw.this.s.str.capitalize(),
+        title=pw.this.s.str.title(),
+        swap=pw.this.s.str.swapcase(),
+        casef=pw.this.s.str.casefold(),
+        ln=pw.this.s.str.len(),
+        strip=pw.this.s.str.strip("_"),
+        lstrip=pw.this.s.str.lstrip("_"),
+        rstrip=pw.this.s.str.rstrip("_"),
+        cnt=pw.this.s.str.count("_"),
+        find=pw.this.s.str.find("Case"),
+        rfind=pw.this.s.str.rfind("_"),
+        starts=pw.this.s.str.startswith("__"),
+        ends=pw.this.s.str.endswith("_"),
+        rep=pw.this.s.str.replace("-", "+"),
+        rmp=pw.this.s.str.removeprefix("__"),
+        rms=pw.this.s.str.removesuffix("_"),
+        rev=pw.this.s.str.reversed(),
+        lj=pw.this.s.str.ljust(15, "."),
+        rj=pw.this.s.str.rjust(15, "."),
+        zf=pw.this.s.str.zfill(15),
+        sl=pw.this.s.str.slice(2, 7),
+    )
+    (row,) = run_table(r).values()
+    names = r.column_names()
+    got = dict(zip(names, row))
+    assert got["up"] == s.upper()
+    assert got["low"] == s.lower()
+    assert got["cap"] == s.capitalize()
+    assert got["title"] == s.title()
+    assert got["swap"] == s.swapcase()
+    assert got["casef"] == s.casefold()
+    assert got["ln"] == len(s)
+    assert got["strip"] == s.strip("_")
+    assert got["lstrip"] == s.lstrip("_")
+    assert got["rstrip"] == s.rstrip("_")
+    assert got["cnt"] == s.count("_")
+    assert got["find"] == s.find("Case")
+    assert got["rfind"] == s.rfind("_")
+    assert got["starts"] is True and got["ends"] is True
+    assert got["rep"] == s.replace("-", "+")
+    assert got["rmp"] == s.removeprefix("__")
+    assert got["rms"] == s.removesuffix("_")
+    assert got["rev"] == s[::-1]
+    assert got["lj"] == s.ljust(15, ".")
+    assert got["rj"] == s.rjust(15, ".")
+    assert got["zf"] == s.zfill(15)
+    assert got["sl"] == s[2:7]
+
+
+def test_str_parse_methods():
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(i=str, f=str, b=str),
+        rows=[("-42", "2.5", "yes")],
+    )
+    r = t.select(
+        i=pw.this.i.str.parse_int(),
+        f=pw.this.f.str.parse_float(),
+        b=pw.this.b.str.parse_bool(),
+    )
+    (row,) = run_table(r).values()
+    assert row == (-42, 2.5, True)
+    bad = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(b=str), rows=[("maybe",)]
+    )
+    opt = bad.select(b=pw.this.b.str.parse_bool(optional=True))
+    (row2,) = run_table(opt).values()
+    assert row2 == (None,)
+
+
+def test_num_namespace_full_matrix():
+    import math
+
+    t = T(
+        """
+          | x
+        1 | -2.25
+        """
+    )
+    x = -2.25
+    r = t.select(
+        ab=pw.this.x.num.abs(),
+        ce=pw.this.x.num.ceil(),
+        fl=pw.this.x.num.floor(),
+        ro=pw.this.x.num.round(1),
+        sq=(pw.this.x * pw.this.x).num.sqrt(),
+        ex=pw.this.x.num.exp(),
+        si=pw.this.x.num.sin(),
+        co=pw.this.x.num.cos(),
+        ta=pw.this.x.num.tan(),
+        lg=(-pw.this.x).num.log(),
+        l2=(-pw.this.x).num.log2(),
+        l10=(-pw.this.x).num.log10(),
+    )
+    (row,) = run_table(r).values()
+    names = r.column_names()
+    got = dict(zip(names, row))
+    assert got["ab"] == 2.25
+    assert got["ce"] == -2
+    assert got["fl"] == -3
+    assert got["ro"] == -2.2
+    assert abs(got["sq"] - 2.25) < 1e-9
+    assert abs(got["ex"] - math.exp(x)) < 1e-9
+    assert abs(got["si"] - math.sin(x)) < 1e-9
+    assert abs(got["co"] - math.cos(x)) < 1e-9
+    assert abs(got["ta"] - math.tan(x)) < 1e-9
+    assert abs(got["lg"] - math.log(2.25)) < 1e-9
+    assert abs(got["l2"] - math.log2(2.25)) < 1e-9
+    assert abs(got["l10"] - math.log10(2.25)) < 1e-9
+
+
+def test_num_fill_na():
+    from typing import Optional
+
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(x=Optional[float]),
+        rows=[(1.5,), (None,)],
+    )
+    r = t.select(a=pw.this.x.num.fill_na(0.0))
+    vals = sorted(v[0] for v in run_table(r).values())
+    assert vals == [0.0, 1.5]
+
+
+def test_str_split_and_to_bytes():
+    t = T(
+        """
+          | s
+        1 | a,b,c
+        """
+    )
+    r = t.select(
+        parts=pw.this.s.str.split(","),
+        raw=pw.this.s.str.to_bytes(),
+        again=pw.this.s.str.to_bytes().str.to_string(),
+    )
+    (row,) = run_table(r).values()
+    assert tuple(row[0]) == ("a", "b", "c")
+    assert row[1] == b"a,b,c"
+    assert row[2] == "a,b,c"
