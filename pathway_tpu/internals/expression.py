@@ -703,6 +703,11 @@ class IxExpression(ColumnExpression):
 def _to_string(v) -> str:
     if v is None:
         return "None"
+    if isinstance(v, bytes):
+        # the inverse of .str.to_bytes() — consistent with
+        # StringNamespace.to_string (divergence from the reference,
+        # whose engine renders bytes in Rust Debug form)
+        return v.decode("utf-8", errors="replace")
     return str(v)
 
 

@@ -193,6 +193,7 @@ def read_object_store(
     persistent_id: str | None = None,
     poll_interval_s: float = _POLL_INTERVAL_S,
     object_cache: str | ObjectCache | None = None,
+    object_size_limit: int | None = None,
     **kwargs,
 ) -> Table:
     """Build an input table over an ObjectStoreClient.
@@ -203,18 +204,49 @@ def read_object_store(
     ``object_cache``: directory (or ObjectCache) persisting fetched
     objects by version — restarts and re-scans skip downloads of
     unchanged objects entirely (reference cached_object_storage.rs).
-    """
+
+    ``object_size_limit``: oversized objects yield an empty payload.
+    Enforced on EVERY serve path (fresh fetch AND cache hit — a cached
+    full payload must not bypass a later limit), the cache only ever
+    stores real content, and skipped objects record a limit-tagged
+    version so changing the limit re-evaluates them."""
     cache = ObjectCache(object_cache) if isinstance(object_cache, str) else object_cache
 
-    def fetch(client, key: str, version: Any) -> bytes:
+    def fetch(client, key: str, version: Any) -> tuple[bytes, bool]:
+        """-> (payload, skipped_by_limit)."""
+        if object_size_limit is not None:
+            # listing-provided size metadata skips the download entirely
+            size = getattr(client, "sizes", {}).get(key)
+            if size is not None and size > object_size_limit:
+                import logging
+
+                logging.info(
+                    "object store: skipping %s (size %d > limit %d)",
+                    key,
+                    size,
+                    object_size_limit,
+                )
+                return b"", True
+        payload = None
         if cache is not None:
-            hit = cache.get(key, version)
-            if hit is not None:
-                return hit
-        payload = client.get_object(key)
-        if cache is not None:
-            cache.put(key, version, payload)
-        return payload
+            payload = cache.get(key, version)
+        if payload is None:
+            payload = client.get_object(key)
+            if cache is not None:
+                cache.put(key, version, payload)
+        if object_size_limit is not None and len(payload) > object_size_limit:
+            return b"", True
+        return payload, False
+
+    def effective_version(version: Any, skipped: bool) -> Any:
+        # with a limit configured, EVERY recorded version carries the
+        # limit it was evaluated under: changing the limit (adding,
+        # raising, lowering) re-evaluates each object — a plain version
+        # match could serve stale full/empty payloads otherwise
+        if object_size_limit is None:
+            return version
+        tag = "__oversized__" if skipped else "__ok__"
+        return [tag, _jsonable(version), object_size_limit]
 
     if schema is None:
         schema = default_schema(format, with_metadata)
@@ -227,7 +259,7 @@ def read_object_store(
         client = client_factory()
         rows: list[dict] = []
         for key, version in sorted(client.list_objects()):
-            payload = fetch(client, key, version)
+            payload, _skipped = fetch(client, key, version)
             rows.extend(
                 rows_from_payload(
                     payload, format, with_metadata, {"path": key}, **kwargs
@@ -248,21 +280,33 @@ def read_object_store(
             for key in sorted(current):
                 version = current[key]
                 old = known.get(key)
-                if old is not None and old[0] == version:
+                # unchanged iff the recorded version matches either the
+                # plain content version (served fully before) or the
+                # skip marker for the SAME limit (skipped before; a
+                # changed limit must re-evaluate)
+                unchanged = False
+                if old is not None:
+                    if isinstance(old[0], (list, tuple)):
+                        unchanged = list(old[0]) in (
+                            effective_version(version, True),
+                            effective_version(version, False),
+                        )
+                    else:
+                        unchanged = (
+                            object_size_limit is None and old[0] == version
+                        )
+                if unchanged:
                     continue
                 old_n = old[1] if old is not None else 0
+                payload, skipped = fetch(client, key, version)
                 rows = rows_from_payload(
-                    fetch(client, key, version),
-                    format,
-                    with_metadata,
-                    {"path": key},
-                    **kwargs,
+                    payload, format, with_metadata, {"path": key}, **kwargs
                 )
                 for i, row in enumerate(rows):
                     ctx.upsert_keyed((key, i), row)
                 for i in range(len(rows), old_n):
                     ctx.upsert_keyed((key, i), None)
-                known[key] = (version, len(rows))
+                known[key] = (effective_version(version, skipped), len(rows))
                 ctx.set_offset(key, known[key])
                 changed = True
             for key in list(known):

@@ -37,7 +37,8 @@ class _GDriveClient:
 
     def list_objects(self):
         page_token = None
-        self.sizes: dict[str, int] = getattr(self, "sizes", {})
+        sizes: dict[str, int] = {}
+        entries = []
         while True:
             resp = (
                 self.service.files()
@@ -50,49 +51,26 @@ class _GDriveClient:
             )
             for f in resp.get("files", []):
                 if "size" in f:
-                    self.sizes[f["id"]] = int(f["size"])
-                yield f["id"], f.get("md5Checksum") or f.get("modifiedTime")
+                    sizes[f["id"]] = int(f["size"])
+                entries.append((f["id"], f.get("md5Checksum") or f.get("modifiedTime")))
             page_token = resp.get("nextPageToken")
             if not page_token:
-                return
+                # swap per listing: ids of deleted files must not
+                # accumulate (nor serve stale sizes)
+                self.sizes = sizes
+                return entries
 
     def get_object(self, key: str) -> bytes:
-        return self.service.files().get_media(fileId=key).execute()
-
-
-class _SizeLimitedClient:
-    """Skip payloads over ``limit`` bytes (reference gdrive
-    object_size_limit semantics: the oversized object's row carries an
-    empty payload instead of the content). Uses the listing's size
-    metadata when the wrapped client exposes it (no download at all);
-    otherwise downloads and discards."""
-
-    def __init__(self, inner, limit: int):
-        self._inner = inner
-        self._limit = limit
-
-    def list_objects(self):
-        return self._inner.list_objects()
-
-    def get_object(self, key: str) -> bytes:
-        import logging
-
-        size = getattr(self._inner, "sizes", {}).get(key)
-        if size is not None and size > self._limit:
-            logging.info(
-                "gdrive: skipping %s (size %d > limit %d)", key, size, self._limit
-            )
-            return b""
-        payload = self._inner.get_object(key)
-        if len(payload) > self._limit:
-            logging.info(
-                "gdrive: skipping %s (downloaded %d > limit %d)",
-                key,
-                len(payload),
-                self._limit,
-            )
-            return b""
-        return payload
+        try:
+            return self.service.files().get_media(fileId=key).execute()
+        except Exception as e:
+            # Google-native files (Docs/Sheets) have no binary media:
+            # emit an empty payload instead of killing the reader, like
+            # the reference's not-downloadable handling (gdrive
+            # __init__.py STATUS_SYMLINKS_NOT_SUPPORTED)
+            if "ownloadable" in str(e):
+                return b""
+            raise
 
 
 def read(
@@ -111,12 +89,9 @@ def read(
     **kwargs,
 ) -> Table:
     def client_factory():
-        client = _client if _client is not None else _GDriveClient(
-            object_id, service_user_credentials_file
-        )
-        if object_size_limit is not None:
-            client = _SizeLimitedClient(client, object_size_limit)
-        return client
+        if _client is not None:
+            return _client
+        return _GDriveClient(object_id, service_user_credentials_file)
 
     return read_object_store(
         client_factory,
@@ -127,5 +102,6 @@ def read(
         name=f"{name}:{object_id}",
         persistent_id=persistent_id,
         poll_interval_s=float(refresh_interval),
+        object_size_limit=object_size_limit,
         **kwargs,
     )

@@ -244,3 +244,94 @@ def test_gdrive_object_size_limit_skips_payload():
     )
     rows = sorted(_collect(t), key=lambda r: len(r["data"]))
     assert [len(r["data"]) for r in rows] == [0, 4]  # big skipped, small kept
+
+
+def test_size_limit_cache_and_offset_interactions(tmp_path, monkeypatch):
+    """The review-flagged failure modes: a cached full payload must not
+    bypass a later limit; a skipped object must re-download when the
+    limit is raised (the skip is recorded per-limit in offsets)."""
+    monkeypatch.setenv("PATHWAY_TPU_FS_ONESHOT", "1")
+
+    class Drive:
+        sizes = {}
+
+        def __init__(self):
+            self.gets = 0
+
+        def list_objects(self):
+            return [("doc", "v1")]
+
+        def get_object(self, key):
+            self.gets += 1
+            return b"x" * 200
+
+    cache_dir = str(tmp_path / "cache")
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+
+    def run_once(limit, client):
+        t = pw.io.gdrive.read(
+            "folder",
+            mode="streaming",
+            format="binary",
+            object_size_limit=limit,
+            object_cache=cache_dir,
+            persistent_id="gd",
+            _client=client,
+        )
+        rows = []
+        pw.io.subscribe(
+            t,
+            on_change=lambda key, row, time, is_addition: rows.append(
+                (len(row["data"]), is_addition)
+            ),
+        )
+        pw.run(
+            monitoring_level="none",
+            persistence_config=pw.persistence.Config.simple_config(backend),
+        )
+        pw.clear_graph()
+        return rows
+
+    # 1. no limit: full payload served and cached
+    c1 = Drive()
+    assert run_once(None, c1) == [(200, True)]
+    assert c1.gets == 1
+
+    # 2. limit added: the cached 200-byte payload must NOT be served;
+    #    the row revises to empty
+    c2 = Drive()
+    rows2 = run_once(100, c2)
+    assert (200, False) in rows2 and (0, True) in rows2
+    assert c2.gets == 0, "cache hit should have avoided the download"
+
+    # 3. same limit again: nothing re-delivers
+    assert run_once(100, Drive()) == []
+
+    # 4. limit raised past the size: full content comes back (from cache)
+    rows4 = run_once(1000, Drive())
+    assert (0, False) in rows4 and (200, True) in rows4
+
+
+def test_size_limit_metadata_skip_avoids_download(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_FS_ONESHOT", "1")
+
+    class Drive:
+        sizes = {"big": 500}
+
+        def __init__(self):
+            self.gets = 0
+
+        def list_objects(self):
+            return [("big", "v1")]
+
+        def get_object(self, key):
+            self.gets += 1
+            return b"x" * 500
+
+    c = Drive()
+    t = pw.io.gdrive.read(
+        "folder", mode="static", format="binary", object_size_limit=100, _client=c
+    )
+    rows = _collect(t)
+    assert [len(r["data"]) for r in rows] == [0]
+    assert c.gets == 0, "listing size metadata should skip the download"
