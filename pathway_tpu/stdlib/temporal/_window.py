@@ -32,9 +32,18 @@ class _SlidingWindow(Window):
 
     def assign(self, t):
         """All (start, end) windows containing t."""
+        import datetime
+
         origin = self.origin
         if origin is None:
-            origin = t * 0  # zero of the right type (int/float); datetimes need origin
+            if isinstance(t, datetime.datetime):
+                # a fixed epoch: datetime windows align to midnight
+                # 1970-01-01 in the value's own timezone (reference
+                # windows accept datetime time columns with timedelta
+                # durations and no explicit origin)
+                origin = datetime.datetime(1970, 1, 1, tzinfo=t.tzinfo)
+            else:
+                origin = t * 0  # zero of the right type (int/float)
         out = []
         # first window whose end > t: start > t - duration
         import math

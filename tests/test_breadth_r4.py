@@ -359,3 +359,45 @@ def test_window_join_streamed_revision():
             ((1, 7), 6, 1),
         ],
     )
+
+
+def test_datetime_tumbling_and_sliding_windows():
+    """Windows over DATE_TIME columns with timedelta durations and no
+    explicit origin (reference windowby datetime support)."""
+    import datetime
+
+    rows = [
+        (datetime.datetime(2024, 5, 1, 12, 0), 1),
+        (datetime.datetime(2024, 5, 1, 12, 7), 2),
+        (datetime.datetime(2024, 5, 1, 12, 20), 5),
+    ]
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(ts=pw.DATE_TIME_NAIVE, v=int), rows=rows
+    )
+    w = t.windowby(
+        pw.this.ts,
+        window=pw.temporal.tumbling(duration=datetime.timedelta(minutes=10)),
+    ).reduce(
+        start=pw.this._pw_window_start, s=pw.reducers.sum(pw.this.v)
+    )
+    state = run_table(w)
+    got = sorted((row[0].minute, row[1]) for row in state.values())
+    assert got == [(0, 3), (20, 5)]
+    pw.clear_graph()
+
+    t2 = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(ts=pw.DATE_TIME_NAIVE, v=int), rows=rows
+    )
+    w2 = t2.windowby(
+        pw.this.ts,
+        window=pw.temporal.sliding(
+            hop=datetime.timedelta(minutes=10),
+            duration=datetime.timedelta(minutes=20),
+        ),
+    ).reduce(start=pw.this._pw_window_start, s=pw.reducers.sum(pw.this.v))
+    state2 = run_table(w2)
+    by_start = {row[0].minute: row[1] for row in state2.values()}
+    # window [11:50,12:10) holds v=1,2; [12:00,12:20) holds 1,2;
+    # [12:10,12:30) holds 5; [12:20,12:40) holds 5
+    assert by_start[50] == 3 and by_start[0] == 3
+    assert by_start[10] == 5 and by_start[20] == 5
