@@ -401,3 +401,36 @@ def test_datetime_tumbling_and_sliding_windows():
     # [12:10,12:30) holds 5; [12:20,12:40) holds 5
     assert by_start[50] == 3 and by_start[0] == 3
     assert by_start[10] == 5 and by_start[20] == 5
+
+
+def test_datetime_session_window_and_interval_join():
+    import datetime
+
+    D = datetime.datetime
+    rows = [(D(2024, 5, 1, 12, 0), 1), (D(2024, 5, 1, 12, 2), 2), (D(2024, 5, 1, 13, 0), 5)]
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(ts=pw.DATE_TIME_NAIVE, v=int), rows=rows
+    )
+    w = t.windowby(
+        pw.this.ts,
+        window=pw.temporal.session(max_gap=datetime.timedelta(minutes=10)),
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    assert sorted(v[0] for v in run_table(w).values()) == [3, 5]
+    pw.clear_graph()
+
+    left = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(ts=pw.DATE_TIME_NAIVE, v=int), rows=rows[:2]
+    )
+    right = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(ts=pw.DATE_TIME_NAIVE, w=int),
+        rows=[(D(2024, 5, 1, 12, 1), 7)],
+    )
+    res = left.interval_join(
+        right,
+        pw.left.ts,
+        pw.right.ts,
+        pw.temporal.interval(
+            datetime.timedelta(minutes=-5), datetime.timedelta(minutes=5)
+        ),
+    ).select(v=pw.left.v, w=pw.right.w)
+    assert sorted(run_table(res).values()) == [(1, 7), (2, 7)]
