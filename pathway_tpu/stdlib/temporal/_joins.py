@@ -219,8 +219,11 @@ def window_join(
     window: Window,
     *on: ColumnExpression,
     how: str = "inner",
+    behavior=None,
 ) -> _TemporalJoinResult:
-    """Join rows landing in the same window (reference _window_join.py)."""
+    """Join rows landing in the same window (reference _window_join.py).
+    ``behavior``: common behavior applied to both sides, thresholds
+    relative to each side's event time."""
     import pathway_tpu as pw
     from ...internals import dtype as dt
 
@@ -229,11 +232,16 @@ def window_join(
     def assign(t):
         return window.assign(t)
 
-    l = self.with_columns(
-        _pw_wins=pw.apply_with_type(assign, dt.ANY_TUPLE, _resolve(self, self_time))
+    l = self.with_columns(_pw_t=_resolve(self, self_time))
+    r = other.with_columns(_pw_t=_resolve(other, other_time))
+    if behavior is not None:
+        l = _apply_side_behavior(l, behavior)
+        r = _apply_side_behavior(r, behavior)
+    l = l.with_columns(
+        _pw_wins=pw.apply_with_type(assign, dt.ANY_TUPLE, pw.this._pw_t)
     ).flatten(pw.this._pw_wins)
-    r = other.with_columns(
-        _pw_wins=pw.apply_with_type(assign, dt.ANY_TUPLE, _resolve(other, other_time))
+    r = r.with_columns(
+        _pw_wins=pw.apply_with_type(assign, dt.ANY_TUPLE, pw.this._pw_t)
     ).flatten(pw.this._pw_wins)
     conds = [l._pw_wins == r._pw_wins] + [_remap_on(c, l, r, self, other) for c in on]
     jr = l.join(r, *conds, how=how)
@@ -320,6 +328,7 @@ def asof_join(
     how: str = "inner",
     direction: Direction = Direction.BACKWARD,
     defaults: dict | None = None,
+    behavior=None,
 ) -> _AsofJoinResult:
     """For each left row, match the closest right row by time (reference
     _asof_join.py). BACKWARD: latest right with t_r <= t_l."""
@@ -327,6 +336,9 @@ def asof_join(
 
     l = self.with_columns(_pw_t=_resolve(self, self_time), _pw_lkey=pw.this.id)
     r = other.with_columns(_pw_t=_resolve(other, other_time), _pw_rkey=pw.this.id)
+    if behavior is not None:
+        l = _apply_side_behavior(l, behavior)
+        r = _apply_side_behavior(r, behavior)
     conds = [_remap_on(c, l, r, self, other) for c in on]
     if not conds:
         l = l.with_columns(_pw_one=1)

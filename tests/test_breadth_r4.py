@@ -434,3 +434,59 @@ def test_datetime_session_window_and_interval_join():
         ),
     ).select(v=pw.left.v, w=pw.right.w)
     assert sorted(run_table(res).values()) == [(1, 7), (2, 7)]
+
+
+def test_asof_join_with_cutoff_behavior():
+    """asof_join behavior: late left rows past the cutoff never match
+    (reference _asof_join.py:437 behavior application)."""
+    left = T(
+        """
+          | t | v | __time__ | __diff__
+        1 | 1 | 1 | 2        | 1
+        2 | 9 | 2 | 4        | 1
+        3 | 2 | 3 | 8        | 1
+        """
+    )
+    right = T(
+        """
+          | t | w  | __time__ | __diff__
+        1 | 0 | 10 | 2        | 1
+        """
+    )
+    res = left.asof_join(
+        right,
+        pw.left.t,
+        pw.right.t,
+        behavior=pw.temporal.common_behavior(cutoff=2),
+    ).select(v=pw.left.v, w=pw.right.w)
+    got = sorted(v for v in run_table(res).values())
+    # the late (t=2, v=3) row arrived when the watermark (9) was past
+    # t + cutoff -> dropped from the join
+    assert got == [(1, 10), (2, 10)], got
+
+
+def test_window_join_with_cutoff_behavior():
+    left = T(
+        """
+          | t | v | __time__ | __diff__
+        1 | 1 | 1 | 2        | 1
+        2 | 9 | 2 | 4        | 1
+        3 | 1 | 3 | 8        | 1
+        """
+    )
+    right = T(
+        """
+          | t | w  | __time__ | __diff__
+        1 | 2 | 10 | 2        | 1
+        2 | 9 | 90 | 2        | 1
+        """
+    )
+    res = left.window_join(
+        right,
+        pw.left.t,
+        pw.right.t,
+        pw.temporal.tumbling(duration=4),
+        behavior=pw.temporal.common_behavior(cutoff=2),
+    ).select(v=pw.left.v, w=pw.right.w)
+    got = sorted(v for v in run_table(res).values())
+    assert got == [(1, 10), (2, 90)], got
