@@ -159,6 +159,34 @@ def _apply_side_behavior(t: Table, behavior):
     return _Table(cols, t._universe.subset(), op, name=f"{t._name}.join_behavior")
 
 
+def _apply_window_side_behavior(t: Table, behavior):
+    """Behavior for a window-join side AFTER window assignment: delay
+    holds a (row, window) pair until watermark >= window_start + delay;
+    cutoff drops/freezes it once watermark >= window_end + cutoff."""
+    import pathway_tpu as pw
+    from ...internals import dtype as dt
+    from ...internals.table import Column, LogicalOp, Table as _Table
+    from .temporal_behavior import CommonBehavior
+
+    if not isinstance(behavior, CommonBehavior):
+        raise NotImplementedError(
+            "window_join supports common_behavior(delay=, cutoff=)"
+        )
+    start = pw.apply_with_type(lambda w: w[0], dt.ANY, t._pw_wins)
+    end = pw.apply_with_type(lambda w: w[1], dt.ANY, t._pw_wins)
+    params: dict = {"time_expr": t._pw_t}
+    if behavior.delay is not None:
+        params["delay_threshold"] = start + behavior.delay
+    if behavior.cutoff is not None:
+        key = "freeze_threshold" if behavior.keep_results else "cutoff_threshold"
+        params[key] = end + behavior.cutoff
+    if len(params) == 1:
+        return t
+    cols = {n: Column(c.dtype) for n, c in t._columns.items()}
+    op = LogicalOp("temporal_behavior", [t], params)
+    return _Table(cols, t._universe.subset(), op, name=f"{t._name}.winjoin_behavior")
+
+
 def interval_join(
     self: Table,
     other: Table,
@@ -232,17 +260,22 @@ def window_join(
     def assign(t):
         return window.assign(t)
 
-    l = self.with_columns(_pw_t=_resolve(self, self_time))
-    r = other.with_columns(_pw_t=_resolve(other, other_time))
-    if behavior is not None:
-        l = _apply_side_behavior(l, behavior)
-        r = _apply_side_behavior(r, behavior)
+    l = _prep_side(self, self_time, on)
+    r = _prep_side(other, other_time, on)
     l = l.with_columns(
         _pw_wins=pw.apply_with_type(assign, dt.ANY_TUPLE, pw.this._pw_t)
     ).flatten(pw.this._pw_wins)
     r = r.with_columns(
         _pw_wins=pw.apply_with_type(assign, dt.ANY_TUPLE, pw.this._pw_t)
     ).flatten(pw.this._pw_wins)
+    if behavior is not None:
+        # per-WINDOW thresholds, applied after window assignment: a row
+        # is late for a window only once the watermark passes that
+        # window's end + cutoff (CommonBehavior's documented contract;
+        # one row belongs to several sliding windows, so a per-row
+        # pre-filter could not express this)
+        l = _apply_window_side_behavior(l, behavior)
+        r = _apply_window_side_behavior(r, behavior)
     conds = [l._pw_wins == r._pw_wins] + [_remap_on(c, l, r, self, other) for c in on]
     jr = l.join(r, *conds, how=how)
     return _TemporalJoinResult(jr, None, lmap=l, rmap=r, lorig=self, rorig=other)
@@ -267,16 +300,24 @@ def window_join_outer(self, other, self_time, other_time, window, *on, **kw):
 class _AsofJoinResult:
     """select()-able asof join result (reference _asof_join.py)."""
 
-    def __init__(self, left: Table, right: Table, pairs: Table, how: str):
+    def __init__(
+        self, left: Table, right: Table, pairs: Table, how: str, lorig: Table | None = None
+    ):
+        # ``left`` is the PREPPED side (shares pairs' universe — with a
+        # behavior, rows past the cutoff are already excluded from it);
+        # ``lorig`` is the user's table, whose refs remap onto ``left``
         self._left = left
         self._right = right
         self._pairs = pairs  # keyed by left id: columns _pw_rkey
         self._how = how
+        self._lorig = lorig if lorig is not None else left
 
     def select(self, *args, **kwargs) -> Table:
         import pathway_tpu as pw
 
         left, right, pairs = self._left, self._right, self._pairs
+
+        lorig = self._lorig
 
         def map_expr(e):
             def map_table(t):
@@ -293,7 +334,7 @@ class _AsofJoinResult:
                         if x._name == "id":
                             return pairs._pw_rkey
                         return IxExpression(right, pairs._pw_rkey, x._name, True)
-                    if t is left_cls or isinstance(t, ThisMetaclass):
+                    if t is left_cls or isinstance(t, ThisMetaclass) or t is lorig:
                         return ColumnReference(left, x._name) if x._name != "id" else left.id
                 return None
 
@@ -373,7 +414,7 @@ def asof_join(
     pairs = l.select(
         _pw_rkey=chosen.ix(pw.this.id, optional=True)._pw_rkey,
     )
-    return _AsofJoinResult(self, other, pairs, how)
+    return _AsofJoinResult(l, other, pairs, how, lorig=self)
 
 
 def asof_join_left(self, other, self_time, other_time, *on, **kw):

@@ -490,3 +490,55 @@ def test_window_join_with_cutoff_behavior():
     ).select(v=pw.left.v, w=pw.right.w)
     got = sorted(v for v in run_table(res).values())
     assert got == [(1, 10), (2, 90)], got
+
+
+def test_asof_join_behavior_consistent_without_right_columns():
+    """Review regression: a behavior-dropped left row must vanish from
+    the result regardless of which columns the select touches."""
+    left = T(
+        """
+          | t | v | __time__ | __diff__
+        1 | 1 | 1 | 2        | 1
+        2 | 9 | 2 | 4        | 1
+        3 | 2 | 3 | 8        | 1
+        """
+    )
+    right = T(
+        """
+          | t | w  | __time__ | __diff__
+        1 | 0 | 10 | 2        | 1
+        """
+    )
+    j = left.asof_join(
+        right, pw.left.t, pw.right.t, behavior=pw.temporal.common_behavior(cutoff=2)
+    )
+    left_only = sorted(v[0] for v in run_table(j.select(v=pw.left.v)).values())
+    assert left_only == [1, 2], left_only
+
+
+def test_window_join_cutoff_is_per_window_not_per_row():
+    """Review regression: a row still inside its window's allowed
+    lateness (watermark < window_end + cutoff) must join, even when its
+    own event time is far behind the watermark."""
+    left = T(
+        """
+          | t | v | __time__ | __diff__
+        1 | 5 | 1 | 2        | 1
+        2 | 0 | 2 | 4        | 1
+        """
+    )
+    right = T(
+        """
+          | t | w  | __time__ | __diff__
+        1 | 1 | 10 | 2        | 1
+        2 | 5 | 50 | 2        | 1
+        """
+    )
+    res = left.window_join(
+        right,
+        pw.left.t,
+        pw.right.t,
+        pw.temporal.tumbling(duration=4),
+        behavior=pw.temporal.common_behavior(cutoff=2),
+    ).select(v=pw.left.v, w=pw.right.w)
+    assert sorted(run_table(res).values()) == [(1, 50), (2, 10)]
