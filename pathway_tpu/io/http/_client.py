@@ -3,12 +3,15 @@
 from __future__ import annotations
 
 import json
+import logging
 import time
 
 from ...internals import dtype as dt
 from ...internals.schema import Schema, schema_builder, ColumnDefinition
 from ...internals.table import Table
 from .._connector import StreamingContext, input_table_from_reader, add_output_sink
+
+logger = logging.getLogger(__name__)
 
 
 def read(
@@ -20,21 +23,45 @@ def read(
     mode: str = "streaming",
     autocommit_duration_ms: int | None = 1500,
     name: str = "http",
+    max_failed_attempts_in_row: int | None = 8,
+    _session=None,
     **kwargs,
 ) -> Table:
-    """Poll an HTTP endpoint; each returned record becomes a row."""
-    import requests
+    """Poll an HTTP endpoint; each new record becomes a row.
+
+    ``max_failed_attempts_in_row`` bounds consecutive request failures
+    before the connector aborts the run (``None`` = retry forever in
+    streaming mode; static mode always fails on the first error — a
+    one-shot read of a dead endpoint is a configuration error, not
+    something to retry silently). ``_session`` injects a
+    requests-shaped client for tests."""
 
     if schema is None:
         schema = schema_builder({"data": ColumnDefinition(dtype=dt.JSON)}, name="HttpSchema")
 
     def reader(ctx: StreamingContext) -> None:
+        session = _session
+        if session is None:
+            import requests
+
+            session = requests
         seen: set = set()
+        failures = 0
         while True:
             try:
-                resp = requests.get(url, timeout=30)
+                resp = session.get(url, timeout=30)
                 payload = resp.json() if format == "json" else resp.text
-            except Exception:
+                failures = 0
+            except Exception as e:
+                failures += 1
+                if mode == "static" or (
+                    max_failed_attempts_in_row is not None
+                    and failures >= max_failed_attempts_in_row
+                ):
+                    raise
+                logger.error(
+                    "http.read %s failed (%s); retrying in %ss", url, e, poll_interval_s
+                )
                 time.sleep(poll_interval_s)
                 continue
             records = payload if isinstance(payload, list) else [payload]
@@ -60,20 +87,45 @@ def read(
     )
 
 
-def write(table: Table, url: str, *, method: str = "POST", name: str = "http.write", **kwargs) -> None:
-    import requests
-
+def write(
+    table: Table,
+    url: str,
+    *,
+    method: str = "POST",
+    name: str = "http.write",
+    n_retries: int = 0,
+    retry_delay_s: float = 1.0,
+    _session=None,
+    **kwargs,
+) -> None:
+    """POST each change of ``table`` to ``url`` as JSON (payload carries
+    the row columns plus time/diff). Failures raise after ``n_retries``
+    — a dead sink must fail the run, not drop deliveries silently."""
     names = table.column_names()
 
     def on_change(key, row, time_, diff):
+        session = _session
+        if session is None:
+            import requests
+
+            session = requests
         from ..fs import _jsonable
 
         payload = {n: _jsonable(row[n]) for n in names}
         payload["time"] = time_
         payload["diff"] = diff
-        try:
-            requests.request(method, url, json=payload, timeout=30)
-        except Exception:
-            pass
+        attempt = 0
+        while True:
+            try:
+                resp = session.request(method, url, json=payload, timeout=30)
+                status = getattr(resp, "status_code", 200)
+                if status >= 400:
+                    raise RuntimeError(f"http.write {url} answered {status}")
+                return
+            except Exception:
+                attempt += 1
+                if attempt > n_retries:
+                    raise
+                time.sleep(retry_delay_s)
 
     add_output_sink(table, on_change, name=name)

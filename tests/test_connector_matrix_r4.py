@@ -335,3 +335,87 @@ def test_size_limit_metadata_skip_avoids_download(monkeypatch):
     rows = _collect(t)
     assert [len(r["data"]) for r in rows] == [0]
     assert c.gets == 0, "listing size metadata should skip the download"
+
+
+# ----------------------------------------------------------- http client
+
+
+class FakeHttp:
+    """requests-shaped double."""
+
+    def __init__(self, payloads=None, fail=False, status=200):
+        self.payloads = list(payloads or [])
+        self.fail = fail
+        self.status = status
+        self.sent = []
+
+    def get(self, url, timeout=None):
+        if self.fail:
+            raise ConnectionError("endpoint down")
+
+        class R:
+            def __init__(self, p):
+                self._p = p
+
+            def json(self):
+                return self._p
+
+            @property
+            def text(self):
+                return json.dumps(self._p)
+
+        return R(self.payloads.pop(0) if self.payloads else [])
+
+    def request(self, method, url, json=None, timeout=None):
+        if self.fail:
+            raise ConnectionError("endpoint down")
+        self.sent.append((method, json))
+
+        class R:
+            status_code = self.status
+
+        return R()
+
+
+def test_http_read_static(tmp_path):
+    class S(pw.Schema):
+        id: int
+        word: str
+
+    t = pw.io.http.read(
+        "http://x/feed",
+        schema=S,
+        mode="static",
+        _session=FakeHttp([[{"id": 1, "word": "a"}, {"id": 2, "word": "b"}]]),
+    )
+    rows = sorted((r["id"], r["word"]) for r in _collect(t))
+    assert rows == [(1, "a"), (2, "b")]
+
+
+def test_http_read_static_dead_endpoint_fails():
+    class S(pw.Schema):
+        id: int
+
+    t = pw.io.http.read(
+        "http://x/feed", schema=S, mode="static", _session=FakeHttp(fail=True)
+    )
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: None)
+    with pytest.raises(EngineError, match="failed"):
+        pw.run(monitoring_level="none")
+    pw.clear_graph()
+
+
+def test_http_write_posts_changes_and_fails_on_error_status():
+    session = FakeHttp()
+    t = pw.debug.table_from_rows(schema=pw.schema_from_types(a=int), rows=[(7,)])
+    pw.io.http.write(t, "http://x/sink", _session=session)
+    pw.run(monitoring_level="none")
+    pw.clear_graph()
+    assert session.sent and session.sent[0][1]["a"] == 7
+
+    bad = FakeHttp(status=500)
+    t2 = pw.debug.table_from_rows(schema=pw.schema_from_types(a=int), rows=[(7,)])
+    pw.io.http.write(t2, "http://x/sink", _session=bad)
+    with pytest.raises(Exception):
+        pw.run(monitoring_level="none")
+    pw.clear_graph()
