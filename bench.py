@@ -486,8 +486,8 @@ for warm_n in (2048, 16):
 class DocSource(pw.io.python.ConnectorSubject):
     def run(self):
         for lo in range(0, N, BATCH):
-            for i in range(lo, min(lo + BATCH, N)):
-                self.next(doc_id=i, toks=doc_tok_lists[i])
+            hi = min(lo + BATCH, N)
+            self.next_batch(doc_id=list(range(lo, hi)), toks=doc_tok_lists[lo:hi])
             self.commit()
 
 class DocSchema(pw.Schema):
@@ -588,12 +588,14 @@ def suite_streaming_tpu_chip() -> None:
             list(range(n_w)), emb.encode_device(texts[:n_w], pad_to=pad)
         )
     warm_idx.search_batch(np.zeros((16, emb.get_embedding_dimension()), np.float32), 3)
+    warm_idx.attach_encoder(emb._encoder)
+    warm_idx.search_texts_batch(["warm query"] * 16, 3)
 
     class DocSource(pw.io.python.ConnectorSubject):
         def run(self):
             for lo in range(0, N, BATCH):
-                for i in range(lo, min(lo + BATCH, N)):
-                    self.next(doc_id=i, text=texts[i])
+                hi = min(lo + BATCH, N)
+                self.next_batch(doc_id=list(range(lo, hi)), text=texts[lo:hi])
                 self.commit()
 
     class DocSchema(pw.Schema):

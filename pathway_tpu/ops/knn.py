@@ -637,6 +637,12 @@ class DeviceKnnIndex:
             return self.search_batch(np.asarray(enc.encode(texts)), k, filter_fns)
         ids_mat, lens = m
         self._sync()
+        # cache the fused program on the ENCODER (shared across index
+        # instances): a warm-up index using the same embedder warms the
+        # engine's index too — per-instance caches cold-compiled the
+        # fused query mid-run (~3-4s on tunneled chips)
+        if self._fused_jit is None:
+            self._fused_jit = getattr(enc, "_pw_fused_query_jit", None)
         if self._fused_jit is None:
             import jax
             import jax.numpy as jnp
@@ -656,6 +662,7 @@ class DeviceKnnIndex:
                 return jax.lax.top_k(scores, k)
 
             self._fused_jit = fused
+            enc._pw_fused_query_jit = fused
 
         from ..models.batching import DEFAULT_SEQ_BUCKETS, bucket
 

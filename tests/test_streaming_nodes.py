@@ -495,3 +495,53 @@ def test_batch_apply_error_routes_per_row():
     pw.clear_graph()
     vals = [v[0] for v in cap.state.values()]
     assert all(isinstance(v, Error) for v in vals) and len(vals) == 2
+
+
+def test_next_batch_columnar_emit_matches_per_row():
+    """ConnectorSubject.next_batch: same rows, keys, and recovery seq as
+    per-row next()."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    class Batchy(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next_batch(w=["a", "b"], n=[1, 2])
+            self.commit()
+            self.next(w="c", n=3)  # mixing APIs keeps the seq consistent
+            self.commit()
+
+    class S(pw.Schema):
+        w: str
+        n: int
+
+    t = pw.io.python.read(Batchy(), schema=S)
+    rows = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: rows.append((row["w"], row["n"]))
+    )
+    pw.run(monitoring_level="none")
+    pw.clear_graph()
+    assert sorted(rows) == [("a", 1), ("b", 2), ("c", 3)]
+
+
+def test_next_batch_coerces_and_validates():
+    import pytest
+
+    import pathway_tpu as pw
+
+    class Bad(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next_batch(w=["a"], n=[1, 2])  # mismatched lengths
+            self.commit()
+
+    class S(pw.Schema):
+        w: str
+        n: int
+
+    t = pw.io.python.read(Bad(), schema=S)
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: None)
+    from pathway_tpu.engine.dataflow import EngineError
+
+    with pytest.raises(EngineError, match="failed"):
+        pw.run(monitoring_level="none")
+    pw.clear_graph()
