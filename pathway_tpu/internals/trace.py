@@ -1,22 +1,33 @@
-"""Build-time user trace frames.
+"""Build-time call-site capture.
 
-Rebuild of /root/reference/python/pathway/internals/trace.py: when the
-user builds an operator (``t.select(...)``, ``pw.io.kafka.read(...)``),
-the call site in THEIR code is captured; build errors re-raise with an
-"Occurred here" note pointing at that line, and runtime row errors
-carry it into the error-log tables — so a failing UDF names the user's
-source line, not an engine internal.
+When user code builds an operator (``t.select(...)``,
+``pw.io.kafka.read(...)``) we remember the line in *their* file that
+made the call.  Build errors re-raise annotated with that line, and
+runtime row errors carry it into the error-log tables, so a failing UDF
+names the user's source line rather than an engine internal.
+
+Parity surface: reference ``python/pathway/internals/trace.py``
+(Frame/Trace/trace_user_frame).  The mechanism here is this repo's own:
+public API entry points are wrapped in a shim whose code object acts as
+a stack sentinel, and the user frame is found by walking the *live*
+frame chain outward past the outermost shim to the first frame that
+lives outside the package.
 """
 
 from __future__ import annotations
 
 import functools
+import linecache
 import os
-import traceback
+import sys
 from dataclasses import dataclass
 from typing import Any, Callable
 
 _PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Exceptions already annotated carry the frame under this attribute, so a
+# re-raise through an outer decorated API call never annotates twice.
+_ORIGIN_ATTR = "_ptpu_call_site"
 
 
 @dataclass(frozen=True)
@@ -27,15 +38,12 @@ class Frame:
     function: str
 
     def is_external(self) -> bool:
-        """A frame outside the pathway_tpu package (and not a decorator
-        shim) — i.e. the user's code."""
+        """True for frames outside the pathway_tpu package (and not a
+        decorator shim) — i.e. the user's own code."""
         path = os.path.abspath(self.filename)
         if path.startswith(_PACKAGE_DIR + os.sep):
             return False
         return "@beartype" not in self.filename
-
-    def is_marker(self) -> bool:
-        return self.function == "_pathway_trace_marker"
 
     def as_dict(self) -> dict:
         return {
@@ -46,75 +54,88 @@ class Frame:
         }
 
 
+def _snapshot(frame) -> Frame:
+    """Materialize a live frame into a Frame record."""
+    code = frame.f_code
+    lineno = frame.f_lineno
+    text = linecache.getline(code.co_filename, lineno).rstrip("\n") or None
+    return Frame(
+        filename=code.co_filename,
+        line_number=lineno,
+        line=text,
+        function=code.co_name,
+    )
+
+
+def _locate_call_site(depth: int) -> Frame | None:
+    """Walk the live stack outward from ``depth`` callers up.
+
+    Returns the innermost user-code frame that sits *outside* the
+    outermost API shim: any candidate found below a shim is inside the
+    package's own plumbing and gets discarded when the shim is passed.
+    """
+    try:
+        frame = sys._getframe(depth + 1)
+    except ValueError:  # pragma: no cover - stack shallower than depth
+        return None
+    found: Frame | None = None
+    while frame is not None:
+        if frame.f_code is _SHIM_CODE:
+            found = None
+        elif found is None:
+            snap_path = os.path.abspath(frame.f_code.co_filename)
+            if not snap_path.startswith(_PACKAGE_DIR + os.sep):
+                if "@beartype" not in frame.f_code.co_filename:
+                    found = _snapshot(frame)
+        frame = frame.f_back
+    return found
+
+
 @dataclass(frozen=True)
 class Trace:
-    frames: list[Frame]
     user_frame: Frame | None
 
     @staticmethod
     def from_traceback() -> "Trace":
-        frames = [
-            Frame(
-                filename=e.filename,
-                line_number=e.lineno,
-                line=e.line,
-                function=e.name,
-            )
-            for e in traceback.extract_stack()[:-1]
-        ]
-        user_frame: Frame | None = None
-        for frame in frames:
-            if frame.is_marker():
-                break
-            if frame.is_external():
-                user_frame = frame
-        return Trace(frames=frames, user_frame=user_frame)
+        return Trace(user_frame=_locate_call_site(1))
 
 
 def user_frame() -> Frame | None:
-    """The innermost user-code frame of the current stack (the call site
-    that is building the operator)."""
-    return Trace.from_traceback().user_frame
+    """The user-code frame currently building an operator, if any."""
+    return _locate_call_site(1)
 
 
 def _format_frame(frame: Frame) -> str:
+    src = (frame.line or "").strip()
     return (
-        "Occurred here:\n"
-        f"    Line: {frame.line}\n"
-        f"    File: {frame.filename}:{frame.line_number}"
+        f"Occurred here: {frame.filename}:{frame.line_number},"
+        f" in {frame.function}\n    {src}"
     )
 
 
-def add_pathway_trace_note(e: BaseException, frame: Frame) -> None:
-    note = _format_frame(frame)
-    e._pathway_trace_note = note  # type: ignore[attr-defined]
-    e.add_note(note)
-
-
-def _reraise_with_user_frame(e: Exception, trace: Trace | None = None) -> None:
-    tb = e.__traceback__
-    if tb is not None:
-        tb = tb.tb_next
-    e = e.with_traceback(tb)
-    if hasattr(e, "_pathway_trace_note"):
-        raise e
-    if trace is None:
-        trace = Trace.from_traceback()
-    if trace.user_frame is not None:
-        add_pathway_trace_note(e, trace.user_frame)
-    raise e
+def _attach_call_site(exc: BaseException, frame: Frame) -> None:
+    setattr(exc, _ORIGIN_ATTR, frame)
+    exc.add_note(_format_frame(frame))
 
 
 def trace_user_frame(func: Callable) -> Callable:
-    """Decorator: exceptions raised while building an operator re-raise
-    annotated with the user's call site (reference trace.py
-    trace_user_frame)."""
+    """Decorate a public API entry point so exceptions raised while
+    building an operator re-raise annotated with the user's call site."""
 
     @functools.wraps(func)
-    def _pathway_trace_marker(*args: Any, **kwargs: Any):
+    def _api_shim(*args: Any, **kwargs: Any):
         try:
             return func(*args, **kwargs)
-        except Exception as e:
-            _reraise_with_user_frame(e)
+        except Exception as exc:
+            if getattr(exc, _ORIGIN_ATTR, None) is None:
+                site = _locate_call_site(1)
+                if site is not None:
+                    _attach_call_site(exc, site)
+            raise
 
-    return _pathway_trace_marker
+    return _api_shim
+
+
+# Every _api_shim closure shares one compiled code object; that object is
+# the sentinel _locate_call_site scans for.
+_SHIM_CODE = trace_user_frame(lambda: None).__code__
