@@ -5,13 +5,17 @@ PathwayWebserver :329, RestServerSubject :490, rest_connector :624 with
 the response_writer that resolves per-key asyncio events :778-804)."""
 
 from ._docs import EndpointDocumentation, EndpointExamples
+from ._retry import DEFAULT_RETRY_CODES, RequestRunner, RetryPolicy
 from ._server import PathwayWebserver, rest_connector
 from ._client import read, write
 
 __all__ = [
+    "DEFAULT_RETRY_CODES",
     "EndpointDocumentation",
     "EndpointExamples",
     "PathwayWebserver",
+    "RequestRunner",
+    "RetryPolicy",
     "read",
     "rest_connector",
     "write",

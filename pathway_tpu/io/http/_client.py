@@ -1,17 +1,183 @@
-"""HTTP client connectors (reference io/http read/write)."""
+"""HTTP client connectors.
+
+Parity surface: reference ``python/pathway/io/http/__init__.py`` read
+:100-155 / write :158-230, ``_streaming.py`` (HttpStreamingSubject :13 —
+long-lived chunked response split on a delimiter) and ``_common.py``
+(Sender/RetryPolicy).  Two transports:
+
+- ``stream=True`` (or any ``delimiter``/``response_mapper``): one
+  long-lived request; the chunked response body is split on the
+  delimiter and every piece becomes a row.  Mid-stream drops reconnect
+  with :class:`RetryPolicy` backoff while the run is in streaming mode.
+- default: poll the endpoint every ``poll_interval_s`` and emit records
+  not seen in the recent-fingerprint window (bounded LRU — a
+  long-running poll must not grow memory without limit, and records
+  repeated beyond the window are genuinely re-emitted).
+"""
 
 from __future__ import annotations
 
+import copy
 import json
 import logging
 import time
+from collections import OrderedDict
+from typing import Any, Callable
 
 from ...internals import dtype as dt
 from ...internals.schema import Schema, schema_builder, ColumnDefinition
 from ...internals.table import Table
 from .._connector import StreamingContext, input_table_from_reader, add_output_sink
+from ._retry import DEFAULT_RETRY_CODES, RequestRunner, RetryPolicy
 
 logger = logging.getLogger(__name__)
+
+
+def _policy_factory(retry_policy) -> Callable[[], RetryPolicy]:
+    if retry_policy is None:
+        return RetryPolicy.default
+    if callable(retry_policy) and not isinstance(retry_policy, RetryPolicy):
+        return retry_policy
+    # an instance is a prototype: each logical request restarts its schedule
+    return lambda: copy.copy(retry_policy)
+
+
+def split_stream(chunks, delimiter: str | bytes | None):
+    """Re-frame a chunked byte stream into delimiter-separated records.
+
+    ``delimiter=None`` means newline records with optional ``\\r``
+    (the wire format of SSE-ish / JSONL endpoints).  The trailing
+    unterminated piece is flushed when the stream ends.
+    """
+    if delimiter is None:
+        sep, universal = b"\n", True
+    else:
+        sep = delimiter.encode() if isinstance(delimiter, str) else delimiter
+        universal = False
+    buffered = b""
+    for chunk in chunks:
+        if not chunk:
+            continue
+        if isinstance(chunk, str):
+            chunk = chunk.encode()
+        buffered += chunk
+        *complete, buffered = buffered.split(sep)
+        for piece in complete:
+            if universal and piece.endswith(b"\r"):
+                piece = piece[:-1]
+            yield piece
+    if buffered:
+        if universal and buffered.endswith(b"\r"):
+            buffered = buffered[:-1]
+        yield buffered
+
+
+class _RecentWindow:
+    """Bounded LRU of record fingerprints for the polled transport."""
+
+    def __init__(self, capacity: int):
+        self._capacity = capacity
+        self._entries: OrderedDict[str, None] = OrderedDict()
+
+    def check_and_add(self, fingerprint: str) -> bool:
+        """True if the fingerprint was already in the window (refreshes
+        its recency); False if new (and records it)."""
+        if fingerprint in self._entries:
+            self._entries.move_to_end(fingerprint)
+            return True
+        self._entries[fingerprint] = None
+        while len(self._entries) > self._capacity:
+            self._entries.popitem(last=False)
+        return False
+
+
+def stream_records(
+    session: Any,
+    url: str,
+    *,
+    method: str = "GET",
+    headers: dict[str, str] | None = None,
+    payload: Any = None,
+    delimiter: str | bytes | None = None,
+    response_mapper: Callable[[bytes], bytes] | None = None,
+    once: bool = False,
+    runner: RequestRunner | None = None,
+    retry_policy: RetryPolicy | Callable[[], RetryPolicy] | None = None,
+    max_failed_attempts_in_row: int | None = 8,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Yield record payloads from a long-lived streaming endpoint.
+
+    Opens one request with ``stream=True`` and splits the chunked body
+    on ``delimiter``.  A drop (connection error, mid-body exception, or
+    error status) reconnects with backoff — the reconnect schedule
+    restarts whenever data actually arrives, and
+    ``max_failed_attempts_in_row`` consecutive dataless failures give
+    up.  With ``once=True`` the body is consumed exactly one time and
+    any failure raises (static-read semantics)."""
+    policy_factory = _policy_factory(retry_policy)
+    if runner is None:
+        runner = RequestRunner(
+            session, retry_policy_factory=policy_factory, sleep=sleep
+        )
+    reconnect = policy_factory()
+    drops = 0
+    while True:
+        try:
+            resp = runner.send(method, url, headers=headers, data=payload, stream=True)
+            status = getattr(resp, "status_code", 200)
+            if status >= 400:
+                raise RuntimeError(f"http stream {url} answered {status}")
+            for piece in split_stream(resp.iter_content(chunk_size=None), delimiter):
+                if response_mapper is not None:
+                    piece = response_mapper(piece)
+                if not piece:
+                    continue
+                yield piece
+                drops = 0
+                reconnect = policy_factory()
+        except Exception as exc:
+            drops += 1
+            if once or (
+                max_failed_attempts_in_row is not None
+                and drops >= max_failed_attempts_in_row
+            ):
+                raise
+            wait = reconnect.wait_duration_before_retry()
+            logger.error(
+                "http stream %s dropped (%s); reconnecting in %.2fs", url, exc, wait
+            )
+            sleep(wait)
+            continue
+        if once:
+            return
+
+
+def _emit_value(ctx: StreamingContext, value: Any) -> None:
+    """Insert an already-parsed record: dicts become rows, anything else
+    lands in the ``data`` column."""
+    if isinstance(value, dict):
+        ctx.insert(value)
+    else:
+        ctx.insert({"data": value})
+
+
+def _emit_wire(ctx: StreamingContext, piece: bytes | str, format: str) -> bool:
+    """Insert one wire-format record from the streaming transport.
+    In json mode, undecodable pieces (SSE keep-alives, comments) are
+    logged and skipped rather than crashing the stream.  Returns True
+    if a row was produced."""
+    text = piece.decode("utf-8", errors="replace") if isinstance(piece, bytes) else piece
+    if format == "json":
+        try:
+            value = json.loads(text)
+        except ValueError:
+            logger.warning("http stream: skipping non-JSON record %.80r", text)
+            return False
+        _emit_value(ctx, value)
+    else:
+        ctx.insert({"data": text})
+    return True
 
 
 def read(
@@ -19,38 +185,107 @@ def read(
     *,
     schema: type[Schema] | None = None,
     format: str = "json",
-    poll_interval_s: float = 1.0,
     mode: str = "streaming",
+    method: str = "GET",
+    headers: dict[str, str] | None = None,
+    payload: Any = None,
+    # long-lived streaming-response transport
+    stream: bool = False,
+    delimiter: str | bytes | None = None,
+    response_mapper: Callable[[bytes], bytes] | None = None,
+    # polled transport
+    poll_interval_s: float = 1.0,
+    dedupe_window: int = 65536,
+    # resilience
+    n_retries: int = 0,
+    retry_policy: RetryPolicy | Callable[[], RetryPolicy] | None = None,
+    retry_codes: tuple | None = DEFAULT_RETRY_CODES,
+    connect_timeout_ms: int | None = None,
+    request_timeout_ms: int | None = 30_000,
+    allow_redirects: bool = True,
+    max_failed_attempts_in_row: int | None = 8,
     autocommit_duration_ms: int | None = 1500,
     name: str = "http",
-    max_failed_attempts_in_row: int | None = 8,
     _session=None,
+    _sleep: Callable[[float], None] = time.sleep,
     **kwargs,
 ) -> Table:
-    """Poll an HTTP endpoint; each new record becomes a row.
+    """Read an HTTP endpoint into a table.
 
-    ``max_failed_attempts_in_row`` bounds consecutive request failures
-    before the connector aborts the run (``None`` = retry forever in
-    streaming mode; static mode always fails on the first error — a
-    one-shot read of a dead endpoint is a configuration error, not
-    something to retry silently). ``_session`` injects a
-    requests-shaped client for tests."""
+    With ``stream=True`` (implied by ``delimiter`` or
+    ``response_mapper``) a single long-lived request is made and the
+    chunked response is split on ``delimiter`` (newline by default);
+    each piece — optionally rewritten by ``response_mapper(bytes) ->
+    bytes`` — becomes a row.  If the response drops mid-stream the
+    connector reconnects with ``retry_policy`` backoff, up to
+    ``max_failed_attempts_in_row`` consecutive failures (``None`` =
+    reconnect forever); in static mode the stream is consumed once.
 
+    Without ``stream`` the endpoint is polled every ``poll_interval_s``
+    seconds and records are deduplicated against the last
+    ``dedupe_window`` fingerprints (bounded — repeats beyond the window
+    re-emit rather than leaking memory).
+
+    ``n_retries``/``retry_codes`` bound per-request retries inside each
+    attempt.  ``_session`` injects a requests-shaped client and
+    ``_sleep`` a time source for tests.
+    """
     if schema is None:
-        schema = schema_builder({"data": ColumnDefinition(dtype=dt.JSON)}, name="HttpSchema")
+        schema = schema_builder(
+            {"data": ColumnDefinition(dtype=dt.JSON)}, name="HttpSchema"
+        )
+    use_stream = stream or delimiter is not None or response_mapper is not None
 
-    def reader(ctx: StreamingContext) -> None:
-        session = _session
-        if session is None:
-            import requests
+    def _make_runner(session):
+        return RequestRunner(
+            session,
+            n_retries=n_retries,
+            retry_policy_factory=_policy_factory(retry_policy),
+            retry_codes=retry_codes,
+            connect_timeout_ms=connect_timeout_ms,
+            request_timeout_ms=request_timeout_ms,
+            allow_redirects=allow_redirects,
+            sleep=_sleep,
+        )
 
-            session = requests
-        seen: set = set()
+    def _get_session():
+        if _session is not None:
+            return _session
+        import requests
+
+        return requests
+
+    def stream_reader(ctx: StreamingContext) -> None:
+        session = _get_session()
+        for piece in stream_records(
+            session,
+            url,
+            method=method,
+            headers=headers,
+            payload=payload,
+            delimiter=delimiter,
+            response_mapper=response_mapper,
+            once=(mode == "static"),
+            runner=_make_runner(session),
+            retry_policy=retry_policy,
+            max_failed_attempts_in_row=max_failed_attempts_in_row,
+            sleep=_sleep,
+        ):
+            if _emit_wire(ctx, piece, format):
+                ctx.commit()
+
+    def poll_reader(ctx: StreamingContext) -> None:
+        session = _get_session()
+        runner = _make_runner(session)
+        window = _RecentWindow(dedupe_window)
         failures = 0
         while True:
             try:
-                resp = session.get(url, timeout=30)
-                payload = resp.json() if format == "json" else resp.text
+                resp = runner.send(method, url, headers=headers, data=payload)
+                status = getattr(resp, "status_code", 200)
+                if status >= 400:
+                    raise RuntimeError(f"http.read {url} answered {status}")
+                body = resp.json() if format == "json" else resp.text
                 failures = 0
             except Exception as e:
                 failures += 1
@@ -60,30 +295,32 @@ def read(
                 ):
                     raise
                 logger.error(
-                    "http.read %s failed (%s); retrying in %ss", url, e, poll_interval_s
+                    "http.read %s failed (%s); retrying in %ss",
+                    url,
+                    e,
+                    poll_interval_s,
                 )
-                time.sleep(poll_interval_s)
+                _sleep(poll_interval_s)
                 continue
-            records = payload if isinstance(payload, list) else [payload]
+            records = body if isinstance(body, list) else [body]
             changed = False
             for rec in records:
                 fp = json.dumps(rec, sort_keys=True, default=str)
-                if fp in seen:
+                if window.check_and_add(fp):
                     continue
-                seen.add(fp)
-                if isinstance(rec, dict):
-                    ctx.insert(rec)
-                else:
-                    ctx.insert({"data": rec})
+                _emit_value(ctx, rec)
                 changed = True
             if changed:
                 ctx.commit()
             if mode == "static":
                 break
-            time.sleep(poll_interval_s)
+            _sleep(poll_interval_s)
 
     return input_table_from_reader(
-        schema, reader, name=name, autocommit_duration_ms=autocommit_duration_ms
+        schema,
+        stream_reader if use_stream else poll_reader,
+        name=name,
+        autocommit_duration_ms=autocommit_duration_ms,
     )
 
 
@@ -94,14 +331,27 @@ def write(
     method: str = "POST",
     name: str = "http.write",
     n_retries: int = 0,
-    retry_delay_s: float = 1.0,
+    retry_policy: RetryPolicy | Callable[[], RetryPolicy] | None = None,
+    retry_codes: tuple | None = DEFAULT_RETRY_CODES,
+    connect_timeout_ms: int | None = None,
+    request_timeout_ms: int | None = 30_000,
+    allow_redirects: bool = True,
+    headers: dict[str, str] | None = None,
+    retry_delay_s: float | None = None,
     _session=None,
+    _sleep: Callable[[float], None] = time.sleep,
     **kwargs,
 ) -> None:
     """POST each change of ``table`` to ``url`` as JSON (payload carries
-    the row columns plus time/diff). Failures raise after ``n_retries``
-    — a dead sink must fail the run, not drop deliveries silently."""
+    the row columns plus time/diff).  Failures raise after ``n_retries``
+    backoff-scheduled attempts — a dead sink must fail the run, not drop
+    deliveries silently.  ``retry_delay_s`` (legacy) builds a fixed-delay
+    policy."""
     names = table.column_names()
+    if retry_policy is None and retry_delay_s is not None:
+        retry_policy = RetryPolicy(
+            first_delay_ms=int(retry_delay_s * 1000), backoff_factor=1.0, jitter_ms=0
+        )
 
     def on_change(key, row, time_, diff):
         session = _session
@@ -111,21 +361,23 @@ def write(
             session = requests
         from ..fs import _jsonable
 
-        payload = {n: _jsonable(row[n]) for n in names}
-        payload["time"] = time_
-        payload["diff"] = diff
-        attempt = 0
-        while True:
-            try:
-                resp = session.request(method, url, json=payload, timeout=30)
-                status = getattr(resp, "status_code", 200)
-                if status >= 400:
-                    raise RuntimeError(f"http.write {url} answered {status}")
-                return
-            except Exception:
-                attempt += 1
-                if attempt > n_retries:
-                    raise
-                time.sleep(retry_delay_s)
+        body = {n: _jsonable(row[n]) for n in names}
+        body["time"] = time_
+        body["diff"] = diff
+        send_headers = {"Content-Type": "application/json", **(headers or {})}
+        runner = RequestRunner(
+            session,
+            n_retries=n_retries,
+            retry_policy_factory=_policy_factory(retry_policy),
+            retry_codes=retry_codes,
+            connect_timeout_ms=connect_timeout_ms,
+            request_timeout_ms=request_timeout_ms,
+            allow_redirects=allow_redirects,
+            sleep=_sleep,
+        )
+        resp = runner.send(method, url, headers=send_headers, data=json.dumps(body))
+        status = getattr(resp, "status_code", 200)
+        if status >= 400:
+            raise RuntimeError(f"http.write {url} answered {status}")
 
     add_output_sink(table, on_change, name=name)

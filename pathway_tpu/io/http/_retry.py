@@ -1,0 +1,122 @@
+"""Retry scheduling + resilient request execution for the HTTP connectors.
+
+Parity surface: reference ``python/pathway/io/http/_common.py``
+(RetryPolicy :13, Sender :38).  Implementation is this repo's own: the
+request loop distinguishes transport errors from retryable status codes,
+takes an injectable session and sleep function (so tests can drive it
+without real endpoints or real delays), and exposes the attempt history
+for assertions.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable
+
+#: status codes that indicate a transient server-side condition
+DEFAULT_RETRY_CODES: tuple[int, ...] = (429, 500, 502, 503, 504)
+
+
+class RetryPolicy:
+    """Escalating wait schedule: each retry waits ``backoff_factor``
+    times longer than the last, plus a uniform jitter so a fleet of
+    connectors does not reconnect in lockstep."""
+
+    def __init__(
+        self,
+        first_delay_ms: int = 1000,
+        backoff_factor: float = 1.5,
+        jitter_ms: int = 300,
+    ):
+        self._delay_s = first_delay_ms / 1000.0
+        self._factor = backoff_factor
+        self._jitter_s = jitter_ms / 1000.0
+
+    @classmethod
+    def default(cls) -> "RetryPolicy":
+        return cls()
+
+    def wait_duration_before_retry(self) -> float:
+        """Seconds to sleep before the next attempt; advances the schedule."""
+        current = self._delay_s
+        self._delay_s = self._delay_s * self._factor + random.uniform(
+            0.0, self._jitter_s
+        )
+        return current
+
+
+class RequestRunner:
+    """Executes one logical HTTP request with bounded retries.
+
+    A fresh :class:`RetryPolicy` is built per logical request (via
+    ``retry_policy_factory``) so the backoff schedule restarts for every
+    new request rather than escalating forever across the connector's
+    lifetime.
+    """
+
+    def __init__(
+        self,
+        session: Any,
+        *,
+        n_retries: int = 0,
+        retry_policy_factory: Callable[[], RetryPolicy] | None = None,
+        retry_codes: tuple[int, ...] | None = DEFAULT_RETRY_CODES,
+        connect_timeout_ms: int | None = None,
+        request_timeout_ms: int | None = None,
+        allow_redirects: bool = True,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self._session = session
+        self._n_retries = n_retries
+        self._policy_factory = retry_policy_factory or RetryPolicy.default
+        self._retry_codes = tuple(retry_codes or ())
+        self._timeout = (
+            connect_timeout_ms / 1000.0 if connect_timeout_ms else None,
+            request_timeout_ms / 1000.0 if request_timeout_ms else None,
+        )
+        self._allow_redirects = allow_redirects
+        self._sleep = sleep
+        #: (attempt_index, wait_seconds) per backoff taken — for tests/metrics
+        self.backoffs: list[tuple[int, float]] = []
+
+    def send(
+        self,
+        method: str,
+        url: str,
+        *,
+        headers: dict[str, str] | None = None,
+        data: Any = None,
+        stream: bool = False,
+    ):
+        policy = self._policy_factory()
+        last_exc: Exception | None = None
+        response = None
+        for attempt in range(self._n_retries + 1):
+            try:
+                response = self._session.request(
+                    method,
+                    url,
+                    headers=headers,
+                    data=data,
+                    stream=stream,
+                    timeout=self._timeout,
+                    allow_redirects=self._allow_redirects,
+                )
+                last_exc = None
+            except Exception as exc:
+                last_exc = exc
+                response = None
+            if response is not None:
+                status = getattr(response, "status_code", 200)
+                if status < 400 or status not in self._retry_codes:
+                    return response
+            if attempt == self._n_retries:
+                break
+            wait = policy.wait_duration_before_retry()
+            self.backoffs.append((attempt, wait))
+            self._sleep(wait)
+        if last_exc is not None:
+            raise last_exc
+        assert response is not None
+        return response

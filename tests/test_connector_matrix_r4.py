@@ -341,7 +341,8 @@ def test_size_limit_metadata_skip_avoids_download(monkeypatch):
 
 
 class FakeHttp:
-    """requests-shaped double."""
+    """requests-shaped double: request() serves scripted payloads for
+    reads and records bodies for writes."""
 
     def __init__(self, payloads=None, fail=False, status=200):
         self.payloads = list(payloads or [])
@@ -349,32 +350,37 @@ class FakeHttp:
         self.status = status
         self.sent = []
 
-    def get(self, url, timeout=None):
+    def request(
+        self,
+        method,
+        url,
+        data=None,
+        headers=None,
+        stream=False,
+        timeout=None,
+        allow_redirects=True,
+        **kw,
+    ):
         if self.fail:
             raise ConnectionError("endpoint down")
+        if data is not None or kw.get("json") is not None:
+            self.sent.append((method, json.loads(data) if data else kw.get("json")))
+        payload = self.payloads.pop(0) if self.payloads else []
+        status = self.status
+        body_text = json.dumps(payload)
 
         class R:
-            def __init__(self, p):
-                self._p = p
+            status_code = status
+            text = body_text
 
-            def json(self):
-                return self._p
-
-            @property
-            def text(self):
-                return json.dumps(self._p)
-
-        return R(self.payloads.pop(0) if self.payloads else [])
-
-    def request(self, method, url, json=None, timeout=None):
-        if self.fail:
-            raise ConnectionError("endpoint down")
-        self.sent.append((method, json))
-
-        class R:
-            status_code = self.status
+            @staticmethod
+            def json():
+                return payload
 
         return R()
+
+    def get(self, url, **kw):
+        return self.request("GET", url, **kw)
 
 
 def test_http_read_static(tmp_path):

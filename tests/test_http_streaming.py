@@ -1,0 +1,344 @@
+"""Streaming HTTP connector: delimiter-split long-lived responses,
+reconnect-with-backoff, bounded retries, bounded poll dedupe.
+
+Reference behavior surface: io/http/_streaming.py (HttpStreamingSubject),
+_common.py (Sender/RetryPolicy)."""
+
+from __future__ import annotations
+
+import itertools
+import json
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.io.http._client import (
+    _RecentWindow,
+    split_stream,
+    stream_records,
+)
+from pathway_tpu.io.http._retry import RequestRunner, RetryPolicy
+
+
+def _collect(table):
+    rows = []
+    pw.io.subscribe(
+        table, on_change=lambda key, row, time, is_addition: rows.append(row)
+    )
+    pw.run(monitoring_level="none")
+    pw.clear_graph()
+    return rows
+
+
+# ------------------------------------------------------------ split_stream
+
+
+def test_split_stream_reframes_arbitrary_chunk_boundaries():
+    chunks = [b'{"a"', b": 1}\n{", b'"a": 2}\n{"a"', b": 3}"]
+    assert list(split_stream(chunks, None)) == [
+        b'{"a": 1}',
+        b'{"a": 2}',
+        b'{"a": 3}',  # unterminated tail flushed at stream end
+    ]
+
+
+def test_split_stream_custom_delimiter_and_crlf():
+    assert list(split_stream([b"a|b|", b"c"], "|")) == [b"a", b"b", b"c"]
+    # default mode strips \r so CRLF endpoints look like LF ones
+    assert list(split_stream([b"x\r\ny\r\n"], None)) == [b"x", b"y"]
+    # custom delimiter does NOT strip \r
+    assert list(split_stream([b"x\r;y"], ";")) == [b"x\r", b"y"]
+
+
+# ------------------------------------------------------------- RetryPolicy
+
+
+def test_retry_policy_escalates_geometrically():
+    p = RetryPolicy(first_delay_ms=100, backoff_factor=2.0, jitter_ms=0)
+    assert [p.wait_duration_before_retry() for _ in range(3)] == [0.1, 0.2, 0.4]
+
+
+def test_retry_policy_jitter_bounded():
+    p = RetryPolicy(first_delay_ms=100, backoff_factor=1.0, jitter_ms=50)
+    waits = [p.wait_duration_before_retry() for _ in range(50)]
+    assert waits[0] == 0.1
+    assert all(0.1 <= w <= 0.1 + 50 * 0.05 for w in waits)
+
+
+# ----------------------------------------------------------- RequestRunner
+
+
+class _Resp:
+    def __init__(self, status=200, chunks=()):
+        self.status_code = status
+        self._chunks = list(chunks)
+
+    def iter_content(self, chunk_size=None):
+        for c in self._chunks:
+            if isinstance(c, Exception):
+                raise c
+            yield c
+
+
+class ScriptedSession:
+    """requests-shaped double: each request() pops the next scripted
+    outcome (a _Resp or an Exception to raise)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def request(self, method, url, headers=None, data=None, stream=False,
+                timeout=None, allow_redirects=True):
+        self.calls.append((method, url))
+        outcome = self.script.pop(0) if self.script else ConnectionError("exhausted")
+        if isinstance(outcome, Exception):
+            raise outcome
+        return outcome
+
+
+def test_request_runner_retries_retryable_status_then_succeeds():
+    session = ScriptedSession([_Resp(503), _Resp(503), _Resp(200)])
+    slept = []
+    runner = RequestRunner(
+        session,
+        n_retries=3,
+        retry_policy_factory=lambda: RetryPolicy(100, 2.0, 0),
+        sleep=slept.append,
+    )
+    resp = runner.send("GET", "http://x/s")
+    assert resp.status_code == 200
+    assert len(session.calls) == 3
+    assert slept == [0.1, 0.2]  # backoff escalated between attempts
+    assert runner.backoffs == [(0, 0.1), (1, 0.2)]
+
+
+def test_request_runner_does_not_retry_non_retryable_status():
+    session = ScriptedSession([_Resp(404), _Resp(200)])
+    runner = RequestRunner(session, n_retries=5, sleep=lambda s: None)
+    assert runner.send("GET", "http://x/s").status_code == 404
+    assert len(session.calls) == 1
+
+
+def test_request_runner_raises_last_exception_after_exhaustion():
+    session = ScriptedSession([OSError("down"), OSError("still down")])
+    runner = RequestRunner(session, n_retries=1, sleep=lambda s: None)
+    with pytest.raises(OSError, match="still down"):
+        runner.send("GET", "http://x/s")
+    assert len(session.calls) == 2
+
+
+def test_request_runner_returns_retryable_response_when_out_of_retries():
+    session = ScriptedSession([_Resp(503), _Resp(503)])
+    runner = RequestRunner(session, n_retries=1, sleep=lambda s: None)
+    assert runner.send("GET", "http://x/s").status_code == 503
+
+
+# ---------------------------------------------------------- stream_records
+
+
+def test_stream_records_chunked_delivery():
+    session = ScriptedSession([_Resp(200, [b"r1\nr2", b"\nr3\n"])])
+    got = list(
+        stream_records(session, "http://x/stream", once=True, sleep=lambda s: None)
+    )
+    assert got == [b"r1", b"r2", b"r3"]
+
+
+def test_stream_records_reconnects_mid_stream_and_resumes():
+    # first response dies after two records; second carries on
+    session = ScriptedSession(
+        [
+            _Resp(200, [b"a\nb\n", ConnectionError("reset by peer")]),
+            _Resp(200, [b"c\nd\n"]),
+        ]
+    )
+    slept = []
+    gen = stream_records(
+        session,
+        "http://x/stream",
+        retry_policy=RetryPolicy(100, 2.0, 0),
+        sleep=slept.append,
+    )
+    assert list(itertools.islice(gen, 4)) == [b"a", b"b", b"c", b"d"]
+    assert len(session.calls) == 2  # one reconnect
+    assert slept == [0.1]  # one backoff wait before it
+
+
+def test_stream_records_backoff_escalates_across_dataless_drops():
+    session = ScriptedSession(
+        [
+            ConnectionError("1"),
+            ConnectionError("2"),
+            ConnectionError("3"),
+            _Resp(200, [b"ok\n"]),
+        ]
+    )
+    slept = []
+    gen = stream_records(
+        session,
+        "http://x/stream",
+        retry_policy=RetryPolicy(100, 2.0, 0),
+        sleep=slept.append,
+    )
+    assert next(gen) == b"ok"
+    assert slept == [0.1, 0.2, 0.4]
+
+
+def test_stream_records_gives_up_after_consecutive_dataless_drops():
+    session = ScriptedSession([ConnectionError("down")] * 10)
+    gen = stream_records(
+        session,
+        "http://x/stream",
+        retry_policy=RetryPolicy(1, 1.0, 0),
+        max_failed_attempts_in_row=3,
+        sleep=lambda s: None,
+    )
+    with pytest.raises(ConnectionError):
+        list(gen)
+    assert len(session.calls) == 3
+
+
+def test_stream_records_once_fails_loudly_on_drop():
+    session = ScriptedSession([_Resp(200, [b"a\n", ConnectionError("reset")])])
+    gen = stream_records(session, "http://x/stream", once=True, sleep=lambda s: None)
+    assert next(gen) == b"a"
+    with pytest.raises(ConnectionError):
+        next(gen)
+
+
+def test_stream_records_error_status_triggers_reconnect():
+    session = ScriptedSession([_Resp(502), _Resp(200, [b"a\n"])])
+    gen = stream_records(
+        session,
+        "http://x/stream",
+        runner=RequestRunner(session, n_retries=0, sleep=lambda s: None),
+        retry_policy=RetryPolicy(1, 1.0, 0),
+        sleep=lambda s: None,
+    )
+    assert next(gen) == b"a"
+
+
+# --------------------------------------------------------------- e2e read
+
+
+def test_http_read_stream_static_end_to_end():
+    class S(pw.Schema):
+        id: int
+        word: str
+
+    lines = b'{"id": 1, "word": "a"}\n{"id": 2, "word": "b"}\n'
+    session = ScriptedSession([_Resp(200, [lines[:10], lines[10:]])])
+    t = pw.io.http.read(
+        "http://x/stream",
+        schema=S,
+        stream=True,
+        mode="static",
+        _session=session,
+        _sleep=lambda s: None,
+    )
+    rows = sorted((r["id"], r["word"]) for r in _collect(t))
+    assert rows == [(1, "a"), (2, "b")]
+
+
+def test_http_read_stream_response_mapper():
+    class S(pw.Schema):
+        key: int
+
+    def mapper(msg: bytes) -> bytes:
+        return json.dumps({"key": json.loads(msg)["id"] * 10}).encode()
+
+    session = ScriptedSession([_Resp(200, [b'{"id": 3}\n'])])
+    t = pw.io.http.read(
+        "http://x/stream",
+        schema=S,
+        mode="static",
+        response_mapper=mapper,  # implies streaming transport
+        _session=session,
+        _sleep=lambda s: None,
+    )
+    assert [r["key"] for r in _collect(t)] == [30]
+
+
+# --------------------------------------------------------- bounded dedupe
+
+
+def test_recent_window_is_bounded_lru():
+    w = _RecentWindow(2)
+    assert not w.check_and_add("a")
+    assert not w.check_and_add("b")
+    assert w.check_and_add("a")  # still in window, refreshed
+    assert not w.check_and_add("c")  # evicts b (least recent)
+    assert w.check_and_add("a")
+    assert not w.check_and_add("b")  # b was evicted → re-admitted as new
+
+
+def test_http_read_stream_skips_non_json_keepalives():
+    class S(pw.Schema):
+        id: int
+
+    session = ScriptedSession([_Resp(200, [b": ping\n{\"id\": 5}\n: ping\n"])])
+    t = pw.io.http.read(
+        "http://x/stream",
+        schema=S,
+        stream=True,
+        mode="static",
+        _session=session,
+        _sleep=lambda s: None,
+    )
+    assert [r["id"] for r in _collect(t)] == [5]
+
+
+def test_poll_read_sends_method_and_headers():
+    class S(pw.Schema):
+        id: int
+
+    seen = {}
+
+    class Recording:
+        def request(self, method, url, headers=None, **kw):
+            seen["method"], seen["headers"] = method, headers
+
+            class R:
+                status_code = 200
+
+                @staticmethod
+                def json():
+                    return [{"id": 1}]
+
+            return R()
+
+    t = pw.io.http.read(
+        "http://x/feed",
+        schema=S,
+        mode="static",
+        method="POST",
+        headers={"Authorization": "Bearer tok"},
+        _session=Recording(),
+        _sleep=lambda s: None,
+    )
+    assert [r["id"] for r in _collect(t)] == [1]
+    assert seen["method"] == "POST"
+    assert seen["headers"] == {"Authorization": "Bearer tok"}
+
+
+def test_poll_read_dedupes_within_single_poll():
+    class S(pw.Schema):
+        id: int
+
+    class OnePoll:
+        def request(self, method, url, **kw):
+            class R:
+                status_code = 200
+
+                @staticmethod
+                def json():
+                    return [{"id": 1}, {"id": 1}, {"id": 2}]
+
+            return R()
+
+    t = pw.io.http.read(
+        "http://x/feed", schema=S, mode="static", _session=OnePoll(),
+        _sleep=lambda s: None,
+    )
+    assert sorted(r["id"] for r in _collect(t)) == [1, 2]
