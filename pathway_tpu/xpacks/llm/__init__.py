@@ -16,6 +16,7 @@ from . import (
     servers,
     splitters,
 )
+from . import rag_evals
 from .document_store import DocumentStore, SlidesDocumentStore
 from .vector_store import (
     SlidesVectorStoreServer,
@@ -34,6 +35,7 @@ __all__ = [
     "parsers",
     "prompts",
     "question_answering",
+    "rag_evals",
     "rerankers",
     "servers",
     "splitters",
