@@ -46,6 +46,9 @@ class EncoderConfig:
     # attention path: "auto" (pallas fused kernel on TPU — see
     # ops/fused_attention.py), "xla", "fused", "interpret"
     attention_impl: str = "auto"
+    # whole-layer path for the inference encode jits: "auto" (one pallas
+    # dispatch per layer on TPU — ops/fused_layer.py), "fused", "xla"
+    layer_impl: str = "auto"
 
     @classmethod
     def minilm_l6(cls, **kw) -> "EncoderConfig":

@@ -1,0 +1,306 @@
+"""Pallas TPU kernel: one FULL transformer encoder layer per dispatch.
+
+The per-op XLA lowering of a MiniLM-geometry layer (hidden 384) streams
+every intermediate — qkv, attention context, FFN activations — through
+HBM between ops; at the embed hot path's shapes the layer is memory-
+bound, not FLOP-bound (reference hot path: sentence-transformers torch
+encode, /root/reference/python/pathway/xpacks/llm/embedders.py:270-329).
+This kernel keeps a block of packed sequences resident in VMEM for the
+whole layer:
+
+    x -> qkv proj -> block-diagonal attention -> out proj
+      -> +residual, LayerNorm -> FFN (gelu) -> +residual, LayerNorm
+
+Weights ride constant-index BlockSpecs, so Mosaic fetches them into
+VMEM once and re-uses them across the token-block grid; HBM traffic per
+layer is x in + x out + weights once, instead of ~8 activation-sized
+round-trips.  Numerics: matmuls accumulate f32 on the MXU, layernorm
+and softmax run in f32 on the VPU, activations carry bf16 between
+stages — matching the flax module (encoder.py EncoderLayer) to bf16
+tolerance.  Backward recomputes through the flax/XLA path via
+custom_vjp (attention-style: recompute beats storing probs).
+
+``encoder_forward`` runs the whole TextEncoder (embeddings + N fused
+layers + pooling) straight off the flax params tree, so checkpoints and
+the module stay the single source of truth.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .fused_attention import BLOCK_OFF, KEY_OFF
+
+
+def _ln(x32, scale_ref, bias_ref, eps):
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    return (x32 - mu) * inv * scale_ref[0:1, :] + bias_ref[0:1, :]
+
+
+def _gelu_tanh(x32):
+    # tanh-approximate gelu, matching jax.nn.gelu(approximate=True)
+    c = math.sqrt(2.0 / math.pi)
+    return 0.5 * x32 * (1.0 + jnp.tanh(c * (x32 + 0.044715 * x32**3)))
+
+
+def _layer_kernel(
+    x_ref,
+    kbias_ref,
+    wqkv_ref,
+    bqkv_ref,
+    wout_ref,
+    bout_ref,
+    ln1s_ref,
+    ln1b_ref,
+    w1_ref,
+    b1_ref,
+    w2_ref,
+    b2_ref,
+    ln2s_ref,
+    ln2b_ref,
+    out_ref,
+    *,
+    n_heads: int,
+    seq: int,
+    scale: float,
+    eps: float,
+):
+    rows, d = out_ref.shape
+    hd = d // n_heads
+    x = x_ref[...]
+    qkv = (
+        jnp.dot(x, wqkv_ref[...], preferred_element_type=jnp.float32)
+        + bqkv_ref[0:1, :]
+    ).astype(x.dtype)
+    # attention: p sequences packed per block; a token attends exactly
+    # its own sequence's unpadded keys
+    qi = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 0) // seq
+    ki = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 1) // seq
+    bias = jnp.where(qi == ki, 0.0, BLOCK_OFF) + kbias_ref[0, 0:1, :]
+    parts = []
+    for i in range(n_heads):
+        qh = qkv[:, i * hd : (i + 1) * hd]
+        kh = qkv[:, d + i * hd : d + (i + 1) * hd]
+        vh = qkv[:, 2 * d + i * hd : 2 * d + (i + 1) * hd]
+        s = (
+            jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+            + bias
+        )
+        m = jnp.max(s, axis=1, keepdims=True)
+        e = jnp.exp(s - m)
+        p = (e / jnp.sum(e, axis=1, keepdims=True)).astype(x.dtype)
+        parts.append(jnp.dot(p, vh, preferred_element_type=jnp.float32))
+    ctx = jnp.concatenate(parts, axis=1).astype(x.dtype)
+    att = (
+        jnp.dot(ctx, wout_ref[...], preferred_element_type=jnp.float32)
+        + bout_ref[0:1, :]
+    )
+    h1 = _ln(x.astype(jnp.float32) + att, ln1s_ref, ln1b_ref, eps)
+    h1b = h1.astype(x.dtype)
+    mid = (
+        jnp.dot(h1b, w1_ref[...], preferred_element_type=jnp.float32)
+        + b1_ref[0:1, :]
+    )
+    midb = _gelu_tanh(mid).astype(x.dtype)
+    m2 = (
+        jnp.dot(midb, w2_ref[...], preferred_element_type=jnp.float32)
+        + b2_ref[0:1, :]
+    )
+    out_ref[...] = _ln(h1 + m2, ln2s_ref, ln2b_ref, eps).astype(out_ref.dtype)
+
+
+def _pack_rows(s: int) -> int:
+    """Sequences packed per token block — same policy the attention
+    kernel measured best on v5e (fused_attention._fused_call)."""
+    if s <= 128:
+        return max(1, 256 // s)
+    if s < 256:
+        return max(1, 512 // s)
+    return 1
+
+
+def _row2(v):
+    """1D param vector -> (1, n) so it tiles onto VMEM lanes."""
+    return v.reshape(1, -1)
+
+
+def fused_layer_tokens(
+    tokens,
+    kbias,
+    layer_params: dict,
+    *,
+    n_heads: int,
+    seq: int,
+    eps: float,
+    interpret: bool = False,
+):
+    """One encoder layer over pre-packed tokens [bp*rows, d] with the
+    per-block key bias [bp, 8, rows] (see ``pack_tokens``)."""
+    d = tokens.shape[1]
+    rows = _pack_rows(seq) * seq
+    bp = tokens.shape[0] // rows
+    att, ln1 = layer_params["attention"], layer_params["ln_att"]
+    w = lambda t: t.astype(tokens.dtype)
+    const = lambda shape: pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape))
+    args = [
+        w(att["qkv"]["kernel"]),
+        _row2(att["qkv"]["bias"].astype(jnp.float32)),
+        w(att["out"]["kernel"]),
+        _row2(att["out"]["bias"].astype(jnp.float32)),
+        _row2(ln1["scale"].astype(jnp.float32)),
+        _row2(ln1["bias"].astype(jnp.float32)),
+        w(layer_params["mlp_in"]["kernel"]),
+        _row2(layer_params["mlp_in"]["bias"].astype(jnp.float32)),
+        w(layer_params["mlp_out"]["kernel"]),
+        _row2(layer_params["mlp_out"]["bias"].astype(jnp.float32)),
+        _row2(layer_params["ln_mlp"]["scale"].astype(jnp.float32)),
+        _row2(layer_params["ln_mlp"]["bias"].astype(jnp.float32)),
+    ]
+    return pl.pallas_call(
+        functools.partial(
+            _layer_kernel,
+            n_heads=n_heads,
+            seq=seq,
+            scale=1.0 / math.sqrt(d // n_heads),
+            eps=eps,
+        ),
+        grid=(bp,),
+        in_specs=[
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, 8, rows), lambda i: (i, 0, 0)),
+            *[const(a.shape) for a in args],
+        ],
+        out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(tokens.shape, tokens.dtype),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(tokens, kbias, *args)
+
+
+def pack_tokens(x, key_mask):
+    """[B, S, d] -> packed [bp*rows, d] tokens + [bp, 8, rows] key bias
+    (+ the original B for unpacking)."""
+    b, s, d = x.shape
+    p = _pack_rows(s)
+    rows = p * s
+    pad = (-b) % p
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
+        key_mask = jnp.pad(key_mask, ((0, pad), (0, 0)))
+    bp = x.shape[0] // p
+    tokens = x.reshape(bp * rows, d)
+    kbias = jnp.where(key_mask, 0.0, KEY_OFF).astype(jnp.float32).reshape(bp, rows)
+    kbias = jnp.broadcast_to(kbias[:, None, :], (bp, 8, rows))
+    return tokens, kbias, b
+
+
+def unpack_tokens(tokens, b: int, s: int):
+    d = tokens.shape[1]
+    return tokens.reshape(-1, s, d)[:b]
+
+
+def _forward_impl(params, cfg, ids, mask, interpret: bool):
+    from flax.core import meta as _meta
+
+    p = params["params"] if "params" in params else params
+    p = _meta.unbox(p)
+    dtype = cfg.dtype
+    x = p["tok_embed"]["embedding"].astype(dtype)[ids]
+    x = x + p["pos_embed"]["embedding"].astype(dtype)[None, : ids.shape[1]]
+    if cfg.type_vocab_size:
+        x = x + p["type_embed"]["embedding"].astype(dtype)[0][None, None, :]
+    emb_ln = p["ln_embed"]
+    x = _ln(
+        x.astype(jnp.float32),
+        _row2(emb_ln["scale"].astype(jnp.float32)),
+        _row2(emb_ln["bias"].astype(jnp.float32)),
+        cfg.layer_norm_eps,
+    ).astype(dtype)
+    b, s, d = x.shape
+    tokens, kbias, b0 = pack_tokens(x, mask)
+    for i in range(cfg.num_layers):
+        tokens = fused_layer_tokens(
+            tokens,
+            kbias,
+            p[f"layer_{i}"],
+            n_heads=cfg.num_heads,
+            seq=s,
+            eps=cfg.layer_norm_eps,
+            interpret=interpret,
+        )
+    x = unpack_tokens(tokens, b0, s)
+    if cfg.pooling == "cls":
+        pooled = x[:, 0].astype(jnp.float32)
+    else:
+        m = mask[:, :, None].astype(x.dtype)
+        pooled = ((x * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)).astype(
+            jnp.float32
+        )
+    if cfg.normalize:
+        pooled = pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
+        )
+    return pooled
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 4))
+def _encoder_forward(params, cfg, ids, mask, interpret):
+    return _forward_impl(params, cfg, ids, mask, interpret)
+
+
+def _efwd(params, cfg, ids, mask, interpret):
+    return _forward_impl(params, cfg, ids, mask, interpret), (params, ids, mask)
+
+
+def _ebwd(cfg, interpret, res, g):
+    params, ids, mask = res
+    from ..models.encoder import TextEncoder
+
+    module = TextEncoder(cfg)
+    _, vjp = jax.vjp(lambda pr: module.apply(pr, ids, mask), params)
+    return (vjp(g)[0], None, None)
+
+
+_encoder_forward.defvjp(_efwd, _ebwd)
+
+
+def supports_fused_encoder(cfg, seq_len: int) -> bool:
+    """Geometry gate: the fused-layer path covers the inference encoder
+    exactly when the attention kernel's packing fits and the module has
+    no segment packing in play."""
+    return (
+        cfg.hidden_size % cfg.num_heads == 0
+        and seq_len <= 512
+        and cfg.pooling in ("mean", "cls")
+    )
+
+
+def use_fused_encoder(cfg, seq_len: int) -> bool:
+    """Policy gate — THE single dispatch decision for every encode path
+    (SentenceEncoder jits, the fused text-query jit, benches): honors
+    ``cfg.layer_impl`` ("xla" disables, "fused" forces) and otherwise
+    picks the kernel on TPU when the geometry fits."""
+    impl = getattr(cfg, "layer_impl", "auto")
+    if impl == "xla":
+        return False
+    if impl == "fused":
+        return True
+    return jax.default_backend() == "tpu" and supports_fused_encoder(cfg, seq_len)
+
+
+def encoder_forward(params, cfg, ids, mask, *, interpret: bool = False):
+    """TextEncoder forward (embeddings -> fused layers -> pooling)
+    running each layer as ONE pallas dispatch.  Differentiable: the
+    backward pass recomputes through the flax module."""
+    return _encoder_forward(params, cfg, ids, mask, interpret)
