@@ -195,6 +195,53 @@ def _scatter_dev_fn() -> Callable:
     return _UPDATE_JIT["scatter_dev"]
 
 
+def _empty_fn() -> Callable:
+    """Jitted on-device creation of an EMPTY resident index (zeroed
+    matrix, all-invalid rows, NEG bias).  A cold index receiving its
+    first device-resident batch must not fabricate the matrix by
+    uploading a host buffer — on a tunneled host that transfer costs
+    seconds and defeats the whole zero-host-bounce ingest design."""
+    if "empty" not in _UPDATE_JIT:
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from .pallas_knn import NEG as _PNEG
+
+        @partial(jax.jit, static_argnames=("cap", "dim"))
+        def empty(cap, dim):
+            return (
+                jnp.zeros((cap, dim), jnp.float32),
+                jnp.zeros((cap,), bool),
+                jnp.full((cap,), _PNEG, jnp.float32),
+            )
+
+        _UPDATE_JIT["empty"] = empty
+    return _UPDATE_JIT["empty"]
+
+
+def _scatter_tomb_fn() -> Callable:
+    """Jitted tombstone-only flush: mark slots invalid + NEG bias.  The
+    matrix rows stay untouched (they are dead by validity), so neither
+    the matrix nor any vector payload crosses the link."""
+    if "scatter_tomb" not in _UPDATE_JIT:
+        import jax
+        import jax.numpy as jnp
+
+        from .pallas_knn import NEG as _PNEG
+
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def scatter_tomb(valid, bias, slots):
+            valid = valid.at[slots].set(False, mode="drop")
+            bias = bias.at[slots].set(_PNEG, mode="drop")
+            return valid, bias
+
+        _UPDATE_JIT["scatter_tomb"] = scatter_tomb
+    return _UPDATE_JIT["scatter_tomb"]
+
+
 def _grow_fn() -> Callable:
     """Jitted on-device capacity doubling: pad the resident arrays into
     a fresh zeroed buffer (one compile per capacity bucket) so growth
@@ -341,9 +388,21 @@ class DeviceKnnIndex:
         if n == 0:
             return
         if self._full or self._dev_matrix is None:
-            # cold start: no resident matrix to scatter into yet
-            self.add_batch_arrays(keys, np.asarray(dev_vectors)[:n], metadatas)
-            return
+            if not self._slot_of and not self._pending and self.mesh is None:
+                # cold start on an EMPTY index (the streaming engine's
+                # first epoch): materialize the resident arrays on
+                # device — zero host transfer — and fall through to the
+                # normal scatter.  Pulling dev_vectors down to host here
+                # costs seconds per epoch on a tunneled link.
+                self._dev_matrix, self._dev_valid, self._dev_bias = _empty_fn()(
+                    cap=self.capacity, dim=self.dim
+                )
+                self._full = False
+                self._pending.clear()
+            else:
+                # host rows already exist (or the matrix is mesh-sharded):
+                # one full upload, then scatter the device batch into it
+                self._upload_full()
         for key in keys:
             if key in self._slot_of:
                 self.remove(key)
@@ -466,6 +525,17 @@ class DeviceKnnIndex:
         m = len(self._pending)
         mb = _k_bucket(m)
         slots = np.full((mb,), n_rows, np.int32)  # pad rows scatter out of bounds
+        if all(vec is None for vec in self._pending.values()):
+            # tombstone-only flush (the retraction half of churn): only
+            # the slot ids need to cross the link — shipping a zeroed
+            # [mb, dim] vecs matrix made every churn round upload ~400x
+            # more bytes than the update carries
+            slots[:m] = list(self._pending.keys())
+            self._dev_valid, self._dev_bias = _scatter_tomb_fn()(
+                self._dev_valid, self._dev_bias, slots
+            )
+            self._pending.clear()
+            return
         vecs = np.zeros((mb, self.dim), np.float32)
         flags = np.zeros((mb,), bool)
         for i, (slot, vec) in enumerate(self._pending.items()):
@@ -649,17 +719,34 @@ class DeviceKnnIndex:
             from functools import partial
 
             module = enc.module
+            cfg = getattr(enc, "cfg", None)
 
             @partial(jax.jit, static_argnames=("k", "l2"))
             def fused(params, ids, lens, matrix, valid, k, l2):
                 mask = jnp.arange(ids.shape[1])[None, :] < lens[:, None]
-                emb = module.apply(params, ids, mask)  # [q, dim], L2-normed
+                use_fused_layer = False
+                if cfg is not None:
+                    from ..ops.fused_layer import use_fused_encoder
+
+                    use_fused_layer = use_fused_encoder(cfg, ids.shape[1])
+                if use_fused_layer:
+                    from ..ops.fused_layer import encoder_forward
+
+                    emb = encoder_forward(params, cfg, ids, mask)
+                else:
+                    emb = module.apply(params, ids, mask)  # [q, dim], L2-normed
                 scores = emb @ matrix.T
                 if l2:
                     sq = jnp.sum(matrix * matrix, axis=1)
                     scores = 2.0 * scores - sq[None, :] - 1.0  # |emb|=1
                 scores = jnp.where(valid[None, :], scores, _NEG)
-                return jax.lax.top_k(scores, k)
+                vals, idx = jax.lax.top_k(scores, k)
+                # ONE packed host transfer: scores | bitcast(idx) — two
+                # separate np.asarray pulls pay the host link round-trip
+                # twice per epoch on tunneled devices
+                return jnp.concatenate(
+                    [vals, jax.lax.bitcast_convert_type(idx, jnp.float32)], axis=1
+                )
 
             self._fused_jit = fused
             enc._pw_fused_query_jit = fused
@@ -677,16 +764,19 @@ class DeviceKnnIndex:
         def dispatch(todo, fetch):
             # the fused kernel scores every query each pass; refills
             # (rare, filter starvation) just deepen fetch for all
-            vals, idx = self._fused_jit(
-                enc.params,
-                ids,
-                lens_p,
-                self._dev_matrix,
-                self._dev_valid,
-                k=min(fetch, self.capacity),
-                l2=self.metric == "l2",
+            kk = min(fetch, self.capacity)
+            packed = np.asarray(
+                self._fused_jit(
+                    enc.params,
+                    ids,
+                    lens_p,
+                    self._dev_matrix,
+                    self._dev_valid,
+                    k=kk,
+                    l2=self.metric == "l2",
+                )
             )
-            return np.asarray(vals)[todo], np.asarray(idx)[todo]
+            return packed[:, :kk][todo], packed[:, kk:].view(np.int32)[todo]
 
         return self._assemble(n, k, filter_fns, dispatch)
 
