@@ -237,6 +237,9 @@ class CoordinatorCluster(ShardCluster):
             self._poll_replies = self._broadcast({"op": "poll"})
         return self._poll_replies
 
+    def _speedrun_supported(self) -> bool:
+        return False  # worker-process logs are not visible to process 0
+
     def _remote_replay_frontier(self) -> int:
         return max(self._worker_frontiers, default=-1)
 

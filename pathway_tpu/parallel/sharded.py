@@ -32,7 +32,12 @@ from ..engine import dataflow as df
 
 
 def recover_sources(
-    persistence, sources, cfg, auto_prefix: str = "auto", delivered_frontier: int = -1
+    persistence,
+    sources,
+    cfg,
+    auto_prefix: str = "auto",
+    delivered_frontier: int = -1,
+    speedrun: bool = False,
 ) -> int:
     """Shared source-recovery pass (process 0 AND worker processes):
     assign auto ids, reset offset-unaware logs, restore offsets +
@@ -44,17 +49,20 @@ def recover_sources(
     (persistence.recover_source)."""
     mode = str(getattr(cfg, "persistence_mode", "batch") or "batch").lower()
     record_mode = "record" in mode
-    if getattr(cfg, "auto_persistent_ids", False) or record_mode:
+    if getattr(cfg, "auto_persistent_ids", False) or record_mode or speedrun:
         for i, s in enumerate(sources):
             if s.persistent_id is not None or s.is_error_log:
                 continue
-            if record_mode or s.supports_offsets:
+            # speedrun never starts readers, so offset-unaware sources
+            # are safe to replay; otherwise only offset-aware (or
+            # freshly-reset record-mode) sources get ids
+            if speedrun or record_mode or s.supports_offsets:
                 s.persistent_id = f"{auto_prefix}_{i}"
     frontier = -1
     for s in sources:
         if s.persistent_id is None:
             continue
-        if not s.supports_offsets:
+        if not s.supports_offsets and not speedrun:
             # offset-unaware reader: run() re-produces all input, so
             # replaying a stale log on top would double it — reset
             persistence.reset_source(s.persistent_id)
@@ -277,9 +285,37 @@ class ShardCluster:
         cfg = primary.persistence_config
         mode = str(getattr(cfg, "persistence_mode", "batch") or "batch").lower()
         if "speedrun" in mode:
-            raise NotImplementedError(
-                "speedrun replay is single-worker (PATHWAY_THREADS=1)"
+            if not self._speedrun_supported():
+                raise NotImplementedError(
+                    "speedrun replay runs in-process (any PATHWAY_THREADS); "
+                    "multi-process replay would need every worker's log"
+                )
+            # SPEEDRUN across all shards: sources never start their
+            # readers; the recorded stream replays through the normal
+            # epoch loop, sharded exactly like a live run, and sinks
+            # re-deliver every epoch (replay_frontier = -1). The log is
+            # read-only here — no batch logging, no snapshots.
+            self._speedrun = True
+            p = EnginePersistence(cfg)
+            recover_sources(p, primary.session_sources, cfg, speedrun=True)
+            # a snapshot-compacted log has no full stream to replay —
+            # fail loudly rather than re-deliver only the tail (same
+            # guard as the single-engine speedrun path)
+            p.check_compaction_covered(
+                [
+                    s.persistent_id
+                    for s in primary.session_sources
+                    if s.persistent_id is not None
+                ],
+                None,
             )
+            p.close()
+            for e in self.engines:
+                e.replay_frontier = -1
+            self._opsnap_ok = False
+            self._opsnap_time = -1
+            self._last_opsnap_wall = 0.0
+            return
         p = EnginePersistence(cfg)
         self._persistence = p
         frontier = recover_sources(p, primary.session_sources, cfg)
@@ -400,11 +436,13 @@ class ShardCluster:
     def run(self, monitoring_callback: Callable | None = None) -> None:
         primary = self.engines[0]
         self._persistence = None
+        self._speedrun = False
         if primary.persistence_config is not None:
             self._setup_persistence()
-        for t in primary.connector_threads:
-            t.start()
-        primary._threads_started = True
+        if not self._speedrun:
+            for t in primary.connector_threads:
+                t.start()
+            primary._threads_started = True
         last_time = -1
         while not (self._stop or primary._stop):
             primary._raise_connector_failure()
@@ -437,6 +475,8 @@ class ShardCluster:
 
             remote_pending = False
             if scripted_t is None and not session_batches:
+                if self._speedrun:
+                    break  # recorded stream exhausted; readers never ran
                 # partitioned sources read on worker processes may hold
                 # input even when process 0 is idle
                 remote_pending = self._remote_input_pending()
@@ -533,8 +573,9 @@ class ShardCluster:
         self._finish_remote()
         if self._persistence is not None:
             self._persistence.close()
-        for t in primary.connector_threads:
-            t.join(timeout=5.0)
+        if not self._speedrun:  # speedrun never started the readers
+            for t in primary.connector_threads:
+                t.join(timeout=5.0)
         if self._pool is not None:
             self._pool.shutdown(wait=False)
 
@@ -547,6 +588,12 @@ class ShardCluster:
 
     def _finish_remote(self) -> None:
         pass
+
+    def _speedrun_supported(self) -> bool:
+        """In-process clusters replay recorded streams across any number
+        of shards; the multi-process coordinator overrides this (worker
+        logs live in other processes)."""
+        return True
 
     def _remote_input_pending(self) -> bool:
         return False
