@@ -8,9 +8,12 @@ thin async wrappers; HFPipelineChat runs a local transformers pipeline.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
+import threading
 from abc import abstractmethod
+from dataclasses import dataclass, field
 from typing import Any
 
 from ...engine.value import Json
@@ -25,6 +28,80 @@ def _prep_message_log(messages: list[dict], verbose: bool) -> str:
     if verbose:
         return json.dumps(messages, ensure_ascii=False, default=str)[:5000]
     return "..."
+
+
+@dataclass
+class ModelUsage:
+    """Accumulated accounting for one model id."""
+
+    requests: int = 0
+    failures: int = 0
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+    @property
+    def total_tokens(self) -> int:
+        return self.prompt_tokens + self.completion_tokens
+
+
+@dataclass
+class UsageTracker:
+    """Per-model request/token accounting for chat and embedder UDFs.
+
+    Every provider call records its reported ``usage`` block here (the
+    reference logs request/response events but keeps no running
+    totals — reference llms.py:268-287).  Thread-safe: async executors
+    fan calls out concurrently.
+    """
+
+    per_model: dict[str, ModelUsage] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _ids: Any = field(default_factory=lambda: itertools.count(1), repr=False)
+
+    def next_request_id(self) -> str:
+        return f"req-{next(self._ids)}"
+
+    def record(self, model: str | None, usage: Any = None, failed: bool = False):
+        """``usage`` accepts an OpenAI-shaped object or dict with
+        prompt_tokens / completion_tokens (extra keys ignored)."""
+        name = model or "<unknown>"
+        get = (
+            usage.get
+            if isinstance(usage, dict)
+            else lambda k, d=0: getattr(usage, k, d) or d
+        )
+        with self._lock:
+            entry = self.per_model.setdefault(name, ModelUsage())
+            entry.requests += 1
+            if failed:
+                entry.failures += 1
+            elif usage is not None:
+                entry.prompt_tokens += int(get("prompt_tokens", 0) or 0)
+                entry.completion_tokens += int(get("completion_tokens", 0) or 0)
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {
+                m: {
+                    "requests": u.requests,
+                    "failures": u.failures,
+                    "prompt_tokens": u.prompt_tokens,
+                    "completion_tokens": u.completion_tokens,
+                    "total_tokens": u.total_tokens,
+                }
+                for m, u in self.per_model.items()
+            }
+
+    def cost_estimate(self, prices_per_1k: dict[str, tuple[float, float]]) -> float:
+        """USD estimate given {model: ($/1k prompt, $/1k completion)}."""
+        total = 0.0
+        with self._lock:
+            for m, u in self.per_model.items():
+                if m in prices_per_1k:
+                    pin, pout = prices_per_1k[m]
+                    total += u.prompt_tokens / 1000.0 * pin
+                    total += u.completion_tokens / 1000.0 * pout
+        return total
 
 
 def _messages_to_plain(messages) -> list[dict]:
@@ -63,7 +140,16 @@ class BaseChat(udfs.UDF):
 
 
 class OpenAIChat(BaseChat):
-    """OpenAI chat.completions wrapper (reference llms.py:84)."""
+    """OpenAI chat.completions wrapper (reference llms.py:84).
+
+    ``capacity``/``retry_strategy``/``cache_strategy`` wire the UDF
+    executor (concurrency bound, backoff retries, persistent response
+    cache) and are fixed at construction; every sampling/decoding
+    option below (and any extra provider kwarg) sets a default that a
+    per-call kwarg overrides.  Each request/response pair is logged as
+    a structured event under a shared correlation id, and the reported
+    token usage accumulates on :attr:`usage` (a :class:`UsageTracker`,
+    shareable between chats to account a whole app)."""
 
     def __init__(
         self,
@@ -72,14 +158,55 @@ class OpenAIChat(BaseChat):
         cache_strategy: udfs.CacheStrategy | None = None,
         model: str | None = "gpt-3.5-turbo",
         verbose: bool = False,
+        *,
+        api_key: str | None = None,
+        base_url: str | None = None,
+        temperature: float | None = None,
+        max_tokens: int | None = None,
+        top_p: float | None = None,
+        frequency_penalty: float | None = None,
+        presence_penalty: float | None = None,
+        n: int | None = None,
+        seed: int | None = None,
+        stop: list[str] | str | None = None,
+        response_format: dict | None = None,
+        tools: list | None = None,
+        tool_choice: Any = None,
+        logit_bias: dict | None = None,
+        logprobs: bool | None = None,
+        top_logprobs: int | None = None,
+        user: str | None = None,
+        timeout: float | None = None,
+        usage_tracker: UsageTracker | None = None,
         **openai_kwargs,
     ):
         executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
         super().__init__(executor=executor, cache_strategy=cache_strategy)
         self.verbose = verbose
+        self.usage = usage_tracker or UsageTracker()
         self.kwargs = dict(openai_kwargs)
-        if model is not None:
-            self.kwargs["model"] = model
+        declared = {
+            "model": model,
+            "api_key": api_key,
+            "base_url": base_url,
+            "temperature": temperature,
+            "max_tokens": max_tokens,
+            "top_p": top_p,
+            "frequency_penalty": frequency_penalty,
+            "presence_penalty": presence_penalty,
+            "n": n,
+            "seed": seed,
+            "stop": stop,
+            "response_format": response_format,
+            "tools": tools,
+            "tool_choice": tool_choice,
+            "logit_bias": logit_bias,
+            "logprobs": logprobs,
+            "top_logprobs": top_logprobs,
+            "user": user,
+            "timeout": timeout,
+        }
+        self.kwargs.update({k: v for k, v in declared.items() if v is not None})
 
     async def __wrapped__(self, messages, **kwargs) -> str | None:
         try:
@@ -88,12 +215,42 @@ class OpenAIChat(BaseChat):
             raise ImportError("OpenAIChat requires the openai package") from e
         messages = _messages_to_plain(messages)
         kwargs = {**self.kwargs, **kwargs}
-        logger.info("OpenAIChat call: %s", _prep_message_log(messages, self.verbose))
+        model = kwargs.get("model")
+        req_id = self.usage.next_request_id()
+        logger.info(
+            json.dumps(
+                {
+                    "_type": "openai_chat_request",
+                    "id": req_id,
+                    "model": model,
+                    "messages": _prep_message_log(messages, self.verbose),
+                },
+                ensure_ascii=False,
+            )
+        )
         client = openai.AsyncOpenAI(
             api_key=kwargs.pop("api_key", None), base_url=kwargs.pop("base_url", None)
         )
-        ret = await client.chat.completions.create(messages=messages, **kwargs)
-        return ret.choices[0].message.content
+        try:
+            ret = await client.chat.completions.create(messages=messages, **kwargs)
+        except Exception:
+            self.usage.record(model, failed=True)
+            raise
+        self.usage.record(model, getattr(ret, "usage", None))
+        response = ret.choices[0].message.content
+        logger.info(
+            json.dumps(
+                {
+                    "_type": "openai_chat_response",
+                    "id": req_id,
+                    # non-verbose is the privacy posture: no content in
+                    # logs on either side of the exchange
+                    "response": response if self.verbose else "...",
+                },
+                ensure_ascii=False,
+            )
+        )
+        return response
 
     def _accepts_call_arg(self, arg_name: str) -> bool:
         return _check_model_accepts_arg(self.model or "", "openai", arg_name)
@@ -109,11 +266,13 @@ class LiteLLMChat(BaseChat):
         cache_strategy: udfs.CacheStrategy | None = None,
         model: str | None = None,
         verbose: bool = False,
+        usage_tracker: UsageTracker | None = None,
         **litellm_kwargs,
     ):
         executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
         super().__init__(executor=executor, cache_strategy=cache_strategy)
         self.verbose = verbose
+        self.usage = usage_tracker or UsageTracker()
         self.kwargs = dict(litellm_kwargs)
         if model is not None:
             self.kwargs["model"] = model
@@ -124,8 +283,14 @@ class LiteLLMChat(BaseChat):
         except ImportError as e:  # pragma: no cover
             raise ImportError("LiteLLMChat requires the litellm package") from e
         messages = _messages_to_plain(messages)
+        kwargs = {**self.kwargs, **kwargs}
         logger.info("LiteLLMChat call: %s", _prep_message_log(messages, self.verbose))
-        ret = await litellm.acompletion(messages=messages, **{**self.kwargs, **kwargs})
+        try:
+            ret = await litellm.acompletion(messages=messages, **kwargs)
+        except Exception:
+            self.usage.record(kwargs.get("model"), failed=True)
+            raise
+        self.usage.record(kwargs.get("model"), getattr(ret, "usage", None))
         return ret.choices[0]["message"]["content"]
 
     def _accepts_call_arg(self, arg_name: str) -> bool:

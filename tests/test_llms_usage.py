@@ -1,0 +1,154 @@
+"""Chat option depth + usage accounting (reference llms.py:84-310):
+constructor options flow into the provider call, request/response
+events log under one correlation id, and reported token usage
+accumulates per model on a shareable UsageTracker."""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import types
+
+import pytest
+
+from pathway_tpu.xpacks.llm.llms import LiteLLMChat, OpenAIChat, UsageTracker
+
+
+class _Resp:
+    def __init__(self, text, prompt_toks, completion_toks):
+        msg = types.SimpleNamespace(content=text)
+        self.choices = [types.SimpleNamespace(message=msg)]
+        self.usage = types.SimpleNamespace(
+            prompt_tokens=prompt_toks, completion_tokens=completion_toks
+        )
+
+
+@pytest.fixture
+def fake_openai(monkeypatch):
+    calls = []
+
+    class _Completions:
+        async def create(self, messages, **kwargs):
+            calls.append({"messages": messages, **kwargs})
+            if kwargs.get("model") == "broken-model":
+                raise RuntimeError("upstream 500")
+            return _Resp("hello there", 12, 5)
+
+    class _Client:
+        def __init__(self, api_key=None, base_url=None):
+            calls.append({"_client": {"api_key": api_key, "base_url": base_url}})
+            self.chat = types.SimpleNamespace(completions=_Completions())
+
+    mod = types.ModuleType("openai")
+    mod.AsyncOpenAI = _Client
+    monkeypatch.setitem(sys.modules, "openai", mod)
+    return calls
+
+
+def _ask(chat, messages=None):
+    return asyncio.run(chat.__wrapped__(messages or [{"role": "user", "content": "hi"}]))
+
+
+def test_constructor_options_reach_the_provider_call(fake_openai):
+    chat = OpenAIChat(
+        model="gpt-4o",
+        temperature=0.2,
+        max_tokens=100,
+        seed=7,
+        stop=["END"],
+        response_format={"type": "json_object"},
+        api_key="sk-test",
+        base_url="http://proxy",
+    )
+    assert _ask(chat) == "hello there"
+    client_call = next(c["_client"] for c in fake_openai if "_client" in c)
+    assert client_call == {"api_key": "sk-test", "base_url": "http://proxy"}
+    create = next(c for c in fake_openai if "messages" in c)
+    assert create["model"] == "gpt-4o"
+    assert create["temperature"] == 0.2
+    assert create["max_tokens"] == 100
+    assert create["seed"] == 7
+    assert create["stop"] == ["END"]
+    assert create["response_format"] == {"type": "json_object"}
+    # unset options stay absent instead of shipping None
+    assert "tools" not in create and "logit_bias" not in create
+
+
+def test_per_call_kwargs_override_defaults(fake_openai):
+    chat = OpenAIChat(model="gpt-4o", temperature=0.2)
+    asyncio.run(
+        chat.__wrapped__([{"role": "user", "content": "hi"}], temperature=0.9)
+    )
+    create = next(c for c in fake_openai if "messages" in c)
+    assert create["temperature"] == 0.9
+
+
+def test_usage_accumulates_per_model(fake_openai):
+    chat = OpenAIChat(model="gpt-4o")
+    _ask(chat)
+    _ask(chat)
+    u = chat.usage.as_dict()["gpt-4o"]
+    assert u == {
+        "requests": 2,
+        "failures": 0,
+        "prompt_tokens": 24,
+        "completion_tokens": 10,
+        "total_tokens": 34,
+    }
+    est = chat.usage.cost_estimate({"gpt-4o": (0.005, 0.015)})
+    assert est == pytest.approx(24 / 1000 * 0.005 + 10 / 1000 * 0.015)
+
+
+def test_failures_are_counted(fake_openai):
+    chat = OpenAIChat(model="broken-model")
+    with pytest.raises(RuntimeError):
+        _ask(chat)
+    u = chat.usage.as_dict()["broken-model"]
+    assert u["requests"] == 1 and u["failures"] == 1
+    assert u["total_tokens"] == 0
+
+
+def test_shared_tracker_accounts_across_chats(fake_openai):
+    shared = UsageTracker()
+    a = OpenAIChat(model="gpt-4o", usage_tracker=shared)
+    b = OpenAIChat(model="gpt-4o-mini", usage_tracker=shared)
+    _ask(a)
+    _ask(b)
+    d = shared.as_dict()
+    assert set(d) == {"gpt-4o", "gpt-4o-mini"}
+    assert all(v["requests"] == 1 for v in d.values())
+
+
+def test_litellm_usage(monkeypatch):
+    async def acompletion(messages, **kwargs):
+        resp = types.SimpleNamespace(
+            choices=[{"message": {"content": "ok"}}],
+            usage=types.SimpleNamespace(prompt_tokens=3, completion_tokens=4),
+        )
+        return resp
+
+    mod = types.ModuleType("litellm")
+    mod.acompletion = acompletion
+    monkeypatch.setitem(sys.modules, "litellm", mod)
+    chat = LiteLLMChat(model="claude-x")
+    assert _ask(chat) == "ok"
+    assert chat.usage.as_dict()["claude-x"]["total_tokens"] == 7
+
+
+def test_request_response_events_share_an_id(fake_openai, caplog):
+    import json as _json
+    import logging
+
+    caplog.set_level(logging.INFO, logger="pathway_tpu.xpacks.llm.llms")
+    chat = OpenAIChat(model="gpt-4o")
+    _ask(chat)
+    events = [
+        _json.loads(r.message)
+        for r in caplog.records
+        if r.message.startswith("{")
+    ]
+    req = next(e for e in events if e["_type"] == "openai_chat_request")
+    resp = next(e for e in events if e["_type"] == "openai_chat_response")
+    assert req["id"] == resp["id"]
+    assert req["messages"] == "..."  # non-verbose redaction
+    assert resp["response"] == "..."  # response content redacted too
