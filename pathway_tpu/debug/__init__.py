@@ -145,7 +145,19 @@ def table_from_markdown(
         rows.append((int(key), data, time, diff))
 
     op = LogicalOp("static", [], {"rows": rows})
-    return Table(cols, Universe(), op, name="markdown")
+    out = Table(cols, Universe(), op, name="markdown")
+    _mark_static_append_only(out, rows)
+    return out
+
+
+def _mark_static_append_only(table: Table, records) -> None:
+    """A static source is append-only when it is pure distinct-key
+    inserts — no diff=-1 rows, no same-key re-inserts (upserts)."""
+    keys = [r[0] for r in records]
+    if all(r[-1] == 1 for r in records) and len(set(keys)) == len(keys):
+        table._universe_append_only = True
+        for c in table._columns.values():
+            c.append_only = True
 
 
 # alias used by the reference
@@ -180,7 +192,9 @@ def table_from_rows(
         records.append((int(key), tuple(data), int(time), int(diff)))
     cols = {n: Column(t) for n, t in dtypes.items()}
     op = LogicalOp("static", [], {"rows": records})
-    return Table(cols, Universe(), op, name="from_rows")
+    out = Table(cols, Universe(), op, name="from_rows")
+    _mark_static_append_only(out, records)
+    return out
 
 
 def table_from_pandas(
@@ -214,7 +228,9 @@ def table_from_pandas(
         records.append((int(key), tuple(data), 0, 1))
     cols = {n: Column(t) for n, t in dtypes.items()}
     op = LogicalOp("static", [], {"rows": records})
-    return Table(cols, Universe(), op, name="from_pandas")
+    out = Table(cols, Universe(), op, name="from_pandas")
+    _mark_static_append_only(out, records)
+    return out
 
 
 def _run_capture(table: Table, terminate_on_error: bool = True):

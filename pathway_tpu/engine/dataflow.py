@@ -151,6 +151,11 @@ class Node:
 
     n_inputs = 1
 
+    # statically proven insert-only stream (logical-plan analysis of
+    # schema append_only declarations propagated through operators):
+    # consolidation and retraction bookkeeping can be skipped
+    append_only = False
+
     # attribute names forming the node's recoverable state (operator
     # snapshots, reference src/persistence/operator_snapshot.rs); empty
     # for stateless operators
@@ -400,6 +405,19 @@ class SessionSourceNode(Node):
         self.emit(list(ups), time)
 
     def feed_batch(self, raw: list[Update], time) -> list[Update]:
+        if self.append_only:
+            # declared insert-only: upsert resolution can never trigger
+            # and the old-value state dict would only grow — skip both
+            # it and consolidation. A retraction here is a broken
+            # declaration, not data: fail loudly (the reference errors
+            # on deletions into append-only inputs too).
+            if any(d != 1 for _k, _r, d in raw):
+                raise EngineError(
+                    f"source {self.name!r} is declared append_only but "
+                    "produced a retraction or upsert"
+                )
+            self.emit(raw, time)
+            return raw
         out: list[Update] = []
         for key, row, diff in raw:
             if diff == 2:  # upsert marker
@@ -1676,7 +1694,11 @@ class OutputNode(Node):
             self._epoch_buf.extend(updates)
 
     def time_end(self, time):
-        updates = consolidate(self._epoch_buf)
+        # append-only sinks: every buffered update is a net insert by
+        # construction, nothing can cancel — skip the consolidation probe
+        updates = (
+            self._epoch_buf if self.append_only else consolidate(self._epoch_buf)
+        )
         self._epoch_buf = []
         # sinks are terminal: nothing is emitted downstream, so the
         # "net changes only" invariant holds structurally
@@ -1721,7 +1743,9 @@ class CaptureNode(Node):
             self._epoch_buf.extend(updates)
 
     def time_end(self, time):
-        updates = consolidate(self._epoch_buf)
+        updates = (
+            self._epoch_buf if self.append_only else consolidate(self._epoch_buf)
+        )
         self._epoch_buf = []
         for key, row, diff in updates:
             self.stream.append((key, row, int(time), diff))

@@ -387,6 +387,27 @@ def unoptionalize(t: DType) -> DType:
     return t.wrapped if isinstance(t, Optional) else t
 
 
+def is_concrete(t: DType) -> bool:
+    """True when t pins a definite runtime type — no ANY reachable inside.
+
+    Build-time strictness hinges on this: operators over concrete operand
+    types must match a typing rule or the pipeline is rejected at
+    construction, while anything that can still be ANY (schema-less
+    sources, untyped UDF results, unresolved pw.this) stays lenient and
+    defers to runtime evaluation."""
+    if t is ANY or t is ERROR:
+        return False
+    if isinstance(t, (Optional, List, Future)):
+        return is_concrete(t.wrapped)
+    if isinstance(t, Tuple):
+        return t.args is not Ellipsis and all(is_concrete(a) for a in t.args)
+    if isinstance(t, Array):
+        return t.wrapped is not ANY
+    if isinstance(t, Callable):
+        return False
+    return True
+
+
 def is_optional(t: DType) -> bool:
     return isinstance(t, Optional) or t is NONE or t is ANY
 

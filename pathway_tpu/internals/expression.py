@@ -280,8 +280,26 @@ _ARITH_OPS = {"+", "-", "*", "/", "//", "%", "**", "@"}
 _CMP_OPS = {"==", "!=", "<", "<=", ">", ">="}
 _BOOL_OPS = {"&", "|", "^"}
 
+# concrete simple types whose values have a total order the engine can use
+_ORDERABLE = {
+    dt.INT,
+    dt.FLOAT,
+    dt.BOOL,
+    dt.STR,
+    dt.BYTES,
+    dt.DATE_TIME_NAIVE,
+    dt.DATE_TIME_UTC,
+    dt.DURATION,
+    dt.POINTER,
+}
 
-def _binary_result_type(op: str, l: dt.DType, r: dt.DType) -> dt.DType:
+
+def _binary_rule(op: str, l: dt.DType, r: dt.DType) -> dt.DType | None:
+    """Typing rule table for binary operators. Returns the result dtype,
+    or None when no rule covers the operand pair — the caller decides
+    whether that is a build-time error (both operands concrete) or a
+    deferred-to-runtime ANY (reference analogue: type_interpreter.py
+    _eval_binary_op + operator mapping tables)."""
     lo, ro = dt.unoptionalize(l), dt.unoptionalize(r)
     opt = dt.is_optional(l) or dt.is_optional(r)
 
@@ -289,20 +307,45 @@ def _binary_result_type(op: str, l: dt.DType, r: dt.DType) -> dt.DType:
         return dt.Optional(t) if opt else t
 
     if op in _CMP_OPS:
-        return dt.BOOL
+        if lo is dt.ANY or ro is dt.ANY:
+            return dt.BOOL
+        eq_only = op in ("==", "!=")
+        if lo == ro:
+            if eq_only or lo in _ORDERABLE or isinstance(lo, (dt.Tuple, dt.List)):
+                return dt.BOOL
+            return None
+        if {lo, ro} <= {dt.INT, dt.FLOAT}:
+            return dt.BOOL
+        if eq_only and (l is dt.NONE or r is dt.NONE):
+            return dt.BOOL
+        if isinstance(lo, dt.Tuple) and isinstance(ro, dt.Tuple):
+            return dt.BOOL
+        if isinstance(lo, dt.Array) or isinstance(ro, dt.Array):
+            return dt.BOOL
+        return None
     if op in _BOOL_OPS:
         if lo is dt.BOOL and ro is dt.BOOL:
             return w(dt.BOOL)
         if lo is dt.INT and ro is dt.INT:
             return w(dt.INT)
-        return w(dt.ANY)
+        if lo is dt.ANY or ro is dt.ANY:
+            return w(dt.ANY)
+        return None
     if op in _ARITH_OPS:
+        if op == "@":
+            if isinstance(lo, dt.Array) or isinstance(ro, dt.Array):
+                return w(dt.ANY_ARRAY)
+            if lo is dt.ANY or ro is dt.ANY:
+                return w(dt.ANY)
+            return None
         if lo is dt.INT and ro is dt.INT:
             return w(dt.FLOAT if op == "/" else dt.INT)
         if lo in (dt.INT, dt.FLOAT) and ro in (dt.INT, dt.FLOAT):
             return w(dt.FLOAT)
         if op == "+" and lo is dt.STR and ro is dt.STR:
             return w(dt.STR)
+        if op == "+" and lo is dt.BYTES and ro is dt.BYTES:
+            return w(dt.BYTES)
         if op == "*" and {lo, ro} <= {dt.STR, dt.INT} and lo != ro:
             return w(dt.STR)
         if op == "+" and isinstance(lo, dt.Tuple) and isinstance(ro, dt.Tuple):
@@ -324,8 +367,23 @@ def _binary_result_type(op: str, l: dt.DType, r: dt.DType) -> dt.DType:
             return w(dt.DURATION)
         if isinstance(lo, dt.Array) or isinstance(ro, dt.Array):
             return w(dt.ANY_ARRAY)
-        return w(dt.ANY)
+        if lo is dt.ANY or ro is dt.ANY:
+            return w(dt.ANY)
+        return None
     return dt.ANY
+
+
+def _binary_result_type(op: str, l: dt.DType, r: dt.DType) -> dt.DType:
+    res = _binary_rule(op, l, r)
+    if res is not None:
+        return res
+    if dt.is_concrete(l) and dt.is_concrete(r):
+        raise TypeError(
+            f"operator {op!r} is not defined for column types {l} and {r}; "
+            "cast an operand with pw.cast, or compute the value in Python "
+            "with pw.apply"
+        )
+    return dt.BOOL if op in _CMP_OPS else dt.ANY
 
 
 class ColumnBinaryOpExpression(ColumnExpression):
@@ -357,11 +415,23 @@ class ColumnUnaryOpExpression(ColumnExpression):
         self._refresh_dtype()
 
     def _refresh_dtype(self) -> None:
-        self._dtype = (
-            dt.BOOL
-            if self._op == "~" and self._expr._dtype is dt.BOOL
-            else self._expr._dtype
-        )
+        t = self._expr._dtype
+        to = dt.unoptionalize(t)
+        opt = dt.is_optional(t) and t is not dt.ANY
+        if self._op == "~":
+            if to in (dt.BOOL, dt.INT):
+                self._dtype = dt.Optional(to) if opt else to
+                return
+        elif self._op == "-":
+            if to in (dt.INT, dt.FLOAT, dt.DURATION) or isinstance(to, dt.Array):
+                self._dtype = t
+                return
+        if dt.is_concrete(t):
+            raise TypeError(
+                f"unary operator {self._op!r} is not defined for column "
+                f"type {t}; cast with pw.cast or use pw.apply"
+            )
+        self._dtype = t
 
     @property
     def _deps(self):
@@ -444,12 +514,30 @@ class ConvertExpression(ColumnExpression):
 
 
 class DeclareTypeExpression(ColumnExpression):
-    """pw.declare_type — unchecked type assertion."""
+    """pw.declare_type — type assertion, valid only along the subtype
+    axis (narrowing or widening); a cross-type reinterpretation is
+    rejected at build time — that is pw.cast's job."""
 
     def __init__(self, target: Any, expr: Any):
         super().__init__()
         self._expr = smart_wrap(expr)
         self._dtype = dt.wrap(target)
+        self._refresh_dtype()
+
+    def _refresh_dtype(self) -> None:
+        src = self._expr._dtype
+        if (
+            dt.is_concrete(src)
+            and dt.is_concrete(self._dtype)
+            and not (
+                self._dtype.is_subclass_of(src) or src.is_subclass_of(self._dtype)
+            )
+        ):
+            raise TypeError(
+                f"pw.declare_type can only narrow or widen a column's type; "
+                f"{src} -> {self._dtype} changes it outright — use pw.cast "
+                "for a value conversion"
+            )
 
     @property
     def _deps(self):
@@ -481,6 +569,15 @@ class FillErrorExpression(ColumnExpression):
 
     def _refresh_dtype(self) -> None:
         self._dtype = dt.lub(self._expr._dtype, self._replacement._dtype)
+        if (
+            self._dtype is dt.ANY
+            and dt.is_concrete(self._expr._dtype)
+            and dt.is_concrete(self._replacement._dtype)
+        ):
+            raise TypeError(
+                f"pw.fill_error replacement type {self._replacement._dtype} "
+                f"does not unify with the column type {self._expr._dtype}"
+            )
 
     @property
     def _deps(self):
@@ -496,7 +593,22 @@ class IfElseExpression(ColumnExpression):
         self._refresh_dtype()
 
     def _refresh_dtype(self) -> None:
-        self._dtype = dt.lub(self._then._dtype, self._else._dtype)
+        cond = self._if._dtype
+        if dt.unoptionalize(cond) is not dt.BOOL and dt.is_concrete(cond):
+            raise TypeError(
+                f"pw.if_else condition must be a bool column, got {cond}"
+            )
+        then_t, else_t = self._then._dtype, self._else._dtype
+        self._dtype = dt.lub(then_t, else_t)
+        if (
+            self._dtype is dt.ANY
+            and dt.is_concrete(then_t)
+            and dt.is_concrete(else_t)
+        ):
+            raise TypeError(
+                f"pw.if_else branches have no common type: {then_t} vs "
+                f"{else_t}; cast one branch with pw.cast"
+            )
 
     @property
     def _deps(self):
@@ -513,6 +625,12 @@ class CoalesceExpression(ColumnExpression):
         result = self._args[-1]._dtype
         for a in reversed(self._args[:-1]):
             result = dt.lub(dt.unoptionalize(a._dtype), result)
+        if result is dt.ANY and all(dt.is_concrete(a._dtype) for a in self._args):
+            raise TypeError(
+                "pw.coalesce arguments have no common type: "
+                f"{[str(a._dtype) for a in self._args]}; cast them with "
+                "pw.cast first"
+            )
         non_opt = any(not dt.is_optional(a._dtype) for a in self._args)
         self._dtype = dt.unoptionalize(result) if non_opt else result
 
@@ -578,6 +696,16 @@ class SequenceGetExpression(ColumnExpression):
 
     def _refresh_dtype(self) -> None:
         base = self._expr._dtype
+        idx_t = dt.unoptionalize(self._index._dtype)
+        if (
+            idx_t is not dt.INT
+            and dt.is_concrete(self._index._dtype)
+            and isinstance(dt.unoptionalize(base), (dt.Tuple, dt.List, dt.Array))
+        ):
+            # JSON bases take str keys too; sequences are int-indexed only
+            raise TypeError(
+                f"sequence index must be an int column, got {self._index._dtype}"
+            )
         check_if_exists = self._check_if_exists
         if isinstance(base, dt.Tuple) and base.args is not Ellipsis and isinstance(self._index, ConstColumnExpression) and isinstance(self._index._val, int) and -len(base.args) <= self._index._val < len(base.args):
             self._dtype = base.args[self._index._val]

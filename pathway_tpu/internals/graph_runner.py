@@ -197,6 +197,7 @@ class GraphRunner:
             r.capture(table)  # routed to shard 0; replica's stays empty
         low = self.lower(table)
         cap = df.CaptureNode(self.engine)
+        cap.append_only = table.is_append_only
         cap.connect(low.node)
         self.engine.captures.append(cap)
         return cap, low.names
@@ -225,6 +226,7 @@ class GraphRunner:
             on_time_end=on_time_end,
             on_end=on_end,
         )
+        out.append_only = table.is_append_only
         out.connect(low.node)
         self.engine.outputs.append(out)
         return out

@@ -69,6 +69,12 @@ class Table:
         op.output = self
         self._id = next(_table_ids)
         self._name = name or f"table_{self._id}"
+        # rows of this table are only ever added, never deleted (the
+        # universe-level half of the append-only property; the per-value
+        # half lives on Column.append_only). Construction sites that can
+        # prove it set this after building the table; everything else
+        # stays conservatively False.
+        self._universe_append_only = False
         from .parse_graph import G
 
         G.register(self)
@@ -105,6 +111,17 @@ class Table:
             name=f"{self._name}_schema",
         )
 
+    @property
+    def is_append_only(self) -> bool:
+        """True when the whole table's update stream is insert-only: no
+        row deletions (universe level) and no value changes (every
+        column). Sinks and the engine's epoch consolidation skip
+        retraction bookkeeping for such tables (reference analogue:
+        internals/column_properties.py append_only tracking)."""
+        return self._universe_append_only and all(
+            c.append_only for c in self._columns.values()
+        )
+
     def column_names(self) -> list[str]:
         return list(self._columns.keys())
 
@@ -123,9 +140,15 @@ class Table:
     @trace_user_frame
     def select(self, *args: ColumnReference, **kwargs: Any) -> "Table":
         exprs = _named_exprs(self, args, kwargs)
-        cols = {n: Column(e._dtype) for n, e in exprs.items()}
+        ao = self._universe_append_only
+        cols = {
+            n: Column(e._dtype, append_only=ao and _expr_append_only(e))
+            for n, e in exprs.items()
+        }
         op = LogicalOp("select", [self], {"exprs": exprs})
-        return Table(cols, self._universe, op, name=f"{self._name}.select")
+        out = Table(cols, self._universe, op, name=f"{self._name}.select")
+        out._universe_append_only = ao
+        return out
 
     @trace_user_frame
     def with_columns(self, *args: ColumnReference, **kwargs: Any) -> "Table":
@@ -134,9 +157,15 @@ class Table:
             n: ColumnReference(self, n) for n in self._columns
         }
         all_exprs.update(exprs)
-        cols = {n: Column(e._dtype) for n, e in all_exprs.items()}
+        ao = self._universe_append_only
+        cols = {
+            n: Column(e._dtype, append_only=ao and _expr_append_only(e))
+            for n, e in all_exprs.items()
+        }
         op = LogicalOp("select", [self], {"exprs": all_exprs})
-        return Table(cols, self._universe, op, name=f"{self._name}.with_columns")
+        out = Table(cols, self._universe, op, name=f"{self._name}.with_columns")
+        out._universe_append_only = ao
+        return out
 
     def __add__(self, other: "Table") -> "Table":
         """Concatenate columns of two same-universe tables (reference
@@ -152,16 +181,30 @@ class Table:
             n: ColumnReference(self, n) for n in self._columns
         }
         exprs.update({n: ColumnReference(other, n) for n in other._columns})
-        cols = {n: Column(e._dtype) for n, e in exprs.items()}
+        ao = self._universe_append_only and other._universe_append_only
+        cols = {
+            n: Column(e._dtype, append_only=ao and _expr_append_only(e))
+            for n, e in exprs.items()
+        }
         op = LogicalOp("concat_columns", [self, other], {"exprs": exprs})
-        return Table(cols, self._universe, op, name=f"{self._name}+")
+        out = Table(cols, self._universe, op, name=f"{self._name}+")
+        out._universe_append_only = ao
+        return out
 
     @trace_user_frame
     def filter(self, filter_expression: ColumnExpression) -> "Table":
         expr = _resolve_this(smart_wrap(filter_expression), self)
-        cols = {n: Column(c.dtype) for n, c in self._columns.items()}
+        # an append-only predicate over append-only rows never flips, so
+        # no filtered-in row is ever retracted
+        ao = self._universe_append_only and _expr_append_only(expr)
+        cols = {
+            n: Column(c.dtype, append_only=ao and c.append_only)
+            for n, c in self._columns.items()
+        }
         op = LogicalOp("filter", [self], {"expr": expr})
-        return Table(cols, self._universe.subset(), op, name=f"{self._name}.filter")
+        out = Table(cols, self._universe.subset(), op, name=f"{self._name}.filter")
+        out._universe_append_only = ao
+        return out
 
     def split(self, split_expression: ColumnExpression) -> tuple["Table", "Table"]:
         pos = self.filter(split_expression)
@@ -254,13 +297,17 @@ class Table:
         tables = [self, *others]
         cols = _common_columns(tables)
         op = LogicalOp("concat", tables, {})
-        return Table(cols, Universe(), op, name=f"{self._name}.concat")
+        out = Table(cols, Universe(), op, name=f"{self._name}.concat")
+        out._universe_append_only = all(t._universe_append_only for t in tables)
+        return out
 
     def concat_reindex(self, *others: "Table") -> "Table":
         tables = [self, *others]
         cols = _common_columns(tables)
         op = LogicalOp("concat_reindex", tables, {})
-        return Table(cols, Universe(), op, name=f"{self._name}.concat_reindex")
+        out = Table(cols, Universe(), op, name=f"{self._name}.concat_reindex")
+        out._universe_append_only = all(t._universe_append_only for t in tables)
+        return out
 
     def update_rows(self, other: "Table") -> "Table":
         cols = {}
@@ -285,9 +332,19 @@ class Table:
         return self.update_cells(other)
 
     def intersect(self, *others: "Table") -> "Table":
-        cols = {n: Column(c.dtype) for n, c in self._columns.items()}
+        # an intersection row appears once every input has it and — with
+        # all inputs append-only — is never taken back
+        ao = self._universe_append_only and all(
+            t._universe_append_only for t in others
+        )
+        cols = {
+            n: Column(c.dtype, append_only=ao and c.append_only)
+            for n, c in self._columns.items()
+        }
         op = LogicalOp("intersect", [self, *others], {})
-        return Table(cols, self._universe.subset(), op, name=f"{self._name}.intersect")
+        out = Table(cols, self._universe.subset(), op, name=f"{self._name}.intersect")
+        out._universe_append_only = ao
+        return out
 
     def difference(self, other: "Table") -> "Table":
         cols = {n: Column(c.dtype) for n, c in self._columns.items()}
@@ -876,6 +933,33 @@ def _rewrite(expr: ColumnExpression, map_table: Callable):
     return new if changed else expr
 
 
+def _expr_append_only(e: ColumnExpression) -> bool:
+    """Is the value stream produced by this expression insert-only?
+
+    Holds when every column it reads is append-only (so no operand is
+    ever retracted) and the computation is deterministic (a
+    non-deterministic UDF re-run on replay could change history).
+    Constants are trivially append-only."""
+    from .expression import ApplyExpression, ColumnReference, IxExpression
+
+    if isinstance(e, IxExpression):
+        # ix lowers to a join against another table whose later updates
+        # retract and re-emit the looked-up value; _deps only carries the
+        # key expression, so answer for the hidden table conservatively
+        return False
+    if isinstance(e, ColumnReference):
+        tab = e._table
+        if not isinstance(tab, Table):
+            return False  # unresolved pw.this — resolver re-checks later
+        if e._name == "id":
+            return tab._universe_append_only
+        col = tab._columns.get(e._name)
+        return col.append_only if col is not None else False
+    if isinstance(e, ApplyExpression) and not e._deterministic:
+        return False
+    return all(_expr_append_only(d) for d in e._deps)
+
+
 def _named_exprs(table: Table, args, kwargs) -> dict[str, ColumnExpression]:
     from .thisclass import _WithoutSpec
 
@@ -916,7 +1000,9 @@ def _common_columns(tables: list[Table]) -> dict[str, Column]:
     cols = {}
     for n in names:
         d = tables[0]._columns[n].dtype
+        ao = tables[0]._columns[n].append_only
         for t in tables[1:]:
             d = dt.lub(d, t._columns[n].dtype)
-        cols[n] = Column(d)
+            ao = ao and t._columns[n].append_only
+        cols[n] = Column(d, append_only=ao)
     return cols

@@ -85,6 +85,13 @@ class StreamingContext:
         self.dtypes = schema.dtypes()
         self.names = list(self.dtypes.keys())
         self.pk = schema.primary_key_columns()
+        # append-only declaration: primary-keyed rows skip the upsert
+        # protocol (each key arrives exactly once, there is no old value
+        # to replace), matching the engine's no-retraction fast path
+        self.append_only = bool(schema.__properties__.append_only) or (
+            bool(schema.columns())
+            and all(d.append_only is True for d in schema.columns().values())
+        )
         import os
 
         self.process_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0") or 0)
@@ -118,7 +125,7 @@ class StreamingContext:
         # locked append as the row: a concurrent autocommit tick must not
         # commit the row with pre-row offsets (double-read on recovery)
         off = {"__seq__": seq[0], **(offsets or {})}
-        if self.pk:
+        if self.pk and not self.append_only:
             self.session.upsert(key, row, offsets=off)
             self._deletions[key] = row
         else:
@@ -224,12 +231,21 @@ def input_table_from_reader(
     reads on process 0 only and rows are forwarded by key shard."""
 
     dtypes = schema.dtypes()
+    # schema-declared append-only: class S(pw.Schema, append_only=True)
+    # or every column defined with column_definition(append_only=True).
+    # The engine trusts the declaration (like the reference's
+    # SessionType::Native sources) and skips retraction bookkeeping.
+    defs = schema.columns()
+    schema_ao = bool(schema.__properties__.append_only) or (
+        bool(defs) and all(d.append_only is True for d in defs.values())
+    )
 
     def build(engine: df.EngineGraph, runner) -> df.Node:
         node = df.SessionSourceNode(engine)
         node.persistent_id = persistent_id
         node.supports_offsets = supports_offsets
         node.parallel_readers = parallel_readers
+        node.append_only = schema_ao
         ctx = StreamingContext(node.session, schema)
         if parallel_readers and ctx.n_processes > 1:
             # each process logs its partition slice under its own
@@ -255,9 +271,18 @@ def input_table_from_reader(
         engine.connector_threads.append(t)
         return node
 
-    cols = {n: Column(t) for n, t in dtypes.items()}
+    cols = {
+        n: Column(
+            t,
+            append_only=schema_ao
+            or (n in defs and defs[n].append_only is True),
+        )
+        for n, t in dtypes.items()
+    }
     op = LogicalOp("connector", [], {"build": build})
-    return Table(cols, Universe(), op, name=name)
+    out = Table(cols, Universe(), op, name=name)
+    out._universe_append_only = schema_ao
+    return out
 
 
 def static_table_from_rows(

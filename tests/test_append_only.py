@@ -1,0 +1,216 @@
+"""Append-only property tracking: schema declarations flow through the
+logical plan (reference analogue: internals/column_properties.py +
+column.py context append_only rules), and the engine consumes the proof
+— insert-only sources skip upsert state, append-only sinks skip epoch
+consolidation, and a retraction into a declared append-only source is an
+error."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import dataflow as df
+
+
+def _static():
+    return pw.debug.table_from_markdown(
+        """
+          | name  | amount
+        1 | alice | 10
+        2 | bob   | 20
+        """
+    )
+
+
+def test_static_table_is_append_only():
+    t = _static()
+    assert t.is_append_only
+    assert all(c.append_only for c in t._columns.values())
+
+
+def test_update_stream_static_table_is_not_append_only():
+    t = pw.debug.table_from_markdown(
+        """
+          | v | __time__ | __diff__
+        1 | 1 | 2        | 1
+        1 | 1 | 4        | -1
+        """
+    )
+    assert not t.is_append_only
+
+
+def test_select_preserves_append_only():
+    t = _static()
+    out = t.select(x=pw.this.amount * 2, y=pw.this.name)
+    assert out.is_append_only
+    assert out._columns["x"].append_only
+
+
+def test_nondeterministic_udf_breaks_append_only():
+    t = _static()
+    out = t.select(
+        x=pw.apply(lambda v: v, pw.this.amount)  # deterministic default
+    )
+    assert out._columns["x"].append_only
+    from pathway_tpu.internals.expression import ApplyExpression
+
+    e = ApplyExpression(lambda v: v, int, (t.amount,), {}, deterministic=False)
+    out2 = t.select(x=e)
+    assert not out2._columns["x"].append_only
+    assert not out2.is_append_only
+
+
+def test_filter_with_append_only_predicate_preserves():
+    t = _static()
+    out = t.filter(pw.this.amount > 5)
+    assert out.is_append_only
+
+
+def test_groupby_is_not_append_only():
+    t = _static()
+    out = t.groupby(pw.this.name).reduce(
+        name=pw.this.name, s=pw.reducers.sum(pw.this.amount)
+    )
+    assert not out.is_append_only
+
+
+def test_concat_of_append_only_is_append_only():
+    a = _static()
+    b = pw.debug.table_from_markdown(
+        """
+          | name | amount
+        9 | carl | 30
+        """
+    )
+    assert a.concat_reindex(b).is_append_only
+
+
+def test_intersect_of_append_only_preserves():
+    a = _static()
+    b = _static()
+    assert a.intersect(b).is_append_only
+
+
+def test_deduplicate_is_not_append_only():
+    t = _static()
+    assert not t.deduplicate(value=pw.this.amount).is_append_only
+
+
+def test_schema_declaration_marks_connector_source():
+    class S(pw.Schema, append_only=True):
+        a: int
+        b: str
+
+    from pathway_tpu.io._connector import input_table_from_reader
+
+    t = input_table_from_reader(S, lambda ctx: None, name="src")
+    assert t.is_append_only
+    assert t.select(x=pw.this.a + 1).is_append_only
+
+
+def test_undeclared_connector_source_not_append_only():
+    class S(pw.Schema):
+        a: int
+
+    from pathway_tpu.io._connector import input_table_from_reader
+
+    t = input_table_from_reader(S, lambda ctx: None, name="src")
+    assert not t.is_append_only
+
+
+def test_append_only_source_skips_upsert_state():
+    """Engine consumption: a declared append-only source must not grow
+    the old-value dict (unbounded memory on long streams), and results
+    are identical to the consolidating path."""
+
+    class S(pw.Schema, append_only=True):
+        a: int
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(50):
+                self.next(a=i)
+
+    received = []
+    t = pw.io.python.read(Src(), schema=S)
+    assert t.is_append_only
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: received.append(
+            (row["a"], 1 if is_addition else -1)
+        ),
+    )
+    pw.run()
+    assert sorted(v for v, _ in received) == list(range(50))
+    assert all(d == 1 for _, d in received)
+
+
+def test_append_only_source_rejects_retraction():
+    # direct engine-level check: feed_batch refuses diff != 1
+    g = df.EngineGraph()
+    n = df.SessionSourceNode(g)
+    n.append_only = True
+    with pytest.raises(df.EngineError, match="append_only"):
+        n.feed_batch([(1, ("x",), 1), (2, ("y",), -1)], 0)
+    # and keeps no old-value state on the clean path
+    n.feed_batch([(1, ("x",), 1), (2, ("y",), 1)], 0)
+    assert n.state == {}
+
+
+def test_append_only_with_primary_key_runs_clean():
+    """A primary-keyed append-only schema must not trip the engine's
+    no-upsert guard: pk rows skip the upsert protocol entirely."""
+
+    class S(pw.Schema, append_only=True):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(10):
+                self.next(k=i, v=f"row{i}")
+
+    received = []
+    t = pw.io.python.read(Src(), schema=S)
+    assert t.is_append_only
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: received.append(
+            (row["k"], is_addition)
+        ),
+    )
+    pw.run()
+    assert sorted(k for k, _ in received) == list(range(10))
+    assert all(add for _, add in received)
+
+
+def test_ix_lookup_is_not_append_only():
+    """ix() joins against another table that can retract — an expression
+    containing it must never be marked append-only even when the key
+    expression is."""
+    src = _static()
+    other = pw.debug.table_from_markdown(
+        """
+          | w | __time__ | __diff__
+        1 | 5 | 2        | 1
+        1 | 6 | 4        | 1
+        1 | 5 | 4        | -1
+        """
+    )
+    from pathway_tpu.internals.expression import IxExpression
+    from pathway_tpu.internals.table import _expr_append_only
+
+    e = IxExpression(other, src.id, "w", optional=True)
+    assert not _expr_append_only(e)
+
+
+def test_append_only_pipeline_end_to_end():
+    """Full run through select+filter with append-only sinks gives the
+    same results as the consolidating path."""
+    t = _static()
+    out = t.filter(pw.this.amount >= 10).select(
+        name=pw.this.name, double=pw.this.amount * 2
+    )
+    assert out.is_append_only
+    keys, cols = pw.debug.table_to_dicts(out)
+    got = {cols["name"][k]: cols["double"][k] for k in keys}
+    assert got == {"alice": 20, "bob": 40}

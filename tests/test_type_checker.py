@@ -1,0 +1,224 @@
+"""Build-time type checking: incompatible operand types are rejected when
+the pipeline is constructed, not at runtime (reference behavior:
+python/pathway/internals/type_interpreter.py raises TypeError from
+eval_binary_op/eval_unary_op/eval_declare/eval_coalesce).
+
+ANY stays lenient: schema-less sources and untyped UDF results defer to
+runtime evaluation."""
+
+import datetime
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import dtype as dt
+
+
+def _t():
+    return pw.debug.table_from_markdown(
+        """
+          | name  | amount | score
+        1 | alice | 10     | 1.5
+        2 | bob   | 20     | 2.5
+        """
+    )
+
+
+# ---- binary operators ----
+
+
+def test_str_plus_int_rejected_at_build_time():
+    t = _t()
+    with pytest.raises(TypeError, match=r"operator '\+'.*STR.*INT"):
+        t.select(x=pw.this.name + pw.this.amount)
+
+
+def test_str_lt_int_rejected():
+    t = _t()
+    with pytest.raises(TypeError, match="not defined"):
+        t.select(x=pw.this.name < pw.this.amount)
+
+
+def test_eq_between_str_and_int_rejected():
+    t = _t()
+    with pytest.raises(TypeError):
+        t.select(x=pw.this.name == pw.this.amount)
+
+
+def test_bool_and_on_str_rejected():
+    t = _t()
+    with pytest.raises(TypeError):
+        t.select(x=pw.this.name & pw.this.name)
+
+
+def test_valid_arithmetic_still_works():
+    t = _t()
+    out = t.select(
+        a=pw.this.amount + 1,
+        b=pw.this.amount / 2,
+        c=pw.this.amount * pw.this.score,
+        d=pw.this.name + "!",
+        e=pw.this.amount == 10,
+        f=pw.this.name < "zzz",
+    )
+    assert out._columns["a"].dtype is dt.INT
+    assert out._columns["b"].dtype is dt.FLOAT
+    assert out._columns["c"].dtype is dt.FLOAT
+    assert out._columns["d"].dtype is dt.STR
+    assert out._columns["e"].dtype is dt.BOOL
+    assert out._columns["f"].dtype is dt.BOOL
+
+
+def test_int_float_mix_comparison_ok():
+    t = _t()
+    out = t.select(x=pw.this.amount < pw.this.score)
+    assert out._columns["x"].dtype is dt.BOOL
+
+
+def test_datetime_minus_datetime_is_duration():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(ts=datetime.datetime),
+        [(1, datetime.datetime(2026, 1, 1)), (2, datetime.datetime(2026, 1, 2))],
+    )
+    out = t.select(d=pw.this.ts - pw.this.ts)
+    assert out._columns["d"].dtype is dt.DURATION
+
+
+def test_datetime_plus_datetime_rejected():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(ts=datetime.datetime),
+        [(1, datetime.datetime(2026, 1, 1))],
+    )
+    with pytest.raises(TypeError):
+        t.select(d=pw.this.ts + pw.this.ts)
+
+
+def test_any_operand_stays_lenient():
+    t = _t()
+    u = t.select(x=pw.apply(lambda v: v, pw.this.name))  # untyped UDF -> ANY
+    out = u.select(y=pw.this.x + 1)  # ANY + INT defers to runtime
+    assert out._columns["y"].dtype is dt.ANY
+
+
+def test_error_raised_inside_select_with_this():
+    # pw.this refs resolve at select() time; the error must still fire
+    t = _t()
+    with pytest.raises(TypeError, match="not defined"):
+        t.select(x=pw.this.name * pw.this.name)
+
+
+# ---- unary operators ----
+
+
+def test_neg_str_rejected():
+    t = _t()
+    with pytest.raises(TypeError, match="unary"):
+        t.select(x=-pw.this.name)
+
+
+def test_invert_int_ok_neg_ok():
+    t = _t()
+    out = t.select(x=~pw.this.amount, y=-pw.this.amount)
+    assert out._columns["x"].dtype is dt.INT
+    assert out._columns["y"].dtype is dt.INT
+
+
+def test_invert_str_rejected():
+    t = _t()
+    with pytest.raises(TypeError, match="unary"):
+        t.select(x=~pw.this.name)
+
+
+# ---- if_else / coalesce / fill_error ----
+
+
+def test_if_else_non_bool_condition_rejected():
+    t = _t()
+    with pytest.raises(TypeError, match="condition"):
+        t.select(x=pw.if_else(pw.this.amount, 1, 2))
+
+
+def test_if_else_mismatched_branches_rejected():
+    t = _t()
+    with pytest.raises(TypeError, match="common type"):
+        t.select(x=pw.if_else(pw.this.amount > 5, pw.this.name, pw.this.amount))
+
+
+def test_if_else_int_float_branches_unify():
+    t = _t()
+    out = t.select(x=pw.if_else(pw.this.amount > 5, pw.this.amount, pw.this.score))
+    assert out._columns["x"].dtype is dt.FLOAT
+
+
+def test_coalesce_mismatched_rejected():
+    t = _t()
+    with pytest.raises(TypeError, match="coalesce"):
+        t.select(x=pw.coalesce(pw.this.name, pw.this.amount))
+
+
+def test_coalesce_compatible_ok():
+    t = _t()
+    out = t.select(x=pw.coalesce(pw.this.amount, 0))
+    assert out._columns["x"].dtype is dt.INT
+
+
+def test_fill_error_mismatched_replacement_rejected():
+    t = _t()
+    with pytest.raises(TypeError, match="fill_error"):
+        t.select(x=pw.fill_error(pw.this.amount, "oops"))
+
+
+# ---- declare_type ----
+
+
+def test_declare_type_narrowing_ok():
+    t = _t()
+    u = t.select(x=pw.apply(lambda v: v, pw.this.amount))  # ANY
+    out = u.select(y=pw.declare_type(int, pw.this.x))
+    assert out._columns["y"].dtype is dt.INT
+
+
+def test_declare_type_optional_narrowing_ok():
+    t = _t()
+    u = t.select(x=pw.cast(dt.Optional(dt.INT), pw.this.amount))
+    out = u.select(y=pw.declare_type(int, pw.this.x))
+    assert out._columns["y"].dtype is dt.INT
+
+
+def test_declare_type_cross_cast_rejected():
+    t = _t()
+    with pytest.raises(TypeError, match="declare_type"):
+        t.select(x=pw.declare_type(str, pw.this.amount))
+
+
+# ---- sequence get ----
+
+
+def test_tuple_str_index_rejected():
+    t = _t()
+    u = t.select(x=pw.make_tuple(pw.this.amount, pw.this.score))
+    with pytest.raises(TypeError, match="sequence index"):
+        u.select(y=pw.this.x["nope"])
+
+
+def test_tuple_int_index_typed():
+    t = _t()
+    u = t.select(x=pw.make_tuple(pw.this.amount, pw.this.score))
+    out = u.select(y=pw.this.x[0], z=pw.this.x[1])
+    assert out._columns["y"].dtype is dt.INT
+    assert out._columns["z"].dtype is dt.FLOAT
+
+
+# ---- the checks don't break runtime evaluation ----
+
+
+def test_checked_pipeline_still_computes():
+    t = _t()
+    out = t.select(
+        n=pw.this.name,
+        double=pw.this.amount * 2,
+        label=pw.if_else(pw.this.amount > 15, "big", "small"),
+    )
+    keys, cols = pw.debug.table_to_dicts(out)
+    rows = {cols["n"][k]: (cols["double"][k], cols["label"][k]) for k in keys}
+    assert rows == {"alice": (20, "small"), "bob": (40, "big")}
