@@ -408,16 +408,26 @@ class SessionSourceNode(Node):
         if self.append_only:
             # declared insert-only: upsert resolution can never trigger
             # and the old-value state dict would only grow — skip both
-            # it and consolidation. A retraction here is a broken
-            # declaration, not data: fail loudly (the reference errors
-            # on deletions into append-only inputs too).
-            if any(d != 1 for _k, _r, d in raw):
-                raise EngineError(
-                    f"source {self.name!r} is declared append_only but "
-                    "produced a retraction or upsert"
-                )
-            self.emit(raw, time)
-            return raw
+            # it and consolidation. Scanner connectors speak the upsert
+            # wire protocol (diff=2) even for brand-new rows, so a
+            # marker WITH a row is just an insert of a fresh key here;
+            # a deletion (diff<=0, or a marker without a row) is a
+            # broken declaration, not data: fail loudly (the reference
+            # errors on deletions into append-only inputs too).
+            if all(d == 1 for _k, _r, d in raw):
+                self.emit(raw, time)
+                return raw
+            out: list[Update] = []
+            for key, row, diff in raw:
+                if diff == 1 or (diff == 2 and row is not None):
+                    out.append((key, row, 1))
+                else:
+                    raise EngineError(
+                        f"source {self.name!r} is declared append_only "
+                        "but produced a retraction"
+                    )
+            self.emit(out, time)
+            return out
         out: list[Update] = []
         for key, row, diff in raw:
             if diff == 2:  # upsert marker

@@ -186,6 +186,19 @@ def _dtype_from_str(ann: str) -> dt.DType:
     return simple.get(ann, dt.ANY)
 
 
+def schema_is_append_only(schema: "SchemaMetaclass") -> bool:
+    """One predicate for both halves of the append-only contract: the
+    connector wire protocol (plain inserts instead of upserts) and the
+    engine's no-retraction fast path key off the SAME answer, so a
+    schema can never emit upserts into a node that refuses them.
+    Declared via ``class S(pw.Schema, append_only=True)`` or by marking
+    every column ``column_definition(append_only=True)``."""
+    if bool(schema.__properties__.append_only):
+        return True
+    defs = schema.columns()
+    return bool(defs) and all(d.append_only is True for d in defs.values())
+
+
 class Schema(metaclass=SchemaMetaclass):
     """Base schema class. Subclass with annotations:
 
@@ -261,5 +274,9 @@ def schema_from_pandas(
 def schema_from_csv(path: str, *, name: str | None = None, **kwargs) -> type[Schema]:
     import pandas as pd
 
-    df = pd.read_csv(path, nrows=100, **{k: v for k, v in kwargs.items() if k in ("sep", "quotechar")})
+    df = pd.read_csv(
+        path,
+        nrows=100,
+        **{k: v for k, v in kwargs.items() if k in ("sep", "quotechar", "comment", "escapechar")},
+    )
     return schema_from_pandas(df, name=name or "schema_from_csv")

@@ -12,8 +12,9 @@ and gated on their client libraries only for real deployments."""
 from __future__ import annotations
 
 from . import csv, fs, jsonlines, null, plaintext, python
-from ._subscribe import subscribe
+from ._subscribe import OnChangeCallback, OnFinishCallback, subscribe
 from ._connector import add_output_sink
+from ._formats import CsvParserSettings
 
 # service-backed connectors (client libs needed only at run time)
 from . import kafka, s3, s3_csv, minio, elasticsearch, postgres, debezium, mongodb
@@ -21,6 +22,9 @@ from . import redpanda, nats, gdrive, sqlite, deltalake, bigquery, pubsub, logst
 from . import airbyte, http, pyfilesystem, slack
 
 __all__ = [
+    "CsvParserSettings",
+    "OnChangeCallback",
+    "OnFinishCallback",
     "add_output_sink",
     "airbyte",
     "bigquery",

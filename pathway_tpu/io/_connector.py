@@ -88,10 +88,9 @@ class StreamingContext:
         # append-only declaration: primary-keyed rows skip the upsert
         # protocol (each key arrives exactly once, there is no old value
         # to replace), matching the engine's no-retraction fast path
-        self.append_only = bool(schema.__properties__.append_only) or (
-            bool(schema.columns())
-            and all(d.append_only is True for d in schema.columns().values())
-        )
+        from ..internals.schema import schema_is_append_only
+
+        self.append_only = schema_is_append_only(schema)
         import os
 
         self.process_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0") or 0)
@@ -231,14 +230,13 @@ def input_table_from_reader(
     reads on process 0 only and rows are forwarded by key shard."""
 
     dtypes = schema.dtypes()
-    # schema-declared append-only: class S(pw.Schema, append_only=True)
-    # or every column defined with column_definition(append_only=True).
-    # The engine trusts the declaration (like the reference's
-    # SessionType::Native sources) and skips retraction bookkeeping.
+    # schema-declared append-only: the engine trusts the declaration
+    # (like the reference's SessionType::Native sources) and skips
+    # retraction bookkeeping
+    from ..internals.schema import schema_is_append_only
+
     defs = schema.columns()
-    schema_ao = bool(schema.__properties__.append_only) or (
-        bool(defs) and all(d.append_only is True for d in defs.values())
-    )
+    schema_ao = schema_is_append_only(schema)
 
     def build(engine: df.EngineGraph, runner) -> df.Node:
         node = df.SessionSourceNode(engine)
@@ -301,7 +299,15 @@ def static_table_from_rows(
         records.append((key, coerce_to_schema(values, dtypes), 0, 1))
     cols = {n: Column(t) for n, t in dtypes.items()}
     op = LogicalOp("static", [], {"rows": records})
-    return Table(cols, Universe(), op, name=name)
+    out = Table(cols, Universe(), op, name=name)
+    # static snapshots are pure distinct-key inserts unless primary-key
+    # collisions make later rows upserts of earlier ones
+    keys = [r[0] for r in records]
+    if len(set(keys)) == len(keys):
+        out._universe_append_only = True
+        for c in out._columns.values():
+            c.append_only = True
+    return out
 
 
 def add_output_sink(

@@ -71,19 +71,89 @@ class JsonLinesParser:
         return [("insert", rec)]
 
 
-class DsvParser:
-    """Delimiter-separated values with a header (data_format.rs :500)."""
+class CsvParserSettings:
+    """CSV dialect configuration (reference io/_utils.py:125 wrapping the
+    engine-side parser options). Accepted by ``pw.io.csv.read`` /
+    ``pw.io.s3_csv.read`` as ``csv_settings=`` and by :class:`DsvParser`.
 
-    def __init__(self, field_names: list[str] | None = None, separator: str = ","):
+    Args:
+        delimiter: field separator.
+        quote: quote character wrapping fields that contain the
+            delimiter or newlines.
+        escape: escape character inside quoted fields (None = rely on
+            doubled quotes).
+        enable_double_quote_escapes: treat ``""`` inside a quoted field
+            as a literal quote.
+        enable_quoting: honor the quote character at all; off = split
+            on raw delimiters.
+        comment_character: lines starting with this character are
+            skipped entirely.
+    """
+
+    def __init__(
+        self,
+        delimiter: str = ",",
+        quote: str = '"',
+        escape: str | None = None,
+        enable_double_quote_escapes: bool = True,
+        enable_quoting: bool = True,
+        comment_character: str | None = None,
+    ):
+        self.delimiter = delimiter
+        self.quote = quote
+        self.escape = escape
+        self.enable_double_quote_escapes = enable_double_quote_escapes
+        self.enable_quoting = enable_quoting
+        self.comment_character = comment_character
+
+    def reader_kwargs(self) -> dict:
+        """Options for Python's csv module readers."""
+        import csv as _pycsv
+
+        return {
+            "delimiter": self.delimiter,
+            "quotechar": self.quote,
+            "escapechar": self.escape,
+            "doublequote": self.enable_double_quote_escapes,
+            "quoting": _pycsv.QUOTE_MINIMAL if self.enable_quoting else _pycsv.QUOTE_NONE,
+        }
+
+
+class DsvParser:
+    """Delimiter-separated values with a header (data_format.rs :500).
+    Quote/escape/comment handling comes from ``settings``; the plain
+    ``separator`` shorthand keeps the naive fast path."""
+
+    def __init__(
+        self,
+        field_names: list[str] | None = None,
+        separator: str = ",",
+        settings: CsvParserSettings | None = None,
+    ):
         self.field_names = field_names
-        self.separator = separator
+        self.settings = settings
+        self.separator = settings.delimiter if settings is not None else separator
         self._header: list[str] | None = list(field_names) if field_names else None
         self._expects_header = field_names is None
+
+    def _split(self, line: str) -> list[str]:
+        if self.settings is None:
+            return line.split(self.separator)
+        import csv as _pycsv
+
+        return next(_pycsv.reader([line], **self.settings.reader_kwargs()))
 
     def parse(self, payload: bytes | str) -> list[tuple[str, dict]]:
         if isinstance(payload, bytes):
             payload = payload.decode()
-        parts = payload.rstrip("\r\n").split(self.separator)
+        line = payload.rstrip("\r\n")
+        if (
+            self.settings is not None
+            and self.settings.comment_character
+            and line.startswith(self.settings.comment_character)
+        ):
+            return []
+        parts = self._split(line)
         if self._expects_header and self._header is None:
             self._header = parts
             return []

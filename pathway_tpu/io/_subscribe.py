@@ -2,10 +2,26 @@
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable, Protocol, runtime_checkable
 
 from ..internals.parse_graph import G
 from ..internals.table import Table
+
+
+@runtime_checkable
+class OnChangeCallback(Protocol):
+    """Signature expected by ``pw.io.subscribe``'s ``on_change``."""
+
+    def __call__(
+        self, key: Any, row: dict, time: int, is_addition: bool
+    ) -> Any: ...
+
+
+@runtime_checkable
+class OnFinishCallback(Protocol):
+    """Signature expected by ``pw.io.subscribe``'s ``on_end``."""
+
+    def __call__(self) -> Any: ...
 
 
 def subscribe(

@@ -28,7 +28,20 @@ def read(
             if not files:
                 raise ValueError(f"csv.read: no files found at {path!r} to infer schema; pass schema=")
             probe = files[0]
-        schema = schema_from_csv(probe)
+        settings = kwargs.get("csv_settings")
+        dialect = (
+            {
+                "sep": settings.delimiter,
+                "quotechar": settings.quote,
+                "comment": settings.comment_character,
+                "escapechar": settings.escape,
+            }
+            if settings is not None
+            else {}
+        )
+        schema = schema_from_csv(
+            probe, **{k: v for k, v in dialect.items() if v is not None}
+        )
     return _fs.read(
         path,
         format="csv",

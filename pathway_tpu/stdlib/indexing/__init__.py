@@ -16,8 +16,12 @@ from .nearest_neighbors import (
     UsearchKnn,
     UsearchKnnFactory,
 )
+
+# reference capitalization alias (stdlib/indexing/nearest_neighbors.py:65)
+USearchKnn = UsearchKnn
 from .retrievers import AbstractRetrieverFactory, InnerIndexFactory
 from .sorting import (
+    SortedIndex,
     build_sorted_index,
     retrieve_prev_next_values,
     sort_from_index,
@@ -43,6 +47,7 @@ __all__ = [
     "KnnIndexFactory",
     "LshKnn",
     "LshKnnFactory",
+    "USearchKnn",
     "UsearchKnn",
     "UsearchKnnFactory",
     "USearchMetricKind",
@@ -56,6 +61,7 @@ __all__ = [
     "default_usearch_knn_document_index",
     "default_lsh_knn_document_index",
     "default_full_text_document_index",
+    "SortedIndex",
     "build_sorted_index",
     "sort_from_index",
     "retrieve_prev_next_values",

@@ -20,13 +20,22 @@ instance's tree.
 from __future__ import annotations
 
 import hashlib
-from typing import Any
+from typing import Any, TypedDict
 
 import pathway_tpu as pw
 from ... import reducers
 from ...internals import thisclass
 from ...internals.expression import ColumnReference
 from ...internals.table import Table
+
+
+class SortedIndex(TypedDict):
+    """Shape of ``build_sorted_index``'s result (reference
+    sorting.py:85): ``index`` — one row per node with left/right/parent
+    pointers; ``oracle`` — the root per instance."""
+
+    index: Table
+    oracle: Table
 
 
 def hash(val) -> int:

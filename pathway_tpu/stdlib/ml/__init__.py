@@ -1,7 +1,7 @@
 """pw.ml (reference stdlib/ml/): index (KNN), classifiers (LSH),
 smart_table_ops (fuzzy join), hmm, datasets."""
 
-from . import classifiers, datasets, hmm, index, smart_table_ops
+from . import classifiers, datasets, hmm, index, smart_table_ops, utils
 from .hmm import create_hmm_reducer
 from .index import KNNIndex, DistanceTypes
 from .smart_table_ops import (
@@ -14,6 +14,7 @@ from .smart_table_ops import (
 
 __all__ = [
     "classifiers",
+    "utils",
     "datasets",
     "create_hmm_reducer",
     "DistanceTypes",

@@ -33,7 +33,17 @@ from ._joins import (
     window_join_right,
     window_join_outer,
     Direction,
+    Interval,
 )
+
+# public names for the join-result types (reference exports these for
+# annotations/isinstance; interval and window joins share one result
+# implementation here, asof_now returns the core JoinResult)
+from ._joins import _AsofJoinResult as AsofJoinResult
+from ._joins import _TemporalJoinResult as IntervalJoinResult
+from ._joins import _TemporalJoinResult as WindowJoinResult
+from ...internals.table import JoinResult as AsofNowJoinResult
+from .time_utils import inactivity_detection, utc_now
 from .temporal_behavior import (
     Behavior,
     CommonBehavior,
@@ -43,11 +53,18 @@ from .temporal_behavior import (
 )
 
 __all__ = [
+    "AsofJoinResult",
+    "AsofNowJoinResult",
     "Behavior",
     "CommonBehavior",
     "Direction",
     "ExactlyOnceBehavior",
+    "Interval",
+    "IntervalJoinResult",
     "Window",
+    "WindowJoinResult",
+    "inactivity_detection",
+    "utc_now",
     "asof_join",
     "asof_join_left",
     "asof_join_outer",

@@ -83,7 +83,22 @@ class SentenceTransformerEmbedder(BaseEmbedder):
 
 class OpenAIEmbedder(BaseEmbedder):
     """OpenAI `embeddings.create` wrapper (reference embedders.py:85).
-    Network calls require the `openai` package and an API key."""
+    Network calls require the `openai` package and an API key.
+
+    Args:
+        capacity: max concurrent in-flight requests; None = unbounded.
+            Rows queue in the async executor beyond this.
+        retry_strategy: a ``udfs.AsyncRetryStrategy`` applied per request
+            (e.g. ``udfs.ExponentialBackoffRetryStrategy``); None = fail
+            on first error, routing the row to the error log.
+        cache_strategy: a ``udfs.CacheStrategy`` memoizing responses by
+            input text — on a restart, previously embedded documents are
+            served from the cache instead of re-billed.
+        model: embedding model id; forwarded with every request.
+        **openai_kwargs: forwarded verbatim to ``embeddings.create``
+            (plus ``api_key``/``base_url``, which configure the shared
+            client).
+    """
 
     def __init__(
         self,
@@ -107,15 +122,20 @@ class OpenAIEmbedder(BaseEmbedder):
             raise ImportError("OpenAIEmbedder requires the openai package") from e
         kwargs = {**self.kwargs, **kwargs}
         api_kwargs = {k: v for k, v in kwargs.items() if k not in ("api_key", "base_url")}
-        client = openai.AsyncOpenAI(
-            api_key=kwargs.get("api_key"), base_url=kwargs.get("base_url")
-        )
+        from ._utils import shared_openai_client
+
+        client = shared_openai_client(kwargs.get("api_key"), kwargs.get("base_url"))
         ret = await client.embeddings.create(input=[input or "."], **api_kwargs)
         return np.array(ret.data[0].embedding)
 
 
 class LiteLLMEmbedder(BaseEmbedder):
-    """litellm.aembedding wrapper (reference embedders.py:180)."""
+    """litellm.aembedding wrapper (reference embedders.py:180): one class
+    fronting every provider litellm routes to (``model`` picks the
+    provider, e.g. ``"ollama/llama2"``). Same ``capacity`` /
+    ``retry_strategy`` / ``cache_strategy`` semantics as
+    :class:`OpenAIEmbedder`; extra kwargs go to ``litellm.aembedding``
+    verbatim (``api_base``, ``api_version``, ...)."""
 
     def __init__(
         self,
@@ -141,7 +161,11 @@ class LiteLLMEmbedder(BaseEmbedder):
 
 
 class GeminiEmbedder(BaseEmbedder):
-    """google.generativeai embed_content wrapper (reference embedders.py:330)."""
+    """google.generativeai ``embed_content`` wrapper (reference
+    embedders.py:330). Same ``capacity`` / ``retry_strategy`` /
+    ``cache_strategy`` semantics as :class:`OpenAIEmbedder`; extra
+    kwargs (``task_type``, ``output_dimensionality``, ...) forward to
+    ``embed_content`` verbatim."""
 
     def __init__(
         self,

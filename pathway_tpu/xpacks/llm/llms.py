@@ -228,8 +228,10 @@ class OpenAIChat(BaseChat):
                 ensure_ascii=False,
             )
         )
-        client = openai.AsyncOpenAI(
-            api_key=kwargs.pop("api_key", None), base_url=kwargs.pop("base_url", None)
+        from ._utils import shared_openai_client
+
+        client = shared_openai_client(
+            kwargs.pop("api_key", None), kwargs.pop("base_url", None)
         )
         try:
             ret = await client.chat.completions.create(messages=messages, **kwargs)

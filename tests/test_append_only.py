@@ -203,6 +203,40 @@ def test_ix_lookup_is_not_append_only():
     assert not _expr_append_only(e)
 
 
+def test_append_only_scanner_connector_runs_clean(tmp_path):
+    """File-scanner connectors speak the upsert wire protocol (diff=2)
+    even for fresh rows — an append-only schema must treat those as
+    inserts, not crash (review finding r5)."""
+
+    class S(pw.Schema, append_only=True):
+        a: int
+        b: str
+
+    import json as _json
+
+    with open(tmp_path / "rows.jsonl", "w") as f:
+        for i in range(5):
+            f.write(_json.dumps({"a": i, "b": f"r{i}"}) + "\n")
+
+    t = pw.io.jsonlines.read(str(tmp_path), schema=S, mode="static")
+    assert t.is_append_only
+    keys, cols = pw.debug.table_to_dicts(t.select(a=pw.this.a))
+    assert sorted(cols["a"][k] for k in keys) == list(range(5))
+
+
+def test_append_only_scanner_streaming_upsert_markers():
+    """Engine-level: diff=2 markers WITH a row pass the append-only fast
+    path as inserts; markers without a row (deletions) are refused."""
+    g = df.EngineGraph()
+    n = df.SessionSourceNode(g)
+    n.append_only = True
+    out = n.feed_batch([(1, ("x",), 2), (2, ("y",), 1)], 0)
+    assert [(k, d) for k, _r, d in out] == [(1, 1), (2, 1)]
+    assert n.state == {}
+    with pytest.raises(df.EngineError, match="append_only"):
+        n.feed_batch([(3, None, 2)], 0)
+
+
 def test_append_only_pipeline_end_to_end():
     """Full run through select+filter with append-only sinks gives the
     same results as the consolidating path."""

@@ -77,13 +77,18 @@ PROGRAM = textwrap.dedent(
         word: str
 
     backend_kind = os.environ["WC_BACKEND"]
-    if backend_kind == "filesystem":
+    if os.environ.get("WC_USE_ENV_PERSISTENCE"):
+        # persistence comes from PATHWAY_REPLAY_STORAGE/_MODE env (the
+        # CLI record/replay path) instead of an explicit config
+        cfg = None
+    elif backend_kind == "filesystem":
         backend = pw.persistence.Backend.filesystem(os.environ["WC_PSTORE"])
+        cfg = pw.persistence.Config.simple_config(backend)
     else:
         backend = pw.persistence.Backend.s3(
             "s3://bucket/pstore", _client=DiskS3(os.environ["WC_PSTORE"])
         )
-    cfg = pw.persistence.Config.simple_config(backend)
+        cfg = pw.persistence.Config.simple_config(backend)
 
     t = pw.io.jsonlines.read(
         os.environ["WC_IN"], schema=S, mode="streaming",
@@ -131,7 +136,7 @@ def _write_words(d, fname, words):
             f.write(json.dumps({"word": w}) + "\n")
 
 
-def _start(tmp, tag: str, backend: str):
+def _start(tmp, tag: str, backend: str, extra_env: dict | None = None):
     prog = tmp / "wc.py"
     prog.write_text(PROGRAM.format(fake_s3=FAKE_S3 if backend == "s3" else ""))
     env = dict(os.environ)
@@ -144,6 +149,7 @@ def _start(tmp, tag: str, backend: str):
         JAX_PLATFORMS="cpu",
         PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
     )
+    env.update(extra_env or {})
     return subprocess.Popen(
         [sys.executable, str(prog)],
         env=env,
@@ -201,6 +207,107 @@ def test_crash_recovery_wordcount(tmp_path, backend):
         if p2.poll() is None:
             p2.kill()
     assert _strict_apply([out1, out2]) == {"cat": 2, "dog": 2, "emu": 1}
+
+
+def test_crash_recovery_wordcount_sharded(tmp_path):
+    """The multi-worker × persistence × crash cross-product: the same
+    SIGKILL-mid-stream scenario under PATHWAY_THREADS=4 (key-sharded
+    workers). Recovery must restore sharded groupby state and keep the
+    sink exactly-once, identically to the single-worker run."""
+    threads = {"PATHWAY_THREADS": "4"}
+    (tmp_path / "in").mkdir()
+    words1 = ["cat", "dog", "cat", "emu", "fox", "dog", "cat", "ant"] * 5
+    _write_words(tmp_path / "in", "a.jsonl", words1)
+    p1, out1, _stop1 = _start(tmp_path, "run1", "filesystem", threads)
+    try:
+        _wait_for_events(out1, 3)
+        os.kill(p1.pid, signal.SIGKILL)
+        p1.wait(timeout=30)
+    finally:
+        if p1.poll() is None:
+            p1.kill()
+
+    words2 = ["dog", "emu", "gnu", "cat"] * 3
+    _write_words(tmp_path / "in", "b.jsonl", words2)
+    p2, out2, stop2 = _start(tmp_path, "run2", "filesystem", threads)
+    try:
+        _wait_for_events(out2, 1)
+        want: dict[str, int] = {}
+        for w in words1 + words2:
+            want[w] = want.get(w, 0) + 1
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if _strict_apply([out1, out2]) == want:
+                break
+            time.sleep(0.2)
+        open(stop2, "w").close()
+        p2.wait(timeout=30)
+    finally:
+        if p2.poll() is None:
+            p2.kill()
+    assert _strict_apply([out1, out2]) == want
+
+
+def test_sharded_replay_after_crash_matches(tmp_path):
+    """Record a live sharded (4-worker) run, then speedrun-replay the
+    persisted stream under BOTH 1 and 4 workers: each replay's final
+    state must equal the live run's (replay × worker-count
+    cross-product; reference PersistenceMode::SpeedrunReplay works under
+    any worker config, src/connectors/mod.rs:108)."""
+    rec_store = str(tmp_path / "recstore")
+    threads4 = {
+        "PATHWAY_THREADS": "4",
+        "WC_USE_ENV_PERSISTENCE": "1",
+        "PATHWAY_REPLAY_STORAGE": rec_store,
+        "PATHWAY_REPLAY_MODE": "record",
+    }
+    (tmp_path / "in").mkdir()
+    words = ["red", "blue", "red", "green", "blue", "red"] * 4
+    _write_words(tmp_path / "in", "a.jsonl", words)
+    p1, out1, stop1 = _start(tmp_path, "live", "filesystem", threads4)
+    try:
+        want = {}
+        for w in words:
+            want[w] = want.get(w, 0) + 1
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                if _strict_apply([out1]) == want:
+                    break
+            except AssertionError:
+                pass
+            time.sleep(0.2)
+        open(stop1, "w").close()
+        p1.wait(timeout=30)
+    finally:
+        if p1.poll() is None:
+            p1.kill()
+    assert _strict_apply([out1]) == want
+
+    for n_workers in ("1", "4"):
+        replay_env = {
+            "PATHWAY_THREADS": n_workers,
+            "WC_USE_ENV_PERSISTENCE": "1",
+            "PATHWAY_REPLAY_STORAGE": rec_store,
+            "PATHWAY_REPLAY_MODE": "speedrun",
+        }
+        tag = f"replay{n_workers}"
+        p, out, stop = _start(tmp_path, tag, "filesystem", replay_env)
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    if _strict_apply([out]) == want:
+                        break
+                except AssertionError:
+                    pass
+                time.sleep(0.2)
+            open(stop, "w").close()
+            p.wait(timeout=30)
+        finally:
+            if p.poll() is None:
+                p.kill()
+        assert _strict_apply([out]) == want, f"replay with {n_workers} workers"
 
 
 # ---------------------------------------------------------------------------
