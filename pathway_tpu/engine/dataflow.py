@@ -359,7 +359,7 @@ class SessionSourceNode(Node):
     snapshot protocols."""
 
     n_inputs = 0
-    _snap_attrs = ("state",)
+    _snap_attrs = ("state", "_ao_seen")
 
     def __init__(self, graph):
         super().__init__(graph)
@@ -373,6 +373,9 @@ class SessionSourceNode(Node):
         # termination and are not recorded by persistence
         self.is_error_log = False
         self.last_offsets: dict | None = None
+        # append-only fast path: keys already ingested (dedupes scanner
+        # re-emissions without storing row values)
+        self._ao_seen: set[int] = set()
         # recovery: finalized batches to replay, in time order
         self.replay_batches: list[tuple[int, list[Update]]] = []
         graph.session_sources.append(self)
@@ -397,30 +400,40 @@ class SessionSourceNode(Node):
         return fed
 
     def _apply_replay(self, ups, time) -> None:
-        for key, row, diff in ups:
-            if diff > 0:
-                self.state[key] = row
-            else:
-                self.state.pop(key, None)
+        if self.append_only:
+            # recovered keys must count as seen or a post-restart
+            # scanner re-emission would duplicate them; the old-value
+            # dict stays empty, as on the live path
+            self._ao_seen.update(k for k, _r, d in ups if d > 0)
+        else:
+            for key, row, diff in ups:
+                if diff > 0:
+                    self.state[key] = row
+                else:
+                    self.state.pop(key, None)
         self.emit(list(ups), time)
 
     def feed_batch(self, raw: list[Update], time) -> list[Update]:
         if self.append_only:
-            # declared insert-only: upsert resolution can never trigger
-            # and the old-value state dict would only grow — skip both
-            # it and consolidation. Scanner connectors speak the upsert
-            # wire protocol (diff=2) even for brand-new rows, so a
-            # marker WITH a row is just an insert of a fresh key here;
-            # a deletion (diff<=0, or a marker without a row) is a
-            # broken declaration, not data: fail loudly (the reference
-            # errors on deletions into append-only inputs too).
-            if all(d == 1 for _k, _r, d in raw):
-                self.emit(raw, time)
-                return raw
+            # declared insert-only: upsert resolution can never trigger,
+            # so the old-VALUE dict is skipped; only a key SET remains
+            # (~10-20x lighter than storing rows) because scanner
+            # connectors re-emit every (path, i) key of a modified file
+            # via the upsert wire protocol (diff=2) — an already-seen
+            # key is an idempotent re-emission to drop, a fresh key is
+            # an insert. A deletion (diff<=0, or a marker without a
+            # row) is a broken declaration, not data: fail loudly (the
+            # reference errors on deletions into append-only inputs
+            # too). A re-emitted key with CHANGED row content would be
+            # an in-place update — undetectable without storing values;
+            # the declaration is trusted, as at every other fast path.
+            seen = self._ao_seen
             out: list[Update] = []
             for key, row, diff in raw:
                 if diff == 1 or (diff == 2 and row is not None):
-                    out.append((key, row, 1))
+                    if key not in seen:
+                        seen.add(key)
+                        out.append((key, row, 1))
                 else:
                     raise EngineError(
                         f"source {self.name!r} is declared append_only "

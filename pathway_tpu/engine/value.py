@@ -173,7 +173,17 @@ def _serialize_for_hash(value: Any, out: bytearray) -> bool:
     elif isinstance(value, bool) or isinstance(value, np.bool_):
         out += b"\x01" + (b"\x01" if value else b"\x00")
     elif isinstance(value, (int, np.integer)):
-        out += b"\x02" + struct.pack("<q", int(value))
+        iv = int(value)
+        if -(2**63) <= iv < 2**63:
+            out += b"\x02" + struct.pack("<q", iv)
+        else:
+            # Python ints are unbounded (a uint64-backed id column read
+            # back as a row value already exceeds i64); wide ints get a
+            # length-prefixed two's-complement encoding under their OWN
+            # tag — reusing \x02 would make the stream ambiguous with a
+            # small int whose first packed byte collides
+            b = iv.to_bytes((iv.bit_length() + 8) // 8, "little", signed=True)
+            out += b"\x0d" + struct.pack("<I", len(b)) + b
     elif isinstance(value, (float, np.floating)):
         f = float(value)
         if f != f:

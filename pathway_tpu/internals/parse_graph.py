@@ -18,6 +18,10 @@ class ParseGraph:
         self.outputs: list[tuple["Table", dict]] = []  # (table, sink spec)
         self.subscriptions: list[dict] = []
         self.error_log_tables: list["Table"] = []
+        # bumped on every clear(): per-program caches (e.g. the shared
+        # utc_now clock table) key on this so a cleared graph never
+        # serves tables built for a discarded program
+        self.generation = 0
 
     def register(self, table: "Table") -> None:
         self.tables.append(table)
@@ -33,6 +37,7 @@ class ParseGraph:
         self.outputs.clear()
         self.subscriptions.clear()
         self.error_log_tables.clear()
+        self.generation += 1
 
 
 G = ParseGraph()

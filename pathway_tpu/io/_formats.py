@@ -119,6 +119,48 @@ class CsvParserSettings:
         }
 
 
+def csv_reader_source(lines, csv_settings, raw_kwargs: dict):
+    """Shared dialect plumbing for every CSV-reading connector (fs,
+    s3/minio object store): returns ``(line_iterable, DictReader
+    kwargs)`` honoring ``csv_settings`` — including quote-aware comment
+    skipping — or the legacy raw ``delimiter``/``quotechar`` kwargs."""
+    if csv_settings is None:
+        return lines, {
+            k: v for k, v in raw_kwargs.items() if k in ("delimiter", "quotechar")
+        }
+    dialect = csv_settings.reader_kwargs()
+    comment_char = csv_settings.comment_character
+    if not comment_char:
+        return lines, dialect
+
+    quote = csv_settings.quote
+    escape = csv_settings.escape
+    quoting = csv_settings.enable_quoting
+
+    def skip_comments(src):
+        # a comment line only counts OUTSIDE a quoted field — a
+        # multi-line quoted value whose continuation happens to start
+        # with the comment char is data. Under QUOTE_NONE the quote
+        # char is literal data: no tracking at all.
+        in_quote = False
+        for ln in src:
+            if not in_quote and ln.startswith(comment_char):
+                continue
+            if quoting:
+                i, n = 0, len(ln)
+                while i < n:
+                    c = ln[i]
+                    if escape and c == escape:
+                        i += 2
+                        continue
+                    if c == quote:
+                        in_quote = not in_quote
+                    i += 1
+            yield ln
+
+    return skip_comments(lines), dialect
+
+
 class DsvParser:
     """Delimiter-separated values with a header (data_format.rs :500).
     Quote/escape/comment handling comes from ``settings``; the plain

@@ -154,10 +154,14 @@ def rows_from_payload(
                 {"data": line} for line in text.splitlines() if line
             )
     elif format == "csv":
-        reader = _csv.DictReader(
+        from ._formats import csv_reader_source
+
+        src, dialect = csv_reader_source(
             _io.StringIO(payload.decode(errors="replace")),
-            **{k: v for k, v in kwargs.items() if k in ("delimiter", "quotechar")},
+            kwargs.get("csv_settings"),
+            kwargs,
         )
+        reader = _csv.DictReader(src, **dialect)
         rows.extend(dict(rec) for rec in reader)
     elif format in ("json", "jsonlines"):
         for line in payload.decode(errors="replace").splitlines():

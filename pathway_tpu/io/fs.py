@@ -101,42 +101,10 @@ def _rows_for_file(fpath: str, format: str, schema, with_metadata: bool, **kwarg
                 row["_metadata"] = _metadata(fpath)
             yield row
     elif format == "csv":
-        csv_settings = kwargs.get("csv_settings")
-        if csv_settings is not None:
-            dialect = csv_settings.reader_kwargs()
-        else:
-            dialect = {k: v for k, v in kwargs.items() if k in ("delimiter", "quotechar")}
-        comment_char = getattr(csv_settings, "comment_character", None)
-
-        def _skip_comments(lines, quote, escape):
-            # a comment line only counts OUTSIDE a quoted field — a
-            # multi-line quoted value whose continuation happens to
-            # start with the comment char is data, not a comment
-            in_quote = False
-            for ln in lines:
-                if not in_quote and ln.startswith(comment_char):
-                    continue
-                i, n = 0, len(ln)
-                while i < n:
-                    c = ln[i]
-                    if escape and c == escape:
-                        i += 2
-                        continue
-                    if c == quote:
-                        in_quote = not in_quote
-                    i += 1
-                yield ln
+        from ._formats import csv_reader_source
 
         with open(fpath, "r", newline="", errors="replace") as f:
-            src = (
-                _skip_comments(
-                    f,
-                    getattr(csv_settings, "quote", '"'),
-                    getattr(csv_settings, "escape", None),
-                )
-                if comment_char
-                else f
-            )
+            src, dialect = csv_reader_source(f, kwargs.get("csv_settings"), kwargs)
             reader = _csv.DictReader(src, **dialect)
             for rec in reader:
                 # strict field count (reference DsvParser data_format.rs

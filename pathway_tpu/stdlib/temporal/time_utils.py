@@ -31,10 +31,14 @@ def utc_now(refresh_rate: datetime.timedelta = datetime.timedelta(seconds=60)):
     """
     from ...internals.parse_graph import G
 
-    cache_key = (id(G), refresh_rate)
+    # keyed by graph GENERATION: clear_graph() bumps it, so a new
+    # program gets a fresh clock and stale entries are dropped
+    cache_key = (G.generation, refresh_rate)
     cached = _now_tables.get(cache_key)
     if cached is not None:
         return cached
+    for k in [k for k in _now_tables if k[0] != G.generation]:
+        del _now_tables[k]
 
     Clock = _schema.schema_from_types(timestamp_utc=datetime.datetime)
 

@@ -71,6 +71,54 @@ def test_csv_settings_drive_schema_inference(tmp_path):
     assert len(state) == 2
 
 
+def test_csv_settings_on_object_store_path():
+    """s3/s3_csv/minio decode path honors csv_settings too (review
+    finding r5)."""
+    from pathway_tpu.io._object_store import rows_from_payload
+
+    payload = b'a|b\n# comment\n"x|1"|2\n'
+    rows = rows_from_payload(
+        payload,
+        "csv",
+        False,
+        None,
+        csv_settings=pw.io.CsvParserSettings(delimiter="|", comment_character="#"),
+    )
+    assert rows == [{"a": "x|1", "b": "2"}]
+
+
+def test_csv_comment_skip_with_quoting_disabled(tmp_path):
+    """Under QUOTE_NONE a stray quote char must not disable comment
+    skipping (review finding r5)."""
+    (tmp_path / "d.csv").write_text('a,b\n1,5" pipe\n# note\n2,z\n')
+
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    t = pw.io.csv.read(
+        str(tmp_path),
+        schema=S,
+        mode="static",
+        csv_settings=pw.io.CsvParserSettings(
+            enable_quoting=False, comment_character="#"
+        ),
+    )
+    state = run_table(t)
+    assert sorted(state.values()) == [(1, '5" pipe'), (2, "z")]
+
+
+def test_utc_now_cache_invalidates_on_clear_graph():
+    a = pw.temporal.utc_now(refresh_rate=datetime.timedelta(seconds=5))
+    pw.clear_graph()
+    b = pw.temporal.utc_now(refresh_rate=datetime.timedelta(seconds=5))
+    assert a is not b
+    from pathway_tpu.internals.parse_graph import G
+
+    assert b in G.tables  # the fresh clock belongs to the NEW program
+    pw.clear_graph()
+
+
 def test_subscribe_callback_protocols():
     # the exported names are typing.Protocols matching subscribe's API
     def cb(key, row, time, is_addition):

@@ -1,0 +1,135 @@
+"""Append-only fast-path equivalence fuzz: random insert-only pipelines
+run twice — once with the append-only proof wired through (sources skip
+upsert state, sinks skip consolidation) and once with every fast-path
+flag forced off — must produce byte-identical sink streams. The plan
+analysis itself is also fuzzed: pipelines containing a retraction-capable
+stage must never claim is_append_only."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+
+
+def _rand_rows(rng, n):
+    return [
+        {
+            "k": int(i),
+            "grp": f"g{int(rng.integers(0, 5))}",
+            "x": int(rng.integers(-100, 100)),
+            "s": "".join(rng.choice(list("abcdef"), size=4)),
+        }
+        for i in range(n)
+    ]
+
+
+def _rand_pipeline(rng, t):
+    """Random chain of append-only-preserving row-wise stages."""
+    n_stages = int(rng.integers(1, 4))
+    for _ in range(n_stages):
+        choice = int(rng.integers(0, 4))
+        if choice == 0:
+            t = t.filter(pw.this.x > int(rng.integers(-60, 30)))
+        elif choice == 1:
+            t = t.select(
+                k=pw.this.k, grp=pw.this.grp, x=pw.this.x * 2, s=pw.this.s
+            )
+        elif choice == 2:
+            t = t.with_columns(y=pw.this.x + 1)
+        else:
+            t = t.filter(pw.this.s < "e").select(
+                k=pw.this.k, grp=pw.this.grp, x=pw.this.x, s=pw.this.s + "!"
+            )
+    return t
+
+
+def _run_once(rows, seed, disable_fast_path):
+    class S(pw.Schema, append_only=True):
+        k: int = pw.column_definition(primary_key=True)
+        grp: str
+        x: int
+        s: str
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i, r in enumerate(rows):
+                self.next(**r)
+                if i % 7 == 6:
+                    self.commit()
+
+    rng = np.random.default_rng(seed)
+    t = pw.io.python.read(Src(), schema=S)
+    out = _rand_pipeline(rng, t)
+    assert out.is_append_only
+
+    events = []
+    pw.io.subscribe(
+        out,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (tuple(sorted(row.items())), is_addition)
+        ),
+    )
+    if disable_fast_path:
+        # force every append-only shortcut off at the engine layer (the
+        # flags are set during lowering; flip them before running): the
+        # general consolidating path must agree with the fast path
+        from pathway_tpu.internals.graph_runner import GraphRunner
+        from pathway_tpu.internals.parse_graph import G
+
+        runner = GraphRunner()
+        for spec in list(G.subscriptions):
+            runner.subscribe(
+                spec["table"],
+                on_change=spec.get("on_change"),
+                on_time_end=spec.get("on_time_end"),
+                on_end=spec.get("on_end"),
+            )
+        for eng in [runner.engine] + [r.engine for r in runner._replicas]:
+            for node in eng.nodes:
+                node.append_only = False
+        runner.run()
+    else:
+        pw.run()
+    pw.clear_graph()
+    return sorted(events)
+
+
+@pytest.mark.parametrize("seed", [7, 23, 99])
+def test_fast_path_equals_consolidating_path(seed):
+    rng = np.random.default_rng(seed)
+    rows = _rand_rows(rng, 60)
+    fast = _run_once(rows, seed, disable_fast_path=False)
+    slow = _run_once(rows, seed, disable_fast_path=True)
+    assert fast == slow
+    assert all(add for _, add in fast)  # append-only: inserts only
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_retraction_stages_never_claim_append_only(seed):
+    """Soundness of the plan analysis: splice one retraction-capable
+    stage into a random row-wise chain — is_append_only must be False."""
+    rng = np.random.default_rng(1000 + seed)
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, grp=str, x=int, s=str),
+        [(r["k"], r["grp"], r["x"], r["s"]) for r in _rand_rows(rng, 20)],
+    )
+    t = _rand_pipeline(rng, t)
+    assert t.is_append_only  # row-wise chain over static rows
+
+    breaker = int(rng.integers(0, 3))
+    if breaker == 0:
+        broken = t.groupby(pw.this.grp).reduce(
+            grp=pw.this.grp, total=pw.reducers.sum(pw.this.x)
+        )
+        downstream = broken.filter(pw.this.total > -(10**9))
+    elif breaker == 1:
+        broken = t.deduplicate(value=pw.this.x)
+        downstream = broken.filter(pw.this.x > -(10**9))
+    else:
+        broken = t.difference(t.filter(pw.this.x > 0))
+        downstream = broken.filter(pw.this.x > -(10**9))
+    assert not broken.is_append_only
+    # and anything built on top stays non-append-only
+    assert not downstream.is_append_only

@@ -1,0 +1,227 @@
+"""Sharded-equivalence matrix for the operator families the base sharded
+suite doesn't touch: temporal joins, sorting/prev-next, iterate
+fixpoints, gradual broadcast, ix lookups, set ops, update_cells, and
+session windows. Every pipeline must produce identical final state under
+1 vs 4 workers (reference PATHWAY_THREADS CI matrix, tests/utils.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from .test_sharded import assert_same_result
+from .utils import T
+
+EVENTS = """
+  | k | t  | v
+1 | a | 2  | 10
+2 | a | 6  | 20
+3 | b | 4  | 30
+4 | b | 12 | 40
+5 | a | 14 | 50
+6 | c | 8  | 60
+"""
+
+PROBES = """
+  | k | t
+1 | a | 5
+2 | a | 13
+3 | b | 9
+4 | c | 3
+"""
+
+
+def test_sharded_asof_join():
+    def build():
+        left = T(PROBES)
+        right = T(EVENTS)
+        return left.asof_join(
+            right, left.t, right.t, left.k == right.k, how="left"
+        ).select(k=left.k, probe_t=left.t, v=right.v)
+
+    assert_same_result(build)
+
+
+def test_sharded_interval_join():
+    def build():
+        left = T(PROBES)
+        right = T(EVENTS)
+        return left.interval_join(
+            right,
+            left.t,
+            right.t,
+            pw.temporal.interval(-4, 4),
+            left.k == right.k,
+        ).select(k=left.k, lt=left.t, rt=right.t, v=right.v)
+
+    assert_same_result(build)
+
+
+def test_sharded_window_join():
+    def build():
+        left = T(PROBES)
+        right = T(EVENTS)
+        return left.window_join(
+            right,
+            left.t,
+            right.t,
+            pw.temporal.tumbling(duration=8),
+            left.k == right.k,
+        ).select(k=left.k, lt=left.t, rt=right.t)
+
+    assert_same_result(build)
+
+
+def test_sharded_sort_prev_next():
+    def build():
+        t = T(EVENTS)
+        return t.sort(key=pw.this.t, instance=pw.this.k)
+
+    assert_same_result(build)
+
+
+def test_sharded_iterate_fixpoint():
+    def build():
+        verts = T(
+            """
+              | name | is_source
+            1 | a    | True
+            2 | b    | False
+            3 | c    | False
+            4 | d    | False
+            """
+        ).with_id_from(pw.this.name)
+        edges_raw = T(
+            """
+              | u | v | dist
+            1 | a | b | 1.0
+            2 | b | c | 2.0
+            3 | a | c | 10.0
+            4 | c | d | 1.0
+            """
+        )
+        edges = edges_raw.select(
+            u=verts.pointer_from(edges_raw.u),
+            v=verts.pointer_from(edges_raw.v),
+            dist=edges_raw.dist,
+        )
+        from pathway_tpu.stdlib.graphs import bellman_ford
+
+        return bellman_ford(verts, edges)
+
+    assert_same_result(build)
+
+
+def test_sharded_gradual_broadcast():
+    def build():
+        data = T(EVENTS)
+        thresholds = T(
+            """
+              | lo | mid | hi
+            1 | 0  | 25  | 100
+            """
+        )
+        return data._gradual_broadcast(
+            thresholds, thresholds.lo, thresholds.mid, thresholds.hi
+        )
+
+    assert_same_result(build)
+
+
+def test_sharded_ix_lookup():
+    def build():
+        base = T(EVENTS)
+        keyed = base.select(kk=pw.this.k, doubled=pw.this.v * 2).with_id_from(
+            pw.this.kk
+        )
+        probes = T(PROBES)
+        return probes.select(
+            k=pw.this.k,
+            got=keyed.ix(keyed.pointer_from(probes.k), optional=True).doubled,
+        )
+
+    assert_same_result(build)
+
+
+def test_sharded_set_ops_chain():
+    def build():
+        t = T(EVENTS)
+        pos = t.filter(pw.this.v >= 30)
+        neg = t.filter(pw.this.v < 30)
+        both = t.intersect(pos)
+        return t.difference(neg).concat_reindex(both)
+
+    assert_same_result(build)
+
+
+def test_sharded_update_cells():
+    def build():
+        t = T(EVENTS)
+        patch = t.filter(pw.this.v > 25).select(v=pw.this.v + 1000)
+        return t.update_cells(patch)
+
+    assert_same_result(build)
+
+
+def test_sharded_session_window():
+    def build():
+        t = T(EVENTS)
+        return t.windowby(
+            t.t,
+            window=pw.temporal.session(max_gap=5),
+            instance=t.k,
+        ).reduce(
+            k=pw.this._pw_instance,
+            cnt=pw.reducers.count(),
+            total=pw.reducers.sum(pw.this.v),
+        )
+
+    assert_same_result(build)
+
+
+def test_sharded_flatten_then_sort():
+    def build():
+        t = T(
+            """
+              | k | parts
+            1 | a | 3
+            2 | b | 2
+            """
+        )
+        expanded = t.select(
+            k=pw.this.k,
+            pieces=pw.apply_with_type(
+                lambda n: tuple(range(n)), tuple, pw.this.parts
+            ),
+        )
+        flat = expanded.flatten(pw.this.pieces)
+        return flat.groupby(pw.this.k).reduce(
+            k=pw.this.k, n=pw.reducers.count()
+        )
+
+    assert_same_result(build)
+
+
+def test_sharded_streamed_interval_join():
+    def build():
+        left = pw.debug.table_from_markdown(
+            """
+              | k | t | __time__
+            1 | a | 4 | 2
+            2 | a | 9 | 4
+            3 | b | 6 | 6
+            """
+        )
+        right = pw.debug.table_from_markdown(
+            """
+              | k | t | v  | __time__
+            1 | a | 5 | 10 | 4
+            2 | a | 8 | 20 | 6
+            3 | b | 7 | 30 | 2
+            """
+        )
+        return left.interval_join(
+            right, left.t, right.t, pw.temporal.interval(-2, 2), left.k == right.k
+        ).select(k=left.k, lt=left.t, rt=right.t, v=right.v)
+
+    assert_same_result(build)

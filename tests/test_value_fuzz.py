@@ -1,0 +1,146 @@
+"""Value-layer fuzz: the serialize/equality/consolidation contract.
+
+The invariant everything else leans on (native/python consolidation
+grouping, key derivation, shard routing): for EXACT serializations,
+byte equality of ``_serialize_for_hash`` coincides with
+``values_equal``. Random structured values — including the hostile
+edges: uint64-range ints, arbitrary-precision ints, NaN, -0.0, integral
+floats, bool-vs-int, nested tuples/lists, numpy arrays — are checked
+pairwise, and engine-level consolidation is checked against a
+brute-force multiset oracle. Regression territory: big-int key
+serialization used to crash (r5)."""
+
+from __future__ import annotations
+
+import math
+import struct
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine.value import (
+    Pointer,
+    _serialize_for_hash,
+    ref_scalar,
+    values_equal,
+)
+from pathway_tpu.engine.dataflow import consolidate, shard_of_value
+
+
+def _ser(v):
+    out = bytearray()
+    exact = _serialize_for_hash(v, out)
+    return bytes(out), exact
+
+
+def _gen_value(rng, depth=0):
+    choice = int(rng.integers(0, 14 if depth < 2 else 11))
+    if choice == 0:
+        return None
+    if choice == 1:
+        return bool(rng.integers(0, 2))
+    if choice == 2:
+        return int(rng.integers(-100, 100))
+    if choice == 3:  # int64 boundary band
+        base = int(rng.choice([2**63 - 1, -(2**63), 2**62, -(2**62)]))
+        return base + int(rng.integers(-2, 3))
+    if choice == 4:  # beyond int64: uint64 ids and arbitrary precision
+        return int(rng.choice([2**63, 2**64 - 1, 2**80 + 7, -(2**70)]))
+    if choice == 5:
+        return float(rng.normal() * 10)
+    if choice == 6:
+        return float(rng.choice([math.nan, math.inf, -math.inf, 0.0, -0.0, 3.0]))
+    if choice == 7:
+        return "".join(rng.choice(list("abñé"), size=int(rng.integers(0, 5))))
+    if choice == 8:
+        return bytes(rng.integers(0, 256, size=int(rng.integers(0, 6)), dtype=np.uint8))
+    if choice == 9:
+        return Pointer(int(rng.integers(0, 2**63)) * 2 + int(rng.integers(0, 2)))
+    if choice == 10:
+        dt = rng.choice(["i8", "f8", "u8"])
+        return np.array(rng.integers(0, 50, size=int(rng.integers(1, 4))), dtype=dt)
+    if choice == 11:
+        return tuple(_gen_value(rng, depth + 1) for _ in range(int(rng.integers(0, 3))))
+    if choice == 12:
+        return [_gen_value(rng, depth + 1) for _ in range(int(rng.integers(0, 3)))]
+    return (int(rng.integers(0, 3)), _gen_value(rng, depth + 1))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_serialize_equality_contract(seed):
+    rng = np.random.default_rng(seed)
+    values = [_gen_value(rng) for _ in range(160)]
+    sers = [_ser(v) for v in values]
+    for i, (bi, exact_i) in enumerate(sers):
+        for j, (bj, exact_j) in enumerate(sers):
+            if not (exact_i and exact_j):
+                continue
+            eq_bytes = bi == bj
+            eq_vals = values_equal(values[i], values[j])
+            assert eq_bytes == eq_vals, (
+                f"contract break: {values[i]!r} vs {values[j]!r}: "
+                f"bytes_eq={eq_bytes} values_equal={eq_vals}"
+            )
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_ref_scalar_and_shard_never_crash(seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(300):
+        v = _gen_value(rng)
+        key = ref_scalar(v)
+        assert 0 <= int(key) < 2**64
+        for n in (1, 3, 8):
+            s = shard_of_value(v, n)
+            assert 0 <= s < n
+
+
+def test_big_int_keys_regression():
+    # uint64-backed id read back as a row value (the r5 crash) and
+    # arbitrary-precision ints both key and shard cleanly
+    for v in (2**63, 2**64 - 1, 2**100, -(2**100), 2**63 - 1, -(2**63)):
+        key = ref_scalar(v)
+        assert isinstance(key, Pointer)
+        assert shard_of_value(v, 4) in range(4)
+    # distinct wide ints serialize distinctly
+    assert _ser(2**63)[0] != _ser(2**63 + 1)[0]
+    assert _ser(2**100)[0] != _ser(-(2**100))[0]
+    # and stay within the exact contract
+    assert _ser(2**63)[1] is True
+
+
+def _consolidate_oracle(updates):
+    from collections import Counter
+
+    net: Counter = Counter()
+    order = {}
+    for i, (key, row, diff) in enumerate(updates):
+        sig = _ser((key, row))[0]
+        net[sig] += diff
+        order.setdefault(sig, (i, key, row))
+    return {
+        sig: (order[sig][1], order[sig][2], d) for sig, d in net.items() if d != 0
+    }
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_consolidate_matches_multiset_oracle(seed):
+    rng = np.random.default_rng(seed)
+    rows = [
+        (
+            int(rng.integers(0, 6)),
+            (int(rng.integers(0, 3)), float(rng.integers(0, 3))),
+            int(rng.choice([1, 1, 1, -1])),
+        )
+        for _ in range(200)
+    ]
+    got = consolidate(list(rows))
+    from collections import Counter
+
+    got_net: Counter = Counter()
+    for key, row, diff in got:
+        got_net[_ser((key, row))[0]] += diff
+    want = _consolidate_oracle(rows)
+    assert {s: d for s, d in got_net.items() if d != 0} == {
+        s: v[2] for s, v in want.items()
+    }
