@@ -202,6 +202,7 @@ def table_from_pandas(
     *,
     id_from: list[str] | None = None,
     schema: type[Schema] | None = None,
+    unsafe_trusted_ids: bool = False,
 ) -> Table:
     from ..internals.schema import schema_from_pandas
 
@@ -221,7 +222,12 @@ def table_from_pandas(
             elif isinstance(v, np.bool_):
                 v = bool(v)
             data.append(v)
-        if id_from:
+        if unsafe_trusted_ids:
+            # keys taken verbatim from the frame index — the round-trip
+            # partner of table_to_pandas(include_id=True); "unsafe"
+            # because nothing checks they are distinct or well-formed
+            key = int(idx) & 0xFFFFFFFFFFFFFFFF
+        elif id_from:
             key = ref_scalar(*[data[names.index(n)] for n in id_from])
         else:
             key = ref_scalar("__pd__", i)
@@ -330,3 +336,162 @@ def table_to_stream(table: Table):
     """Return the raw update stream [(key, row, time, diff), ...]."""
     cap, names = _run_capture(table)
     return cap.stream, names
+
+
+def table_from_parquet(
+    path,
+    id_from: list[str] | None = None,
+    unsafe_trusted_ids: bool = False,
+    schema: type[Schema] | None = None,
+) -> Table:
+    """Parquet file -> table via pandas (reference debug/__init__.py
+    table_from_parquet :464). ``unsafe_trusted_ids`` takes row keys
+    verbatim from the frame's integer index (the round-trip partner of
+    ``table_to_pandas(include_id=True)``)."""
+    import pandas as pd
+
+    return table_from_pandas(
+        pd.read_parquet(path),
+        id_from=id_from,
+        schema=schema,
+        unsafe_trusted_ids=unsafe_trusted_ids,
+    )
+
+
+def table_to_parquet(table: Table, filename) -> None:
+    """Run the table to completion and write its final state to a
+    Parquet file (reference debug/__init__.py table_to_parquet :481)."""
+    df = table_to_pandas(table, include_id=False)
+    df.to_parquet(filename)
+
+
+class StreamGenerator:
+    """Scripted multi-batch input streams for tests (reference
+    debug/__init__.py StreamGenerator :496).
+
+    The reference writes persistence snapshot events per worker and
+    replays them; this engine's scripted static sources already carry
+    (key, row, time, diff) directly, so batches lower straight onto
+    that — the worker ids in the by-workers form are accepted for API
+    parity but routing is by key shard here, exactly as for any other
+    source."""
+
+    def _table_from_dict(
+        self,
+        batches: dict[int, dict[int, list[tuple[int, Any, list[Any]]]]],
+        schema: type[Schema],
+    ) -> Table:
+        """``{timestamp: {worker: [(diff, key, values), ...]}}`` -> table.
+
+        Timestamps must be positive; odd ones are doubled (engine times
+        are even, with a warning), matching the reference's contract."""
+        import warnings
+
+        if any(t < 0 for t in batches):
+            raise ValueError("negative timestamp cannot be used")
+        if any(t == 0 for t in batches):
+            warnings.warn(
+                "rows with timestamp 0 are backfill-only and skip output connectors"
+            )
+        if any(t % 2 for t in batches):
+            warnings.warn("timestamps are required to be even; doubling them")
+            batches = {2 * t: b for t, b in batches.items()}
+
+        dtypes = schema.dtypes()
+        names = list(dtypes)
+        rows = []
+        for t in sorted(batches):
+            for worker in sorted(batches[t]):
+                for diff, key, values in batches[t][worker]:
+                    if diff not in (1, -1):
+                        raise ValueError("only diffs of 1 and -1 are supported")
+                    rows.append((int(key), tuple(values), int(t), int(diff)))
+        cols = {n: Column(dtypes[n]) for n in names}
+        op = LogicalOp("static", [], {"rows": rows})
+        return Table(cols, Universe(), op, name="stream_generator")
+
+    def table_from_list_of_batches_by_workers(
+        self,
+        batches: list[dict[int, list[dict[str, Any]]]],
+        schema: type[Schema],
+    ) -> Table:
+        """Each batch maps worker id -> rows (dicts of column values);
+        batches become successive engine epochs."""
+        import itertools
+
+        counter = itertools.count()
+        names = list(schema.dtypes())
+        formatted: dict[int, dict[int, list[tuple[int, Any, list[Any]]]]] = {}
+        t = 2
+        for batch in batches:
+            formatted[t] = {
+                worker: [
+                    (1, int(ref_scalar(next(counter))), [row[n] for n in names])
+                    for row in rows
+                ]
+                for worker, rows in batch.items()
+            }
+            t += 2
+        return self._table_from_dict(formatted, schema)
+
+    def table_from_list_of_batches(
+        self,
+        batches: list[list[dict[str, Any]]],
+        schema: type[Schema],
+    ) -> Table:
+        """Each batch is a list of row dicts; one engine epoch per batch."""
+        return self.table_from_list_of_batches_by_workers(
+            [{0: batch} for batch in batches], schema
+        )
+
+    def table_from_pandas(
+        self,
+        df,
+        id_from: list[str] | None = None,
+        schema: type[Schema] | None = None,
+    ) -> Table:
+        """DataFrame with optional ``_time``/``_worker``/``_diff``
+        columns -> scripted stream (reference StreamGenerator
+        table_from_pandas)."""
+        from ..internals.schema import schema_from_pandas
+
+        special = [c for c in ("_time", "_worker", "_diff") if c in df.columns]
+        plain = df.drop(columns=special)
+        if schema is None:
+            schema = schema_from_pandas(plain, id_from=id_from)
+        names = list(schema.dtypes())
+        import itertools
+
+        counter = itertools.count()
+        # a _diff=-1 row retracts the latest prior insert with EQUAL
+        # values — the engine cancels by (key, row), so the retraction
+        # must reuse that insert's key
+        live: dict[tuple, list[int]] = {}
+        formatted: dict[int, dict[int, list[tuple[int, Any, list[Any]]]]] = {}
+        # per-COLUMN extraction: iterrows() upcasts each row to a common
+        # dtype (an int column next to a float one comes back float64);
+        # .tolist() converts per column, preserving declared types
+        col_vals = {n: df[n].tolist() for n in names}
+        times = df["_time"].tolist() if "_time" in df.columns else [2] * len(df)
+        workers = df["_worker"].tolist() if "_worker" in df.columns else [0] * len(df)
+        diffs = df["_diff"].tolist() if "_diff" in df.columns else [1] * len(df)
+        for i in range(len(df)):
+            t = int(times[i])
+            worker = int(workers[i])
+            diff = int(diffs[i])
+            values = [col_vals[n][i] for n in names]
+            sig = tuple(values)
+            if diff == 1:
+                key = int(ref_scalar(next(counter)))
+                live.setdefault(sig, []).append(key)
+            else:
+                stack = live.get(sig)
+                if not stack:
+                    raise ValueError(
+                        f"_diff=-1 row {sig!r} has no matching prior insert"
+                    )
+                key = stack.pop()
+            formatted.setdefault(t, {}).setdefault(worker, []).append(
+                (diff, key, values)
+            )
+        return self._table_from_dict(formatted, schema)

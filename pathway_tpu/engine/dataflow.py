@@ -373,9 +373,13 @@ class SessionSourceNode(Node):
         # termination and are not recorded by persistence
         self.is_error_log = False
         self.last_offsets: dict | None = None
-        # append-only fast path: keys already ingested (dedupes scanner
-        # re-emissions without storing row values)
-        self._ao_seen: set[int] = set()
+        # append-only fast path: keys already ingested, deduping scanner
+        # re-emissions without storing row values. Allocated lazily on
+        # the FIRST upsert-protocol marker (diff=2): scanners speak that
+        # protocol from their first batch, while seq-keyed sources
+        # (python/kafka — every key provably fresh) never do and so pay
+        # zero state, matching the reference's insert-only sessions.
+        self._ao_seen: set[int] | None = None
         # recovery: finalized batches to replay, in time order
         self.replay_batches: list[tuple[int, list[Update]]] = []
         graph.session_sources.append(self)
@@ -401,10 +405,11 @@ class SessionSourceNode(Node):
 
     def _apply_replay(self, ups, time) -> None:
         if self.append_only:
-            # recovered keys must count as seen or a post-restart
-            # scanner re-emission would duplicate them; the old-value
-            # dict stays empty, as on the live path
-            self._ao_seen.update(k for k, _r, d in ups if d > 0)
+            # scanner sources dedupe across restarts via their reader
+            # offsets; the seen-set only needs refreshing when one was
+            # already in play (restored from an operator snapshot)
+            if self._ao_seen is not None:
+                self._ao_seen.update(k for k, _r, d in ups if d > 0)
         else:
             for key, row, diff in ups:
                 if diff > 0:
@@ -427,12 +432,20 @@ class SessionSourceNode(Node):
             # too). A re-emitted key with CHANGED row content would be
             # an in-place update — undetectable without storing values;
             # the declaration is trusted, as at every other fast path.
-            seen = self._ao_seen
             out: list[Update] = []
             for key, row, diff in raw:
-                if diff == 1 or (diff == 2 and row is not None):
-                    if key not in seen:
-                        seen.add(key)
+                if diff == 1:
+                    # plain-insert protocol: keys are fresh by the
+                    # connector's construction; dedupe only once the
+                    # scanner protocol has appeared on this source
+                    if self._ao_seen is not None:
+                        self._ao_seen.add(key)
+                    out.append((key, row, 1))
+                elif diff == 2 and row is not None:
+                    if self._ao_seen is None:
+                        self._ao_seen = set()
+                    if key not in self._ao_seen:
+                        self._ao_seen.add(key)
                         out.append((key, row, 1))
                 else:
                     raise EngineError(

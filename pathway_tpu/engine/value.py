@@ -174,16 +174,25 @@ def _serialize_for_hash(value: Any, out: bytearray) -> bool:
         out += b"\x01" + (b"\x01" if value else b"\x00")
     elif isinstance(value, (int, np.integer)):
         iv = int(value)
-        if -(2**63) <= iv < 2**63:
+        if -(2**62) <= iv < 2**62:
             out += b"\x02" + struct.pack("<q", iv)
+        elif -(2**63) <= iv < 2**63:
+            # [2^62, 2^63): fits i64, but an integral FLOAT of equal
+            # numeric value serializes under the float tag — byte
+            # equality stops tracking the numeric tower here, so report
+            # INEXACT (consolidation then groups via values_equal)
+            out += b"\x02" + struct.pack("<q", iv)
+            return False
         else:
             # Python ints are unbounded (a uint64-backed id column read
             # back as a row value already exceeds i64); wide ints get a
             # length-prefixed two's-complement encoding under their OWN
             # tag — reusing \x02 would make the stream ambiguous with a
-            # small int whose first packed byte collides
+            # small int whose first packed byte collides. Inexact for
+            # the same numeric-tower reason as the band above.
             b = iv.to_bytes((iv.bit_length() + 8) // 8, "little", signed=True)
             out += b"\x0d" + struct.pack("<I", len(b)) + b
+            return False
     elif isinstance(value, (float, np.floating)):
         f = float(value)
         if f != f:
@@ -192,6 +201,11 @@ def _serialize_for_hash(value: Any, out: bytearray) -> bool:
         elif f.is_integer() and abs(f) < 2**62:
             # int/float hash consistency like python's numeric tower
             out += b"\x02" + struct.pack("<q", int(f))
+        elif f.is_integer():
+            # integral float >= 2^62: an INT of equal value serializes
+            # differently — inexact, the other side of the band above
+            out += b"\x03" + struct.pack("<d", f)
+            return False
         else:
             out += b"\x03" + struct.pack("<d", f)
     elif isinstance(value, str):

@@ -17,6 +17,30 @@ def read(
     name: str = "csv",
     **kwargs,
 ) -> Table:
+    """Read CSV files under ``path`` into a table (reference io/csv
+    read :25).
+
+    The first line of each file is the header; columns map to the
+    schema by name and values are coerced to the declared types.
+
+    Args:
+        path: a file, or a directory scanned recursively.
+        schema: column names/types. When omitted, the schema is
+            INFERRED by probing the first file's initial rows (types
+            from pandas dtypes) — convenient for exploration, explicit
+            schemas for production.
+        mode: ``"streaming"`` watches for file additions, modifications
+            and deletions (rows of a deleted file are retracted);
+            ``"static"`` reads a snapshot and closes.
+        with_metadata: add a ``_metadata`` JSON column (path, size,
+            modification time, ...).
+        autocommit_duration_ms: epoch granularity of commits.
+        csv_settings: (kwarg) a :class:`pw.io.CsvParserSettings` fixing
+            the dialect — delimiter, quote/escape characters, comment
+            character. Drives both parsing and schema inference.
+        persistent_id: (kwarg) enable checkpoint/recovery for this
+            source.
+    """
     if schema is None:
         from ..internals.schema import schema_from_csv
         import glob
@@ -55,4 +79,8 @@ def read(
 
 
 def write(table: Table, filename: str, **kwargs) -> None:
+    """Stream the table's changes to ``filename`` as CSV (reference
+    io/csv write :136): header first, then one row per change carrying
+    the columns plus ``time``/``diff`` — retractions appear as
+    ``diff=-1`` rows, so the file is a replayable changelog."""
     _fs.write(table, filename, format="csv", name="csv.write", **kwargs)

@@ -88,6 +88,29 @@ def read(
     _client: Any = None,
     **kwargs,
 ) -> Table:
+    """Read a Google Drive file or folder as a table of file payloads
+    (reference io/gdrive read :478).
+
+    Args:
+        object_id: Drive id of a file or folder (folders are walked
+            recursively; shortcuts/symlinks are skipped like the
+            reference's STATUS_SYMLINKS_NOT_SUPPORTED path).
+        mode: ``"streaming"`` polls every ``refresh_interval`` seconds
+            and emits upserts for new/modified files and retractions
+            for deleted ones; ``"static"`` snapshots once.
+        format: ``"binary"`` (one row per file) or any pw.io.fs format.
+        object_size_limit: files larger than this many bytes are
+            skipped (a warning row in the error log), matching the
+            reference's size gate.
+        service_user_credentials_file: path to a service-account JSON
+            key; the account needs read access to the objects.
+        with_metadata: add ``_metadata`` (id, name, mtime, size).
+        refresh_interval: poll period in seconds.
+        persistent_id: checkpoint/recovery — unchanged files (by
+            version) are not re-downloaded on restart.
+        _client: injectable Drive client for tests.
+    """
+
     def client_factory():
         if _client is not None:
             return _client

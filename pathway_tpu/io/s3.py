@@ -117,7 +117,33 @@ def read(
     _client: Any = None,
     **kwargs,
 ) -> Table:
-    """Read objects under an S3 prefix as a (streaming) table."""
+    """Read objects under an S3 prefix as a (streaming) table
+    (reference io/s3 read :78).
+
+    Args:
+        path: ``s3://bucket/prefix`` (bucket may instead come from
+            ``aws_s3_settings``). Every object under the prefix is
+            decoded with ``format``.
+        aws_s3_settings: :class:`AwsS3Settings` — bucket, region,
+            endpoint (MinIO/Wasabi/DigitalOcean work via a custom
+            endpoint), access keys or profile.
+        format: ``"plaintext"`` (one row per line), ``"plaintext_by_file"``
+            / ``"binary"`` (one row per object), ``"csv"``,
+            ``"json"``/``"jsonlines"``.
+        schema: payload schema for csv/jsonlines formats.
+        mode: ``"streaming"`` re-lists the prefix and emits
+            upserts/retractions as objects appear, change (version/etag
+            diff) or disappear; ``"static"`` snapshots once.
+        with_metadata: add a ``_metadata`` column (object key, size,
+            version) per row.
+        csv_settings: (kwarg) :class:`pw.io.CsvParserSettings` CSV
+            dialect for ``format="csv"``.
+        persistent_id: checkpoint/recovery — restarts skip objects whose
+            version was already ingested, and the cached object store
+            avoids re-downloading unchanged objects entirely.
+        _client: injectable boto3-shaped client (tests run against a
+            fake; production uses ``aws_s3_settings.create_client()``).
+    """
     bucket, prefix = _split_path(path, aws_s3_settings)
 
     def client_factory():

@@ -233,3 +233,64 @@ def test_inactivity_detection_builds():
 class _NullSubject(pw.io.python.ConnectorSubject):
     def run(self):
         pass
+
+
+# ---- debug utilities (reference debug/__init__.py parity) ----
+
+
+class _W(pw.Schema):
+    w: str
+
+
+def test_stream_generator_batches_become_epochs():
+    sg = pw.debug.StreamGenerator()
+    t = sg.table_from_list_of_batches([[{"w": "a"}, {"w": "b"}], [{"w": "a"}]], _W)
+    counts = t.groupby(pw.this.w).reduce(w=pw.this.w, n=pw.reducers.count())
+    stream, _names = pw.debug.table_to_stream(counts)
+    assert len({s[2] for s in stream}) >= 2  # two distinct epochs
+    keys, cols = pw.debug.table_to_dicts(counts)
+    assert {cols["w"][k]: cols["n"][k] for k in keys} == {"a": 2, "b": 1}
+    pw.clear_graph()
+
+
+def test_stream_generator_by_workers_and_validation():
+    import pytest as _pytest
+
+    sg = pw.debug.StreamGenerator()
+    t = sg.table_from_list_of_batches_by_workers([{0: [{"w": "x"}], 1: [{"w": "y"}]}], _W)
+    keys, cols = pw.debug.table_to_dicts(t)
+    assert sorted(cols["w"].values()) == ["x", "y"]
+    pw.clear_graph()
+    with _pytest.raises(ValueError, match="negative"):
+        sg._table_from_dict({-2: {0: [(1, 1, ["x"])]}}, _W)
+    with _pytest.warns(UserWarning, match="doubl"):
+        sg._table_from_dict({3: {0: [(1, 1, ["x"])]}}, _W)
+    pw.clear_graph()
+
+
+def test_stream_generator_pandas_scripted_retraction():
+    import pandas as pd
+
+    sg = pw.debug.StreamGenerator()
+    df = pd.DataFrame({"w": ["a", "b", "a"], "_time": [2, 2, 4], "_diff": [1, 1, -1]})
+    t = sg.table_from_pandas(df, schema=_W)
+    keys, cols = pw.debug.table_to_dicts(t)
+    assert sorted(cols["w"].values()) == ["b"]
+    pw.clear_graph()
+
+
+def test_parquet_round_trip(tmp_path):
+    t = pw.debug.table_from_markdown(
+        """
+          | a | b
+        1 | 1 | x
+        2 | 2 | y
+        """
+    )
+    f = str(tmp_path / "t.parquet")
+    pw.debug.table_to_parquet(t, f)
+    pw.clear_graph()
+    t2 = pw.debug.table_from_parquet(f)
+    keys, cols = pw.debug.table_to_dicts(t2.select(a=pw.this.a, b=pw.this.b))
+    assert sorted((cols["a"][k], cols["b"][k]) for k in keys) == [(1, "x"), (2, "y")]
+    pw.clear_graph()

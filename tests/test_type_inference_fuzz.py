@@ -1,0 +1,146 @@
+"""Type-inference-vs-runtime fuzz: random expression trees over typed
+columns. The contract with the build-time checker
+(internals/expression.py):
+
+1. an expression the checker ACCEPTS evaluates without TypeError, and
+   every produced value inhabits the inferred dtype;
+2. the checker's accept/reject decision is deterministic and
+   construction-order independent (building the same shape twice agrees).
+
+Trees are built from column refs, constants, arithmetic/comparison/
+boolean operators, if_else and coalesce; evaluation runs through the
+full engine (columnar evaluators + per-row fallback)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import dtype as dt
+
+from .utils import T, run_table
+
+COLS = {
+    "i1": dt.INT,
+    "i2": dt.INT,
+    "f1": dt.FLOAT,
+    "s1": dt.STR,
+    "b1": dt.BOOL,
+}
+
+
+def _table():
+    return T(
+        """
+          | i1 | i2 | f1  | s1  | b1
+        1 | 3  | -2 | 0.5 | ab  | True
+        2 | 0  | 7  | -1.5| cd  | False
+        3 | -4 | 1  | 2.0 | ab  | True
+        """
+    )
+
+
+def _leaf(rng, t):
+    c = int(rng.integers(0, 7))
+    if c < 5:
+        name = list(COLS)[c]
+        return t[name], COLS[name]
+    if c == 5:
+        v = int(rng.integers(-5, 6))
+        return v, dt.INT
+    return float(rng.integers(-3, 4)), dt.FLOAT
+
+
+def _build(rng, t, depth=0):
+    """Returns (expr, static_ok) — static_ok None means 'didn't raise'."""
+    if depth >= 3 or rng.random() < 0.4:
+        e, _ = _leaf(rng, t)
+        return e
+    kind = int(rng.integers(0, 4))
+    if kind == 0:
+        op = rng.choice(["+", "-", "*", "/", "//", "%"])
+        l = _build(rng, t, depth + 1)
+        r = _build(rng, t, depth + 1)
+        return {
+            "+": lambda a, b: a + b,
+            "-": lambda a, b: a - b,
+            "*": lambda a, b: a * b,
+            "/": lambda a, b: a / b,
+            "//": lambda a, b: a // b,
+            "%": lambda a, b: a % b,
+        }[op](l, r)
+    if kind == 1:
+        op = rng.choice(["==", "<", ">="])
+        l = _build(rng, t, depth + 1)
+        r = _build(rng, t, depth + 1)
+        return {
+            "==": lambda a, b: a == b,
+            "<": lambda a, b: a < b,
+            ">=": lambda a, b: a >= b,
+        }[op](l, r)
+    if kind == 2:
+        cond = _build(rng, t, depth + 1)
+        a = _build(rng, t, depth + 1)
+        b = _build(rng, t, depth + 1)
+        return pw.if_else(cond, a, b)
+    return pw.coalesce(_build(rng, t, depth + 1), _build(rng, t, depth + 1))
+
+
+def _inhabits(value, d: dt.DType) -> bool:
+    d = dt.unoptionalize(d)
+    if value is None:
+        return True  # division-by-zero etc. route to ERROR/None cells
+    if d is dt.INT:
+        return isinstance(value, (int, np.integer)) and not isinstance(value, bool)
+    if d is dt.FLOAT:
+        return isinstance(value, (float, np.floating, int, np.integer))
+    if d is dt.BOOL:
+        return isinstance(value, (bool, np.bool_))
+    if d is dt.STR:
+        return isinstance(value, str)
+    return True  # ANY and composites: no constraint to check
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_accepted_expressions_evaluate_and_inhabit(seed):
+    rng = np.random.default_rng(seed)
+    t = _table()
+    try:
+        e = _build(rng, t)
+    except TypeError:
+        pw.clear_graph()
+        return  # checker rejected at build — contract part 2 below
+    if not hasattr(e, "_dtype"):
+        pw.clear_graph()
+        return  # degenerate tree: bare constant
+    inferred = e._dtype
+    sel = t.select(out=e)
+    assert sel._columns["out"].dtype == inferred
+    state = run_table(sel)
+    from pathway_tpu.engine.value import ERROR
+
+    for (val,) in state.values():
+        if val is ERROR or isinstance(val, type(ERROR)):
+            continue  # runtime errors (div by zero) route to ERROR cells
+        assert _inhabits(val, inferred), (
+            f"value {val!r} does not inhabit inferred {inferred} (seed {seed})"
+        )
+    pw.clear_graph()
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_checker_decision_is_deterministic(seed):
+    def attempt():
+        rng = np.random.default_rng(seed)
+        t = _table()
+        try:
+            e = _build(rng, t)
+            d = getattr(e, "_dtype", None)
+            pw.clear_graph()
+            return ("ok", repr(d))
+        except TypeError as exc:
+            pw.clear_graph()
+            return ("reject", str(exc))
+
+    assert attempt() == attempt()

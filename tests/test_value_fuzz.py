@@ -105,8 +105,14 @@ def test_big_int_keys_regression():
     # distinct wide ints serialize distinctly
     assert _ser(2**63)[0] != _ser(2**63 + 1)[0]
     assert _ser(2**100)[0] != _ser(-(2**100))[0]
-    # and stay within the exact contract
-    assert _ser(2**63)[1] is True
+    # ints >= 2^62 sit in the int/float numeric-tower ambiguity band:
+    # the serializer must declare them INEXACT so consolidation groups
+    # them via values_equal, not bytes
+    assert _ser(2**63)[1] is False
+    assert _ser(2**62)[1] is False
+    assert _ser(float(2**63))[1] is False
+    assert values_equal(2**63, float(2**63))  # the ambiguity being declared
+    assert _ser(2**62 - 1)[1] is True
 
 
 def _consolidate_oracle(updates):
