@@ -139,7 +139,7 @@ from .internals.table import (
     Table as TableLike,
     Table as Joinable,
 )
-from .internals.udfs import UDF as UDFSync, UDF as UDFAsync
+from .internals.udfs import UDFAsync, UDFSync
 from .internals import udfs as asynchronous
 from .stdlib import viz  # attaches Table.show/plot (reference-style)
 
@@ -190,12 +190,7 @@ def table_transformer(func=None, **kw):
     return func
 
 
-def udf_async(fn=None, **kwargs):
-    """Deprecated alias of @pw.udf for async functions (reference
-    udf_async)."""
-    if fn is None:
-        return lambda f: udf(f, **kwargs)
-    return udf(fn, **kwargs)
+from .internals.udfs import udf_async  # noqa: E402  (deprecated alias)
 
 
 def enable_interactive_mode() -> None:

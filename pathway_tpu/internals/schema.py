@@ -183,6 +183,11 @@ def _dtype_from_str(ann: str) -> dt.DType:
     m = re.fullmatch(r"(?:typing\.)?Optional\[(\w+)\]", ann)
     if m and m.group(1) in simple:
         return dt.Optional(simple[m.group(1)])
+    # Pointer annotations in any spelling ("Pointer", "pw.Pointer",
+    # "_dt.Pointer", "Pointer[Any]") — postponed evaluation turns them
+    # into strings before the metaclass sees them
+    if re.fullmatch(r"(?:[\w.]+\.)?Pointer(?:\[.*\])?", ann):
+        return dt.POINTER
     return simple.get(ann, dt.ANY)
 
 

@@ -521,3 +521,83 @@ def udf(
     if fun is not None:
         return wrapper(fun)
     return wrapper
+
+
+# ---- deprecated aliases kept for reference-code migration ----
+# (reference udfs/__init__.py UDFSync :214, UDFFunction :231,
+# UDFAsync :405, udf_async :449, executors.py async_options :286)
+
+
+def async_options(
+    capacity: int | None = None,
+    timeout: float | None = None,
+    retry_strategy: AsyncRetryStrategy | None = None,
+    cache_strategy: "CacheStrategy | None" = None,
+):
+    """Decorator wrapping a plain function to run under the async
+    executor with the given concurrency/timeout/retry/cache options."""
+
+    def wrapper(fun):
+        return udf(
+            fun,
+            executor=async_executor(
+                capacity=capacity, timeout=timeout, retry_strategy=retry_strategy
+            ),
+            cache_strategy=cache_strategy,
+        )
+
+    return wrapper
+
+
+class UDFSync(UDF):
+    """Deprecated: use ``UDF`` (sync is the default executor)."""
+
+    def __init_subclass__(cls, **kwargs):
+        import warnings
+
+        warnings.warn(
+            "UDFSync is deprecated, subclass UDF instead", DeprecationWarning
+        )
+        super().__init_subclass__(**kwargs)
+
+
+UDFFunction = UDF
+
+
+class UDFAsync(UDF):
+    """Deprecated: use ``UDF`` with ``executor=async_executor()``."""
+
+    def __init__(self, *args, capacity=None, retry_strategy=None, **kwargs):
+        import warnings
+
+        warnings.warn(
+            "UDFAsync is deprecated, use UDF with executor=pw.udfs.async_executor()",
+            DeprecationWarning,
+        )
+        kwargs.setdefault(
+            "executor",
+            async_executor(capacity=capacity, retry_strategy=retry_strategy),
+        )
+        super().__init__(*args, **kwargs)
+
+
+def udf_async(fun=None, **kwargs):
+    """Deprecated: use ``pw.udf`` with ``executor=async_executor()``."""
+    import warnings
+
+    warnings.warn(
+        "udf_async is deprecated, use pw.udf with executor=pw.udfs.async_executor()",
+        DeprecationWarning,
+    )
+    if fun is not None:
+        return udf(fun, executor=async_executor(), **kwargs)
+    return lambda f: udf(f, executor=async_executor(), **kwargs)
+
+
+__all__ += [
+    "UDFAsync",
+    "UDFFunction",
+    "UDFSync",
+    "async_options",
+    "udf_async",
+]

@@ -110,3 +110,36 @@ __all__ = [
     "louvain_level",
     "pagerank",
 ]
+
+# typed building blocks for graph pipelines (reference
+# stdlib/graphs/common.py:10-41): extend these schemas with your own
+# columns; Edge/Clustering columns are row POINTERS into vertex tables
+from ...internals.schema import Schema as _Schema
+from ...internals import dtype as _dt
+
+
+class Vertex(_Schema):
+    pass
+
+
+class Edge(_Schema):
+    """An edge holds pointers to its endpoint vertex rows."""
+
+    u: _dt.Pointer
+    v: _dt.Pointer
+
+
+class Weight(_Schema):
+    """Weight mixin for Vertex/Edge extensions."""
+
+    weight: float
+
+
+class Cluster(Vertex):
+    pass
+
+
+class Clustering(_Schema):
+    """Membership relation: vertex (row id) belongs to cluster ``c``."""
+
+    c: _dt.Pointer

@@ -52,10 +52,6 @@ def _table_of(expr: ColumnExpression) -> Table:
     return found[0]
 
 
-def apply_all_rows(*args, **kwargs):
-    raise NotImplementedError("col.apply_all_rows: use pw.udfs.batch_executor instead")
-
-
 def groupby_reduce_majority(column: ColumnReference, majority_of: ColumnReference):
     table = column._table
     counted = table.groupby(column, majority_of).reduce(
@@ -74,3 +70,107 @@ def _count_reducer():
     from ... import reducers as red
 
     return red.count()
+
+
+def flatten_column(
+    column: ColumnReference,
+    origin_id: str | ColumnReference | None = "origin_id",
+) -> Table:
+    """Deprecated: use ``pw.Table.flatten`` (reference col.py:16).
+    Flattens ``column``, spreading the table's other columns, with the
+    source row's id stored under ``origin_id``."""
+    import warnings
+
+    warnings.warn(
+        "flatten_column is deprecated, use pw.Table.flatten instead",
+        DeprecationWarning,
+    )
+    name = origin_id._name if isinstance(origin_id, ColumnReference) else origin_id
+    return column._table.flatten(column, origin_id=name)
+
+
+def unpack_col_dict(column: ColumnExpression, schema: Any) -> Table:
+    """Extract typed columns out of a JSON-object column by schema
+    (reference col.py:143): each schema field becomes a column; missing
+    fields yield None (declare them Optional)."""
+    from ... import apply_with_type
+    from ...engine.value import Json
+
+    table = _table_of(column)
+    dtypes = schema.dtypes()
+
+    def getter(field, target):
+        conv = dt.unoptionalize(target)
+
+        def get(j, _f=field, _c=conv):
+            v = j.value if isinstance(j, Json) else j
+            v = (v or {}).get(_f)
+            if isinstance(v, Json):
+                v = v.value
+            if v is None:
+                return None
+            if _c is dt.FLOAT:
+                return float(v)
+            if _c is dt.INT and not isinstance(v, bool):
+                return int(v)
+            return v
+
+        return get
+
+    return table.select(
+        **{
+            n: apply_with_type(getter(n, d), d, column)
+            for n, d in dtypes.items()
+        }
+    )
+
+
+def multiapply_all_rows(
+    *cols: ColumnReference,
+    fun: Any,
+    result_col_names: list,
+) -> Table:
+    """Apply ``fun`` to entire columns at once (all rows gathered to one
+    accumulator), producing ``len(result_col_names)`` output columns
+    re-keyed to the original rows (reference col.py:211). Meant for
+    small tables / infrequent whole-table transforms."""
+    import pathway_tpu as pw
+
+    assert cols, "multiapply_all_rows needs at least one column"
+    table = cols[0]._table
+    names = [
+        c._name if isinstance(c, ColumnReference) else str(c)
+        for c in result_col_names
+    ]
+
+    packed = table.select(_pw_row=pw.make_tuple(table.id, *cols))
+    gathered = packed.reduce(rows=pw.reducers.sorted_tuple(packed._pw_row))
+
+    def compute(rows):
+        ids = [r[0] for r in rows]
+        ins = [list(col) for col in zip(*(r[1:] for r in rows))]
+        outs = fun(*ins)
+        return tuple((i, *vals) for i, vals in zip(ids, zip(*outs)))
+
+    expanded = gathered.select(out=pw.apply(compute, pw.this.rows))
+    flat = expanded.flatten(pw.this.out)
+    keyed = flat.with_id(
+        pw.declare_type(dt.POINTER, flat.out[0])
+    )
+    return keyed.select(
+        **{n: pw.this.out[i + 1] for i, n in enumerate(names)}
+    )
+
+
+def apply_all_rows(
+    *cols: ColumnReference,
+    fun: Any,
+    result_col_name: str,
+) -> Table:
+    """Single-output form of :func:`multiapply_all_rows` (reference
+    col.py:168)."""
+    return multiapply_all_rows(
+        *cols,
+        fun=lambda *ins: (fun(*ins),),
+        result_col_names=[result_col_name],
+    )

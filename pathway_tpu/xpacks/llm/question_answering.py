@@ -497,3 +497,116 @@ class DeckRetriever(BaseQuestionAnswerer):
         if self.server is None:
             raise ValueError("call build_server() first")
         return self.server.run(*args, **kwargs)
+
+
+def send_post_request(
+    url: str, data: dict, headers: dict | None = None, timeout: int | None = None
+):
+    """POST json, raise on HTTP errors, return the decoded body
+    (reference question_answering.py:846). Stdlib-only — no requests
+    dependency."""
+    from ._http import post_json
+
+    return post_json(url, data, headers, timeout=timeout)
+
+
+class RAGClient:
+    """HTTP client for the RAG question-answering servers (reference
+    question_answering.py:854): retrieval + stats ride the underlying
+    VectorStoreClient, answers/summaries hit the QA routes.
+
+    Args:
+        host/port or url: where the server listens (exactly one form).
+        timeout: per-request seconds, default 90.
+        additional_headers: sent with every request.
+    """
+
+    def __init__(
+        self,
+        host: str | None = None,
+        port: int | None = None,
+        url: str | None = None,
+        timeout: int | None = 90,
+        additional_headers: dict | None = None,
+    ):
+        from ._http import derive_url
+        from .vector_store import VectorStoreClient
+
+        self.url = derive_url(host, port, url)
+        self.timeout = timeout
+        self.additional_headers = additional_headers or {}
+        self.index_client = VectorStoreClient(
+            url=self.url,
+            timeout=self.timeout,
+            additional_headers=self.additional_headers,
+        )
+
+    def retrieve(
+        self,
+        query: str,
+        k: int = 3,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ):
+        """Closest documents for a query, straight off the index."""
+        return self.index_client.query(
+            query=query,
+            k=k,
+            metadata_filter=metadata_filter,
+            filepath_globpattern=filepath_globpattern,
+        )
+
+    def statistics(self):
+        """Indexed-corpus stats (/v1/statistics)."""
+        return self.index_client.get_vectorstore_statistics()
+
+    def pw_ai_answer(
+        self,
+        prompt: str,
+        filters: str | None = None,
+        model: str | None = None,
+    ):
+        """RAG answer for a prompt (POST /v1/pw_ai_answer)."""
+        payload: dict = {"prompt": prompt}
+        if filters:
+            payload["filters"] = filters
+        if model:
+            payload["model"] = model
+        return send_post_request(
+            f"{self.url}/v1/pw_ai_answer",
+            payload,
+            self.additional_headers,
+            timeout=self.timeout,
+        )
+
+    def pw_ai_summary(self, text_list: list[str], model: str | None = None):
+        """Summarize texts (POST /v1/pw_ai_summary)."""
+        payload: dict = {"text_list": text_list}
+        if model:
+            payload["model"] = model
+        return send_post_request(
+            f"{self.url}/v1/pw_ai_summary",
+            payload,
+            self.additional_headers,
+            timeout=self.timeout,
+        )
+
+    def pw_list_documents(self, filters: str | None = None, keys: list | None = None):
+        """Indexed documents' metadata (POST /v1/pw_list_documents);
+        ``keys`` narrows each returned metadata dict to those fields,
+        client-side, like the reference client."""
+        payload: dict = {}
+        if filters:
+            payload["metadata_filter"] = filters
+        docs = send_post_request(
+            f"{self.url}/v1/pw_list_documents",
+            payload,
+            self.additional_headers,
+            timeout=self.timeout,
+        )
+        if keys and isinstance(docs, list):
+            docs = [
+                {k: d[k] for k in keys if k in d} if isinstance(d, dict) else d
+                for d in docs
+            ]
+        return docs

@@ -494,30 +494,21 @@ class VectorStoreClient:
         timeout: int | None = 15,
         additional_headers: dict | None = None,
     ):
-        err = "specify either host and port or url"
-        if url is not None:
-            if host or port:
-                raise ValueError(err)
-            self.url = url
-        else:
-            if host is None:
-                raise ValueError(err)
-            port = port or 80
-            self.url = f"http://{host}:{port}"
+        from ._http import derive_url
+
+        self.url = derive_url(host, port, url)
         self.timeout = timeout
         self.additional_headers = additional_headers or {}
 
     def _post(self, path: str, payload: dict) -> object:
-        import urllib.request
+        from ._http import post_json
 
-        req = urllib.request.Request(
+        return post_json(
             self.url + path,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json", **self.additional_headers},
-            method="POST",
+            payload,
+            self.additional_headers,
+            timeout=self.timeout,
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return json.loads(resp.read().decode())
 
     def query(
         self,

@@ -235,6 +235,167 @@ class _NullSubject(pw.io.python.ConnectorSubject):
         pass
 
 
+def test_multiapply_all_rows_keeps_keys():
+    t = T(
+        """
+          | colA | colB
+        1 | 1    | 10
+        2 | 2    | 20
+        3 | 3    | 30
+        """
+    )
+
+    def add_total_sum(c1, c2):
+        s = sum(c1) + sum(c2)
+        return [x + s for x in c1], [x + s for x in c2]
+
+    r = pw.stdlib.utils.col.multiapply_all_rows(
+        t.colA, t.colB, fun=add_total_sum, result_col_names=["res1", "res2"]
+    )
+    assert sorted(run_table(r).values()) == [(67, 76), (68, 86), (69, 96)]
+    # original keys preserved: restrict back onto the source universe
+    joined = run_table(t.select(a=t.colA, r1=r.restrict(t).res1))
+    assert sorted(joined.values()) == [(1, 67), (2, 68), (3, 69)]
+    pw.clear_graph()
+
+
+def test_apply_all_rows_single_column():
+    t = T(
+        """
+          | v
+        1 | 5
+        2 | 7
+        """
+    )
+    r = pw.stdlib.utils.col.apply_all_rows(
+        t.v, fun=lambda vs: [x - min(vs) for x in vs], result_col_name="rel"
+    )
+    assert sorted(run_table(r).values()) == [(0,), (2,)]
+    pw.clear_graph()
+
+
+def test_unpack_col_dict():
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=pw.Json),
+        rows=[
+            ({"field_a": 13, "field_b": "foo", "field_c": False},),
+            ({"field_a": 17, "field_c": True, "field_d": 3.4},),
+        ],
+    )
+
+    class DS(pw.Schema):
+        field_a: int
+        field_b: str | None
+        field_c: bool
+        field_d: float | None
+
+    r = pw.stdlib.utils.col.unpack_col_dict(t.data, schema=DS)
+    assert sorted(run_table(r).values()) == [
+        (13, "foo", False, None),
+        (17, None, True, 3.4),
+    ]
+    pw.clear_graph()
+
+
+def test_filtering_bucketing_flatten_column():
+    import warnings
+
+    t = T(
+        """
+          | g | v
+        1 | a | 5
+        2 | a | 9
+        3 | b | 2
+        """
+    )
+    mx = pw.stdlib.utils.argmax_rows(t, t.g, what=t.v)
+    assert sorted(run_table(mx).values()) == [("a", 9), ("b", 2)]
+    pw.clear_graph()
+    t2 = T(
+        """
+          | g | v
+        1 | a | 5
+        2 | a | 9
+        """
+    )
+    mn = pw.stdlib.utils.argmin_rows(t2, t2.g, what=t2.v)
+    assert sorted(run_table(mn).values()) == [("a", 5)]
+    pw.clear_graph()
+
+    assert pw.stdlib.utils.bucketing.truncate_to_minutes(
+        datetime.datetime(2026, 7, 31, 12, 34, 56, 789)
+    ) == datetime.datetime(2026, 7, 31, 12, 34)
+
+    t3 = T(
+        """
+          | pet | age
+        1 | Dog | 2
+        """
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        f = pw.stdlib.utils.col.flatten_column(t3.pet)
+    vals = sorted(run_table(f).values())
+    assert len(vals) == 3 and {v[0] for v in vals} == {"D", "o", "g"}
+    pw.clear_graph()
+
+
+def test_rag_client_list_documents_keys_filter(monkeypatch):
+    from pathway_tpu.xpacks.llm import question_answering as qa
+
+    sent = {}
+
+    def fake_post(url, data, headers=None, timeout=None):
+        sent["url"] = url
+        return [
+            {"path": "/a", "size": 3, "owner": "x"},
+            {"path": "/b", "size": 7, "owner": "y"},
+        ]
+
+    monkeypatch.setattr(qa, "send_post_request", fake_post)
+    c = qa.RAGClient(host="127.0.0.1", port=12345)
+    docs = c.pw_list_documents(keys=["path", "size"])
+    assert docs == [{"path": "/a", "size": 3}, {"path": "/b", "size": 7}]
+    assert sent["url"].endswith("/v1/pw_list_documents")
+
+
+def test_udfs_deprecated_aliases():
+    import warnings
+
+    @pw.udfs.async_options(capacity=2)
+    async def double(x):
+        return x * 2
+
+    t = T(
+        """
+          | a
+        1 | 3
+        """
+    )
+    state = run_table(t.select(b=double(pw.this.a)))
+    assert list(state.values()) == [(6,)]
+    pw.clear_graph()
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+
+        @pw.udfs.udf_async
+        async def trip(x):
+            return x * 3
+
+        assert any("deprecated" in str(x.message) for x in w)
+    t2 = T(
+        """
+          | a
+        1 | 3
+        """
+    )
+    state2 = run_table(t2.select(b=trip(pw.this.a)))
+    assert list(state2.values()) == [(9,)]
+    assert pw.udfs.UDFFunction is pw.udfs.UDF
+    pw.clear_graph()
+
+
 # ---- debug utilities (reference debug/__init__.py parity) ----
 
 

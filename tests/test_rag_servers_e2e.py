@@ -96,3 +96,74 @@ def test_qa_rest_server_answers_over_http():
     assert "ici" in text.lower() or text  # fake chat echoes context+prompt
     stats = got["stats"]
     assert isinstance(stats, dict) and stats  # file counts / timestamps
+
+
+def test_rag_client_against_live_server():
+    """RAGClient (reference question_answering.py:854) drives the same
+    live server: retrieve, statistics, and answer round-trips."""
+    from pathway_tpu.internals.graph_runner import GraphRunner
+    from pathway_tpu.xpacks.llm.question_answering import (
+        BaseRAGQuestionAnswerer,
+        RAGClient,
+    )
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    port = _free_port()
+    docs = make_docs_table(
+        [
+            ("tpu pods interconnect chips over ici links", "/d/ici.txt"),
+            ("streaming dataflow engines process retractions", "/d/stream.txt"),
+        ]
+    )
+    store = VectorStoreServer(docs, embedder=fake_embeddings_model)
+    rag = BaseRAGQuestionAnswerer(llm=FakeChatModel(), indexer=store)
+    rag.build_server(host="127.0.0.1", port=port)
+
+    got: dict = {}
+    errors: list = []
+
+    runner = GraphRunner()
+    for table, sink in list(pw.parse_graph.outputs):
+        build = sink.get("build")
+        if build is not None:
+            build(runner, table)
+    for spec in list(pw.parse_graph.subscriptions):
+        runner.subscribe(
+            spec["table"],
+            on_change=spec.get("on_change"),
+            on_time_end=spec.get("on_time_end"),
+            on_end=spec.get("on_end"),
+        )
+
+    def client():
+        try:
+            c = RAGClient(host="127.0.0.1", port=port)
+            deadline = time.time() + 25
+            while time.time() < deadline:
+                try:
+                    got["answer"] = c.pw_ai_answer("what links tpu chips?")
+                    break
+                except Exception:
+                    time.sleep(0.3)
+            got["docs"] = c.retrieve("tpu interconnect", k=1)
+            got["stats"] = c.statistics()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+        finally:
+            runner.engine.stop()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    runner.run()
+    t.join(timeout=30)
+    pw.clear_graph()
+
+    assert not errors, errors
+    assert got["answer"]
+    # fake_embeddings_model hashes content (not semantic): assert the
+    # result is a well-formed hit from the corpus, not which one
+    assert isinstance(got["docs"], list) and len(got["docs"]) == 1
+    hit = got["docs"][0]
+    assert {"text", "metadata", "dist"} <= set(hit)
+    assert hit["metadata"]["path"] in ("/d/ici.txt", "/d/stream.txt")
+    assert isinstance(got["stats"], dict) and got["stats"]
