@@ -418,3 +418,41 @@ def write(
             producer.send(topic_name, payload)
 
     add_output_sink(table, on_change, name=name)
+
+
+def simple_read(
+    server: str,
+    topic: str,
+    *,
+    read_only_new: bool = False,
+    schema: type[Schema] | None = None,
+    format: str = "raw",
+    autocommit_duration_ms: int | None = 1500,
+    json_field_paths: dict[str, str] | None = None,
+    parallel_readers: bool = False,
+    persistent_id: str | None = None,
+    _consumer=None,
+) -> Table:
+    """Minimal-config Kafka read (reference io/kafka simple_read :299):
+    just a bootstrap server and topic, anonymous group, starting from
+    the beginning of the topic unless ``read_only_new``. For
+    authentication or tuning, use :func:`read`."""
+    import uuid
+
+    rdkafka_settings = {
+        "bootstrap.servers": server,
+        "group.id": f"pathway-simple-{uuid.uuid4().hex[:12]}",
+        "auto.offset.reset": "latest" if read_only_new else "earliest",
+    }
+    return read(
+        rdkafka_settings,
+        topic,
+        schema=schema,
+        format=format,
+        autocommit_duration_ms=autocommit_duration_ms,
+        json_field_paths=json_field_paths,
+        parallel_readers=parallel_readers,
+        persistent_id=persistent_id,
+        name="kafka.simple",
+        _consumer=_consumer,
+    )

@@ -7,6 +7,7 @@ src/persistence/input_snapshot.rs)."""
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -95,3 +96,23 @@ PersistenceMode = type("PersistenceMode", (), {"BATCH": "batch", "SPEEDRUN_REPLA
 SnapshotAccess = type("SnapshotAccess", (), {"FULL": "full", "RECORD": "record", "REPLAY": "replay"})
 
 __all__ = ["Backend", "Config", "PersistenceMode", "SnapshotAccess"]
+
+
+@contextmanager
+def get_persistence_engine_config(persistence_config: "Config | None"):
+    """Context manager bracketing a run with the persistence config's
+    before/after hooks and yielding the engine-facing config (reference
+    persistence/__init__.py:165). The engine here consumes the Config
+    object directly; None passes through for unpersisted runs."""
+    if persistence_config is None:
+        yield None
+        return
+    before = getattr(persistence_config, "on_before_run", None)
+    if before is not None:
+        before()
+    try:
+        yield persistence_config
+    finally:
+        after = getattr(persistence_config, "on_after_run", None)
+        if after is not None:
+            after()

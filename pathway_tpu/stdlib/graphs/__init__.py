@@ -102,7 +102,12 @@ from . import louvain_communities
 from .louvain_communities import exact_modularity, louvain_level
 
 __all__ = [
+    "Cluster",
+    "Clustering",
+    "Edge",
     "Graph",
+    "Vertex",
+    "Weight",
     "WeightedGraph",
     "bellman_ford",
     "exact_modularity",

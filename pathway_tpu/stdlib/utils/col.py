@@ -104,7 +104,11 @@ def unpack_col_dict(column: ColumnExpression, schema: Any) -> Table:
 
         def get(j, _f=field, _c=conv):
             v = j.value if isinstance(j, Json) else j
-            v = (v or {}).get(_f)
+            if not isinstance(v, dict):
+                # non-object JSON cell (list/str/number): no fields to
+                # extract — degrade like a missing field, don't crash
+                return None
+            v = v.get(_f)
             if isinstance(v, Json):
                 v = v.value
             if v is None:

@@ -340,6 +340,39 @@ def test_filtering_bucketing_flatten_column():
     pw.clear_graph()
 
 
+def test_unpack_col_dict_non_object_cells_yield_none():
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=pw.Json),
+        rows=[({"field_a": 1},), ([1, 2],), ("plain",)],
+    )
+
+    class DS(pw.Schema):
+        field_a: int | None
+
+    r = pw.stdlib.utils.col.unpack_col_dict(t.data, schema=DS)
+    assert sorted(run_table(r).values(), key=str) == [(1,), (None,), (None,)]
+    pw.clear_graph()
+
+
+def test_kafka_simple_read():
+    msgs = [(b"k1", b"hello"), (b"k2", b"world")]
+    t = pw.io.kafka.simple_read(
+        "srv:9092", "t", format="plaintext", _consumer=iter(msgs)
+    )
+    state = run_table(t)
+    vals = sorted(v[-1] for v in state.values())
+    assert vals == ["hello", "world"]
+    pw.clear_graph()
+
+
+def test_persistence_engine_config_ctx():
+    with pw.persistence.get_persistence_engine_config(None) as c:
+        assert c is None
+    cfg = pw.persistence.Config.simple_config(pw.persistence.Backend.mock([]))
+    with pw.persistence.get_persistence_engine_config(cfg) as c:
+        assert c is cfg
+
+
 def test_rag_client_list_documents_keys_filter(monkeypatch):
     from pathway_tpu.xpacks.llm import question_answering as qa
 
