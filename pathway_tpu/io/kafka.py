@@ -437,11 +437,25 @@ def simple_read(
     just a bootstrap server and topic, anonymous group, starting from
     the beginning of the topic unless ``read_only_new``. For
     authentication or tuning, use :func:`read`."""
+    import hashlib
+    import os
     import uuid
 
+    # one consumer group per RUN, shared by every process of a spawn
+    # cluster (PATHWAY_CLUSTER_TOKEN is minted once per `pathway spawn`)
+    # so partitioned reads split the topic instead of each process
+    # re-ingesting all of it; outside a cluster, a fresh uuid keeps
+    # separate runs from stealing each other's offsets
+    token = os.environ.get("PATHWAY_CLUSTER_TOKEN")
+    if token:
+        gid = hashlib.blake2b(
+            f"{token}:{topic}".encode(), digest_size=6
+        ).hexdigest()
+    else:
+        gid = uuid.uuid4().hex[:12]
     rdkafka_settings = {
         "bootstrap.servers": server,
-        "group.id": f"pathway-simple-{uuid.uuid4().hex[:12]}",
+        "group.id": f"pathway-simple-{gid}",
         "auto.offset.reset": "latest" if read_only_new else "earliest",
     }
     return read(

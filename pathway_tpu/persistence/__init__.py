@@ -95,7 +95,13 @@ class Config:
 PersistenceMode = type("PersistenceMode", (), {"BATCH": "batch", "SPEEDRUN_REPLAY": "speedrun", "PERSISTING": "persisting"})
 SnapshotAccess = type("SnapshotAccess", (), {"FULL": "full", "RECORD": "record", "REPLAY": "replay"})
 
-__all__ = ["Backend", "Config", "PersistenceMode", "SnapshotAccess"]
+__all__ = [
+    "Backend",
+    "Config",
+    "PersistenceMode",
+    "SnapshotAccess",
+    "get_persistence_engine_config",
+]
 
 
 @contextmanager

@@ -248,6 +248,66 @@ def test_crash_recovery_wordcount_sharded(tmp_path):
     assert _strict_apply([out1, out2]) == want
 
 
+@pytest.mark.parametrize("seed", [3, 17])
+def test_crash_recovery_random_timing(tmp_path, seed):
+    """Crash-timing fuzz: SIGKILL lands at a randomized point in the
+    ingest (after a seed-chosen number of sink events plus a random
+    extra delay), twice in a row, and exactly-once must still hold
+    across all three runs. Catches windows a fixed kill point can miss
+    (mid-commit, between offset write and data write, ...)."""
+    import random
+
+    rng = random.Random(seed)
+    (tmp_path / "in").mkdir()
+    words1 = [f"w{i % 7}" for i in range(40)]
+    _write_words(tmp_path / "in", "a.jsonl", words1)
+
+    outs = []
+    p1, out1, _ = _start(tmp_path, "r1", "filesystem")
+    outs.append(out1)
+    try:
+        _wait_for_events(out1, rng.randint(1, 5))
+        time.sleep(rng.random() * 0.4)
+        os.kill(p1.pid, signal.SIGKILL)
+        p1.wait(timeout=30)
+    finally:
+        if p1.poll() is None:
+            p1.kill()
+
+    words2 = [f"w{i % 5}" for i in range(20)]
+    _write_words(tmp_path / "in", "b.jsonl", words2)
+    p2, out2, _ = _start(tmp_path, "r2", "filesystem")
+    outs.append(out2)
+    try:
+        _wait_for_events(out2, 1)
+        time.sleep(rng.random() * 0.5)
+        os.kill(p2.pid, signal.SIGKILL)
+        p2.wait(timeout=30)
+    finally:
+        if p2.poll() is None:
+            p2.kill()
+
+    want: dict[str, int] = {}
+    for w in words1 + words2:
+        want[w] = want.get(w, 0) + 1
+    p3, out3, stop3 = _start(tmp_path, "r3", "filesystem")
+    outs.append(out3)
+    try:
+        deadline = time.monotonic() + 40
+        while time.monotonic() < deadline:
+            # _strict_apply raises on any duplicate insert or mismatched
+            # retract — the exactly-once oracle across all three runs
+            if _strict_apply(outs) == want:
+                break
+            time.sleep(0.2)
+        open(stop3, "w").close()
+        p3.wait(timeout=30)
+    finally:
+        if p3.poll() is None:
+            p3.kill()
+    assert _strict_apply(outs) == want
+
+
 def test_sharded_replay_after_crash_matches(tmp_path):
     """Record a live sharded (4-worker) run, then speedrun-replay the
     persisted stream under BOTH 1 and 4 workers: each replay's final
