@@ -437,25 +437,24 @@ def simple_read(
     just a bootstrap server and topic, anonymous group, starting from
     the beginning of the topic unless ``read_only_new``. For
     authentication or tuning, use :func:`read`."""
-    import hashlib
-    import os
     import uuid
 
-    # one consumer group per RUN, shared by every process of a spawn
-    # cluster (PATHWAY_CLUSTER_TOKEN is minted once per `pathway spawn`)
-    # so partitioned reads split the topic instead of each process
-    # re-ingesting all of it; outside a cluster, a fresh uuid keeps
-    # separate runs from stealing each other's offsets
-    token = os.environ.get("PATHWAY_CLUSTER_TOKEN")
-    if token:
-        gid = hashlib.blake2b(
-            f"{token}:{topic}".encode(), digest_size=6
-        ).hexdigest()
-    else:
-        gid = uuid.uuid4().hex[:12]
+    # each call gets its own anonymous consumer group (fresh uuid): two
+    # simple_reads over one topic each see the FULL topic, and reruns
+    # never inherit a previous run's committed offsets. The flip side:
+    # partition-sharing across a multi-process cluster needs one SHARED
+    # group, which an anonymous group cannot provide — that combination
+    # is refused rather than silently ingesting every record per
+    # process (the reference's simple_read has that silent behavior).
+    if parallel_readers:
+        raise ValueError(
+            "kafka.simple_read cannot shard partitions across processes "
+            "with an anonymous consumer group; use pw.io.kafka.read with "
+            "an explicit rdkafka 'group.id' shared by the cluster"
+        )
     rdkafka_settings = {
         "bootstrap.servers": server,
-        "group.id": f"pathway-simple-{gid}",
+        "group.id": f"pathway-simple-{uuid.uuid4().hex[:12]}",
         "auto.offset.reset": "latest" if read_only_new else "earliest",
     }
     return read(

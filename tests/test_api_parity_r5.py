@@ -355,6 +355,8 @@ def test_unpack_col_dict_non_object_cells_yield_none():
 
 
 def test_kafka_simple_read():
+    import pytest as _pytest
+
     msgs = [(b"k1", b"hello"), (b"k2", b"world")]
     t = pw.io.kafka.simple_read(
         "srv:9092", "t", format="plaintext", _consumer=iter(msgs)
@@ -362,6 +364,11 @@ def test_kafka_simple_read():
     state = run_table(t)
     vals = sorted(v[-1] for v in state.values())
     assert vals == ["hello", "world"]
+    pw.clear_graph()
+    # anonymous groups cannot shard partitions: the footgun combination
+    # is refused (a silent every-process-reads-everything would follow)
+    with _pytest.raises(ValueError, match="group.id"):
+        pw.io.kafka.simple_read("srv:9092", "t", parallel_readers=True)
     pw.clear_graph()
 
 

@@ -1,0 +1,89 @@
+"""Numerical expression namespace vs Python math semantics, through the
+full engine over a fuzzed corpus (reference analogue:
+internals/expressions/numerical.py per-method tests)."""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+import pathway_tpu as pw
+
+from .utils import run_table
+
+FLOATS = [0.0, -0.0, 1.5, -2.75, 3.14159, 100.0, 0.001, -17.25, 9.0]
+INTS = [0, 1, -1, 7, -42, 1000]
+
+
+def _ftab():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(x=float), [(v,) for v in FLOATS]
+    )
+
+
+def _itab():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(v,) for v in INTS]
+    )
+
+
+FCASES = [
+    ("abs", lambda c: c.num.abs(), abs),
+    ("round", lambda c: c.num.round(), lambda v: round(v)),
+    ("round2", lambda c: c.num.round(2), lambda v: round(v, 2)),
+    ("floor", lambda c: c.num.floor(), math.floor),
+    ("ceil", lambda c: c.num.ceil(), math.ceil),
+    ("exp", lambda c: c.num.exp(), math.exp),
+    ("sin", lambda c: c.num.sin(), math.sin),
+    ("cos", lambda c: c.num.cos(), math.cos),
+    ("tan", lambda c: c.num.tan(), math.tan),
+]
+
+
+@pytest.mark.parametrize("name,build,oracle", FCASES, ids=[c[0] for c in FCASES])
+def test_num_method_matches_python_floats(name, build, oracle):
+    t = _ftab()
+    out = t.select(x=pw.this.x, r=build(t.x))
+    for x, r in run_table(out).values():
+        w = oracle(x)
+        assert r == pytest.approx(w, rel=1e-9, abs=1e-12), (name, x, r, w)
+    pw.clear_graph()
+
+
+def test_num_positive_only_methods():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=float), [(1.0,), (4.0,), (0.25,), (math.e,)]
+    )
+    out = t.select(
+        x=pw.this.x,
+        sq=t.x.num.sqrt(),
+        ln=t.x.num.log(),
+        l2=t.x.num.log2(),
+        l10=t.x.num.log10(),
+    )
+    for x, sq, ln, l2, l10 in run_table(out).values():
+        assert sq == pytest.approx(math.sqrt(x))
+        assert ln == pytest.approx(math.log(x))
+        assert l2 == pytest.approx(math.log2(x))
+        assert l10 == pytest.approx(math.log10(x))
+    pw.clear_graph()
+
+
+def test_num_abs_round_on_ints():
+    t = _itab()
+    out = t.select(x=pw.this.x, a=t.x.num.abs(), r=t.x.num.round())
+    for x, a, r in run_table(out).values():
+        assert a == abs(x) and r == round(x), (x, a, r)
+    pw.clear_graph()
+
+
+def test_num_fill_na():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=float),
+        [(1.5,), (float("nan"),), (-2.0,)],
+    )
+    out = t.select(r=t.x.num.fill_na(0.0))
+    vals = sorted(v[0] for v in run_table(out).values())
+    assert vals == [-2.0, 0.0, 1.5]
+    pw.clear_graph()

@@ -1,0 +1,156 @@
+"""String expression namespace vs Python's own str semantics: every
+``.str`` method runs over a fuzzed corpus through the FULL engine
+(columnar evaluators + fallback) and must agree cell-for-cell with the
+plain Python call — the oracle style the reference gets from its
+per-method expression tests (reference internals/expressions/string.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+
+from .utils import run_table
+
+CORPUS = [
+    "",
+    " ",
+    "abc",
+    "  padded  ",
+    "MiXeD CaSe",
+    "tab\tsep",
+    "ünïcödé Straße",
+    "a,b,,c",
+    "  lead",
+    "trail  ",
+    "UPPER",
+    "lower",
+    "12345",
+    "-17",
+    "3.5",
+    "true",
+    "prefix_mid_suffix",
+    "aaabbbaaa",
+]
+
+
+def _table():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [(c,) for c in CORPUS]
+    )
+
+
+# (method-name, engine-expression builder, python oracle)
+CASES = [
+    ("lower", lambda c: c.str.lower(), lambda s: s.lower()),
+    ("upper", lambda c: c.str.upper(), lambda s: s.upper()),
+    ("reversed", lambda c: c.str.reversed(), lambda s: s[::-1]),
+    ("len", lambda c: c.str.len(), lambda s: len(s)),
+    ("strip", lambda c: c.str.strip(), lambda s: s.strip()),
+    ("strip_chars", lambda c: c.str.strip("a "), lambda s: s.strip("a ")),
+    ("lstrip", lambda c: c.str.lstrip(), lambda s: s.lstrip()),
+    ("rstrip", lambda c: c.str.rstrip(), lambda s: s.rstrip()),
+    ("startswith", lambda c: c.str.startswith("a"), lambda s: s.startswith("a")),
+    ("endswith", lambda c: c.str.endswith("  "), lambda s: s.endswith("  ")),
+    ("count", lambda c: c.str.count("a"), lambda s: s.count("a")),
+    ("count_rng", lambda c: c.str.count("a", 1, 7), lambda s: s.count("a", 1, 7)),
+    ("find", lambda c: c.str.find("b"), lambda s: s.find("b")),
+    ("rfind", lambda c: c.str.rfind("a"), lambda s: s.rfind("a")),
+    ("replace", lambda c: c.str.replace("a", "X"), lambda s: s.replace("a", "X")),
+    (
+        "replace_n",
+        lambda c: c.str.replace("a", "X", 2),
+        lambda s: s.replace("a", "X", 2),
+    ),
+    ("split", lambda c: c.str.split(","), lambda s: tuple(s.split(","))),
+    ("title", lambda c: c.str.title(), lambda s: s.title()),
+    ("capitalize", lambda c: c.str.capitalize(), lambda s: s.capitalize()),
+    ("casefold", lambda c: c.str.casefold(), lambda s: s.casefold()),
+    ("swapcase", lambda c: c.str.swapcase(), lambda s: s.swapcase()),
+    ("ljust", lambda c: c.str.ljust(12, "."), lambda s: s.ljust(12, ".")),
+    ("rjust", lambda c: c.str.rjust(12, "."), lambda s: s.rjust(12, ".")),
+    ("zfill", lambda c: c.str.zfill(8), lambda s: s.zfill(8)),
+    (
+        "removeprefix",
+        lambda c: c.str.removeprefix("pre"),
+        lambda s: s.removeprefix("pre"),
+    ),
+    (
+        "removesuffix",
+        lambda c: c.str.removesuffix("fix"),
+        lambda s: s.removesuffix("fix"),
+    ),
+    ("slice", lambda c: c.str.slice(1, 5), lambda s: s[1:5]),
+    ("to_bytes", lambda c: c.str.to_bytes(), lambda s: s.encode()),
+    ("to_string", lambda c: c.str.to_string(), lambda s: str(s)),
+]
+
+
+@pytest.mark.parametrize("name,build,oracle", CASES, ids=[c[0] for c in CASES])
+def test_str_method_matches_python(name, build, oracle):
+    t = _table()
+    out = t.select(s=pw.this.s, r=build(t.s))
+    state = run_table(out)
+    got = {s: r for s, r in state.values()}
+    want = {s: oracle(s) for s in CORPUS}
+    # engine may represent lists as tuples; normalize
+    norm = lambda v: tuple(v) if isinstance(v, (list, tuple)) else v
+    mism = {
+        s: (norm(got[s]), norm(want[s]))
+        for s in CORPUS
+        if norm(got[s]) != norm(want[s])
+    }
+    assert not mism, f"{name}: {mism}"
+    pw.clear_graph()
+
+
+def test_parse_int_float_bool():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("17",), ("-3",), ("0",)]
+    )
+    out = t.select(v=t.s.str.parse_int())
+    assert sorted(v[0] for v in run_table(out).values()) == [-3, 0, 17]
+    pw.clear_graph()
+
+    t2 = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("3.5",), ("-0.25",)]
+    )
+    out2 = t2.select(v=t2.s.str.parse_float())
+    assert sorted(v[0] for v in run_table(out2).values()) == [-0.25, 3.5]
+    pw.clear_graph()
+
+    t3 = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("on",), ("no",), ("true",)]
+    )
+    out3 = t3.select(v=t3.s.str.parse_bool())
+    assert sorted(v[0] for v in run_table(out3).values()) == [False, True, True]
+    pw.clear_graph()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_str_chained_random_pipelines(seed):
+    """Random 3-deep chains of string methods agree with the same chain
+    of Python calls."""
+    rng = np.random.default_rng(seed)
+    chain_pool = [
+        (lambda e: e.str.lower(), lambda s: s.lower()),
+        (lambda e: e.str.strip(), lambda s: s.strip()),
+        (lambda e: e.str.replace("a", "b"), lambda s: s.replace("a", "b")),
+        (lambda e: e.str.title(), lambda s: s.title()),
+        (lambda e: e.str.slice(0, 6), lambda s: s[0:6]),
+        (lambda e: e.str.swapcase(), lambda s: s.swapcase()),
+    ]
+    picks = [chain_pool[int(i)] for i in rng.integers(0, len(chain_pool), 3)]
+    t = _table()
+    e = t.s
+    for b, _ in picks:
+        e = b(e)
+    out = t.select(s=pw.this.s, r=e)
+    state = run_table(out)
+    for s, r in state.values():
+        w = s
+        for _, o in picks:
+            w = o(w)
+        assert r == w, (s, r, w)
+    pw.clear_graph()
