@@ -223,6 +223,7 @@ def groupby(table, *args, **kw):
 
 
 from .stdlib import temporal as window  # pw.window.tumbling(...) namespace
+from . import analysis  # pw.analysis.analyze / suppress (static verifier)
 
 
 def __getattr__(name):
@@ -253,5 +254,5 @@ __all__ = [
     "output_attribute", "transformer",
     "set_monitoring_config", "sql", "stdlib", "temporal", "this", "udf",
     "udfs", "unpack_col", "unsafe_make_pointer", "unwrap", "utils",
-    "wrap_py_object", "xpacks", "universes", "LiveTable",
+    "wrap_py_object", "xpacks", "universes", "LiveTable", "analysis",
 ]

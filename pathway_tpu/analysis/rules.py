@@ -1,0 +1,670 @@
+"""The initial rule pack of the pre-execution graph verifier.
+
+Every rule has a stable id (``PWL001``…), walks the logical parse graph
+(see :mod:`.graph_view`), and yields :class:`..analysis.Diagnostic`
+records anchored to the offending operator's build-time call site.
+
+Rules
+-----
+PWL001 (error)   dtype consistency across operator boundaries: join key
+                 dtype mismatches, non-bool filter predicates, concat /
+                 update columns whose concrete types do not unify.
+PWL002 (error)   unbounded state: groupby/join/deduplicate fed by a
+                 streaming connector with no window grouping and no
+                 state-bounding temporal behavior (cutoff/freeze).
+PWL003 (warning) shard safety: UDFs capturing mutable globals or
+                 closures, non-deterministic expressions routing keys
+                 through ``shard_of_value``, reducers that are not
+                 commutative/associative per the engine registry.
+PWL004 (warning) JAX UDF purity: jit-batched UDFs that close over JAX
+                 tracers (error), call host numpy from a jitted
+                 function, or perform Python side effects.
+PWL005 (info)    dead columns: columns never read by any consumer on
+                 the way to an output (wasted exchange bandwidth).
+PWL006 (info)    unconnected tables/nodes: built but feeding no output.
+"""
+
+from __future__ import annotations
+
+import dis
+from typing import Any, Callable, Iterable
+
+from ..engine import reducers as engine_reducers
+from ..internals import dtype as dt
+from ..internals.expression import (
+    ApplyExpression,
+    AsyncApplyExpression,
+    ColumnExpression,
+    ColumnReference as ColumnReferenceExpr,
+    ReducerExpression,
+)
+from ..internals.table import LogicalOp, Table
+from ..internals.udfs import _DynamicBatcher
+from .diagnostics import Diagnostic, Severity
+from .graph_view import (
+    GraphView,
+    PASSTHROUGH_KINDS,
+    SOURCE_KINDS,
+    expr_applies,
+    expr_refs,
+    grouping_is_windowed,
+    iter_param_exprs,
+    join_is_windowed,
+    walk_expr,
+)
+
+#: rule id -> (default severity, one-line title); the README's "Static
+#: analysis" section mirrors this table.
+RULES: dict[str, tuple[Severity, str]] = {
+    "PWL001": (Severity.ERROR, "dtype mismatch across operator boundary"),
+    "PWL002": (Severity.ERROR, "unbounded state on a streaming source"),
+    "PWL003": (Severity.WARNING, "shard-unsafe UDF / key routing / reducer"),
+    "PWL004": (Severity.WARNING, "impure jit-batched UDF"),
+    "PWL005": (Severity.INFO, "dead column (never read downstream)"),
+    "PWL006": (Severity.INFO, "unconnected table / engine node"),
+}
+
+_MUTABLE_TYPES = (list, dict, set, bytearray)
+
+
+def _diag(
+    rule: str,
+    message: str,
+    table: Table | None = None,
+    *,
+    severity: Severity | None = None,
+    detail: dict | None = None,
+) -> Diagnostic:
+    op = table._op if table is not None else None
+    return Diagnostic(
+        rule=rule,
+        severity=severity if severity is not None else RULES[rule][0],
+        message=message,
+        table=table._name if table is not None else None,
+        table_id=table._id if table is not None else None,
+        op_kind=op.kind if op is not None else None,
+        trace=op.trace if op is not None else None,
+        detail=detail or {},
+    )
+
+
+def _is_concrete(d: dt.DType) -> bool:
+    if d is dt.ANY:
+        return False
+    if isinstance(d, dt.Optional):
+        return _is_concrete(d.wrapped)
+    return True
+
+
+def _unifies(a: dt.DType, b: dt.DType) -> bool:
+    if not (_is_concrete(a) and _is_concrete(b)):
+        return True  # ANY anywhere: dynamically typed, nothing to prove
+    return dt.lub(a, b) is not dt.ANY
+
+
+# --------------------------------------------------------------------------
+# PWL001 — dtype consistency across operator boundaries
+
+
+def check_dtype_consistency(view: GraphView) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    for t in view.tables:
+        op = t._op
+        if op.kind == "join_select":
+            for cond in op.params.get("on") or []:
+                left = getattr(cond, "_left", None)
+                right = getattr(cond, "_right", None)
+                if not isinstance(left, ColumnExpression) or not isinstance(
+                    right, ColumnExpression
+                ):
+                    continue
+                ld, rd = left._dtype, right._dtype
+                if not _unifies(ld, rd):
+                    out.append(
+                        _diag(
+                            "PWL001",
+                            f"join key dtypes do not unify: {ld} vs {rd} "
+                            "— rows can never match and the key hash "
+                            "routes them to different shards",
+                            t,
+                            detail={"left": str(ld), "right": str(rd)},
+                        )
+                    )
+        elif op.kind == "filter":
+            pred = op.params.get("expr")
+            if pred is not None:
+                d = pred._dtype
+                base = d.wrapped if isinstance(d, dt.Optional) else d
+                if _is_concrete(d) and base is not dt.BOOL:
+                    out.append(
+                        _diag(
+                            "PWL001",
+                            f"filter predicate has dtype {d}, expected BOOL",
+                            t,
+                            detail={"dtype": str(d)},
+                        )
+                    )
+        elif op.kind in ("concat", "concat_reindex", "update_rows", "update_cells"):
+            for name in t._columns:
+                dtypes = [
+                    inp._columns[name].dtype
+                    for inp in op.inputs
+                    if name in inp._columns
+                ]
+                concrete = [d for d in dtypes if _is_concrete(d)]
+                for other in concrete[1:]:
+                    if not _unifies(concrete[0], other):
+                        out.append(
+                            _diag(
+                                "PWL001",
+                                f"column {name!r} has incompatible dtypes "
+                                f"across {op.kind} inputs: "
+                                f"{concrete[0]} vs {other}",
+                                t,
+                                detail={"column": name},
+                            )
+                        )
+                        break
+    return out
+
+
+# --------------------------------------------------------------------------
+# PWL002 — unbounded state
+
+
+def check_unbounded_state(view: GraphView) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    for t in view.tables:
+        op = t._op
+        if op.kind == "groupby_reduce":
+            src = op.inputs[0]
+            if not view.is_streaming(src):
+                continue
+            if grouping_is_windowed(op) or view.streaming_paths_mitigated(op):
+                continue
+            out.append(
+                _diag(
+                    "PWL002",
+                    "groupby/reduce over a streaming source retains state "
+                    "for every group forever; attach a window "
+                    "(t.windowby(...)) or a temporal behavior with a "
+                    "cutoff/freeze threshold",
+                    t,
+                )
+            )
+        elif op.kind == "join_select":
+            how = str(op.params.get("how") or "inner")
+            if how.startswith("asof_now"):
+                continue  # left side is not stored
+            streaming = [inp for inp in op.inputs if view.is_streaming(inp)]
+            if not streaming:
+                continue
+            if join_is_windowed(op) or view.streaming_paths_mitigated(op):
+                continue
+            both = len(streaming) == len(op.inputs)
+            out.append(
+                _diag(
+                    "PWL002",
+                    (
+                        "join between two streaming sources stores both "
+                        "sides unboundedly"
+                        if both
+                        else "join with a streaming input stores that side's "
+                        "full history"
+                    )
+                    + "; window the join keys or use asof_now semantics",
+                    t,
+                    severity=Severity.ERROR if both else Severity.WARNING,
+                )
+            )
+        elif op.kind == "deduplicate":
+            src = op.inputs[0]
+            if not view.is_streaming(src):
+                continue
+            if op.params.get("instance") is None:
+                continue  # single global instance: O(1) state
+            if view.streaming_paths_mitigated(op):
+                continue
+            out.append(
+                _diag(
+                    "PWL002",
+                    "deduplicate with an instance key over a streaming "
+                    "source keeps one row per distinct instance forever",
+                    t,
+                    severity=Severity.WARNING,
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# PWL003 — shard safety
+
+
+def _unwrap_fn(fn: Any) -> Any:
+    seen = 0
+    while hasattr(fn, "__wrapped__") and seen < 10:
+        fn = fn.__wrapped__
+        seen += 1
+    return fn
+
+
+def _user_fn(expr: ApplyExpression) -> Any | None:
+    """The user-authored callable behind an apply expression, or None
+    for package-internal helpers (windowby desugaring etc.)."""
+    fn = expr._fn
+    if isinstance(fn, _DynamicBatcher):
+        fn = fn.batch_fn
+    fn = _unwrap_fn(fn)
+    if isinstance(fn, _DynamicBatcher):
+        fn = _unwrap_fn(fn.batch_fn)
+    mod = getattr(fn, "__module__", "") or ""
+    if mod.startswith("pathway_tpu"):
+        return None
+    return fn
+
+
+def _mutable_captures(fn: Any) -> list[str]:
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return []
+    found: list[str] = []
+    fn_globals = getattr(fn, "__globals__", {})
+    for name in code.co_names:
+        if isinstance(fn_globals.get(name), _MUTABLE_TYPES):
+            found.append(f"global {name!r}")
+    for var, cell in zip(code.co_freevars, fn.__closure__ or ()):
+        try:
+            value = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(value, _MUTABLE_TYPES):
+            found.append(f"closure {var!r}")
+    return found
+
+
+def _reducer_registry() -> dict[str, type]:
+    reg: dict[str, type] = {}
+    for obj in vars(engine_reducers).values():
+        if (
+            isinstance(obj, type)
+            and issubclass(obj, engine_reducers.Reducer)
+            and obj is not engine_reducers.Reducer
+        ):
+            reg[obj.name] = obj
+    # stdlib aliases lowered onto StatefulReducer (graph_runner)
+    reg.setdefault("stateful", engine_reducers.StatefulReducer)
+    reg["stateful_single"] = engine_reducers.StatefulReducer
+    reg["stateful_many"] = engine_reducers.StatefulReducer
+    return reg
+
+
+#: param keys whose expressions decide a row's shard / output key
+_KEY_PARAMS = {
+    "groupby_reduce": ("grouping", "id_from"),
+    "join_select": ("on", "id_from"),
+    "reindex": ("expr",),
+    "deduplicate": ("instance",),
+}
+
+
+def check_shard_safety(view: GraphView) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    reducer_registry = _reducer_registry()
+    seen_fns: set[int] = set()
+    for t in view.tables:
+        op = t._op
+        # (a) UDFs capturing mutable state — any apply anywhere
+        for key, expr in iter_param_exprs(op.params):
+            for apply_expr in expr_applies(expr):
+                fn = _user_fn(apply_expr)
+                if fn is None or id(fn) in seen_fns:
+                    continue
+                seen_fns.add(id(fn))
+                for what in _mutable_captures(fn):
+                    out.append(
+                        _diag(
+                            "PWL003",
+                            f"UDF {getattr(fn, '__name__', fn)!r} captures "
+                            f"mutable state ({what}); each worker shard "
+                            "holds its own copy, so results diverge "
+                            "across shards and replays",
+                            t,
+                            detail={"param": key},
+                        )
+                    )
+        # (b) non-deterministic key routing
+        for key in _KEY_PARAMS.get(op.kind, ()):
+            value = op.params.get(key)
+            if value is None:
+                continue
+            exprs = value if isinstance(value, (list, tuple)) else [value]
+            for expr in exprs:
+                if not isinstance(expr, ColumnExpression):
+                    continue
+                for apply_expr in expr_applies(expr):
+                    if not getattr(apply_expr, "_deterministic", True):
+                        fn = _unwrap_fn(apply_expr._fn)
+                        out.append(
+                            _diag(
+                                "PWL003",
+                                "non-deterministic UDF "
+                                f"{getattr(fn, '__name__', 'udf')!r} computes "
+                                f"a {op.kind} key: shard_of_value may route "
+                                "the same logical row to different shards "
+                                "on recomputation; mark it "
+                                "deterministic=True or precompute the key",
+                                t,
+                                detail={"param": key},
+                            )
+                        )
+        # (c) non-commutative / non-associative reducers
+        if op.kind == "groupby_reduce":
+            for name, expr in (op.params.get("exprs") or {}).items():
+                reducer_names: list[str] = []
+                walk_expr(
+                    expr,
+                    lambda e: reducer_names.append(e._reducer_name)
+                    if isinstance(e, ReducerExpression)
+                    else None,
+                )
+                for rname in reducer_names:
+                    cls = reducer_registry.get(rname)
+                    if cls is None:
+                        continue
+                    if not (
+                        getattr(cls, "commutative", True)
+                        and getattr(cls, "associative", True)
+                    ):
+                        out.append(
+                            _diag(
+                                "PWL003",
+                                f"reducer {rname!r} (column {name!r}) is not "
+                                "commutative/associative: merging partial "
+                                "aggregates across shards is order-"
+                                "dependent",
+                                t,
+                                detail={"column": name, "reducer": rname},
+                            )
+                        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# PWL004 — JAX UDF purity
+
+
+def _is_jit_callable(fn: Any) -> bool:
+    mod = getattr(type(fn), "__module__", "") or ""
+    return mod.startswith("jax") or type(fn).__name__ in (
+        "PjitFunction",
+        "CompiledFunction",
+    )
+
+
+def _batch_fn(expr: AsyncApplyExpression) -> Any | None:
+    fn = expr._fn
+    for _ in range(10):
+        if isinstance(fn, _DynamicBatcher):
+            return fn.batch_fn
+        if hasattr(fn, "__wrapped__"):
+            fn = fn.__wrapped__
+        else:
+            return None
+    return None
+
+
+def check_jax_udf_purity(view: GraphView) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    seen: set[int] = set()
+    for t in view.tables:
+        for key, expr in iter_param_exprs(t._op.params):
+            for apply_expr in expr_applies(expr):
+                if not isinstance(apply_expr, AsyncApplyExpression):
+                    continue
+                fn = _batch_fn(apply_expr)
+                if fn is None or id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                out.extend(_inspect_batch_fn(fn, t, key))
+    return out
+
+
+def _inspect_batch_fn(fn: Any, table: Table, param: str) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    jitted = _is_jit_callable(fn)
+    inner = _unwrap_fn(fn)
+    name = getattr(inner, "__name__", getattr(fn, "__name__", "batch_udf"))
+    code = getattr(inner, "__code__", None)
+    # closing over a live tracer (closure cell or module global): the
+    # jit trace that produced it is gone by run time — always an error
+    captured: list[tuple[str, Any]] = []
+    for var, cell in zip(
+        getattr(code, "co_freevars", ()), getattr(inner, "__closure__", None) or ()
+    ):
+        try:
+            captured.append((var, cell.cell_contents))
+        except ValueError:
+            continue
+    inner_globals = getattr(inner, "__globals__", {})
+    for var in getattr(code, "co_names", ()):
+        if var in inner_globals:
+            captured.append((var, inner_globals[var]))
+    for var, value in captured:
+        if "Tracer" in type(value).__name__:
+            out.append(
+                _diag(
+                    "PWL004",
+                    f"jit-batched UDF {name!r} closes over a JAX tracer "
+                    f"({var!r}): the trace it belongs to has ended and "
+                    "the value is invalid at run time",
+                    table,
+                    severity=Severity.ERROR,
+                    detail={"param": param, "capture": var},
+                )
+            )
+    if code is None:
+        return out
+    fn_globals = getattr(inner, "__globals__", {})
+
+    def _module_name(value: Any) -> str:
+        return getattr(value, "__name__", "") if type(value).__name__ == "module" else ""
+
+    refs_numpy = any(
+        _module_name(fn_globals.get(n)) == "numpy" for n in code.co_names
+    )
+    refs_jax = jitted or any(
+        _module_name(fn_globals.get(n)).startswith("jax") for n in code.co_names
+    )
+    if refs_numpy and refs_jax:
+        out.append(
+            _diag(
+                "PWL004",
+                f"jit-batched UDF {name!r} calls host numpy on values that "
+                "are traced under jit; use jax.numpy inside the batched "
+                "function",
+                table,
+                detail={"param": param},
+            )
+        )
+    side_effects = [n for n in code.co_names if n in ("print", "open")]
+    has_store_global = any(
+        ins.opname in ("STORE_GLOBAL", "DELETE_GLOBAL")
+        for ins in dis.get_instructions(code)
+    )
+    if side_effects or has_store_global:
+        what = (
+            f"calls {side_effects[0]}()"
+            if side_effects
+            else "writes a global variable"
+        )
+        out.append(
+            _diag(
+                "PWL004",
+                f"jit-batched UDF {name!r} {what}: side effects run once "
+                "per trace, not once per batch, under jit",
+                table,
+                detail={"param": param},
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# PWL005 — dead columns
+
+
+def _mark_refs(exprs: Iterable[ColumnExpression], live: set) -> bool:
+    changed = False
+    for expr in exprs:
+        for ref in expr_refs(expr):
+            tbl = ref._table
+            if isinstance(tbl, Table):
+                k = (tbl._id, ref._name)
+                if k not in live:
+                    live.add(k)
+                    changed = True
+    return changed
+
+
+def check_dead_columns(view: GraphView) -> list[Diagnostic]:
+    roots = view.output_tables
+    if not roots:
+        return []
+    reachable = view.reachable_from_outputs()
+    tables = [t for t in view.tables if t._id in reachable]
+    by_id = {t._id: t for t in tables}
+    live: set[tuple[int, str]] = set()
+    for r in roots:
+        for n in r._columns:
+            live.add((r._id, n))
+
+    def step() -> bool:
+        changed = False
+        for t in tables:
+            op = t._op
+            params = op.params
+            out_live = [n for n in t._columns if (t._id, n) in live]
+            if not out_live and t._id not in {r._id for r in roots}:
+                continue
+            kind = op.kind
+            if kind in ("select", "concat_columns", "groupby_reduce", "join_select"):
+                exprs_map = params.get("exprs") or {}
+                changed |= _mark_refs(
+                    (e for n, e in exprs_map.items() if n in out_live), live
+                )
+                other = {k: v for k, v in params.items() if k != "exprs"}
+                changed |= _mark_refs((e for _, e in iter_param_exprs(other)), live)
+            elif kind in PASSTHROUGH_KINDS:
+                changed |= _mark_refs((e for _, e in iter_param_exprs(params)), live)
+                if kind == "flatten":
+                    col = params.get("column")
+                    for inp in op.inputs:
+                        if col in inp._columns and (inp._id, col) not in live:
+                            live.add((inp._id, col))
+                            changed = True
+                for n in out_live:
+                    for inp in op.inputs:
+                        if n in inp._columns and (inp._id, n) not in live:
+                            live.add((inp._id, n))
+                            changed = True
+                if kind == "sort":
+                    # sort's output rows pair with the input's whole rows
+                    for inp in op.inputs:
+                        for n in inp._columns:
+                            if (inp._id, n) not in live:
+                                live.add((inp._id, n))
+                                changed = True
+            elif kind in SOURCE_KINDS:
+                continue
+            else:
+                # unknown/opaque kinds: conservatively everything is read
+                changed |= _mark_refs((e for _, e in iter_param_exprs(params)), live)
+                for inp in view.op_inputs(op):
+                    for n in inp._columns:
+                        if (inp._id, n) not in live:
+                            live.add((inp._id, n))
+                            changed = True
+        return changed
+
+    while step():
+        pass
+
+    def materialized_here(t: Table, n: str) -> bool:
+        # report a dead column only where it is produced (a source table
+        # or a computed/renamed expression), not at every operator that
+        # merely carries it along — one finding at the origin instead of
+        # an echo per pipeline stage
+        op = t._op
+        if op.kind in SOURCE_KINDS:
+            return True
+        if op.kind in ("select", "concat_columns", "groupby_reduce", "join_select"):
+            e = (op.params.get("exprs") or {}).get(n)
+            if e is None:
+                return False
+            if isinstance(e, ColumnReferenceExpr) and e._name == n:
+                return False  # bare same-name carry (with_columns etc.)
+            return True
+        return False
+
+    out: list[Diagnostic] = []
+    root_ids = {r._id for r in roots}
+    for t in tables:
+        if t._id in root_ids:
+            continue
+        dead = [
+            n
+            for n in t._columns
+            if (t._id, n) not in live
+            and not n.startswith("_pw")
+            and materialized_here(t, n)
+        ]
+        if dead:
+            out.append(
+                _diag(
+                    "PWL005",
+                    f"column(s) {', '.join(repr(n) for n in sorted(dead))} "
+                    "are never read on any path to an output; they are "
+                    "computed and exchanged for nothing",
+                    t,
+                    detail={"columns": sorted(dead)},
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# PWL006 — unconnected tables
+
+
+def check_unconnected(view: GraphView) -> list[Diagnostic]:
+    if not view.output_tables:
+        return []
+    reachable = view.reachable_from_outputs()
+    out: list[Diagnostic] = []
+    for t in view.tables:
+        if t._id in reachable:
+            continue
+        if view.consumers.get(t._id):
+            continue  # an ancestor leaf will be reported instead
+        if t._op.kind == "error_log":
+            continue
+        out.append(
+            _diag(
+                "PWL006",
+                "table is built but feeds no output, subscription, or "
+                "downstream operator — it will never execute",
+                t,
+            )
+        )
+    return out
+
+
+LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
+    check_dtype_consistency,
+    check_unbounded_state,
+    check_shard_safety,
+    check_jax_udf_purity,
+    check_dead_columns,
+    check_unconnected,
+]

@@ -61,19 +61,30 @@ def _spawn_program(
         env_base["PATHWAY_REPLAY_MODE"] = replay_mode or "record"
 
     procs: list[subprocess.Popen] = []
-    for pid in range(processes):
-        env = dict(env_base)
-        env["PATHWAY_PROCESS_ID"] = str(pid)
-        procs.append(subprocess.Popen(argv, env=env))
+    try:
+        for pid in range(processes):
+            env = dict(env_base)
+            env["PATHWAY_PROCESS_ID"] = str(pid)
+            procs.append(subprocess.Popen(argv, env=env))
+    except OSError:
+        for p in procs:
+            p.terminate()
+        raise
     rc = 0
     try:
         for p in procs:
             code = p.wait()
+            if code < 0:
+                # killed by signal: report the conventional 128+N status
+                # instead of letting sys.exit() truncate the negative
+                code = 128 - code
             if code and not rc:
                 rc = code
     except KeyboardInterrupt:
         for p in procs:
             p.terminate()
+        for p in procs:
+            p.wait()
         rc = 130
     return rc
 
@@ -110,7 +121,44 @@ def spawn_from_env():
     raw = os.environ.get("PATHWAY_SPAWN_ARGS", "")
     if not raw:
         raise click.UsageError("PATHWAY_SPAWN_ARGS is not set")
-    spawn.main(args=shlex.split(raw), standalone_mode=True)
+    # standalone_mode=False returns instead of exiting, so the child's
+    # status reaches OUR caller rather than being decided inside the
+    # nested click invocation
+    try:
+        rv = spawn.main(args=shlex.split(raw), standalone_mode=False)
+    except SystemExit as e:  # spawn's callback sys.exit()s its rc
+        sys.exit(e.code or 0)
+    sys.exit(int(rv) if rv else 0)
+
+
+@cli.command()
+@click.option("--json", "as_json", is_flag=True, help="emit diagnostics as JSON")
+@click.option(
+    "--strict-warnings",
+    is_flag=True,
+    help="exit nonzero on warnings too, not only errors",
+)
+@click.argument("program", required=True)
+@click.argument("arguments", nargs=-1)
+def analyze(as_json, strict_warnings, program, arguments):
+    """Statically verify PROGRAM's dataflow graph without running it.
+
+    The program executes with PATHWAY_ANALYZE_ONLY=1, so pw.run()
+    returns before building sinks or starting connectors; the verifier
+    (pathway_tpu.analysis, rules PWL001..PWL006) then walks the graph it
+    described. Exits 1 when errors are found, 3 when the program itself
+    fails to build its graph.
+    """
+    from .analysis.program import analyze_program
+
+    sys.exit(
+        analyze_program(
+            program,
+            list(arguments),
+            as_json=as_json,
+            strict_warnings=strict_warnings,
+        )
+    )
 
 
 def main() -> None:

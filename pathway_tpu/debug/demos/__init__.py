@@ -1,0 +1,24 @@
+"""Self-contained demo pipelines, one per file.
+
+Each module is a runnable program (``python -m pathway_tpu.cli analyze
+pathway_tpu/debug/demos/word_counts.py`` or plain ``python ...``) and
+doubles as the repo's self-lint corpus: tests/test_self_lint.py runs the
+static verifier over every demo here and fails on any error-severity
+finding, so a rule regression (or a demo that develops an unbounded
+state bug) breaks tier-1.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEMO_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def demo_programs() -> list[str]:
+    """Absolute paths of every runnable demo pipeline in this package."""
+    return sorted(
+        os.path.join(DEMO_DIR, f)
+        for f in os.listdir(DEMO_DIR)
+        if f.endswith(".py") and not f.startswith("_")
+    )

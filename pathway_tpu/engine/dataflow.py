@@ -50,7 +50,18 @@ INF_TIME = float("inf")
 
 
 class EngineError(Exception):
-    pass
+    """Fatal failure inside an engine operator.
+
+    Carries the operator's identity — ``node_name``/``node_id`` and the
+    build-time user ``trace`` (an ``internals.trace.Frame``) — so a
+    runtime failure cites the same source location the static verifier
+    (``pathway_tpu.analysis``) uses for its diagnostics."""
+
+    def __init__(self, message, *, node=None, trace=None):
+        super().__init__(message)
+        self.node_name = getattr(node, "name", None)
+        self.node_id = getattr(node, "id", None)
+        self.trace = trace if trace is not None else getattr(node, "user_frame", None)
 
 
 def consolidate(updates: list[Update]) -> list[Update]:
@@ -450,7 +461,8 @@ class SessionSourceNode(Node):
                 else:
                     raise EngineError(
                         f"source {self.name!r} is declared append_only "
-                        "but produced a retraction"
+                        "but produced a retraction",
+                        node=self,
                     )
             self.emit(out, time)
             return out
@@ -664,7 +676,8 @@ class ConcatNode(Node):
                         self.owners[key] = port
                     elif owner != port:
                         raise EngineError(
-                            f"concat: duplicate key {Pointer(key)} from inputs {owner} and {port}"
+                            f"concat: duplicate key {Pointer(key)} from inputs {owner} and {port}",
+                            node=self,
                         )
                 out.append((key, row, diff))
         self.emit(out, time)
@@ -704,7 +717,9 @@ class FlattenNode(Node):
                 try:
                     items = list(v)
                 except TypeError:
-                    raise EngineError(f"flatten: value {v!r} is not iterable")
+                    raise EngineError(
+                        f"flatten: value {v!r} is not iterable", node=self
+                    )
             for i, item in enumerate(items):
                 nk = ref_scalar(Pointer(key), i)
                 out.append((nk, row[: self.col] + (item,) + row[self.col + 1 :], diff))
@@ -1158,7 +1173,9 @@ class JoinNode(Node):
                 if orow is None or not rows_equal(orow, nrow):
                     out.append((ok, nrow, 1))
             if self.exact_match and self.left.get(jk) and not self.right.get(jk):
-                raise EngineError(f"ix: key {jk!r} missing in indexed table")
+                raise EngineError(
+                    f"ix: key {jk!r} missing in indexed table", node=self
+                )
         self.emit(out, time)
 
 
@@ -1953,7 +1970,8 @@ class EngineGraph:
             else:
                 where = ""
             raise EngineError(
-                f"error in operator {origin.name} (id {origin.id}): {exc!r}{where}"
+                f"error in operator {origin.name} (id {origin.id}): {exc!r}{where}",
+                node=origin,
             ) from exc
         import traceback
 

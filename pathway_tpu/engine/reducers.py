@@ -29,6 +29,14 @@ class Reducer:
     #: semigroup reducers support O(1) add/retract
     is_semigroup = False
     name = "reducer"
+    #: analysis registry (pathway_tpu.analysis rule PWL003): commutative/
+    #: associative reducers produce the same result regardless of the
+    #: order updates arrive in, so merging partial aggregates across
+    #: shards is safe. Order- or processing-time-sensitive reducers
+    #: override these with False and are flagged by the verifier when
+    #: used in a sharded groupby.
+    commutative = True
+    associative = True
 
     def compute(self, values: Iterable[tuple]) -> Any:
         raise NotImplementedError
@@ -396,6 +404,8 @@ class EarliestReducer(Reducer):
 
     name = "earliest"
     needs_time = True
+    # result depends on per-shard processing-time order
+    commutative = False
 
     def compute(self, values):
         best = None
@@ -408,6 +418,8 @@ class EarliestReducer(Reducer):
 class LatestReducer(Reducer):
     name = "latest"
     needs_time = True
+    # result depends on per-shard processing-time order
+    commutative = False
 
     def compute(self, values):
         best = None
@@ -423,6 +435,9 @@ class StatefulReducer(Reducer):
     recompute-from-scratch semantics)."""
 
     name = "stateful"
+    # user combine fn: no algebraic guarantees
+    commutative = False
+    associative = False
 
     def __init__(self, fn: Callable[[list], Any]):
         self.fn = fn

@@ -4,11 +4,36 @@ Rebuild of /root/reference/python/pathway/internals/run.py (:12,:56)."""
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import Any
 
 from .graph_runner import GraphRunner
 from .parse_graph import G
+
+
+def _run_analysis(mode: str | None) -> None:
+    """The opt-in pre-run verifier gate: "strict" raises AnalysisError
+    on error-severity findings before any sink is built or connector
+    started; "warn" prints them to stderr and continues; "off" (the
+    default) skips. PATHWAY_ANALYSIS supplies the mode when the arg is
+    None."""
+    if mode is None:
+        mode = os.environ.get("PATHWAY_ANALYSIS", "off")
+    if mode in ("off", None):
+        return
+    if mode not in ("strict", "warn"):
+        raise ValueError(
+            f"analysis={mode!r}: expected 'strict', 'warn', or 'off'"
+        )
+    from ..analysis import AnalysisError, analyze, has_errors, render_human
+
+    diags = analyze(G)
+    if not diags:
+        return
+    if mode == "strict" and has_errors(diags):
+        raise AnalysisError(diags)
+    print(render_human(diags), file=sys.stderr)
 
 
 def run(
@@ -20,10 +45,16 @@ def run(
     license_key: str | None = None,
     runtime_typechecking: bool = True,
     terminate_on_error: bool = True,
+    analysis: str | None = None,
     **kwargs: Any,
 ) -> None:
     """Execute all registered outputs/subscriptions to completion
     (static sources) or until all streaming connectors close."""
+    if os.environ.get("PATHWAY_ANALYZE_ONLY"):
+        # `pathway analyze <program>`: the graph is fully described at
+        # this point — return before sinks are built or readers started
+        return
+    _run_analysis(analysis)
     from .config import get_pathway_config, pathway_config
     from .licensing import License, check_worker_count
     from .telemetry import Telemetry

@@ -115,7 +115,16 @@ def _format_frame(frame: Frame) -> str:
 
 def _attach_call_site(exc: BaseException, frame: Frame) -> None:
     setattr(exc, _ORIGIN_ATTR, frame)
-    exc.add_note(_format_frame(frame))
+    note = _format_frame(frame)
+    add_note = getattr(exc, "add_note", None)
+    if add_note is not None:
+        add_note(note)
+    else:  # Python < 3.11: emulate PEP 678 so __notes__ consumers work
+        notes = getattr(exc, "__notes__", None)
+        if notes is None:
+            notes = []
+            exc.__notes__ = notes
+        notes.append(note)
 
 
 def trace_user_frame(func: Callable) -> Callable:

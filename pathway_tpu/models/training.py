@@ -63,16 +63,29 @@ class ContrastiveTrainer:
         self.params = init(jax.random.PRNGKey(seed))
         # optax moments mirror the param tree -> inherit param shardings under jit
         self.opt_state = jax.jit(self.tx.init)(self.params)
+        # leaves with no param dependence (adam's step count) come back
+        # committed to a single device; replicate them over the mesh so
+        # the whole state lives on one device set
+        rep = NamedSharding(self.mesh, P())
+        self.opt_state = jax.tree_util.tree_map(
+            lambda x: x if len(x.sharding.device_set) > 1 else jax.device_put(x, rep),
+            self.opt_state,
+        )
+        # donation requires in/out buffers to alias exactly, so pin the
+        # opt state to the shardings it was materialized with — leaving
+        # them unspecified lets GSPMD re-shard between steps and the
+        # donated buffer no longer matches its output alias
+        o_sharding = jax.tree_util.tree_map(lambda x: x.sharding, self.opt_state)
 
         dsh = data_sharding(self.mesh)
 
         @partial(
             jax.jit,
             donate_argnums=(0, 1),
-            in_shardings=(self.p_sharding, None, dsh, dsh, dsh, dsh),
+            in_shardings=(self.p_sharding, o_sharding, dsh, dsh, dsh, dsh),
             # pin params' output sharding too — otherwise GSPMD may
             # re-shard them across steps and the pinned input mismatches
-            out_shardings=(self.p_sharding, None, None),
+            out_shardings=(self.p_sharding, o_sharding, None),
         )
         def train_step(params, opt_state, ids_a, mask_a, ids_b, mask_b):
             def loss_fn(p):

@@ -26,6 +26,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; accept either
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 NEG = -3.0e38  # sentinel below any real score
 
 
@@ -163,7 +166,7 @@ def knn_topk(
             jax.ShapeDtypeStruct((q.shape[0], k), jnp.float32),
             jax.ShapeDtypeStruct((q.shape[0], k), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -194,7 +197,7 @@ def knn_topk_sharded(
     cross-device merge of the reference's sharded index story
     (usearch_integration.rs:53 redesigned for the mesh). Queries are
     replicated. Returns global ([Q, k], [Q, k])."""
-    from jax import shard_map  # jax >= 0.8 (the pinned runtime)
+    from ..parallel.sharding import shard_map  # version-compat wrapper
     from jax.sharding import PartitionSpec as P
 
     n_shards = mesh.shape["data"]

@@ -71,6 +71,20 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool | None = None):
+    """jax.shard_map across jax versions: the top-level export (jax >=
+    0.6, kwarg check_vma) or jax.experimental.shard_map (0.4.x, kwarg
+    check_rep — same meaning)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as sm
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 def host_mesh_from_env() -> Mesh | None:
     """Multi-host init: when PATHWAY_PROCESSES/PROCESS_ID are set (same
     env contract as the reference's config.rs:88-120), join the cluster

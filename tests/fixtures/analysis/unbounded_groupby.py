@@ -1,0 +1,14 @@
+"""Analysis fixture: groupby over a streaming source with no window —
+the verifier must flag PWL002 (unbounded state) and exit nonzero."""
+
+import pathway_tpu as pw
+
+events = pw.demo.range_stream(nb_rows=5, input_rate=1000.0)
+
+per_key = events.groupby(pw.this.value).reduce(
+    pw.this.value, n=pw.reducers.count()
+)
+
+pw.io.null.write(per_key)
+
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)

@@ -1,0 +1,17 @@
+"""Analysis fixture: the same aggregation as unbounded_groupby.py but
+windowed — the verifier must pass it clean (exit 0)."""
+
+import pathway_tpu as pw
+
+events = pw.demo.range_stream(nb_rows=5, input_rate=1000.0)
+
+per_window = events.windowby(
+    pw.this.value,
+    window=pw.temporal.tumbling(duration=10),
+).reduce(
+    n=pw.reducers.count(),
+)
+
+pw.io.null.write(per_window)
+
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)

@@ -1,0 +1,429 @@
+"""Static verifier (pathway_tpu.analysis): one positive and one negative
+fixture pipeline per rule PWL001..PWL006, the suppression API, the
+pw.run(analysis=...) gate, the EngineError trace payload, and a golden
+test pinning the JSON output format."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.analysis import Diagnostic, Severity, render_json
+from pathway_tpu.internals.trace import Frame
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    pw.clear_graph()
+    yield
+    pw.clear_graph()
+
+
+def _rules(diags):
+    return {d.rule for d in diags}
+
+
+def _static(md: str):
+    return pw.debug.table_from_markdown(md)
+
+
+def _stream():
+    return pw.demo.range_stream(nb_rows=5, input_rate=1000.0)
+
+
+# ---------------------------------------------------------------- PWL001
+
+
+def test_pwl001_filter_predicate_not_bool():
+    t = _static("""
+        | x
+      1 | 1
+    """)
+    pw.io.null.write(t.filter(pw.this.x))
+    diags = pw.analysis.analyze()
+    hits = [d for d in diags if d.rule == "PWL001"]
+    assert hits and hits[0].severity is Severity.ERROR
+    assert "BOOL" in hits[0].message
+
+
+def test_pwl001_concat_dtype_conflict():
+    a = _static("""
+        | x
+      1 | 1
+    """)
+    b = _static("""
+        | x
+      1 | s
+    """)
+    pw.io.null.write(pw.Table.concat_reindex(a, b))
+    diags = pw.analysis.analyze()
+    assert any(d.rule == "PWL001" and "'x'" in d.message for d in diags)
+
+
+def test_pwl001_negative_clean_filter_and_concat():
+    a = _static("""
+        | x
+      1 | 1
+    """)
+    b = _static("""
+        | x
+      1 | 2
+    """)
+    pw.io.null.write(pw.Table.concat_reindex(a, b).filter(pw.this.x > 0))
+    assert "PWL001" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL002
+
+
+def test_pwl002_unbounded_streaming_groupby():
+    agg = _stream().groupby(pw.this.value).reduce(
+        pw.this.value, n=pw.reducers.count()
+    )
+    pw.io.null.write(agg)
+    diags = pw.analysis.analyze()
+    hits = [d for d in diags if d.rule == "PWL002"]
+    assert hits and hits[0].severity is Severity.ERROR
+    assert hits[0].op_kind == "groupby_reduce"
+    assert hits[0].trace is not None  # anchored to the user call site
+
+
+def test_pwl002_windowed_groupby_is_clean():
+    win = _stream().windowby(
+        pw.this.value, window=pw.temporal.tumbling(duration=10)
+    ).reduce(n=pw.reducers.count())
+    pw.io.null.write(win)
+    assert "PWL002" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl002_static_groupby_is_clean():
+    t = _static("""
+        | k | v
+      1 | a | 1
+    """)
+    pw.io.null.write(t.groupby(pw.this.k).reduce(pw.this.k, n=pw.reducers.count()))
+    assert "PWL002" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl002_streaming_join_warns_or_errors():
+    s = _stream()
+    t = _static("""
+        | value | label
+      1 | 1     | a
+    """)
+    j = s.join(t, s.value == t.value).select(s.value, t.label)
+    pw.io.null.write(j)
+    diags = pw.analysis.analyze()
+    hits = [d for d in diags if d.rule == "PWL002"]
+    assert hits and hits[0].severity is Severity.WARNING  # one side streaming
+
+
+# ---------------------------------------------------------------- PWL003
+
+
+def test_pwl003_mutable_capture():
+    cache: dict = {}
+
+    def slot(x: int) -> int:
+        return cache.setdefault(x, len(cache))
+
+    t = _static("""
+        | x
+      1 | 1
+    """)
+    pw.io.null.write(t.select(k=pw.apply_with_type(slot, int, pw.this.x)))
+    diags = pw.analysis.analyze()
+    assert any(
+        d.rule == "PWL003" and "mutable state" in d.message for d in diags
+    )
+
+
+def test_pwl003_nondeterministic_grouping_key():
+    import random
+
+    @pw.udf
+    def bucket(x: int) -> int:
+        return x + random.randint(0, 1)
+
+    t = _static("""
+        | x | v
+      1 | 1 | 2
+    """)
+    pw.io.null.write(
+        t.groupby(bucket(pw.this.x)).reduce(total=pw.reducers.sum(pw.this.v))
+    )
+    diags = pw.analysis.analyze()
+    assert any(
+        d.rule == "PWL003" and "non-deterministic" in d.message for d in diags
+    )
+
+
+def test_pwl003_noncommutative_reducer():
+    t = _static("""
+        | k | v
+      1 | a | 2
+    """)
+    pw.io.null.write(
+        t.groupby(pw.this.k).reduce(first=pw.reducers.earliest(pw.this.v))
+    )
+    diags = pw.analysis.analyze()
+    assert any(d.rule == "PWL003" and "commutative" in d.message for d in diags)
+
+
+def test_pwl003_negative_pure_udf_and_sum():
+    @pw.udf(deterministic=True)
+    def double(x: int) -> int:
+        return 2 * x
+
+    t = _static("""
+        | k | v
+      1 | a | 2
+    """)
+    pw.io.null.write(
+        t.groupby(double(pw.this.v)).reduce(total=pw.reducers.sum(pw.this.v))
+    )
+    assert "PWL003" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL004
+
+
+def test_pwl004_numpy_and_side_effect_in_batched_udf():
+    @pw.udf(executor=pw.udfs.BatchExecutor(max_batch_size=8))
+    def embed(xs: list[float]) -> list[float]:
+        arr = np.asarray(xs)  # host numpy on traced values
+        out = jnp.tanh(arr)
+        print("batch", len(xs))  # side effect under jit
+        return list(np.asarray(out))
+
+    t = _static("""
+        | x
+      1 | 1.0
+    """)
+    pw.io.null.write(t.select(y=embed(pw.this.x)))
+    diags = [d for d in pw.analysis.analyze() if d.rule == "PWL004"]
+    assert any("numpy" in d.message for d in diags)
+    assert any("print" in d.message for d in diags)
+
+
+def test_pwl004_negative_pure_jnp_batch():
+    @pw.udf(executor=pw.udfs.BatchExecutor(max_batch_size=8))
+    def embed(xs: list[float]) -> list[float]:
+        return [float(v) for v in jnp.tanh(jnp.asarray(xs))]
+
+    t = _static("""
+        | x
+      1 | 1.0
+    """)
+    pw.io.null.write(t.select(y=embed(pw.this.x)))
+    assert "PWL004" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL005
+
+
+def test_pwl005_dead_column_reported_at_origin():
+    t = _static("""
+        | owner | pet | age
+      1 | Alice | dog | 2
+    """)
+    pw.io.null.write(t.filter(pw.this.age >= 3).select(pw.this.owner))
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL005"]
+    # one finding, at the source that materializes 'pet' — not echoed by
+    # the filter that merely carries it
+    assert len(hits) == 1
+    assert "'pet'" in hits[0].message and hits[0].op_kind == "static"
+
+
+def test_pwl005_negative_all_columns_used():
+    t = _static("""
+        | owner | age
+      1 | Alice | 2
+    """)
+    pw.io.null.write(t.filter(pw.this.age >= 3).select(pw.this.owner, pw.this.age))
+    assert "PWL005" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL006
+
+
+def test_pwl006_unconnected_table():
+    t = _static("""
+        | x
+      1 | 1
+    """)
+    t.select(y=pw.this.x + 1)  # orphan: never consumed
+    pw.io.null.write(t.select(pw.this.x))
+    diags = pw.analysis.analyze()
+    assert any(
+        d.rule == "PWL006" and d.severity is Severity.INFO for d in diags
+    )
+
+
+def test_pwl006_negative_everything_connected():
+    t = _static("""
+        | x
+      1 | 1
+    """)
+    mid = t.select(y=pw.this.x + 1)
+    pw.io.null.write(mid.filter(pw.this.y > 0))
+    assert "PWL006" not in _rules(pw.analysis.analyze())
+
+
+# ----------------------------------------------------------- suppression
+
+
+def test_suppress_context_manager():
+    t = _static("""
+        | k | v
+      1 | a | 2
+    """)
+    with pw.analysis.suppress("PWL003"):
+        g = t.groupby(pw.this.k).reduce(first=pw.reducers.earliest(pw.this.v))
+    pw.io.null.write(g)
+    assert "PWL003" not in _rules(pw.analysis.analyze())
+
+
+def test_suppress_direct_and_unknown_rule():
+    t = _static("""
+        | k | v
+      1 | a | 2
+    """)
+    g = t.groupby(pw.this.k).reduce(first=pw.reducers.earliest(pw.this.v))
+    pw.analysis.suppress("pwl003", g)  # case-insensitive
+    pw.io.null.write(g)
+    assert "PWL003" not in _rules(pw.analysis.analyze())
+    with pytest.raises(ValueError):
+        pw.analysis.suppress("PWL999")
+
+
+# --------------------------------------------------------- run() gate
+
+
+def test_run_analysis_strict_raises_before_running():
+    agg = _stream().groupby(pw.this.value).reduce(n=pw.reducers.count())
+    pw.io.null.write(agg)
+    with pytest.raises(pw.analysis.AnalysisError) as exc:
+        pw.run(analysis="strict")
+    assert any(d.rule == "PWL002" for d in exc.value.diagnostics)
+
+
+def test_run_analysis_warn_prints_and_continues(capsys):
+    t = _static("""
+        | x
+      1 | 1
+    """)
+    pw.io.null.write(t.select(pw.this.x))
+    t.select(dead=pw.this.x)  # orphan -> PWL006 info, not an error
+    pw.run(analysis="warn", monitoring_level=pw.MonitoringLevel.NONE)
+    assert "PWL006" in capsys.readouterr().err
+
+
+def test_run_analysis_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        pw.run(analysis="pedantic")
+
+
+# ------------------------------------------------- engine-level rules
+
+
+def test_analyze_engine_flags_uncaptured_node():
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    t = _static("""
+        | x
+      1 | 1
+    """)
+    orphan = t.select(y=pw.this.x + 1)
+    out = t.select(pw.this.x)
+    runner = GraphRunner(n_workers=1)
+    runner.lower(orphan)
+    runner.capture(out)  # wired to a sink; the orphan is not
+    diags = pw.analysis.analyze(engine=runner.engine)
+    engine_hits = [
+        d for d in diags if d.rule == "PWL006" and "engine node" in d.message
+    ]
+    assert engine_hits
+    # captured path must not be flagged: exactly the orphan's node chain
+    assert all("Select" in d.message for d in engine_hits)
+
+
+# -------------------------------------------------- EngineError payload
+
+
+def test_engine_error_carries_node_identity_and_trace():
+    from pathway_tpu.engine.dataflow import EngineError
+
+    frame = Frame(
+        filename="pipe.py", line_number=7, line="x = y.z", function="<module>"
+    )
+
+    class FakeNode:
+        name = "groupby_reduce"
+        id = 42
+        user_frame = frame
+
+    err = EngineError("boom", node=FakeNode())
+    assert err.node_name == "groupby_reduce"
+    assert err.node_id == 42
+    assert err.trace is frame
+
+
+# ------------------------------------------------------- golden output
+
+
+def test_json_output_is_stable():
+    """The --json wire format is consumed by CI scripts — pin it."""
+    frame = Frame(
+        filename="pipe.py", line_number=12, line="bad = s.groupby(...)",
+        function="<module>",
+    )
+    diags = [
+        Diagnostic(
+            rule="PWL002",
+            severity=Severity.ERROR,
+            message="unbounded state",
+            table="s.reduce",
+            table_id=3,
+            op_kind="groupby_reduce",
+            trace=frame,
+        ),
+        Diagnostic(
+            rule="PWL005",
+            severity=Severity.INFO,
+            message="dead column",
+            table="t",
+            table_id=1,
+            op_kind="static",
+            trace=None,
+        ),
+    ]
+    got = json.loads(render_json(diags))
+    assert got == {
+        "diagnostics": [
+            {
+                "location": {
+                    "file": "pipe.py",
+                    "function": "<module>",
+                    "line": 12,
+                },
+                "message": "unbounded state",
+                "op": "groupby_reduce",
+                "rule": "PWL002",
+                "severity": "error",
+                "table": "s.reduce",
+            },
+            {
+                "message": "dead column",
+                "op": "static",
+                "rule": "PWL005",
+                "severity": "info",
+                "table": "t",
+            },
+        ],
+        "summary": {"error": 1, "info": 1, "warning": 0},
+    }

@@ -52,7 +52,13 @@ def test_fused_encoder_cls_pooling(minilm):
     ids, mask = _batch(np.random.default_rng(0), 4, 32)
     ref = np.asarray(module.apply(p, ids, mask))
     got = np.asarray(encoder_forward(p, cfg, ids, mask, interpret=True))
-    assert np.abs(ref - got).max() < 5e-2
+    # cls outputs are unnormalized (scale ~3), so bound the error
+    # relative to the output scale (a few bf16 ulps) plus direction
+    err = np.abs(ref - got).max()
+    assert err < 3e-2 * max(1.0, np.abs(ref).max()), err
+    rn = ref / np.linalg.norm(ref, axis=1, keepdims=True)
+    gn = got / np.linalg.norm(got, axis=1, keepdims=True)
+    assert (rn * gn).sum(axis=1).min() > 0.999
 
 
 def test_fused_encoder_gradient_flows(minilm):

@@ -42,9 +42,15 @@ def test_public_surface():
 def test_reference_top_level_export_parity():
     """Every name in the reference's pathway.__all__ resolves here
     (the drop-in completeness contract)."""
+    import os
     import re
 
-    ref = open("/root/reference/python/pathway/__init__.py").read()
+    import pytest
+
+    ref_path = "/root/reference/python/pathway/__init__.py"
+    if not os.path.exists(ref_path):
+        pytest.skip("reference pathway checkout not present in this environment")
+    ref = open(ref_path).read()
     m = re.search(r"__all__\s*=\s*\[(.*?)\]", ref, re.S)
     ref_names = set(re.findall(r'"([^"]+)"', m.group(1)))
     import pathway_tpu as pw

@@ -1,0 +1,95 @@
+"""Repo self-lint: the static verifier runs over the shipped demo
+pipelines (pathway_tpu/debug/demos/) and an llm-xpack RAG template, and
+fails this suite on any new error-severity finding. Also exercises the
+``pathway analyze`` CLI end to end, including the nonzero exit + JSON
+contract the CI hook relies on."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug.demos import demo_programs
+
+from .mocks import fake_embeddings_model, make_docs_table
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+
+def _analyze_cli(program: str, *flags: str) -> subprocess.CompletedProcess:
+    env = os.environ.copy()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", "analyze", *flags, program],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+
+
+@pytest.mark.parametrize(
+    "demo", demo_programs(), ids=[os.path.basename(p) for p in demo_programs()]
+)
+def test_demo_pipelines_lint_clean(demo):
+    """Every shipped demo must pass the verifier with zero findings of
+    error severity — this is the repo's own lint gate."""
+    proc = _analyze_cli(demo)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+
+def test_unbounded_fixture_fails_with_pwl002_human():
+    proc = _analyze_cli(os.path.join(FIXTURES, "unbounded_groupby.py"))
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+    assert "PWL002" in proc.stdout
+    assert "error" in proc.stdout
+    # the diagnostic cites the fixture's own source line
+    assert "unbounded_groupby.py" in proc.stdout
+
+
+def test_unbounded_fixture_fails_with_pwl002_json():
+    proc = _analyze_cli(os.path.join(FIXTURES, "unbounded_groupby.py"), "--json")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["error"] >= 1
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL002"]
+    assert diag["severity"] == "error"
+    assert diag["location"]["file"].endswith("unbounded_groupby.py")
+    assert diag["location"]["line"] > 0
+
+
+def test_windowed_fixture_passes_clean():
+    proc = _analyze_cli(os.path.join(FIXTURES, "windowed_groupby.py"))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "no findings" in proc.stdout
+
+
+def test_broken_program_exits_3():
+    proc = _analyze_cli(os.path.join(FIXTURES, "does_not_exist.py"))
+    assert proc.returncode == 3
+
+
+def test_rag_template_lints_clean_in_process():
+    """The llm-xpack vector store template must stay free of
+    error-severity findings (warnings/info are reported, not fatal)."""
+    from pathway_tpu.xpacks.llm import VectorStoreServer
+
+    pw.clear_graph()
+    try:
+        docs = make_docs_table(
+            [("pathway is a streaming dataflow framework", "/data/pathway.txt")]
+        )
+        VectorStoreServer(docs, embedder=fake_embeddings_model)
+        diags = pw.analysis.analyze()
+        errors = [d for d in diags if d.severity is pw.analysis.Severity.ERROR]
+        assert not errors, [d.render() for d in errors]
+    finally:
+        pw.clear_graph()

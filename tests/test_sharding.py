@@ -81,16 +81,21 @@ def test_contrastive_trainer_tp_dp_step():
 
 
 def test_sentence_encoder_data_parallel_consistency():
-    """Mesh-sharded encode must equal single-device encode bitwise-ish."""
+    """Mesh-sharded encode must equal single-device encode up to bf16
+    forward noise: sharding the batch changes XLA's per-device shapes
+    and hence the reduction/fusion order inside the same bf16 network,
+    so bitwise equality is not achievable — bound the drift instead."""
     from pathway_tpu.models.sentence_encoder import SentenceEncoder
 
     rng = np.random.default_rng(1)
     toks = [[101] + rng.integers(999, 2000, 5).tolist() + [102] for _ in range(16)]
     enc_mesh = SentenceEncoder(max_seq_len=32, max_batch=64, mesh=make_mesh(model_parallel=1))
     enc_solo = SentenceEncoder(max_seq_len=32, max_batch=64, mesh=None)
-    a = enc_mesh.encode_tokens(toks)
-    b = enc_solo.encode_tokens(toks)
-    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+    a = np.asarray(enc_mesh.encode_tokens(toks))
+    b = np.asarray(enc_solo.encode_tokens(toks))
+    np.testing.assert_allclose(a, b, rtol=2e-3, atol=1e-3)
+    # normalized embeddings: directions must be essentially identical
+    assert (a * b).sum(axis=1).min() > 0.9999
 
 
 def test_driver_dryrun_multichip_contract():
