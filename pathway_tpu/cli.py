@@ -161,6 +161,49 @@ def analyze(as_json, strict_warnings, program, arguments):
     )
 
 
+@cli.command(
+    context_settings={"allow_extra_args": True, "ignore_unknown_options": True}
+)
+@click.option(
+    "--output",
+    "-o",
+    default="pathway_profile.json",
+    show_default=True,
+    help="Chrome-trace-event JSON output path",
+)
+@click.argument("program", nargs=-1, required=True)
+def profile(output, program):
+    """Run PROGRAM with the per-operator profiler enabled and write a
+    Chrome-trace-event JSON, e.g.:
+
+    pathway profile -o trace.json my_pipeline.py
+
+    Open the result in Perfetto (https://ui.perfetto.dev) or
+    chrome://tracing: one track per worker, one slice per node-epoch,
+    plus a jit track with compile/execute splits.
+    """
+    argv = list(program)
+    if argv[0].endswith(".py"):
+        argv = [sys.executable] + argv
+    env = os.environ.copy()
+    env["PATHWAY_PROFILE"] = output
+    # make pathway_tpu importable from dev checkouts: the child's
+    # sys.path roots at the program's directory, not ours
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = (
+        pkg_root + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH")
+        else pkg_root
+    )
+    rc = subprocess.call(argv, env=env)
+    if rc == 0:
+        click.echo(
+            f"profile written to {output} — load it at https://ui.perfetto.dev",
+            err=True,
+        )
+    sys.exit(rc)
+
+
 def main() -> None:
     cli()
 

@@ -1881,11 +1881,28 @@ class BatchApplyNode(Node):
                     out.append((key, orow, -1))
             else:
                 pending.append((key, row))
+        prof = self.graph.profiler
         for lo in range(0, len(pending), self.max_batch_size):
             chunk = pending[lo : lo + self.max_batch_size]
             arg_cols = list(zip(*[self.row_args_fn(k, r) for k, r in chunk]))
             try:
-                results = self.batch_fn(*[list(c) for c in arg_cols])
+                if prof is not None:
+                    # batch-UDF timing: calls through a wrap_jit'd model
+                    # split compile vs execute themselves; plain batch
+                    # fns report the whole call as execute
+                    import time as _time
+
+                    t0 = _time.perf_counter_ns()
+                    results = self.batch_fn(*[list(c) for c in arg_cols])
+                    if not getattr(self.batch_fn, "__wrapped__", None):
+                        prof.record_jit(
+                            f"batch_udf/{self.name}",
+                            "execute",
+                            _time.perf_counter_ns() - t0,
+                            len(chunk),
+                        )
+                else:
+                    results = self.batch_fn(*[list(c) for c in arg_cols])
                 if len(results) != len(chunk):
                     raise ValueError(
                         f"batch UDF returned {len(results)} results for "
@@ -1947,6 +1964,9 @@ class EngineGraph:
         self._last_opsnap_wall = 0.0
         # multi-worker: set by parallel.sharded.ShardCluster
         self.cluster = None
+        # per-operator run profiler (internals.profiler.RunProfiler),
+        # attached by graph_runner.attach_profiler; None = no timing
+        self.profiler = None
 
     # --- builder helpers used by the graph runner ---
 
@@ -2015,17 +2035,39 @@ class EngineGraph:
         # forward edges; operators that emit "later" than their position
         # (external index answering as-of-now, ix pre-joins) create
         # back-edges — keep sweeping until quiescent.
+        prof = self.profiler
+        if prof is None:
+            while self._dirty:
+                for node in self.nodes:
+                    if node.id in self._dirty:
+                        self._dirty.discard(node.id)
+                        node.process(time)
+            # time-end notifications: outputs/captures deliver the epoch's
+            # consolidated changes
+            for node in self.nodes:
+                te = getattr(node, "time_end", None)
+                if te is not None:
+                    te(time)
+            return
+        # profiled sweep: same control flow with each node's work timed.
+        # Within one worker the calls are strictly sequential, so folding
+        # multi-wave re-processing into one slice per node-epoch is exact.
+        wid = self.worker_id
+        prof.begin_epoch(wid)
         while self._dirty:
             for node in self.nodes:
                 if node.id in self._dirty:
                     self._dirty.discard(node.id)
+                    t0 = prof.now_ns()
                     node.process(time)
-        # time-end notifications: outputs/captures deliver the epoch's
-        # consolidated changes
+                    prof.record_process(wid, node, t0, prof.now_ns() - t0)
         for node in self.nodes:
             te = getattr(node, "time_end", None)
             if te is not None:
+                t0 = prof.now_ns()
                 te(time)
+                prof.record_process(wid, node, t0, prof.now_ns() - t0)
+        prof.end_epoch(wid, self, time)
 
     def _frontier_hooks(self, frontier):
         for node in self.nodes:

@@ -51,6 +51,24 @@ class PathwayConfig:
     def first_port(self) -> int:
         return _env_int("PATHWAY_FIRST_PORT", 10000)
 
+    @property
+    def monitoring_http_port(self) -> int | None:
+        """Explicit /metrics port (PATHWAY_MONITORING_HTTP_PORT); None
+        falls back to 20000 + process_id. 0 = ephemeral."""
+        v = os.environ.get("PATHWAY_MONITORING_HTTP_PORT")
+        if not v:
+            return None
+        try:
+            return int(v)
+        except ValueError:
+            return None
+
+    @property
+    def profile_path(self) -> str | None:
+        """Chrome-trace output path (PATHWAY_PROFILE); set by the
+        ``pathway profile`` CLI subcommand."""
+        return os.environ.get("PATHWAY_PROFILE") or None
+
 
 def get_pathway_config() -> PathwayConfig:
     cfg = PathwayConfig()

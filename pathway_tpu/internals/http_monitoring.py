@@ -3,12 +3,16 @@
 Rebuild of /root/reference/src/engine/http_server.rs (:21-60): serves
 ``/metrics`` in Prometheus text format and ``/status`` as JSON on port
 ``20000 + process_id``, exposing row counters, per-operator stats and
-input/output latency gauges (reference telemetry.rs:41-45).
+input/output latency gauges (reference telemetry.rs:41-45). When a
+profiler is attached to the run, ``/metrics`` additionally exposes
+per-operator self-time histograms (``pathway_operator_self_time_seconds``)
+and event-time lag gauges (``pathway_operator_event_lag_seconds``).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -16,6 +20,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .monitoring import StatsMonitor
 
 BASE_PORT = 20000
+
+logger = logging.getLogger(__name__)
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus text-format label escaping: backslash, double quote,
+    and line feed (the exposition format's own escape set)."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
 
 
 class MonitoringHttpServer:
@@ -25,7 +39,12 @@ class MonitoringHttpServer:
         if port is None:
             from .config import get_pathway_config
 
-            port = BASE_PORT + get_pathway_config().process_id
+            cfg = get_pathway_config()
+            port = (
+                cfg.monitoring_http_port
+                if cfg.monitoring_http_port is not None
+                else BASE_PORT + cfg.process_id
+            )
         self.monitor = monitor
         self.port = port
         self.host = host
@@ -48,12 +67,44 @@ class MonitoringHttpServer:
             f"pathway_input_latency_ms {self.monitor.input_latency_ms(now)}",
             "# TYPE pathway_output_latency_ms gauge",
             f"pathway_output_latency_ms {self.monitor.output_latency_ms(now)}",
-            "# TYPE pathway_operator_rows counter",
+            "# TYPE pathway_operator_rows_total counter",
         ]
         for op_name, (rows_in, rows_out) in sorted(snap.operators.items()):
-            label = op_name.replace("\\", "\\\\").replace('"', '\\"')
-            lines.append(f'pathway_operator_rows{{operator="{label}",direction="in"}} {rows_in}')
-            lines.append(f'pathway_operator_rows{{operator="{label}",direction="out"}} {rows_out}')
+            label = _escape_label(op_name)
+            lines.append(
+                f'pathway_operator_rows_total{{operator="{label}",direction="in"}} {rows_in}'
+            )
+            lines.append(
+                f'pathway_operator_rows_total{{operator="{label}",direction="out"}} {rows_out}'
+            )
+        profiler = self.monitor.profiler
+        if profiler is not None:
+            lines.append("# TYPE pathway_operator_self_time_seconds histogram")
+            by_op = profiler.by_operator()
+            for key in sorted(by_op):
+                agg = by_op[key]
+                label = _escape_label(key)
+                hist = agg["histogram"]
+                for le, count in hist.cumulative():
+                    lines.append(
+                        f'pathway_operator_self_time_seconds_bucket{{operator="{label}",le="{le}"}} {count}'
+                    )
+                lines.append(
+                    f'pathway_operator_self_time_seconds_sum{{operator="{label}"}} {hist.total:.9f}'
+                )
+                lines.append(
+                    f'pathway_operator_self_time_seconds_count{{operator="{label}"}} {hist.count}'
+                )
+            lag_lines = []
+            for key in sorted(by_op):
+                lag = by_op[key]["event_lag_s"]
+                if lag is not None:
+                    lag_lines.append(
+                        f'pathway_operator_event_lag_seconds{{operator="{_escape_label(key)}"}} {lag:.6f}'
+                    )
+            if lag_lines:
+                lines.append("# TYPE pathway_operator_event_lag_seconds gauge")
+                lines.extend(lag_lines)
         return "\n".join(lines) + "\n"
 
     def _status(self) -> str:
@@ -64,6 +115,8 @@ class MonitoringHttpServer:
                 "rows_in": snap.rows_in,
                 "rows_out": snap.rows_out,
                 "operators": snap.operators,
+                "operator_self_time_s": snap.operator_self_time_s,
+                "operator_event_lag_s": snap.operator_event_lag_s,
             }
         )
 
@@ -93,7 +146,20 @@ class MonitoringHttpServer:
             def log_message(self, *args):  # silence request logging
                 pass
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        try:
+            self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        except OSError as exc:
+            # two concurrent runs on one machine both compute
+            # 20000 + process_id; rather than dying, fall back to an
+            # ephemeral port and say where we ended up
+            self._httpd = ThreadingHTTPServer((self.host, 0), Handler)
+            logger.warning(
+                "monitoring HTTP port %d unavailable (%s); serving /metrics on "
+                "port %d instead",
+                self.port,
+                exc,
+                self._httpd.server_port,
+            )
         self.port = self._httpd.server_port  # resolves port=0 to the bound one
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="pathway_tpu:monitoring-http", daemon=True

@@ -105,6 +105,10 @@ class OperatorEntry:
     #: wall time of the last observed output change (None = initializing)
     last_change: float | None = None
     done: bool = False
+    #: cumulative scheduler self-time (profiler, seconds); None = not profiled
+    self_time_s: float | None = None
+    #: event-time watermark lag (seconds); None = not a time-aware node
+    event_lag_s: float | None = None
 
     def latency_ms(self, now: float) -> int | None:
         if self.last_change is None:
@@ -118,6 +122,10 @@ class StatsSnapshot:
     rows_in: int = 0
     rows_out: int = 0
     operators: dict = field(default_factory=dict)  # "id:name" -> (in, out)
+    #: "id:name" -> cumulative self-time seconds (profiler attached only)
+    operator_self_time_s: dict = field(default_factory=dict)
+    #: "id:name" -> event-time watermark lag seconds (time-aware nodes)
+    operator_event_lag_s: dict = field(default_factory=dict)
 
 
 class StatsMonitor:
@@ -132,6 +140,8 @@ class StatsMonitor:
         self.connectors: dict[int, ConnectorStats] = {}
         self.operators: dict[int, OperatorEntry] = {}
         self.dashboard: "LiveDashboard | None" = None
+        #: RunProfiler picked up from the engine on update() (if attached)
+        self.profiler = None
         # wall-clock of the last observed input/output row-count change,
         # for the latency gauges (reference telemetry.rs:41-45)
         self._last_in_change = time.monotonic()
@@ -151,9 +161,17 @@ class StatsMonitor:
     def update(self, engine) -> None:
         now = time.monotonic()
         snap = StatsSnapshot(time=engine.current_time)
+        profiler = getattr(engine, "profiler", None)
+        if profiler is not None:
+            self.profiler = profiler
+            for key, agg in profiler.by_operator().items():
+                snap.operator_self_time_s[key] = agg["self_time_s"]
+                if agg["event_lag_s"] is not None:
+                    snap.operator_event_lag_s[key] = agg["event_lag_s"]
         for node in engine.nodes:
             rows_in, rows_out = node.stats.rows_in, node.stats.rows_out
-            snap.operators[f"{node.id}:{node.name}"] = (rows_in, rows_out)
+            key = f"{node.id}:{node.name}"
+            snap.operators[key] = (rows_in, rows_out)
             snap.rows_in += rows_in
             snap.rows_out += rows_out
             entry = self.operators.get(node.id)
@@ -162,13 +180,17 @@ class StatsMonitor:
             if rows_out != entry.rows_out or rows_in != entry.rows_in:
                 entry.last_change = now
             entry.rows_in, entry.rows_out = rows_in, rows_out
+            if key in snap.operator_self_time_s:
+                entry.self_time_s = snap.operator_self_time_s[key]
+            entry.event_lag_s = snap.operator_event_lag_s.get(key)
             if node.n_inputs == 0:
                 conn = self.connectors.get(node.id)
                 if conn is None:
                     conn = self.connectors[node.id] = ConnectorStats(name=node.name)
                 delta = rows_out - conn.num_messages_from_start
-                if delta:
-                    conn.num_messages_recently_committed = delta
+                # assign unconditionally: an idle connector shows 0 for
+                # its last minibatch, not its last nonzero batch forever
+                conn.num_messages_recently_committed = delta
                 conn.num_messages_from_start = rows_out
                 conn.observe(now, rows_out)
                 session = getattr(node, "session", None)
@@ -252,20 +274,37 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         "Latency is measured as the difference between the time the "
         "operator processed the data and the time pathway acquired it."
     )
+    # profiler-backed columns only appear when a profiler is attached
+    profiled = monitor.profiler is not None
     table = Table(caption=caption, box=box.SIMPLE)
     table.add_column("operator", justify="left")
     table.add_column(r"latency to wall clock \[ms]", justify="right")
     table.add_column("rows out", justify="right")
-    table.add_row("input", f"{monitor.input_latency_ms(now)}", "")
+    if profiled:
+        table.add_column(r"self-time \[ms]", justify="right")
+        table.add_column(r"event lag \[s]", justify="right")
+
+    def row(*cells):
+        table.add_row(*(cells + ("", "") if profiled else cells))  # pad new cols
+
+    row("input", f"{monitor.input_latency_ms(now)}", "")
     if with_operators:
         for entry in monitor.operators.values():
             latency = entry.latency_ms(now)
-            table.add_row(
+            cells = (
                 entry.name,
                 "initializing" if latency is None else f"{latency}",
                 f"{entry.rows_out}",
             )
-    table.add_row("output", f"{monitor.output_latency_ms(now)}", "")
+            if profiled:
+                cells = cells + (
+                    ""
+                    if entry.self_time_s is None
+                    else f"{entry.self_time_s * 1000:.1f}",
+                    "" if entry.event_lag_s is None else f"{entry.event_lag_s:.2f}",
+                )
+            table.add_row(*cells)
+    row("output", f"{monitor.output_latency_ms(now)}", "")
     return table
 
 

@@ -41,15 +41,25 @@ def run(
     debug: bool = False,
     monitoring_level: Any = None,
     with_http_server: bool = False,
+    monitoring_http_port: int | None = None,
     persistence_config: Any = None,
     license_key: str | None = None,
     runtime_typechecking: bool = True,
     terminate_on_error: bool = True,
     analysis: str | None = None,
+    profile: Any = None,
     **kwargs: Any,
 ) -> None:
     """Execute all registered outputs/subscriptions to completion
-    (static sources) or until all streaming connectors close."""
+    (static sources) or until all streaming connectors close.
+
+    ``profile``: a path (``profile="trace.json"``) writes a
+    Chrome-trace-event JSON of per-operator epoch timings (open in
+    Perfetto / chrome://tracing); ``profile=True`` uses
+    ``pathway_profile.json``. The PATHWAY_PROFILE env var (set by the
+    ``pathway profile`` CLI) supplies the path when the arg is None.
+    ``monitoring_http_port``: explicit /metrics port for
+    ``with_http_server`` (0 = ephemeral); default 20000 + process_id."""
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
         # this point — return before sinks are built or readers started
@@ -67,6 +77,21 @@ def run(
     check_worker_count(lic, pwcfg.n_workers)
     telemetry = Telemetry()  # PATHWAY_TELEMETRY_SERVER (local file) or no-op
 
+    # per-operator profiler: explicit profile=/PATHWAY_PROFILE always
+    # activates it; it also rides along whenever another surface that
+    # can show its numbers is up (telemetry, /metrics)
+    if profile is True:
+        profile_path: str | None = "pathway_profile.json"
+    elif profile:
+        profile_path = os.fspath(profile)
+    else:
+        profile_path = pwcfg.profile_path
+    profiler = None
+    if profile_path is not None or telemetry.enabled or with_http_server:
+        from .profiler import RunProfiler, set_current_profiler
+
+        profiler = RunProfiler()
+
     n_workers = max(1, pwcfg.threads)
     processes = max(1, pwcfg.processes)
     runner = GraphRunner(n_workers=n_workers)
@@ -77,6 +102,9 @@ def run(
     runner.engine.terminate_on_error = terminate_on_error
     for r in runner._replicas:
         r.engine.terminate_on_error = terminate_on_error
+    if profiler is not None:
+        runner.attach_profiler(profiler)
+        set_current_profiler(profiler)  # jit hooks in models/ + udfs/
     if persistence_config is None:
         # CLI record/replay wiring (reference cli.py:166-193): spawn's
         # --record/--replay-mode flags arrive via PATHWAY_REPLAY_* env
@@ -123,13 +151,16 @@ def run(
         http_server = None
         if with_http_server:
             # Prometheus endpoint on 20000 + process_id (reference
-            # src/engine/http_server.rs:21)
+            # src/engine/http_server.rs:21), or an explicit port
             from .http_monitoring import MonitoringHttpServer
 
-            http_server = MonitoringHttpServer(monitor)
+            http_server = MonitoringHttpServer(monitor, port=monitoring_http_port)
             http_server.start()
+        run_span = None
         try:
-            with telemetry.span("graph_runner.run", workers=pwcfg.n_workers):
+            with telemetry.span(
+                "graph_runner.run", workers=pwcfg.n_workers
+            ) as run_span:
                 if processes > 1:
                     # reference CommunicationConfig::Cluster (config.rs:62-86):
                     # P processes × T threads; coordinator = process 0
@@ -144,10 +175,18 @@ def run(
                 else:
                     runner.run(monitoring_callback=monitor.update if monitor else None)
         finally:
+            if profiler is not None:
+                set_current_profiler(None)
             if monitor is not None:
                 telemetry.gauge("rows_in", monitor.snapshot.rows_in)
                 telemetry.gauge("rows_out", monitor.snapshot.rows_out)
+            if profiler is not None and telemetry.enabled:
+                # per-operator child spans nest under the run span and
+                # must land before the flush posts /v1/traces
+                profiler.emit_telemetry(telemetry, parent=run_span)
             telemetry.flush()
+            if profiler is not None and profile_path is not None:
+                profiler.write_chrome_trace(profile_path)
             if http_server is not None:
                 http_server.stop()
 

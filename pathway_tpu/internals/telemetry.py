@@ -34,6 +34,7 @@ class Span:
     start_unix_ns: int = 0
     end_unix_ns: int = 0
     span_id: str = ""
+    parent_span_id: str = ""
 
     @property
     def duration_ms(self) -> float:
@@ -94,6 +95,31 @@ class Telemetry:
             s.end = time.monotonic()
             s.end_unix_ns = time.time_ns()
 
+    def add_span(
+        self,
+        name: str,
+        *,
+        start_unix_ns: int,
+        end_unix_ns: int,
+        parent: "Span | None" = None,
+        attrs: dict | None = None,
+    ) -> Span:
+        """Record an already-measured span (the profiler replays its
+        per-operator timings here after the run); nests under ``parent``
+        via parentSpanId while sharing this run's trace_id."""
+        s = Span(
+            name,
+            time.monotonic(),
+            end=time.monotonic(),
+            attrs=dict(attrs or {}),
+            start_unix_ns=start_unix_ns,
+            end_unix_ns=end_unix_ns,
+            span_id=secrets.token_hex(8),
+            parent_span_id=parent.span_id if parent is not None else "",
+        )
+        self.spans.append(s)
+        return s
+
     def gauge(self, name: str, value: float) -> None:
         self.metrics[name] = float(value)
 
@@ -112,6 +138,7 @@ class Telemetry:
             {
                 "traceId": self.trace_id,
                 "spanId": s.span_id or secrets.token_hex(8),
+                **({"parentSpanId": s.parent_span_id} if s.parent_span_id else {}),
                 "name": s.name,
                 "kind": 1,  # SPAN_KIND_INTERNAL
                 "startTimeUnixNano": str(s.start_unix_ns),

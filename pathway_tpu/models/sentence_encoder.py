@@ -74,7 +74,11 @@ class SentenceEncoder:
             self._data_sharding = NamedSharding(mesh, P(data_axis))
         else:
             self._data_sharding = None
-        self._fwd = jax.jit(self.module.apply)
+        # profiled jit: reports the compile-vs-execute split to an
+        # active RunProfiler (no-op outside pw.run(profile=...) runs)
+        from ..internals.profiler import wrap_jit
+
+        self._fwd = wrap_jit("sentence_encoder.fwd", jax.jit(self.module.apply))
 
     @property
     def dim(self) -> int:
@@ -191,7 +195,11 @@ class SentenceEncoder:
                     return encoder_forward(p, self.cfg, ids32, mask)
                 return self.module.apply(p, ids32, mask)
 
-            self._fwd_group = jax.jit(fwd_group)
+            from ..internals.profiler import wrap_jit
+
+            self._fwd_group = wrap_jit(
+                "sentence_encoder.fwd_group", jax.jit(fwd_group)
+            )
         # int16 halves the host->device id bytes; only when ids fit
         wire = np.int16 if self.cfg.vocab_size < 32768 else np.int32
         return self._fwd_group(self.params, ids.astype(wire), lens.astype(np.int32))
@@ -475,7 +483,9 @@ class CrossEncoderScorer:
             except (FileNotFoundError, KeyError):
                 pass
         self.tokenizer = default_tokenizer(checkpoint_dir)
-        self._fwd = jax.jit(self.module.apply)
+        from ..internals.profiler import wrap_jit
+
+        self._fwd = wrap_jit("cross_encoder.fwd", jax.jit(self.module.apply))
 
     def score(self, pairs: Sequence[tuple[str, str]]) -> np.ndarray:
         if not len(pairs):

@@ -238,11 +238,19 @@ class ShardCluster:
         process's shards are quiescent (remote mail may remain)."""
 
         def run_one(e):
+            prof = e.profiler
             while e._dirty:
                 for node in e.nodes:
                     if node.id in e._dirty:
                         e._dirty.discard(node.id)
-                        node.process(time)
+                        if prof is not None:
+                            t0 = prof.now_ns()
+                            node.process(time)
+                            prof.record_process(
+                                e.worker_id, node, t0, prof.now_ns() - t0
+                            )
+                        else:
+                            node.process(time)
 
         while True:
             dirty_engines = [e for e in self.engines if e._dirty]
@@ -262,16 +270,28 @@ class ShardCluster:
 
     def _time_end_all(self, time) -> None:
         for e in self.engines:
+            prof = e.profiler
             for node in e.nodes:
                 te = getattr(node, "time_end", None)
                 if te is not None:
-                    te(time)
+                    if prof is not None:
+                        t0 = prof.now_ns()
+                        te(time)
+                        prof.record_process(e.worker_id, node, t0, prof.now_ns() - t0)
+                    else:
+                        te(time)
 
     def _sweep(self, time) -> None:
         """One bulk-synchronous epoch sweep (single-process: the world
         is local, so the local fixpoint is the global one)."""
+        for e in self.engines:
+            if e.profiler is not None:
+                e.profiler.begin_epoch(e.worker_id)
         self._sweep_local(time)
         self._time_end_all(time)
+        for e in self.engines:
+            if e.profiler is not None:
+                e.profiler.end_epoch(e.worker_id, e, time)
 
     # -- persistence (input snapshots + whole-cluster operator snapshots;
     #    sources live on shard 0, state is spread across all shards) --

@@ -187,7 +187,7 @@ def test_monitoring_http_server_metrics():
             f"http://127.0.0.1:{server.port}/metrics", timeout=5
         ).read().decode()
         assert "pathway_rows_input_total" in body
-        assert 'pathway_operator_rows{operator=' in body
+        assert 'pathway_operator_rows_total{operator=' in body
         assert "pathway_input_latency_ms" in body
         status = urllib.request.urlopen(
             f"http://127.0.0.1:{server.port}/status", timeout=5
@@ -287,3 +287,296 @@ def test_live_dashboard_renders_connectors_and_operators():
         assert m.dashboard is None
     assert MonitoringLevel.coerce("all") is MonitoringLevel.ALL
     assert MonitoringLevel.coerce(None) is MonitoringLevel.NONE
+
+
+# --------------------------------------------- profiler PR satellites
+
+
+def test_idle_connector_resets_last_minibatch():
+    """A connector that commits nothing in an epoch must show 0 as its
+    last-minibatch count, not its last nonzero batch forever."""
+    from types import SimpleNamespace
+
+    from pathway_tpu.internals.monitoring import StatsMonitor
+
+    node = SimpleNamespace(
+        id=0,
+        name="src",
+        n_inputs=0,
+        stats=SimpleNamespace(rows_in=0, rows_out=5),
+        session=None,
+    )
+    engine = SimpleNamespace(current_time=1, nodes=[node], profiler=None)
+    monitor = StatsMonitor()
+    monitor.update(engine)
+    assert monitor.connectors[0].num_messages_recently_committed == 5
+
+    engine.current_time = 2  # quiet epoch: no new rows
+    monitor.update(engine)
+    assert monitor.connectors[0].num_messages_recently_committed == 0
+    assert monitor.connectors[0].num_messages_from_start == 5
+
+
+def test_metrics_port_collision_falls_back_to_ephemeral(caplog):
+    import logging as _logging
+
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+    from pathway_tpu.internals.monitoring import StatsMonitor
+
+    first = MonitoringHttpServer(StatsMonitor(), port=0)
+    first.start()
+    try:
+        second = MonitoringHttpServer(StatsMonitor(), port=first.port)
+        with caplog.at_level(_logging.WARNING):
+            second.start()  # would previously die with OSError
+        try:
+            assert second.port != first.port and second.port > 0
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{second.port}/metrics", timeout=5
+            ).read().decode()
+            assert "pathway_epoch" in body
+            assert any(
+                "unavailable" in r.message for r in caplog.records
+            ), caplog.records
+        finally:
+            second.stop()
+    finally:
+        first.stop()
+
+
+def test_run_accepts_monitoring_http_port():
+    """pw.run(monitoring_http_port=0) binds an ephemeral port instead of
+    20000 + process_id (two concurrent runs no longer race)."""
+    t = T(
+        """
+          | a
+        1 | 1
+        """
+    )
+    seen = []
+    pw.io.subscribe(t, on_change=lambda **kw: seen.append(1))
+    pw.run(with_http_server=True, monitoring_http_port=0)
+    assert seen
+
+
+def _parse_prometheus(body: str):
+    """Minimal exposition-format parser: returns ({series: value},
+    {metric: type}). Raises on malformed lines — the conformance check."""
+    import re
+
+    types: dict[str, str] = {}
+    samples: dict[str, float] = {}
+    line_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (-?[0-9.eE+-]+|NaN)$'
+    )
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for line in body.strip().split("\n"):
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ")
+            types[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue
+        m = line_re.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        labels = m.group(2) or ""
+        if labels:
+            # every label pair must parse; raw newlines would have
+            # broken line_re already
+            inner = labels[1:-1]
+            parsed = label_re.findall(inner)
+            reconstructed = ",".join(f'{k}="{v}"' for k, v in parsed)
+            assert reconstructed == inner, f"bad labels: {labels!r}"
+        samples[m.group(1) + labels] = float(m.group(3))
+    return samples, types
+
+
+def test_metrics_body_is_conformant_exposition_format():
+    """Whole-body /metrics validation: parses cleanly, counters end in
+    _total, histogram buckets are monotone and consistent with _count,
+    label values with newlines/quotes are escaped."""
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+    from pathway_tpu.internals.monitoring import StatsMonitor
+    from pathway_tpu.internals.profiler import RunProfiler
+
+    monitor = StatsMonitor()
+    t = T(
+        """
+          | a
+        1 | 1
+        2 | 2
+        """
+    )
+    res = t.select(b=pw.this.a * 2)
+    runner = GraphRunner()
+    cap, _ = runner.capture(res)
+    prof = RunProfiler()
+    runner.attach_profiler(prof)
+    server = MonitoringHttpServer(monitor, port=0)
+    server.start()
+    try:
+        runner.run(monitoring_callback=monitor.update)
+        # poison a label: operator names with newline/quote must escape
+        monitor.snapshot.operators['9:evil"name\nwith newline'] = (1, 1)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5
+        ).read().decode()
+    finally:
+        server.stop()
+
+    samples, types = _parse_prometheus(body)
+    # counters carry the _total suffix
+    for name, mtype in types.items():
+        if mtype == "counter":
+            assert name.endswith("_total"), f"counter {name} lacks _total"
+    assert types["pathway_operator_rows_total"] == "counter"
+    assert types["pathway_operator_self_time_seconds"] == "histogram"
+    # the escaped label round-trips (no raw newline in the body)
+    assert "\\nwith" in body and 'evil\\"name' in body
+    # histogram: per-operator buckets monotone, +Inf == _count
+    bucket_series = sorted(
+        k for k in samples if k.startswith("pathway_operator_self_time_seconds_bucket")
+    )
+    assert bucket_series, "no histogram buckets exposed"
+    import collections
+
+    def le_of(key: str) -> float:
+        le = key.split('le="')[1].split('"')[0]
+        return float("inf") if le == "+Inf" else float(le)
+
+    per_op = collections.defaultdict(list)
+    for k in bucket_series:
+        op = k.split('operator="')[1].split('"')[0]
+        per_op[op].append((le_of(k), samples[k]))
+    for op, buckets in per_op.items():
+        ordered = [v for _, v in sorted(buckets)]
+        assert ordered == sorted(ordered), f"non-monotone buckets for {op}"
+        inf_key = next(
+            k for k in bucket_series if f'operator="{op}"' in k and 'le="+Inf"' in k
+        )
+        count_key = f'pathway_operator_self_time_seconds_count{{operator="{op}"}}'
+        assert samples[inf_key] == samples[count_key]
+        sum_key = f'pathway_operator_self_time_seconds_sum{{operator="{op}"}}'
+        assert samples[sum_key] >= 0
+    pw.clear_graph()
+
+
+def test_streaming_scrape_histograms_monotone():
+    """Tier-1 CI check (ISSUE satellite): a live streaming pipeline with
+    with_http_server=True exposes the per-operator self-time histogram
+    series mid-run, and their counts are monotone across two scrapes."""
+    import threading
+    import time as _time
+
+    from pathway_tpu.internals import http_monitoring as hm
+
+    class S(pw.Schema):
+        a: int
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(60):
+                self.next(a=i)
+                self.commit()  # one epoch per row: scrapes see progress
+                _time.sleep(0.02)
+
+    t = pw.io.python.read(Src(), schema=S, autocommit_duration_ms=10)
+    res = t.select(b=pw.this.a * 2)
+    pw.io.null.write(res)
+
+    scrapes: list[str] = []
+    errors: list[BaseException] = []
+    orig_start = hm.MonitoringHttpServer.start
+
+    def scraping_start(self):
+        orig_start(self)
+        port = self.port
+
+        def scrape():
+            def get():
+                return urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                ).read().decode()
+
+            try:
+                # poll until the first epoch's histograms surface, then
+                # take the two mid-run scrapes the assertion compares
+                deadline = _time.monotonic() + 5.0
+                while _time.monotonic() < deadline:
+                    body = get()
+                    if "pathway_operator_self_time_seconds_count" in body:
+                        scrapes.append(body)
+                        break
+                    _time.sleep(0.02)
+                _time.sleep(0.1)
+                scrapes.append(get())
+            except BaseException as exc:
+                errors.append(exc)
+
+        threading.Thread(target=scrape, daemon=True).start()
+
+    hm.MonitoringHttpServer.start = scraping_start
+    try:
+        pw.run(
+            monitoring_level=pw.MonitoringLevel.NONE,
+            with_http_server=True,
+            monitoring_http_port=0,
+        )
+    finally:
+        hm.MonitoringHttpServer.start = orig_start
+    assert not errors, errors
+    assert len(scrapes) == 2
+
+    def hist_counts(body: str) -> dict[str, float]:
+        samples, types = _parse_prometheus(body)
+        assert types.get("pathway_operator_self_time_seconds") == "histogram"
+        return {
+            k: v
+            for k, v in samples.items()
+            if k.startswith("pathway_operator_self_time_seconds_count")
+        }
+
+    first, second = hist_counts(scrapes[0]), hist_counts(scrapes[1])
+    assert first, "no per-operator histogram series in first scrape"
+    # same series present, counts monotone non-decreasing across scrapes
+    for series, count in first.items():
+        assert series in second
+        assert second[series] >= count
+    # the stream kept flowing between scrapes, so something advanced
+    assert sum(second.values()) > sum(first.values())
+
+
+def test_dashboard_shows_profiler_columns():
+    """With a profiler attached, the operators table gains self-time and
+    event-lag columns."""
+    import io
+
+    from rich.console import Console
+
+    from pathway_tpu.internals.monitoring import StatsMonitor, build_dashboard
+    from pathway_tpu.internals.profiler import RunProfiler
+
+    t = T(
+        """
+          | a
+        1 | 1
+        2 | 2
+        """
+    )
+    res = t.select(b=pw.this.a * 2)
+    runner = GraphRunner()
+    cap, _ = runner.capture(res)
+    prof = RunProfiler()
+    runner.attach_profiler(prof)
+    monitor = StatsMonitor()
+    runner.run(monitoring_callback=monitor.update)
+    assert monitor.profiler is prof
+    entries = list(monitor.operators.values())
+    assert any(e.self_time_s is not None for e in entries)
+
+    buf = io.StringIO()
+    Console(file=buf, width=200).print(build_dashboard(monitor, 0.0))
+    out = buf.getvalue()
+    assert "self-time" in out
+    assert "event lag" in out
+    pw.clear_graph()

@@ -1,0 +1,362 @@
+"""Per-operator run profiler: scheduler timing, Chrome trace surface,
+event-time lag, jit compile/execute split.
+
+Covers the profiler subsystem end to end: engine hooks in
+EngineGraph._topo_pass, the ``pw.run(profile=...)`` / PATHWAY_PROFILE /
+``pathway profile`` surfaces, and the golden structure of the emitted
+Chrome-trace-event JSON (loadable in Perfetto: one track per worker,
+one slice per node-epoch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import dataflow as df
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.internals.profiler import (
+    HISTOGRAM_BOUNDS,
+    LatencyHistogram,
+    RunProfiler,
+    current_profiler,
+    set_current_profiler,
+    wrap_jit,
+)
+
+from .utils import T
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMO = os.path.join(
+    REPO_ROOT, "pathway_tpu", "debug", "demos", "word_counts.py"
+)
+
+
+def _word_counts_graph():
+    docs = T(
+        """
+          | text
+        1 | to be or not to be
+        2 | that is the question
+        3 | to be is to do
+        """
+    )
+    words = docs.select(
+        word=pw.apply_with_type(str.split, list[str], pw.this.text)
+    ).flatten(pw.this.word)
+    return words.groupby(pw.this.word).reduce(
+        pw.this.word, count=pw.reducers.count()
+    )
+
+
+# ---------------------------------------------------------------- units
+
+
+def test_latency_histogram_buckets_and_cumulative():
+    h = LatencyHistogram()
+    h.observe(0.0)          # first bucket
+    h.observe(0.002)        # mid bucket
+    h.observe(1e9)          # +Inf overflow
+    assert h.count == 3
+    assert h.total == pytest.approx(0.002 + 1e9)
+    cum = h.cumulative()
+    assert len(cum) == len(HISTOGRAM_BOUNDS) + 1
+    assert cum[-1] == ("+Inf", 3)
+    # cumulative counts are monotone non-decreasing
+    counts = [c for _, c in cum]
+    assert counts == sorted(counts)
+
+
+def test_wrap_jit_reports_compile_then_execute():
+    prof = RunProfiler()
+    set_current_profiler(prof)
+    try:
+        cache = [0]
+        grow_next = [True]
+
+        def fn(x):
+            if grow_next[0]:  # simulate a jit cache miss on first call
+                cache[0] += 1
+            return x + 1
+
+        fn._cache_size = lambda: cache[0]
+        wrapped = wrap_jit("test.fn", fn)
+
+        assert wrapped(1) == 2  # cache grew -> compile
+        grow_next[0] = False
+        assert wrapped(1) == 2  # cache stable -> execute
+
+        stats = prof.jit_stats["test.fn"]
+        assert stats["compiles"] == 1
+        assert stats["calls"] == 1
+        assert stats["compile_ns"] > 0
+        assert stats["execute_ns"] > 0
+    finally:
+        set_current_profiler(None)
+
+
+def test_wrap_jit_noop_without_profiler():
+    assert current_profiler() is None
+    calls = []
+
+    def fn(x):
+        calls.append(x)
+        return x
+
+    wrapped = wrap_jit("n", fn)
+    assert wrapped(5) == 5
+    assert calls == [5]
+    assert wrapped.__wrapped__ is fn
+
+
+# ----------------------------------------------- engine scheduler hooks
+
+
+def test_profiler_covers_every_engine_node_every_epoch():
+    res = _word_counts_graph()
+    runner = GraphRunner()
+    cap, _ = runner.capture(res)
+    prof = RunProfiler()
+    runner.attach_profiler(prof)
+    assert runner.engine.profiler is prof
+    runner.run()
+
+    node_ids = {n.id for n in runner.engine.nodes}
+    profiled_ids = {nid for (_w, nid) in prof.profiles}
+    assert profiled_ids == node_ids  # every node profiled
+    epochs = {p.epochs for p in prof.profiles.values()}
+    assert epochs == {1}  # static run: exactly one epoch each
+    # self-time adds up and at least one node did measurable work
+    assert any(p.self_time_ns > 0 for p in prof.profiles.values())
+    for p in prof.profiles.values():
+        assert p.histogram.count == p.epochs
+    pw.clear_graph()
+
+
+def test_profiler_event_lag_for_watermark_nodes():
+    import time as _time
+
+    lag_target = 5.0
+    now = _time.time()
+    g = df.EngineGraph()
+    src = g.static_table(
+        [(0, [(1, (now - lag_target,), 1), (2, (now - lag_target * 2,), 1)])]
+    )
+    buf = df.BufferNode(
+        g,
+        threshold_fn=lambda k, r: r[0],
+        time_fn=lambda k, r: r[0],
+    )
+    buf.connect(src)
+    prof = RunProfiler()
+    g.profiler = prof
+    g.run()
+    bp = prof.profiles[(0, buf.id)]
+    assert bp.event_lag_s is not None
+    # watermark = max event time = now - 5s; lag measured moments later
+    assert bp.event_lag_s == pytest.approx(lag_target, abs=2.0)
+    agg = prof.by_operator()
+    assert agg[bp.key]["event_lag_s"] == pytest.approx(bp.event_lag_s)
+    # non-watermark nodes expose no lag
+    sp = prof.profiles[(0, src.id)]
+    assert sp.event_lag_s is None
+
+
+def test_batch_apply_reports_jit_execute_split():
+    t = T(
+        """
+          | a
+        1 | 1
+        2 | 2
+        3 | 3
+        """
+    )
+    @pw.udf(executor=pw.udfs.BatchExecutor(max_batch_size=8))
+    def double(xs: list[int]) -> list[int]:
+        return [x * 2 for x in xs]
+
+    res = t.select(b=double(pw.this.a))
+    runner = GraphRunner()
+    cap, _ = runner.capture(res)
+    prof = RunProfiler()
+    runner.attach_profiler(prof)
+    runner.run()
+    batch_keys = [k for k in prof.jit_stats if k.startswith("batch_udf/")]
+    assert batch_keys, f"no batch-udf jit stats recorded: {prof.jit_stats}"
+    ent = prof.jit_stats[batch_keys[0]]
+    assert ent["calls"] >= 1
+    assert ent["execute_ns"] > 0
+    assert ent["rows"] == 3
+    pw.clear_graph()
+
+
+# --------------------------------------------------- chrome trace surface
+
+
+def _assert_trace_golden_structure(trace: dict):
+    """The golden shape contract for the profile surface: valid
+    trace-event JSON, process/worker metadata, complete 'X' slices
+    keyed by node id, one slice per node per epoch."""
+    assert set(trace) >= {"traceEvents", "displayTimeUnit"}
+    events = trace["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    slices = [e for e in events if e["ph"] == "X"]
+    assert {m["name"] for m in meta} >= {"process_name", "thread_name"}
+    assert slices, "no slices recorded"
+    op_slices = [s for s in slices if s.get("cat") == "operator"]
+    per_node_epochs: dict[int, list[int]] = {}
+    all_epochs: set[int] = set()
+    for s in op_slices:
+        for key in ("name", "ts", "dur", "pid", "tid", "args"):
+            assert key in s, f"slice missing {key}: {s}"
+        assert s["ts"] >= 0 and s["dur"] >= 0
+        args = s["args"]
+        assert "node_id" in args and "epoch" in args
+        per_node_epochs.setdefault(args["node_id"], []).append(args["epoch"])
+        all_epochs.add(args["epoch"])
+    # one slice per node per epoch: every node has exactly one slice in
+    # every epoch observed anywhere in the trace
+    for node_id, epochs in per_node_epochs.items():
+        assert sorted(epochs) == sorted(all_epochs), (
+            f"node {node_id} epochs {sorted(epochs)} != {sorted(all_epochs)}"
+        )
+        assert len(epochs) == len(set(epochs)), f"duplicate slices for {node_id}"
+    return per_node_epochs
+
+
+def test_run_profile_kwarg_writes_chrome_trace(tmp_path):
+    out = tmp_path / "trace.json"
+    _word_counts_graph_with_sink()
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE, profile=str(out))
+    trace = json.loads(out.read_text())
+    per_node = _assert_trace_golden_structure(trace)
+    assert len(per_node) >= 5  # source, select, flatten, groupby, output
+    assert trace["otherData"]["producer"] == "pathway_tpu.profiler"
+
+
+def _word_counts_graph_with_sink():
+    counts = _word_counts_graph()
+    pw.io.null.write(counts)
+
+
+def test_profile_env_var_in_subprocess_demo(tmp_path):
+    """PATHWAY_PROFILE on the stock word_counts demo — the acceptance
+    path: pw.run picks the path from env, trace covers every node."""
+    out = tmp_path / "demo_trace.json"
+    env = os.environ.copy()
+    env.update(
+        PATHWAY_PROFILE=str(out),
+        PYTHONPATH=REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        [sys.executable, DEMO],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    trace = json.loads(out.read_text())
+    per_node = _assert_trace_golden_structure(trace)
+    names = {
+        e["name"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "X" and e.get("cat") == "operator"
+    }
+    assert {"Flatten", "GroupBy", "Output"} <= names
+    assert len(per_node) >= 5
+
+
+def test_profile_cli_subcommand(tmp_path):
+    out = tmp_path / "cli_trace.json"
+    env = os.environ.copy()
+    env.update(
+        PYTHONPATH=REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pathway_tpu",
+            "profile",
+            "-o",
+            str(out),
+            DEMO,
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "perfetto" in proc.stderr.lower()
+    _assert_trace_golden_structure(json.loads(out.read_text()))
+
+
+def test_trace_has_source_location_and_worker_tracks(tmp_path):
+    out = tmp_path / "t.json"
+    _word_counts_graph_with_sink()
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE, profile=str(out))
+    trace = json.loads(out.read_text())
+    slices = [
+        e
+        for e in trace["traceEvents"]
+        if e["ph"] == "X" and e.get("cat") == "operator"
+    ]
+    # build-time source frames ride on the slices for user-built operators
+    with_loc = [s for s in slices if "file" in s["args"]]
+    assert with_loc, "no slice carries a source location"
+    assert any(s["args"]["file"].endswith(".py") for s in with_loc)
+    # exactly the worker tracks named
+    meta_tids = {
+        m["tid"]
+        for m in trace["traceEvents"]
+        if m["ph"] == "M" and m["name"] == "thread_name"
+    }
+    assert {s["tid"] for s in slices} <= meta_tids
+
+
+def test_profiler_multi_worker_tracks():
+    """Sharded runs profile every worker: one RunProfiler shared across
+    shard engines, one trace track per worker."""
+    res = _word_counts_graph()
+    runner = GraphRunner(n_workers=2)
+    cap, _ = runner.capture(res)
+    prof = RunProfiler()
+    runner.attach_profiler(prof)
+    assert all(e.profiler is prof for e in runner._cluster_engines())
+    runner.run()
+
+    workers = {w for (w, _nid) in prof.profiles}
+    assert workers == {0, 1}
+    # both shards profiled the same node set
+    ids0 = {nid for (w, nid) in prof.profiles if w == 0}
+    ids1 = {nid for (w, nid) in prof.profiles if w == 1}
+    assert ids0 == ids1 == {n.id for n in runner.engine.nodes}
+    trace = prof.chrome_trace()
+    track_names = {
+        m["args"]["name"]
+        for m in trace["traceEvents"]
+        if m["ph"] == "M" and m["name"] == "thread_name"
+    }
+    assert {"worker 0", "worker 1"} <= track_names
+    # aggregation merges both workers under one operator key
+    agg = prof.by_operator()
+    assert all(a["epochs"] >= 1 for a in agg.values())
+    pw.clear_graph()
+
+
+def test_profiler_bounded_events():
+    prof = RunProfiler(max_events=2)
+    for _ in range(5):
+        prof.record_jit("x", "execute", 100, 1)
+    assert len(prof.events) == 2
+    assert prof.dropped_events == 3
+    assert prof.chrome_trace()["otherData"]["dropped_events"] == 3

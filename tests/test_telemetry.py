@@ -1,0 +1,189 @@
+"""OTLP Telemetry exporter: wire-format coverage.
+
+Satellite of the profiler PR: ``Telemetry`` previously had zero direct
+tests. A local HTTP stub collector captures the OTLP/HTTP JSON POSTs
+(/v1/traces + /v1/metrics) so the payload shape — resource attributes,
+span nesting via parentSpanId, gauge datapoints — is pinned, and the
+file-path exporter is checked to write parseable JSONL. Also covers the
+profiler's per-operator child spans: same trace_id as the run span,
+source-location attributes from the node's build-time frame.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.telemetry import Telemetry
+
+
+class _StubCollector:
+    """Minimal OTLP/HTTP collector: records every POST body by path."""
+
+    def __init__(self):
+        self.requests: dict[str, list[dict]] = {}
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length))
+                stub.requests.setdefault(self.path, []).append(body)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        self.endpoint = f"http://127.0.0.1:{self._httpd.server_port}"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture()
+def collector():
+    c = _StubCollector()
+    yield c
+    c.close()
+
+
+def _attr_dict(attrs: list[dict]) -> dict:
+    out = {}
+    for a in attrs:
+        v = a["value"]
+        out[a["key"]] = next(iter(v.values()))
+    return out
+
+
+def test_http_exporter_traces_payload_shape(collector):
+    tel = Telemetry(endpoint=collector.endpoint)
+    assert tel.enabled and tel._is_http
+    with tel.span("outer", workers=2) as outer:
+        pass
+    tel.add_span(
+        "operator/Select",
+        start_unix_ns=outer.start_unix_ns,
+        end_unix_ns=outer.end_unix_ns,
+        parent=outer,
+        attrs={"pathway.node_id": 1, "code.filepath": "prog.py"},
+    )
+    tel.gauge("rows_in", 7.0)
+    tel.flush()
+
+    traces = collector.requests["/v1/traces"]
+    assert len(traces) == 1
+    rs = traces[0]["resourceSpans"]
+    assert len(rs) == 1
+    resource_attrs = _attr_dict(rs[0]["resource"]["attributes"])
+    assert resource_attrs["service.name"] == "pathway_tpu"
+    assert "process.pid" in resource_attrs
+    spans = rs[0]["scopeSpans"][0]["spans"]
+    assert [s["name"] for s in spans] == ["outer", "operator/Select"]
+    # all spans share the run's trace id
+    assert len({s["traceId"] for s in spans}) == 1
+    by_name = {s["name"]: s for s in spans}
+    child = by_name["operator/Select"]
+    # the operator span nests under the run span
+    assert child["parentSpanId"] == by_name["outer"]["spanId"]
+    assert "parentSpanId" not in by_name["outer"]
+    child_attrs = _attr_dict(child["attributes"])
+    assert child_attrs["pathway.node_id"] == "1"  # OTLP intValue is a string
+    assert child_attrs["code.filepath"] == "prog.py"
+    for s in spans:
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+        assert s["kind"] == 1
+
+
+def test_http_exporter_metrics_payload_shape(collector):
+    tel = Telemetry(endpoint=collector.endpoint)
+    tel.gauge("rows_in", 3.0)
+    tel.gauge("rows_out", 5.5)
+    tel.flush()
+
+    metrics = collector.requests["/v1/metrics"]
+    assert len(metrics) == 1
+    rm = metrics[0]["resourceMetrics"][0]
+    assert _attr_dict(rm["resource"]["attributes"])["service.name"] == "pathway_tpu"
+    by_name = {
+        m["name"]: m for m in rm["scopeMetrics"][0]["metrics"]
+    }
+    assert set(by_name) == {"rows_in", "rows_out"}
+    dp = by_name["rows_out"]["gauge"]["dataPoints"]
+    assert len(dp) == 1
+    assert dp[0]["asDouble"] == 5.5
+    assert int(dp[0]["timeUnixNano"]) > 0
+
+
+def test_file_exporter_writes_parseable_jsonl(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    for i in range(2):  # two flushes -> two JSONL lines
+        tel = Telemetry(endpoint=str(path))
+        assert tel.enabled and not tel._is_http
+        with tel.span(f"run{i}", attempt=i):
+            pass
+        tel.gauge("rows", float(i))
+        tel.flush()
+
+    lines = path.read_text().strip().split("\n")
+    assert len(lines) == 2
+    for i, line in enumerate(lines):
+        rec = json.loads(line)  # every line parses independently
+        assert rec["spans"][0]["name"] == f"run{i}"
+        assert rec["spans"][0]["ms"] >= 0
+        assert rec["metrics"] == {"rows": float(i)}
+        assert rec["ts"] > 0
+
+
+def test_unknown_scheme_disables_exporter():
+    tel = Telemetry(endpoint="grpc://collector:4317")
+    assert not tel.enabled
+    tel.flush()  # must not raise
+
+
+def test_run_emits_per_operator_child_spans(collector, monkeypatch):
+    """End-to-end: pw.run with PATHWAY_TELEMETRY_SERVER exports one
+    child span per engine operator, nested under graph_runner.run,
+    sharing its trace_id and carrying source-location attrs."""
+    monkeypatch.setenv("PATHWAY_TELEMETRY_SERVER", collector.endpoint)
+    t = pw.debug.table_from_markdown(
+        """
+          | a
+        1 | 1
+        2 | 2
+        """
+    )
+    res = t.select(b=pw.this.a * 2)
+    pw.io.null.write(res)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+    spans = collector.requests["/v1/traces"][0]["resourceSpans"][0][
+        "scopeSpans"
+    ][0]["spans"]
+    by_name = {s["name"]: s for s in spans}
+    run_span = by_name["graph_runner.run"]
+    op_spans = [s for s in spans if s["name"].startswith("operator/")]
+    assert len(op_spans) >= 3  # source, select, output at minimum
+    for s in op_spans:
+        assert s["traceId"] == run_span["traceId"]
+        assert s["parentSpanId"] == run_span["spanId"]
+        attrs = _attr_dict(s["attributes"])
+        assert "pathway.node_id" in attrs
+        assert "pathway.self_time_s" in attrs
+    # user-built operators carry their build-time source location
+    assert any(
+        "code.filepath" in _attr_dict(s["attributes"]) for s in op_spans
+    )
