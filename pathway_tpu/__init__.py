@@ -224,6 +224,8 @@ def groupby(table, *args, **kw):
 
 from .stdlib import temporal as window  # pw.window.tumbling(...) namespace
 from . import analysis  # pw.analysis.analyze / suppress (static verifier)
+from . import resilience  # retry policy / run supervisor / chaos harness
+from .resilience import Recovery, RecoveryEscalated, RetryPolicy
 
 
 def __getattr__(name):
@@ -255,4 +257,5 @@ __all__ = [
     "set_monitoring_config", "sql", "stdlib", "temporal", "this", "udf",
     "udfs", "unpack_col", "unsafe_make_pointer", "unwrap", "utils",
     "wrap_py_object", "xpacks", "universes", "LiveTable", "analysis",
+    "resilience", "Recovery", "RecoveryEscalated", "RetryPolicy",
 ]

@@ -1819,6 +1819,12 @@ class AsyncApplyNode(Node):
         self.async_fn = async_fn  # async (key, row) -> value tuple appended to row
         self.memo: dict[int, tuple] = {}
         self._snap_attrs = ("memo",)
+        # row-failure policy: "raise" (terminate_on_error routing),
+        # "dead_letter" (row dropped from output, routed to the
+        # dead_letter_id sessions), or "skip" (row silently dropped)
+        self.on_error = "raise"
+        self.dead_letter_id: int | None = None
+        self.on_end_callback: Callable | None = None
 
     def process(self, time):
         updates = self.take()
@@ -1828,6 +1834,8 @@ class AsyncApplyNode(Node):
         pending = []
         for key, row, diff in updates:
             if diff < 0:
+                # a dead-lettered/skipped row has no memo entry, so its
+                # retraction is a no-op here too — consistent lifecycle
                 orow = self.memo.pop(key, None)
                 if orow is not None:
                     out.append((key, orow, -1))
@@ -1837,12 +1845,27 @@ class AsyncApplyNode(Node):
             results = self.graph.run_async_batch(self.async_fn, pending)
             for (key, row), res in zip(pending, results):
                 if isinstance(res, BaseException):
+                    if self.on_error == "dead_letter":
+                        self.graph.report_dead_letter(
+                            self.dead_letter_id, self, key, row, res
+                        )
+                        continue
+                    if self.on_error == "skip":
+                        continue
                     # failed UDF: abort, or ERROR value + error-log entry
                     res = self.graph.report_row_error(self, res)
                 orow = row + (res,)
                 self.memo[key] = orow
                 out.append((key, orow, 1))
         self.emit(out, time)
+
+    def on_end(self):
+        # AsyncTransformer close() lifecycle hook: fires once, after the
+        # final flush (run() calls on_end for every node at stream end)
+        cb = self.on_end_callback
+        if cb is not None:
+            self.on_end_callback = None
+            cb()
 
 
 class BatchApplyNode(Node):
@@ -1867,6 +1890,10 @@ class BatchApplyNode(Node):
         self.max_batch_size = max(1, int(max_batch_size))
         self.memo: dict[int, tuple] = {}
         self._snap_attrs = ("memo",)
+        # same row-failure policy surface as AsyncApplyNode
+        self.on_error = "raise"
+        self.dead_letter_id: int | None = None
+        self.on_end_callback: Callable | None = None
 
     def process(self, time):
         updates = self.take()
@@ -1911,12 +1938,26 @@ class BatchApplyNode(Node):
             except Exception as exc:
                 # the whole chunk failed (same contract as the dynamic
                 # batcher: one exception fails every row of the batch)
+                if self.on_error == "dead_letter":
+                    for key, row in chunk:
+                        self.graph.report_dead_letter(
+                            self.dead_letter_id, self, key, row, exc
+                        )
+                    continue
+                if self.on_error == "skip":
+                    continue
                 results = [self.graph.report_row_error(self, exc)] * len(chunk)
             for (key, row), res in zip(chunk, results):
                 orow = row + (res,)
                 self.memo[key] = orow
                 out.append((key, orow, 1))
         self.emit(out, time)
+
+    def on_end(self):
+        cb = self.on_end_callback
+        if cb is not None:
+            self.on_end_callback = None
+            cb()
 
 
 class EngineGraph:
@@ -1959,6 +2000,10 @@ class EngineGraph:
         # the ERROR value and an entry in the error-log sessions
         self.terminate_on_error = True
         self.error_sessions: list[InputSession] = []
+        # dead-letter routing: dl_id -> sessions feeding that operator's
+        # `.failed` table (on_error="dead_letter"); failures route here
+        # regardless of terminate_on_error
+        self.dead_letter_sessions: dict[int, list[InputSession]] = {}
         self._error_seq = 0
         self._opsnap_time = -1       # operator-snapshot restore point
         self._last_opsnap_wall = 0.0
@@ -2017,6 +2062,45 @@ class EngineGraph:
             session.insert(key, row)
             session.commit()
         return ERROR
+
+    def report_dead_letter(
+        self, dl_id: int | None, origin: "Node", key, row, exc: BaseException
+    ) -> None:
+        """Route a failing row to its operator's dead-letter sessions
+        (the `.failed` table). Unlike report_row_error this never
+        aborts: on_error="dead_letter" is an explicit per-operator
+        override of terminate_on_error. Silently drops the record when
+        the `.failed` table was never consumed (no sessions lowered)."""
+        sessions = self.dead_letter_sessions.get(dl_id) if dl_id is not None else None
+        if not sessions:
+            return
+        import traceback
+
+        tb = traceback.extract_tb(exc.__traceback__)
+        frame = tb[-1] if tb else None
+        trace = (
+            {"file": frame.filename, "line": frame.lineno, "function": frame.name}
+            if frame
+            else None
+        )
+        user = getattr(origin, "user_frame", None)
+        if user is not None:
+            trace = dict(trace or {})
+            trace["user_frame"] = user.as_dict()
+        from .value import Json as _Json
+
+        args = [v if isinstance(v, (str, int, float, bool, type(None))) else repr(v) for v in row]
+        self._error_seq += 1
+        dkey = int(ref_scalar("__dead_letter__", self.worker_id, self._error_seq))
+        drow = (
+            _Json(args),
+            origin.id,
+            f"{type(exc).__name__}: {exc}",
+            _Json(trace) if trace else None,
+        )
+        for session in sessions:
+            session.insert(dkey, drow)
+            session.commit()
 
     def run_async_batch(self, async_fn, pending):
         import asyncio
@@ -2110,7 +2194,10 @@ class EngineGraph:
                 # readers, so there replay stays safe)
                 self.persistence.reset_source(s.persistent_id)
                 continue
-            batches, offsets, f = self.persistence.recover_source(s.persistent_id)
+            batches, offsets, f = self.persistence.recover_source(
+                s.persistent_id,
+                delivered_frontier=self.persistence.delivered_frontier(),
+            )
             s.replay_batches = list(batches)
             s.session.restore_offsets(offsets)
             frontier = max(frontier, f)
@@ -2285,9 +2372,24 @@ class EngineGraph:
             for s, b in session_batches:
                 resolved = s.feed_batch(b, t)
                 if self.persistence is not None and s.persistent_id is not None and resolved:
-                    self.persistence.log_batch(s.persistent_id, t, resolved)
+                    # feed offsets ride along durably (KIND_FEED) so a
+                    # crash after the sink flush but before ADVANCE can
+                    # finalize this epoch on recovery instead of
+                    # re-reading and re-delivering it
+                    self.persistence.log_batch(
+                        s.persistent_id, t, resolved, s.last_offsets or {}
+                    )
             self._topo_pass(t)
             if self.persistence is not None:
+                if session_batches:
+                    # sinks flushed this epoch's output in the topo pass;
+                    # durably mark it delivered BEFORE advancing offset
+                    # cursors — a crash in between must finalize (not
+                    # re-deliver) the epoch on recovery
+                    from ..resilience import chaos as _chaos
+
+                    _chaos.inject("engine.after_sink_flush", time=int(t))
+                    self.persistence.mark_delivered(int(t))
                 for s, _b in session_batches:
                     if s.persistent_id is not None:
                         self.persistence.advance(s.persistent_id, t, s.last_offsets or {})

@@ -499,6 +499,26 @@ class EnginePersistence:
             self._writers[source_id] = w
         return w
 
+    def log_position(self, source_id: str) -> int | None:
+        """Current byte position of the source's log writer, if the
+        backend exposes one (filesystem logs). Used by the chaos
+        harness to script crashes at exact byte offsets. The native
+        writer keeps no python-side handle, so fall back to the file
+        size — exact at the flush boundaries where chaos sites fire."""
+        w = self._writers.get(source_id)
+        f = getattr(w, "_f", None)
+        if f is not None:
+            try:
+                return f.tell()
+            except (OSError, ValueError):
+                return None
+        if w is not None and self.kind == "filesystem":
+            try:
+                return os.path.getsize(self._source_path(source_id))
+            except OSError:
+                return None
+        return None
+
     # -- engine API --
 
     def recover_source(self, source_id: str, delivered_frontier: int = -1):
@@ -698,9 +718,16 @@ class EnginePersistence:
     ) -> None:
         import pickle
 
+        from ..resilience import chaos
+
         w = self.writer_for(source_id)
         for key, row, diff in updates:
             w.append(KIND_DATA, time, key, pickle.dumps((row, diff), protocol=4))
+            chaos.inject(
+                "persistence.append_data",
+                time=int(time),
+                offset=self.log_position(source_id),
+            )
         if offsets is not None:
             # feed-time offsets: durable BEFORE process 0 can deliver the
             # epoch, so a crash between p0's sink flush and this worker's
@@ -712,6 +739,13 @@ class EnginePersistence:
     def advance(self, source_id: str, time: int, offsets: dict) -> None:
         import pickle
 
+        from ..resilience import chaos
+
+        chaos.inject(
+            "persistence.before_advance",
+            time=int(time),
+            offset=self.log_position(source_id),
+        )
         w = self.writer_for(source_id)
         w.append(KIND_ADVANCE, time, 0, pickle.dumps(offsets or {}, protocol=4))
         w.flush()

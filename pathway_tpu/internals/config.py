@@ -69,6 +69,31 @@ class PathwayConfig:
         ``pathway profile`` CLI subcommand."""
         return os.environ.get("PATHWAY_PROFILE") or None
 
+    @property
+    def cluster_accept_timeout(self) -> float | None:
+        """Seconds the coordinator waits for all workers to connect
+        (PATHWAY_CLUSTER_ACCEPT_TIMEOUT); None = CoordinatorCluster
+        default (60 s)."""
+        v = os.environ.get("PATHWAY_CLUSTER_ACCEPT_TIMEOUT")
+        if not v:
+            return None
+        try:
+            return float(v)
+        except ValueError:
+            return None
+
+    @property
+    def cluster_hello_timeout(self) -> float | None:
+        """Seconds allowed for one connected worker's hello handshake
+        (PATHWAY_CLUSTER_HELLO_TIMEOUT); None = default (10 s)."""
+        v = os.environ.get("PATHWAY_CLUSTER_HELLO_TIMEOUT")
+        if not v:
+            return None
+        try:
+            return float(v)
+        except ValueError:
+            return None
+
 
 def get_pathway_config() -> PathwayConfig:
     cfg = PathwayConfig()

@@ -50,3 +50,38 @@ def local_error_log() -> Generator:
     context): in this build every lowered error log receives all row
     errors of the run."""
     yield _make_error_log_table()
+
+
+class DeadLetterSchema(Schema):
+    """Shape of ``.failed`` dead-letter tables: the offending row's
+    input values (JSON-rendered), plus the same (operator_id, message,
+    trace) triple the error log carries."""
+
+    args: Json | None
+    operator_id: int
+    message: str
+    trace: Json | None
+
+
+_dead_letter_seq = [0]
+
+
+def new_dead_letter_id() -> int:
+    """Fresh routing id tying one operator's failures to its ``.failed``
+    table. Monotonic across clear_graph(): ids are only ever matched
+    within one built program, so gaps are harmless."""
+    _dead_letter_seq[0] += 1
+    return _dead_letter_seq[0]
+
+
+def dead_letter_table(dl_id: int, *, name: str = "dead_letter"):
+    """A table fed by the engine's dead-letter sessions for ``dl_id`` —
+    rows a UDF / AsyncTransformer failed on under
+    ``on_error="dead_letter"``. Lowered via LogicalOp kind
+    ``dead_letter`` (graph_runner._lower_dead_letter)."""
+    from .table import Column, LogicalOp, Table
+    from .universe import Universe
+
+    cols = {n: Column(t) for n, t in DeadLetterSchema.dtypes().items()}
+    op = LogicalOp("dead_letter", [], {"dl_id": dl_id})
+    return Table(cols, Universe(), op, name=f"{name}_{dl_id}")

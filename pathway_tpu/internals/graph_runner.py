@@ -250,13 +250,27 @@ class GraphRunner:
         else:
             self.engine.run(monitoring_callback)
 
-    def run_coordinator(self, processes: int, first_port: int, monitoring_callback=None) -> None:
+    def run_coordinator(
+        self,
+        processes: int,
+        first_port: int,
+        monitoring_callback=None,
+        accept_timeout: float | None = None,
+        hello_timeout: float | None = None,
+    ) -> None:
         """Process 0 of a PATHWAY_PROCESSES cluster: local shards
-        [0, T), sources/sinks/persistence + the worker protocol."""
+        [0, T), sources/sinks/persistence + the worker protocol.
+        ``accept_timeout``/``hello_timeout`` bound cluster formation
+        (None = CoordinatorCluster defaults / env)."""
         from ..parallel.multiprocess import CoordinatorCluster
 
+        kwargs = {}
+        if accept_timeout is not None:
+            kwargs["accept_timeout"] = accept_timeout
+        if hello_timeout is not None:
+            kwargs["hello_timeout"] = hello_timeout
         self._cluster = CoordinatorCluster(
-            self._cluster_engines(), processes=processes, first_port=first_port
+            self._cluster_engines(), processes=processes, first_port=first_port, **kwargs
         )
         self._cluster.run(monitoring_callback)
 
@@ -324,6 +338,18 @@ class GraphRunner:
         node = df.SessionSourceNode(self.engine)
         node.is_error_log = True
         self.engine.error_sessions.append(node.session)
+        return Lowered(node, list(table._columns.keys()))
+
+    def _lower_dead_letter(self, table: Table, op: LogicalOp) -> Lowered:
+        """Dead-letter (`.failed`) table: a session source fed by the
+        engine's report_dead_letter for one operator's dl_id. Shares
+        the error-log source treatment (is_error_log) so it is excluded
+        from EOF/persistence accounting and drained at end of run."""
+        node = df.SessionSourceNode(self.engine)
+        node.is_error_log = True
+        self.engine.dead_letter_sessions.setdefault(op.params["dl_id"], []).append(
+            node.session
+        )
         return Lowered(node, list(table._columns.keys()))
 
     def _lower_static(self, table: Table, op: LogicalOp) -> Lowered:
@@ -486,6 +512,11 @@ class GraphRunner:
                     return await _fn(*args, **kwargs)
 
                 anode = df.AsyncApplyNode(self.engine, async_fn)
+            # row-failure policy riding on the expression (udf(on_error=...)
+            # / AsyncTransformer): copy onto the engine node
+            anode.on_error = getattr(ae, "_pw_on_error", "raise")
+            anode.dead_letter_id = getattr(ae, "_pw_dead_letter_id", None)
+            anode.on_end_callback = getattr(ae, "_pw_on_end", None)
             anode.connect(node)
             node = anode
             async_slots[id(ae)] = layout.add_slot()

@@ -105,9 +105,42 @@ class MonitoringHttpServer:
             if lag_lines:
                 lines.append("# TYPE pathway_operator_event_lag_seconds gauge")
                 lines.extend(lag_lines)
+        lines.extend(self._resilience_lines())
         return "\n".join(lines) + "\n"
 
+    @staticmethod
+    def _resilience_lines() -> list[str]:
+        """Retry-policy attempt counters and supervisor restart counters
+        (reference telemetry: one series per connector/udf scope)."""
+        from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
+
+        lines: list[str] = []
+        retries = RETRY_METRICS.snapshot()
+        if retries:
+            for metric in ("attempts", "retries", "successes", "failures"):
+                lines.append(f"# TYPE pathway_retry_{metric}_total counter")
+                for scope in sorted(retries):
+                    lines.append(
+                        f'pathway_retry_{metric}_total{{scope="{_escape_label(scope)}"}} '
+                        f"{retries[scope][metric]}"
+                    )
+        sup = SUPERVISOR_METRICS.snapshot()
+        if sup["restarts_total"] or sup["escalations"]:
+            lines.append("# TYPE pathway_supervisor_restarts_total counter")
+            for cause in sorted(sup["restarts"]):
+                lines.append(
+                    f'pathway_supervisor_restarts_total{{cause="{_escape_label(cause)}"}} '
+                    f"{sup['restarts'][cause]}"
+                )
+            lines.append("# TYPE pathway_supervisor_escalations_total counter")
+            lines.append(
+                f"pathway_supervisor_escalations_total {sup['escalations']}"
+            )
+        return lines
+
     def _status(self) -> str:
+        from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
+
         snap = self.monitor.snapshot
         return json.dumps(
             {
@@ -117,6 +150,8 @@ class MonitoringHttpServer:
                 "operators": snap.operators,
                 "operator_self_time_s": snap.operator_self_time_s,
                 "operator_event_lag_s": snap.operator_event_lag_s,
+                "retries": RETRY_METRICS.snapshot(),
+                "supervisor": SUPERVISOR_METRICS.snapshot(),
             }
         )
 

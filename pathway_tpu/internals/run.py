@@ -48,6 +48,9 @@ def run(
     terminate_on_error: bool = True,
     analysis: str | None = None,
     profile: Any = None,
+    recovery: Any = None,
+    cluster_accept_timeout: float | None = None,
+    cluster_hello_timeout: float | None = None,
     **kwargs: Any,
 ) -> None:
     """Execute all registered outputs/subscriptions to completion
@@ -59,7 +62,21 @@ def run(
     ``pathway_profile.json``. The PATHWAY_PROFILE env var (set by the
     ``pathway profile`` CLI) supplies the path when the arg is None.
     ``monitoring_http_port``: explicit /metrics port for
-    ``with_http_server`` (0 = ephemeral); default 20000 + process_id."""
+    ``with_http_server`` (0 = ephemeral); default 20000 + process_id.
+
+    ``recovery``: ``True`` / restart budget int / a
+    :class:`pathway_tpu.resilience.Recovery` — supervise the run: a
+    worker-process death, connector exception or engine-epoch failure
+    rebuilds the runner and restarts from the last persisted snapshot
+    (requires ``persistence_config`` for exactly-once resumption; a
+    restart without it re-reads sources from scratch). The budget
+    exhausted, the run fails cleanly with
+    :class:`pathway_tpu.resilience.RecoveryEscalated`.
+
+    ``cluster_accept_timeout`` / ``cluster_hello_timeout``: bound
+    multi-process cluster formation on the coordinator (defaults 60 s /
+    10 s; also settable via PATHWAY_CLUSTER_ACCEPT_TIMEOUT /
+    PATHWAY_CLUSTER_HELLO_TIMEOUT)."""
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
         # this point — return before sinks are built or readers started
@@ -94,17 +111,6 @@ def run(
 
     n_workers = max(1, pwcfg.threads)
     processes = max(1, pwcfg.processes)
-    runner = GraphRunner(n_workers=n_workers)
-    if processes > 1 and pwcfg.process_id > 0:
-        # worker process of a `pathway spawn --processes P` cluster:
-        # same graph, no sink callbacks, no reader threads
-        runner.suppress_callbacks = True
-    runner.engine.terminate_on_error = terminate_on_error
-    for r in runner._replicas:
-        r.engine.terminate_on_error = terminate_on_error
-    if profiler is not None:
-        runner.attach_profiler(profiler)
-        set_current_profiler(profiler)  # jit hooks in models/ + udfs/
     if persistence_config is None:
         # CLI record/replay wiring (reference cli.py:166-193): spawn's
         # --record/--replay-mode flags arrive via PATHWAY_REPLAY_* env
@@ -118,19 +124,52 @@ def run(
             # CLI-driven runs record/replay every source, not just those
             # with an explicit persistent_id
             persistence_config.auto_persistent_ids = True
-    if persistence_config is not None:
-        runner.engine.persistence_config = persistence_config
-    for table, sink in list(G.outputs):
-        sink_builder = sink.get("build")
-        if sink_builder is not None:
-            sink_builder(runner, table)
-    for spec in list(G.subscriptions):
-        runner.subscribe(
-            spec["table"],
-            on_change=spec.get("on_change"),
-            on_time_end=spec.get("on_time_end"),
-            on_end=spec.get("on_end"),
-        )
+    accept_timeout = (
+        cluster_accept_timeout
+        if cluster_accept_timeout is not None
+        else pwcfg.cluster_accept_timeout
+    )
+    hello_timeout = (
+        cluster_hello_timeout
+        if cluster_hello_timeout is not None
+        else pwcfg.cluster_hello_timeout
+    )
+
+    def _build_runner(is_restart: bool) -> GraphRunner:
+        """Fresh runner + sinks + subscriptions per (re)start attempt:
+        a crashed attempt's engine state is unrecoverable in place —
+        the persistence layer replays input snapshots into a clean
+        graph instead."""
+        runner = GraphRunner(n_workers=n_workers)
+        # consumed by sinks (e.g. fs.write appends instead of
+        # truncating when the supervisor restarts a run)
+        runner.recovery_restart = is_restart
+        if processes > 1 and pwcfg.process_id > 0:
+            # worker process of a `pathway spawn --processes P` cluster:
+            # same graph, no sink callbacks, no reader threads
+            runner.suppress_callbacks = True
+        runner.engine.terminate_on_error = terminate_on_error
+        for r in runner._replicas:
+            r.engine.terminate_on_error = terminate_on_error
+        if profiler is not None:
+            runner.attach_profiler(profiler)
+        if persistence_config is not None:
+            runner.engine.persistence_config = persistence_config
+        for table, sink in list(G.outputs):
+            sink_builder = sink.get("build")
+            if sink_builder is not None:
+                sink_builder(runner, table)
+        for spec in list(G.subscriptions):
+            runner.subscribe(
+                spec["table"],
+                on_change=spec.get("on_change"),
+                on_time_end=spec.get("on_time_end"),
+                on_end=spec.get("on_end"),
+            )
+        return runner
+
+    if profiler is not None:
+        set_current_profiler(profiler)  # jit hooks in models/ + udfs/
     import contextlib
 
     from .monitoring import MonitoringLevel, monitor_stats
@@ -157,23 +196,47 @@ def run(
             http_server = MonitoringHttpServer(monitor, port=monitoring_http_port)
             http_server.start()
         run_span = None
+
+        def _attempt(is_restart: bool) -> None:
+            runner = _build_runner(is_restart)
+            if processes > 1:
+                # reference CommunicationConfig::Cluster (config.rs:62-86):
+                # P processes × T threads; coordinator = process 0
+                if pwcfg.process_id == 0:
+                    runner.run_coordinator(
+                        processes,
+                        pwcfg.first_port,
+                        monitoring_callback=monitor.update if monitor else None,
+                        accept_timeout=accept_timeout,
+                        hello_timeout=hello_timeout,
+                    )
+                else:
+                    runner.run_worker(processes, pwcfg.first_port, pwcfg.process_id)
+            else:
+                runner.run(monitoring_callback=monitor.update if monitor else None)
+
         try:
             with telemetry.span(
                 "graph_runner.run", workers=pwcfg.n_workers
             ) as run_span:
-                if processes > 1:
-                    # reference CommunicationConfig::Cluster (config.rs:62-86):
-                    # P processes × T threads; coordinator = process 0
-                    if pwcfg.process_id == 0:
-                        runner.run_coordinator(
-                            processes,
-                            pwcfg.first_port,
-                            monitoring_callback=monitor.update if monitor else None,
-                        )
-                    else:
-                        runner.run_worker(processes, pwcfg.first_port, pwcfg.process_id)
+                from ..resilience import Recovery, Supervisor
+
+                rec = Recovery.coerce(recovery)
+                if rec is None:
+                    _attempt(False)
                 else:
-                    runner.run(monitoring_callback=monitor.update if monitor else None)
+                    if persistence_config is None:
+                        import warnings
+
+                        warnings.warn(
+                            "pw.run(recovery=...) without persistence_config: "
+                            "restarts re-read every source from scratch and "
+                            "may re-deliver output already flushed before the "
+                            "crash; configure persistence for exactly-once "
+                            "resumption",
+                            stacklevel=2,
+                        )
+                    Supervisor(rec).run(_attempt)
         finally:
             if profiler is not None:
                 set_current_profiler(None)

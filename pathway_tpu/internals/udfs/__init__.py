@@ -68,11 +68,16 @@ class ExponentialBackoffRetryStrategy(AsyncRetryStrategy):
         initial_delay: int = 1_000,
         backoff_factor: float = 2.0,
         jitter_ms: int = 300,
+        rng: random.Random | None = None,
     ):
         self.max_retries = max_retries
         self.initial_delay = initial_delay / 1000.0
         self.backoff_factor = backoff_factor
         self.jitter = jitter_ms / 1000.0
+        # injectable RNG (e.g. random.Random(seed)) makes the jitter
+        # sequence deterministic for tests; default keeps fleet
+        # de-synchronization via the module-global generator
+        self._rng = rng if rng is not None else random
 
     async def invoke(self, fn, *args, **kwargs):
         delay = self.initial_delay
@@ -82,7 +87,7 @@ class ExponentialBackoffRetryStrategy(AsyncRetryStrategy):
             except Exception:
                 if attempt == self.max_retries:
                     raise
-                await asyncio.sleep(delay + random.random() * self.jitter)
+                await asyncio.sleep(delay + self._rng.random() * self.jitter)
                 delay *= self.backoff_factor
 
 
@@ -193,22 +198,45 @@ def sync_executor() -> Executor:
     return SyncExecutor()
 
 
+def _coerce_retry_strategy(retry_strategy: Any) -> AsyncRetryStrategy | None:
+    """Accept either an AsyncRetryStrategy or a shared
+    pathway_tpu.resilience.RetryPolicy (duck-typed via its
+    as_async_strategy adapter) — one retry knob across the runtime."""
+    if retry_strategy is None or isinstance(retry_strategy, AsyncRetryStrategy):
+        return retry_strategy
+    as_async = getattr(retry_strategy, "as_async_strategy", None)
+    if as_async is not None:
+        return as_async()
+    return retry_strategy
+
+
 def async_executor(
     *,
     capacity: int | None = None,
     timeout: float | None = None,
-    retry_strategy: AsyncRetryStrategy | None = None,
+    retry_strategy: Any = None,
 ) -> Executor:
-    return AsyncExecutor(capacity=capacity, timeout=timeout, retry_strategy=retry_strategy)
+    """``retry_strategy`` may be an :class:`AsyncRetryStrategy` or a
+    :class:`pathway_tpu.resilience.RetryPolicy` (attempt counts then
+    land in ``resilience.RETRY_METRICS`` → ``/metrics``)."""
+    return AsyncExecutor(
+        capacity=capacity,
+        timeout=timeout,
+        retry_strategy=_coerce_retry_strategy(retry_strategy),
+    )
 
 
 def fully_async_executor(
     *,
     capacity: int | None = None,
     timeout: float | None = None,
-    retry_strategy: AsyncRetryStrategy | None = None,
+    retry_strategy: Any = None,
 ) -> Executor:
-    return FullyAsyncExecutor(capacity=capacity, timeout=timeout, retry_strategy=retry_strategy)
+    return FullyAsyncExecutor(
+        capacity=capacity,
+        timeout=timeout,
+        retry_strategy=_coerce_retry_strategy(retry_strategy),
+    )
 
 
 def batch_executor(*, max_batch_size: int = 1024, linger_ms: float = 0.0) -> Executor:
@@ -430,7 +458,12 @@ class UDF:
         executor: Executor | None = None,
         cache_strategy: CacheStrategy | None = None,
         max_batch_size: int | None = None,
+        on_error: str = "raise",
     ):
+        if on_error not in ("raise", "dead_letter", "skip"):
+            raise ValueError(
+                f"on_error={on_error!r}: expected 'raise', 'dead_letter' or 'skip'"
+            )
         self.func = func
         self.return_type = return_type
         self.deterministic = deterministic
@@ -438,6 +471,8 @@ class UDF:
         self.executor = executor or AutoExecutor()
         self.cache_strategy = cache_strategy
         self.max_batch_size = max_batch_size
+        self.on_error = on_error
+        self._dl_id: int | None = None
         if func is not None:
             # update_wrapper sets self.__wrapped__ = func; guarded so a
             # subclass-defined __wrapped__ method is not shadowed by None
@@ -449,6 +484,32 @@ class UDF:
         if fn is None:
             raise TypeError("UDF has no function; override __wrapped__ or pass func")
         return self._build_expression(fn, args, kwargs)
+
+    def _dead_letter_id(self) -> int:
+        if self._dl_id is None:
+            from ..errors import new_dead_letter_id
+
+            self._dl_id = new_dead_letter_id()
+        return self._dl_id
+
+    @property
+    def failed(self):
+        """Dead-letter table: rows this UDF failed on (requires
+        ``on_error="dead_letter"``), shaped as
+        :class:`pathway_tpu.internals.errors.DeadLetterSchema`."""
+        from ..errors import dead_letter_table
+
+        name = getattr(self, "__name__", None) or "udf"
+        return dead_letter_table(self._dead_letter_id(), name=f"{name}.failed")
+
+    def _stamp_policy(self, expr: ColumnExpression) -> ColumnExpression:
+        """Attach the row-failure policy to the built expression; the
+        graph runner copies it onto the engine node."""
+        if self.on_error != "raise":
+            expr._pw_on_error = self.on_error
+            if self.on_error == "dead_letter":
+                expr._pw_dead_letter_id = self._dead_letter_id()
+        return expr
 
     def _build_expression(self, fn, args, kwargs) -> ColumnExpression:
         ret = self.return_type
@@ -469,7 +530,7 @@ class UDF:
                 wrapped = with_cache_strategy(wrapped, self.cache_strategy)
             if self.propagate_none:
                 wrapped = with_propagate_none(wrapped)
-            return AsyncApplyExpression(wrapped, ret, args, kwargs)
+            return self._stamp_policy(AsyncApplyExpression(wrapped, ret, args, kwargs))
 
         if isinstance(ex, AsyncExecutor) or is_async or (
             isinstance(ex, AutoExecutor) and is_async
@@ -491,7 +552,7 @@ class UDF:
                 if isinstance(ex, FullyAsyncExecutor)
                 else AsyncApplyExpression
             )
-            return cls(wrapped, ret, args, kwargs)
+            return self._stamp_policy(cls(wrapped, ret, args, kwargs))
 
         # sync path
         fn_sync = fn
@@ -499,7 +560,14 @@ class UDF:
             cached = with_cache_strategy(fn, self.cache_strategy)
             if self.propagate_none:
                 cached = with_propagate_none(cached)
-            return AsyncApplyExpression(cached, ret, args, kwargs)
+            return self._stamp_policy(AsyncApplyExpression(cached, ret, args, kwargs))
+        if self.on_error != "raise":
+            # dead-letter/skip routing lives on the Async/BatchApply
+            # engine nodes — lift the sync fn onto that path
+            wrapped = coerce_async(fn)
+            if self.propagate_none:
+                wrapped = with_propagate_none(wrapped)
+            return self._stamp_policy(AsyncApplyExpression(wrapped, ret, args, kwargs))
         return ApplyExpression(
             fn_sync,
             ret,
@@ -520,9 +588,15 @@ def udf(
     executor: Executor | None = None,
     cache_strategy: CacheStrategy | None = None,
     max_batch_size: int | None = None,
+    on_error: str = "raise",
 ):
     """Decorator: turn a python function into a UDF usable in expressions
-    (reference udfs/__init__.py:290 `pw.udf`)."""
+    (reference udfs/__init__.py:290 `pw.udf`).
+
+    ``on_error``: per-row failure policy — ``"raise"`` (default,
+    terminate_on_error routing), ``"dead_letter"`` (failing rows drop
+    from the output and land in the UDF's ``.failed`` table with error
+    message, node id and trace), or ``"skip"`` (drop silently)."""
 
     def wrapper(f):
         return UDF(
@@ -533,6 +607,7 @@ def udf(
             executor=executor,
             cache_strategy=cache_strategy,
             max_batch_size=max_batch_size,
+            on_error=on_error,
         )
 
     if fun is not None:

@@ -216,6 +216,7 @@ def input_table_from_reader(
     persistent_id: str | None = None,
     supports_offsets: bool = False,
     parallel_readers: bool = False,
+    retry_policy: Any = None,
 ) -> Table:
     """Create an input Table whose rows are produced by `reader(ctx)`
     running on a named thread (reference reader threads mod.rs:447).
@@ -227,7 +228,14 @@ def input_table_from_reader(
     EVERY process starts its own reader thread and feeds its local
     shard, the reference's partitioned-source mode
     (/root/reference/src/engine/graph.rs:943-950); otherwise the source
-    reads on process 0 only and rows are forwarded by key shard."""
+    reads on process 0 only and rows are forwarded by key shard.
+
+    ``retry_policy``: a :class:`pathway_tpu.resilience.RetryPolicy` —
+    transient reader exceptions re-run ``reader(ctx)`` with backoff
+    instead of failing the run; rows already committed before the
+    failure are NOT re-read (readers resume from ``ctx.offsets``).
+    Attempt counts land in ``resilience.RETRY_METRICS`` under scope
+    ``connector:<name>`` and show up on ``/metrics``."""
 
     dtypes = schema.dtypes()
     # schema-declared append-only: the engine trusts the declaration
@@ -253,8 +261,18 @@ def input_table_from_reader(
             ctx._key_salt = ctx.process_id
 
         def run():
-            try:
+            from ..resilience import chaos
+
+            def attempt():
+                # scripted transient failures for the retry tests
+                chaos.inject(f"connector.{name}")
                 reader(ctx)
+
+            try:
+                if retry_policy is not None:
+                    retry_policy.execute(attempt, scope=f"connector:{name}")
+                else:
+                    attempt()
             except Exception as exc:
                 # record BEFORE close(): the engine loop must see the
                 # failure when it sees the closed session, or a crashed

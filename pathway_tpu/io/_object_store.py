@@ -198,6 +198,7 @@ def read_object_store(
     poll_interval_s: float = _POLL_INTERVAL_S,
     object_cache: str | ObjectCache | None = None,
     object_size_limit: int | None = None,
+    retry_policy: Any = None,
     **kwargs,
 ) -> Table:
     """Build an input table over an ObjectStoreClient.
@@ -335,6 +336,7 @@ def read_object_store(
         autocommit_duration_ms=autocommit_duration_ms,
         persistent_id=persistent_id,
         supports_offsets=True,  # resumes from {key: (version, n_rows)}
+        retry_policy=retry_policy,
     )
 
 

@@ -144,6 +144,7 @@ def read(
     autocommit_duration_ms: int | None = 1500,
     name: str = "fs",
     persistent_id: str | None = None,
+    retry_policy: Any = None,
     **kwargs,
 ) -> Table:
     if schema is None:
@@ -216,6 +217,7 @@ def read(
         autocommit_duration_ms=autocommit_duration_ms,
         persistent_id=persistent_id,
         supports_offsets=True,  # scanner resumes from {path: (mtime, n)}
+        retry_policy=retry_policy,
     )
 
 
@@ -231,12 +233,20 @@ def write(table: Table, filename: str, *, format: str = "csv", name: str = "fs.w
 
     def on_build(runner):
         # open at build time on the delivering process only (worker
-        # processes of a multi-process run never create the file)
-        f = open(filename, "w", newline="")
+        # processes of a multi-process run never create the file).
+        # A supervisor restart (pw.run(recovery=...)) must APPEND: the
+        # persistence layer suppresses replayed epochs, so rows already
+        # flushed before the crash stay and the recovered run only
+        # delivers what comes after the durable frontier.
+        append = bool(getattr(runner, "recovery_restart", False)) and (
+            os.path.exists(filename) and os.path.getsize(filename) > 0
+        )
+        f = open(filename, "a" if append else "w", newline="")
         state["f"] = f
         if format == "csv":
             writer = _csv.writer(f)
-            writer.writerow(names + ["time", "diff"])
+            if not append:
+                writer.writerow(names + ["time", "diff"])
             state["writer"] = writer
 
     if format == "csv":

@@ -6,44 +6,21 @@ request loop distinguishes transport errors from retryable status codes,
 takes an injectable session and sleep function (so tests can drive it
 without real endpoints or real delays), and exposes the attempt history
 for assertions.
+
+``RetryPolicy`` and ``DEFAULT_RETRY_CODES`` are the shared definitions
+from :mod:`pathway_tpu.resilience` — re-exported here for backwards
+compatibility so the HTTP connector and the rest of the runtime cannot
+drift apart (the policy gained a seedable RNG in the move).
 """
 
 from __future__ import annotations
 
-import random
 import time
 from typing import Any, Callable
 
-#: status codes that indicate a transient server-side condition
-DEFAULT_RETRY_CODES: tuple[int, ...] = (429, 500, 502, 503, 504)
+from ...resilience.retry import DEFAULT_RETRY_CODES, RetryPolicy
 
-
-class RetryPolicy:
-    """Escalating wait schedule: each retry waits ``backoff_factor``
-    times longer than the last, plus a uniform jitter so a fleet of
-    connectors does not reconnect in lockstep."""
-
-    def __init__(
-        self,
-        first_delay_ms: int = 1000,
-        backoff_factor: float = 1.5,
-        jitter_ms: int = 300,
-    ):
-        self._delay_s = first_delay_ms / 1000.0
-        self._factor = backoff_factor
-        self._jitter_s = jitter_ms / 1000.0
-
-    @classmethod
-    def default(cls) -> "RetryPolicy":
-        return cls()
-
-    def wait_duration_before_retry(self) -> float:
-        """Seconds to sleep before the next attempt; advances the schedule."""
-        current = self._delay_s
-        self._delay_s = self._delay_s * self._factor + random.uniform(
-            0.0, self._jitter_s
-        )
-        return current
+__all__ = ["DEFAULT_RETRY_CODES", "RetryPolicy", "RequestRunner"]
 
 
 class RequestRunner:

@@ -159,6 +159,7 @@ def read(
     name: str = "kafka",
     parallel_readers: bool = False,
     persistent_id: str | None = None,
+    retry_policy=None,
     _consumer=None,
     **kwargs,
 ) -> Table:
@@ -182,6 +183,10 @@ def read(
     - ``parallel_readers``: in a multi-process run every process reads
       its own partition share (graph.rs:943-950) — consumer groups for
       real clients, round-robin for the injected fake.
+
+    ``retry_policy``: a :class:`pathway_tpu.resilience.RetryPolicy` —
+    transient poller exceptions restart the reader with backoff instead
+    of failing the run (attempt counts on ``/metrics``).
 
     ``_consumer`` injects a fake: an iterable of (key, value[, topic,
     partition, offset, timestamp_ms]) tuples or dicts."""
@@ -286,6 +291,7 @@ def read(
         autocommit_duration_ms=autocommit_duration_ms,
         parallel_readers=parallel_readers,
         persistent_id=persistent_id,
+        retry_policy=retry_policy,
     )
 
 

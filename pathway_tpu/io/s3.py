@@ -143,6 +143,9 @@ def read(
             avoids re-downloading unchanged objects entirely.
         _client: injectable boto3-shaped client (tests run against a
             fake; production uses ``aws_s3_settings.create_client()``).
+        retry_policy: (kwarg) :class:`pathway_tpu.resilience.RetryPolicy`
+            — transient list/fetch exceptions restart the poller with
+            backoff instead of failing the run.
     """
     bucket, prefix = _split_path(path, aws_s3_settings)
 

@@ -78,6 +78,7 @@ import time as _wall
 from typing import Any
 
 from ..engine import dataflow as df
+from ..resilience import chaos
 from .sharded import ShardCluster
 
 _HDR = struct.Struct("<I")
@@ -144,7 +145,14 @@ class CoordinatorCluster(ShardCluster):
     """Process 0's cluster: local shards [0, T) of a P*T world, plus the
     protocol driving P-1 remote worker processes."""
 
-    def __init__(self, engines, processes: int, first_port: int, accept_timeout: float = 60.0):
+    def __init__(
+        self,
+        engines,
+        processes: int,
+        first_port: int,
+        accept_timeout: float = 60.0,
+        hello_timeout: float = 10.0,
+    ):
         threads = len(engines)
         super().__init__(engines, base=0, world=processes * threads)
         self.threads = threads
@@ -160,13 +168,30 @@ class CoordinatorCluster(ShardCluster):
         token = cluster_token()
         try:
             while len(self._conns) < processes - 1:
-                conn, _ = srv.accept()
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    missing = sorted(
+                        set(range(1, processes)) - set(self._conns)
+                    )
+                    raise df.EngineError(
+                        f"cluster formation timed out after {accept_timeout:g}s: "
+                        f"worker process(es) {missing} never connected to port "
+                        f"{first_port} (connected: {sorted(self._conns)}). "
+                        "Check that every process was spawned with the same "
+                        "PATHWAY_FIRST_PORT; raise the limit via "
+                        "pw.run(cluster_accept_timeout=...) or "
+                        "PATHWAY_CLUSTER_ACCEPT_TIMEOUT."
+                    ) from None
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 # the handshake is JSON and token-checked BEFORE any
-                # pickle frame is accepted from the peer
+                # pickle frame is accepted from the peer; a connected
+                # peer that stalls mid-hello must not eat the whole
+                # accept budget
+                conn.settimeout(hello_timeout)
                 try:
                     hello = _recv_json(conn)
-                except (ConnectionError, ValueError):
+                except (ConnectionError, ValueError, socket.timeout):
                     conn.close()
                     continue
                 if hello.get("op") != "hello" or not hmac.compare_digest(
@@ -184,6 +209,7 @@ class CoordinatorCluster(ShardCluster):
                     _send_json(conn, {"op": "fatal", "error": "PATHWAY_THREADS mismatch"})
                     raise RuntimeError("PATHWAY_THREADS differs across processes")
                 _send_json(conn, {"op": "welcome", "token": token})
+                conn.settimeout(None)  # steady-state protocol is blocking
                 self._conns[hello["pid"]] = conn
                 self._worker_frontiers.append(
                     int(hello.get("replay_frontier", -1))
@@ -321,12 +347,14 @@ class CoordinatorCluster(ShardCluster):
         # order loses the epoch's output if the cluster dies in between
         # (workers would resume past input that was never delivered)
         self._time_end_all(time)
+        chaos.inject("coordinator.after_sink_flush", time=int(time))
         if self._persistence is not None:
             # durable delivered marker between the sink flush and the
             # workers' ADVANCE: a crash in that window must finalize the
             # epoch on recovery (workers promote fed-but-unadvanced
             # epochs at or below this marker), never re-deliver it
             self._persistence.mark_delivered(int(time))
+        chaos.inject("coordinator.after_mark_delivered", time=int(time))
         self._broadcast({"op": "time_end", "t": time})
         # the feed round consumed worker input: a cached pending=True
         # would spin empty epochs until the cache expired
@@ -519,6 +547,11 @@ def _feed_partitioned(
                 persistence.log_batch(
                     s.persistent_id, t, resolved, offsets=s.last_offsets or {}
                 )
+                chaos.inject(
+                    "worker.after_feed_log",
+                    time=int(t),
+                    offset=persistence.log_position(s.persistent_id),
+                )
                 # the ADVANCE (offset cursor) flushes only when the
                 # epoch CLOSES: advancing at feed time would mark rows
                 # consumed that a mid-epoch crash never delivered —
@@ -648,10 +681,12 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                 )
             elif op == "time_end":
                 cluster._time_end_all(msg["t"])
+                chaos.inject("worker.before_advance", time=int(msg["t"]))
                 if wp is not None and pending_advance:
                     for sid, (at, offs) in pending_advance.items():
                         wp.advance(sid, at, offs)
                     pending_advance.clear()
+                chaos.inject("worker.after_advance", time=int(msg["t"]))
                 _send(sock, {"op": "ok"})
             elif op == "snapshot":
                 states = {}

@@ -289,6 +289,11 @@ class ShardCluster:
                 e.profiler.begin_epoch(e.worker_id)
         self._sweep_local(time)
         self._time_end_all(time)
+        if getattr(self, "_persistence", None) is not None:
+            # same delivered-marker contract as the coordinator's sweep:
+            # sinks flushed above, offset cursors advance after — a crash
+            # in between must finalize the epoch on recovery
+            self._persistence.mark_delivered(int(time))
         for e in self.engines:
             if e.profiler is not None:
                 e.profiler.end_epoch(e.worker_id, e, time)
@@ -338,7 +343,12 @@ class ShardCluster:
             return
         p = EnginePersistence(cfg)
         self._persistence = p
-        frontier = recover_sources(p, primary.session_sources, cfg)
+        frontier = recover_sources(
+            p,
+            primary.session_sources,
+            cfg,
+            delivered_frontier=p.delivered_frontier(),
+        )
         # worker processes may have logged epochs past process 0's own
         # frontier: snapshot recovery below must see the GLOBAL maximum
         # or it rejects (and deletes) snapshots taken at trailing
@@ -534,7 +544,11 @@ class ShardCluster:
                     and s.persistent_id is not None
                     and resolved
                 ):
-                    self._persistence.log_batch(s.persistent_id, t, resolved)
+                    # include feed offsets (KIND_FEED): crash between the
+                    # sink flush and ADVANCE finalizes, never re-delivers
+                    self._persistence.log_batch(
+                        s.persistent_id, t, resolved, s.last_offsets or {}
+                    )
             self._deliver_mail()
             self._sweep(t)
             if self._persistence is not None:

@@ -1,0 +1,183 @@
+"""Deterministic fault-injection harness.
+
+Instrumented code calls :func:`inject` at named *sites* — e.g.
+``worker.after_feed_log`` right after a KIND_FEED record is made
+durable, or ``coordinator.after_mark_delivered`` between the sink
+flush and the worker ADVANCE broadcast in
+``parallel/multiprocess.py``. A *chaos plan* (rules loaded from the
+``PATHWAY_CHAOS`` environment variable, or activated in-process via
+:func:`activate`) decides whether a given call dies, raises, or
+delays, keyed on the site name, the epoch, the persistence byte
+offset, the process id and a deterministic hit counter. With no plan
+active, :func:`inject` is a near-zero-cost no-op, so the sites stay in
+production code paths.
+
+Rule shape (JSON object, or a list of them, or ``{"rules": [...]}``;
+``PATHWAY_CHAOS`` may hold the JSON itself or a path to a file)::
+
+    {"site": "worker.after_feed_log",   # required, exact match
+     "action": "kill",                  # kill | exit | raise | delay
+     "time": 3,                         # optional: only this epoch
+     "offset": 4096,                    # optional: fire once the reported
+                                        #   byte offset reaches this value
+     "process": 1,                      # optional: PATHWAY_PROCESS_ID
+     "hit": 2,                          # optional: fire on the n-th match
+     "repeat": false,                   # optional: re-arm after firing
+     "code": 17,                        # exit code for action=exit
+     "delay_s": 0.1}                    # for action=delay
+
+``kill`` sends SIGKILL to the calling process (no cleanup, the crash
+the recovery contract is written for); ``exit`` is ``os._exit``;
+``raise`` throws :class:`ChaosInjected`, which the run supervisor
+treats as restartable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time as _time
+from typing import Any
+
+_SIGNALS = {"kill": signal.SIGKILL, "term": signal.SIGTERM}
+_ACTIONS = ("kill", "term", "exit", "raise", "delay")
+
+
+class ChaosInjected(RuntimeError):
+    """Scripted failure thrown by a chaos rule with ``action="raise"``."""
+
+
+class ChaosPlan:
+    """A compiled set of chaos rules with per-rule hit state."""
+
+    def __init__(self, rules: list[dict[str, Any]]) -> None:
+        self.rules: list[dict[str, Any]] = []
+        for rule in rules:
+            rule = dict(rule)
+            if "site" not in rule:
+                raise ValueError(f"chaos rule missing 'site': {rule!r}")
+            action = rule.setdefault("action", "raise")
+            if action not in _ACTIONS:
+                raise ValueError(
+                    f"chaos rule action {action!r}: expected one of {_ACTIONS}"
+                )
+            rule["_hits"] = 0
+            rule["_done"] = False
+            self.rules.append(rule)
+
+    @classmethod
+    def from_spec(cls, spec: Any) -> "ChaosPlan":
+        if isinstance(spec, dict) and "rules" in spec:
+            spec = spec["rules"]
+        if isinstance(spec, dict):
+            spec = [spec]
+        if not isinstance(spec, list):
+            raise ValueError(f"chaos spec: expected object or list, got {type(spec)}")
+        return cls(spec)
+
+    def _matches(
+        self, rule: dict[str, Any], site: str, time: int | None, offset: int | None
+    ) -> bool:
+        if rule["site"] != site:
+            return False
+        if "process" in rule:
+            pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+            if int(rule["process"]) != pid:
+                return False
+        if "time" in rule:
+            if time is None or int(time) != int(rule["time"]):
+                return False
+        if "offset" in rule:
+            # byte offsets grow monotonically within a log; fire the
+            # first time the instrumented site reports reaching it
+            if offset is None or int(offset) < int(rule["offset"]):
+                return False
+        return True
+
+    def fire(self, site: str, time: int | None, offset: int | None) -> None:
+        for rule in self.rules:
+            if rule["_done"] or not self._matches(rule, site, time, offset):
+                continue
+            rule["_hits"] += 1
+            if rule["_hits"] < int(rule.get("hit", 1)):
+                continue
+            if not rule.get("repeat", False):
+                rule["_done"] = True
+            else:
+                rule["_hits"] = 0
+            self._act(rule, site, time, offset)
+
+    def _act(
+        self, rule: dict[str, Any], site: str, time: int | None, offset: int | None
+    ) -> None:
+        action = rule["action"]
+        if action in _SIGNALS:
+            os.kill(os.getpid(), _SIGNALS[action])
+            # SIGKILL is not deliverable to ourselves synchronously on
+            # every platform; make sure we do not keep running
+            _time.sleep(5.0)
+            os._exit(int(rule.get("code", 17)))
+        if action == "exit":
+            os._exit(int(rule.get("code", 17)))
+        if action == "delay":
+            _time.sleep(float(rule.get("delay_s", 0.1)))
+            return
+        raise ChaosInjected(
+            f"chaos[{rule.get('id', rule['site'])}]: site={site} "
+            f"time={time} offset={offset}"
+        )
+
+
+_lock = threading.Lock()
+_active: ChaosPlan | None = None
+_env_loaded = False
+
+
+def activate(plan: ChaosPlan | list[dict[str, Any]] | dict[str, Any] | None) -> None:
+    """Install a plan in-process (tests); ``None`` deactivates."""
+    global _active, _env_loaded
+    with _lock:
+        if plan is not None and not isinstance(plan, ChaosPlan):
+            plan = ChaosPlan.from_spec(plan)
+        _active = plan
+        _env_loaded = True  # explicit activation overrides the env
+
+
+def deactivate() -> None:
+    activate(None)
+
+
+def reload_env() -> None:
+    """Forget any active plan and re-read PATHWAY_CHAOS on the next
+    :func:`inject` (tests that set the env var after import)."""
+    global _active, _env_loaded
+    with _lock:
+        _active = None
+        _env_loaded = False
+
+
+def _load_env() -> None:
+    global _active, _env_loaded
+    with _lock:
+        if _env_loaded:
+            return
+        _env_loaded = True
+        spec = os.environ.get("PATHWAY_CHAOS")
+        if not spec:
+            return
+        if os.path.exists(spec):
+            with open(spec) as f:
+                spec = f.read()
+        _active = ChaosPlan.from_spec(json.loads(spec))
+
+
+def inject(site: str, *, time: int | None = None, offset: int | None = None) -> None:
+    """Chaos hook: no-op unless an active rule matches this call."""
+    if not _env_loaded:
+        _load_env()
+    plan = _active
+    if plan is None:
+        return
+    plan.fire(site, time, offset)

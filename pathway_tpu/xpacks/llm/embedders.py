@@ -89,8 +89,10 @@ class OpenAIEmbedder(BaseEmbedder):
         capacity: max concurrent in-flight requests; None = unbounded.
             Rows queue in the async executor beyond this.
         retry_strategy: a ``udfs.AsyncRetryStrategy`` applied per request
-            (e.g. ``udfs.ExponentialBackoffRetryStrategy``); None = fail
-            on first error, routing the row to the error log.
+            (e.g. ``udfs.ExponentialBackoffRetryStrategy``) or a shared
+            ``pathway_tpu.resilience.RetryPolicy`` (coerced; attempts
+            surface on ``/metrics``); None = fail on first error,
+            routing the row to the error log.
         cache_strategy: a ``udfs.CacheStrategy`` memoizing responses by
             input text — on a restart, previously embedded documents are
             served from the cache instead of re-billed.

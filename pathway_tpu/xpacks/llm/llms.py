@@ -144,7 +144,11 @@ class OpenAIChat(BaseChat):
 
     ``capacity``/``retry_strategy``/``cache_strategy`` wire the UDF
     executor (concurrency bound, backoff retries, persistent response
-    cache) and are fixed at construction; every sampling/decoding
+    cache) and are fixed at construction. ``retry_strategy`` accepts
+    either a ``udfs.AsyncRetryStrategy`` or a shared
+    :class:`pathway_tpu.resilience.RetryPolicy` (coerced via its
+    ``as_async_strategy()``; attempt counts then surface on ``/metrics``
+    as ``pathway_retry_*_total``); every sampling/decoding
     option below (and any extra provider kwarg) sets a default that a
     per-call kwarg overrides.  Each request/response pair is logged as
     a structured event under a shared correlation id, and the reported

@@ -1,0 +1,550 @@
+"""Fault-tolerant runtime: unified RetryPolicy, run supervisor,
+dead-letter routing, chaos harness, cluster-formation timeouts.
+
+Reference model: the reference's persistence/recovery integration suite
+plus udfs.AsyncRetryStrategy semantics; the multi-process crash-window
+proofs live in test_chaos_crash_window.py (marked slow/chaos).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.resilience import (
+    DEFAULT_RETRY_CODES,
+    RETRY_METRICS,
+    SUPERVISOR_METRICS,
+    ChaosInjected,
+    ChaosPlan,
+    Recovery,
+    RecoveryEscalated,
+    RetryPolicy,
+    Supervisor,
+    chaos,
+)
+
+
+def _no_sleep(_s: float) -> None:
+    pass
+
+
+@pytest.fixture(autouse=True)
+def _reset_resilience_state():
+    RETRY_METRICS.reset()
+    SUPERVISOR_METRICS.reset()
+    yield
+    chaos.deactivate()
+    RETRY_METRICS.reset()
+    SUPERVISOR_METRICS.reset()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_jitter_deterministic_under_seed():
+    def waits(policy):
+        sched = policy.spawn()
+        return [sched.wait_duration_before_retry() for _ in range(5)]
+
+    a = RetryPolicy(first_delay_ms=10, jitter_ms=100, max_retries=5, seed=42)
+    b = RetryPolicy(first_delay_ms=10, jitter_ms=100, max_retries=5, seed=42)
+    assert waits(a) == waits(b)
+    # and a seeded policy replays the same schedule on every spawn
+    assert waits(a) == waits(a)
+    # different seed, different jitter
+    c = RetryPolicy(first_delay_ms=10, jitter_ms=100, max_retries=5, seed=7)
+    assert waits(a) != waits(c)
+
+
+def test_retry_backoff_growth_without_jitter():
+    p = RetryPolicy(first_delay_ms=100, backoff_factor=2.0, jitter_ms=0)
+    s = p.spawn()
+    assert [s.wait_duration_before_retry() for _ in range(3)] == [0.1, 0.2, 0.4]
+
+
+def test_retry_execute_succeeds_after_transient_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    p = RetryPolicy(first_delay_ms=1, jitter_ms=0, max_retries=5, sleep=_no_sleep)
+    assert p.execute(flaky, scope="t") == "ok"
+    snap = RETRY_METRICS.snapshot()["t"]
+    assert snap == {"attempts": 3, "retries": 2, "successes": 1, "failures": 0}
+
+
+def test_retry_execute_exhausts_budget_and_raises():
+    p = RetryPolicy(first_delay_ms=1, jitter_ms=0, max_retries=2, sleep=_no_sleep)
+    with pytest.raises(ValueError, match="always"):
+        p.execute(lambda: (_ for _ in ()).throw(ValueError("always")), scope="x")
+    snap = RETRY_METRICS.snapshot()["x"]
+    assert snap["attempts"] == 3  # initial + 2 retries
+    assert snap["failures"] == 1 and snap["successes"] == 0
+
+
+def test_retry_execute_respects_retryable_filter():
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise TypeError("not transient")
+
+    p = RetryPolicy(first_delay_ms=1, jitter_ms=0, max_retries=5, sleep=_no_sleep)
+    with pytest.raises(TypeError):
+        p.execute(fatal, retryable=lambda e: isinstance(e, ConnectionError))
+    assert calls["n"] == 1  # no retry on a non-retryable error
+
+
+def test_retry_none_policy_single_attempt():
+    calls = {"n": 0}
+
+    def fail():
+        calls["n"] += 1
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        RetryPolicy.none().execute(fail)
+    assert calls["n"] == 1
+
+
+def test_http_retry_module_delegates_to_shared_policy():
+    from pathway_tpu.io.http import _retry
+
+    # one class, one code list — they literally ARE the shared objects
+    assert _retry.RetryPolicy is RetryPolicy
+    assert _retry.DEFAULT_RETRY_CODES is DEFAULT_RETRY_CODES
+    assert set(DEFAULT_RETRY_CODES) == {429, 500, 502, 503, 504}
+
+
+def test_exponential_backoff_strategy_accepts_injected_rng():
+    import random
+
+    from pathway_tpu.internals import udfs
+
+    s1 = udfs.ExponentialBackoffRetryStrategy(rng=random.Random(5))
+    s2 = udfs.ExponentialBackoffRetryStrategy(rng=random.Random(5))
+    assert s1._rng.random() == s2._rng.random()
+
+
+def test_retry_policy_coerces_into_udf_executor():
+    import asyncio
+
+    from pathway_tpu.internals.udfs import _coerce_retry_strategy
+
+    strategy = _coerce_retry_strategy(
+        RetryPolicy(first_delay_ms=1, jitter_ms=0, max_retries=3)
+    )
+    calls = {"n": 0}
+
+    async def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise ConnectionError("blip")
+        return 9
+
+    assert asyncio.run(strategy.invoke(flaky)) == 9
+    assert calls["n"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+
+def test_recovery_coerce_forms():
+    assert Recovery.coerce(None) is None
+    assert Recovery.coerce(False) is None
+    assert Recovery.coerce(True).max_restarts == 3
+    assert Recovery.coerce(7).max_restarts == 7
+    r = Recovery(max_restarts=1)
+    assert Recovery.coerce(r) is r
+    with pytest.raises(TypeError):
+        Recovery.coerce("yes")
+
+
+def _fast_recovery(max_restarts: int) -> Recovery:
+    return Recovery(
+        max_restarts=max_restarts,
+        backoff=RetryPolicy(
+            first_delay_ms=1, jitter_ms=0, max_retries=max_restarts, sleep=_no_sleep
+        ),
+    )
+
+
+def test_supervisor_restarts_until_success():
+    state = {"n": 0}
+
+    def attempt(is_restart):
+        state["n"] += 1
+        assert is_restart == (state["n"] > 1)
+        if state["n"] < 3:
+            raise OSError("worker died")
+        return "done"
+
+    assert Supervisor(_fast_recovery(5)).run(attempt) == "done"
+    assert state["n"] == 3
+    snap = SUPERVISOR_METRICS.snapshot()
+    assert snap["restarts"] == {"OSError": 2}
+    assert snap["restarts_total"] == 2 and snap["escalations"] == 0
+
+
+def test_supervisor_escalates_when_budget_exhausted():
+    def always(_is_restart):
+        raise ConnectionError("perma-dead")
+
+    with pytest.raises(RecoveryEscalated, match="budget exhausted"):
+        Supervisor(_fast_recovery(2)).run(always)
+    snap = SUPERVISOR_METRICS.snapshot()
+    assert snap["restarts_total"] == 2 and snap["escalations"] == 1
+
+
+def test_supervisor_does_not_catch_programming_errors():
+    calls = {"n": 0}
+
+    def broken(_is_restart):
+        calls["n"] += 1
+        raise KeyError("bug, not a fault")
+
+    with pytest.raises(KeyError):
+        Supervisor(_fast_recovery(3)).run(broken)
+    assert calls["n"] == 1  # no restart burned on a non-fault
+
+
+def test_run_recovery_restarts_through_chaos_connector_failure(tmp_path):
+    """pw.run(recovery=...): a connector failing on the first attempt
+    (injected via the chaos harness) restarts the run, which then
+    completes and delivers every row."""
+    from pathway_tpu.io._connector import input_table_from_reader
+
+    class S(pw.Schema):
+        v: int
+
+    chaos.activate(ChaosPlan([{"site": "connector.chaotic", "action": "raise"}]))
+
+    def reader(ctx):
+        for i in range(3):
+            ctx.insert({"v": i})
+
+    t = input_table_from_reader(S, reader, name="chaotic")
+    rows: list[int] = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: rows.append(row["v"])
+    )
+    with pytest.warns(UserWarning, match="without persistence_config"):
+        pw.run(monitoring_level="none", recovery=_fast_recovery(2))
+    assert sorted(rows) == [0, 1, 2]
+    assert SUPERVISOR_METRICS.snapshot()["restarts_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Connector retry + metrics surface (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_connector_retry_policy_recovers_and_reports_metrics():
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+    from pathway_tpu.io._connector import input_table_from_reader
+
+    class S(pw.Schema):
+        v: int
+
+    state = {"fails": 2}
+
+    def reader(ctx):
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise ConnectionError("transient network blip")
+        for i in range(3):
+            ctx.insert({"v": i})
+
+    t = input_table_from_reader(
+        S,
+        reader,
+        name="flaky",
+        retry_policy=RetryPolicy(
+            first_delay_ms=1, jitter_ms=0, max_retries=5, sleep=_no_sleep
+        ),
+    )
+    rows: list[int] = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: rows.append(row["v"])
+    )
+    pw.run(monitoring_level="none")
+    assert sorted(rows) == [0, 1, 2]
+    snap = RETRY_METRICS.snapshot()["connector:flaky"]
+    assert snap == {"attempts": 3, "retries": 2, "successes": 1, "failures": 0}
+
+    # the same counters render on the Prometheus endpoint
+    class _FakeMonitor:
+        class snapshot:
+            time = 0
+            rows_in = 0
+            rows_out = 0
+            operators: dict = {}
+            operator_self_time_s: dict = {}
+            operator_event_lag_s: dict = {}
+
+        profiler = None
+
+        def input_latency_ms(self, now):
+            return 0
+
+        def output_latency_ms(self, now):
+            return 0
+
+    text = MonitoringHttpServer(_FakeMonitor(), port=0)._prometheus()
+    assert 'pathway_retry_attempts_total{scope="connector:flaky"} 3' in text
+    assert 'pathway_retry_retries_total{scope="connector:flaky"} 2' in text
+    assert 'pathway_retry_successes_total{scope="connector:flaky"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# Dead-letter routing
+# ---------------------------------------------------------------------------
+
+
+def _run_capture(pairs):
+    """subscribe to [(table, sink_list)] and run once."""
+    for table, out in pairs:
+        pw.io.subscribe(
+            table,
+            on_change=lambda key, row, time, is_addition, out=out: out.append(row),
+        )
+    pw.run(monitoring_level="none")
+
+
+def test_udf_dead_letter_routes_row_with_metadata():
+    @pw.udf(on_error="dead_letter")
+    def bad(x: int) -> int:
+        if x == 2:
+            raise ValueError("boom")
+        return x * 10
+
+    t = pw.debug.table_from_markdown(
+        """
+          | x
+        1 | 1
+        2 | 2
+        3 | 3
+        """
+    )
+    r = t.select(y=bad(pw.this.x))
+    ok: list[dict] = []
+    failed: list[dict] = []
+    _run_capture([(r, ok), (bad.failed, failed)])
+    assert sorted(row["y"] for row in ok) == [10, 30]
+    assert len(failed) == 1
+    rec = failed[0]
+    assert rec["args"] == [2]
+    assert rec["message"] == "ValueError: boom"
+    assert rec["trace"]["function"] == "bad"
+    assert isinstance(rec["operator_id"], int)
+
+
+def test_udf_on_error_skip_drops_row_silently():
+    @pw.udf(on_error="skip")
+    def bad(x: int) -> int:
+        if x == 2:
+            raise ValueError("boom")
+        return x * 10
+
+    t = pw.debug.table_from_markdown(
+        """
+          | x
+        1 | 1
+        2 | 2
+        """
+    )
+    ok: list[dict] = []
+    _run_capture([(t.select(y=bad(pw.this.x)), ok)])
+    assert [row["y"] for row in ok] == [10]
+
+
+def test_udf_on_error_validation():
+    with pytest.raises(ValueError, match="on_error"):
+        pw.udf(on_error="explode")(lambda x: x)
+
+
+def test_async_transformer_failed_table_and_lifecycle():
+    class OutSchema(pw.Schema):
+        ret: int
+
+    events: list[str] = []
+
+    class MyT(pw.AsyncTransformer, output_schema=OutSchema):
+        def open(self):
+            events.append("open")
+
+        def close(self):
+            events.append("close")
+
+        async def invoke(self, x) -> dict:
+            events.append(f"invoke:{x}")
+            if x == 2:
+                raise RuntimeError("nope")
+            return {"ret": x + 100}
+
+    t = pw.debug.table_from_markdown(
+        """
+          | x
+        1 | 1
+        2 | 2
+        3 | 3
+        """
+    )
+    mt = MyT(
+        input_table=t,
+        retry_strategy=RetryPolicy(
+            first_delay_ms=1, jitter_ms=0, max_retries=1, sleep=_no_sleep
+        ),
+    )
+    good: list[dict] = []
+    failed: list[dict] = []
+    _run_capture([(mt.successful, good), (mt.failed, failed)])
+    assert sorted(row["ret"] for row in good) == [101, 103]
+    assert len(failed) == 1 and failed[0]["message"] == "RuntimeError: nope"
+    assert failed[0]["args"] == [2]
+    # open() once before the first invoke, close() once at stream end,
+    # and the retry re-entered invoke without reopening
+    assert events[0] == "open" and events[-1] == "close"
+    assert events.count("open") == 1 and events.count("close") == 1
+    assert events.count("invoke:2") == 2
+
+
+def test_async_transformer_on_error_raise_keeps_legacy_routing():
+    class OutSchema(pw.Schema):
+        ret: int
+
+    class MyT(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, x) -> dict:
+            if x == 2:
+                raise RuntimeError("nope")
+            return {"ret": x}
+
+    t = pw.debug.table_from_markdown(
+        """
+          | x
+        1 | 1
+        2 | 2
+        """
+    )
+    mt = MyT(input_table=t, on_error="raise")
+    with pytest.raises(Exception, match="nope"):
+        good: list[dict] = []
+        _run_capture([(mt.successful, good)])
+
+
+# ---------------------------------------------------------------------------
+# Chaos harness
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_plan_site_and_time_matching():
+    plan = ChaosPlan([{"site": "s1", "time": 2, "action": "raise"}])
+    chaos.activate(plan)
+    chaos.inject("s0", time=2)  # wrong site: no-op
+    chaos.inject("s1", time=1)  # wrong epoch: no-op
+    with pytest.raises(ChaosInjected, match="site=s1"):
+        chaos.inject("s1", time=2)
+    # once-only by default
+    chaos.inject("s1", time=2)
+
+
+def test_chaos_plan_hit_count_and_repeat():
+    chaos.activate(ChaosPlan([{"site": "s", "hit": 3, "action": "raise"}]))
+    chaos.inject("s")
+    chaos.inject("s")
+    with pytest.raises(ChaosInjected):
+        chaos.inject("s")  # third hit fires
+
+    chaos.activate(ChaosPlan([{"site": "r", "repeat": True, "action": "raise"}]))
+    for _ in range(3):
+        with pytest.raises(ChaosInjected):
+            chaos.inject("r")
+
+
+def test_chaos_plan_offset_threshold():
+    chaos.activate(ChaosPlan([{"site": "w", "offset": 100, "action": "raise"}]))
+    chaos.inject("w", offset=50)
+    chaos.inject("w", offset=None)
+    with pytest.raises(ChaosInjected):
+        chaos.inject("w", offset=120)
+
+
+def test_chaos_plan_process_scoping(monkeypatch):
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    chaos.activate(ChaosPlan([{"site": "p", "process": 0, "action": "raise"}]))
+    chaos.inject("p")  # we are process 1: no-op
+    chaos.activate(ChaosPlan([{"site": "p", "process": 1, "action": "raise"}]))
+    with pytest.raises(ChaosInjected):
+        chaos.inject("p")
+
+
+def test_chaos_from_spec_and_env(tmp_path, monkeypatch):
+    plan = ChaosPlan.from_spec({"rules": [{"site": "a"}]})
+    assert len(plan.rules) == 1
+    plan = ChaosPlan.from_spec({"site": "b"})
+    assert plan.rules[0]["site"] == "b"
+
+    spec = tmp_path / "chaos.json"
+    spec.write_text('[{"site": "envsite", "action": "raise"}]')
+    monkeypatch.setenv("PATHWAY_CHAOS", str(spec))
+    chaos.reload_env()  # force a re-read of the env on next inject
+    try:
+        with pytest.raises(ChaosInjected):
+            chaos.inject("envsite")
+    finally:
+        chaos.deactivate()
+
+
+def test_chaos_inactive_is_noop():
+    chaos.deactivate()
+    chaos.inject("anything", time=0, offset=0)
+
+
+# ---------------------------------------------------------------------------
+# Cluster formation timeouts (satellite b)
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_accept_timeout_names_missing_worker(monkeypatch):
+    from pathway_tpu.engine import dataflow as df
+    from pathway_tpu.internals.graph_runner import GraphRunner
+    from pathway_tpu.parallel.multiprocess import CoordinatorCluster
+
+    monkeypatch.setenv("PATHWAY_CLUSTER_TOKEN", "test-token")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    runner = GraphRunner(n_workers=1)
+    with pytest.raises(df.EngineError) as ei:
+        CoordinatorCluster([runner.engine], 3, port, accept_timeout=0.2)
+    msg = str(ei.value)
+    assert "worker process(es) [1, 2] never connected" in msg
+    assert "PATHWAY_CLUSTER_ACCEPT_TIMEOUT" in msg
+
+
+def test_cluster_timeout_env_knobs(monkeypatch):
+    from pathway_tpu.internals.config import get_pathway_config
+
+    monkeypatch.setenv("PATHWAY_CLUSTER_ACCEPT_TIMEOUT", "120.5")
+    monkeypatch.setenv("PATHWAY_CLUSTER_HELLO_TIMEOUT", "2")
+    cfg = get_pathway_config()
+    assert cfg.cluster_accept_timeout == 120.5
+    assert cfg.cluster_hello_timeout == 2.0
+    monkeypatch.delenv("PATHWAY_CLUSTER_ACCEPT_TIMEOUT")
+    monkeypatch.delenv("PATHWAY_CLUSTER_HELLO_TIMEOUT")
+    cfg = get_pathway_config()
+    assert cfg.cluster_accept_timeout is None
+    assert cfg.cluster_hello_timeout is None
