@@ -218,6 +218,26 @@ def main() -> None:
     bound_eps = bench_device_scan_bound(fw_seq)
     fw_per_chip = fw_eps / n_chips
     peak = bench_chip_peak_probe()
+    # first-class summary lines (not just headline fields): the driver's
+    # FINAL SUMMARY tail must carry the framework-path rate and its
+    # fraction of the device-scan bound on their own records
+    _emit(
+        "framework_path_eps",
+        fw_eps,
+        "embeddings/s",
+        seq_bucket=fw_seq,
+        achieved_tflops=fw_tflops,
+        per_chip=round(fw_per_chip, 1),
+    )
+    _emit(
+        "vs_device_scan_bound",
+        fw_eps / bound_eps,
+        "ratio",
+        device_scan_bound_eps=round(bound_eps, 1),
+        note="1.0 = framework path saturates the same jit scan dispatch "
+        "on pre-staged ids; the shortfall is host-side overhead the "
+        "epoch pipeline is meant to hide",
+    )
     headline = {
                 "metric": "minilm_l6_embeddings_per_sec",
                 "value": round(fw_eps, 1),
@@ -639,7 +659,7 @@ def suite_streaming_tpu_chip() -> None:
         doc_id: int
         text: str
 
-    def one_pass():
+    def one_pass(depth: int = 1):
         class DocSource(pw.io.python.ConnectorSubject):
             def run(self):
                 for lo in range(0, N, BATCH):
@@ -668,7 +688,7 @@ def suite_streaming_tpu_chip() -> None:
         res = index.query(queries.text, number_of_matches=3).select(
             nearest=pw.this.doc_id
         )
-        runner = GraphRunner()
+        runner = GraphRunner(pipeline_depth=depth)
         cap, _names = runner.capture(res)
         t0 = _t.perf_counter()
         c0 = _t.process_time()
@@ -679,13 +699,18 @@ def suite_streaming_tpu_chip() -> None:
         assert len(cap.state) == 16
         n_empty = sum(1 for v in cap.state.values() if not v[0])
         assert n_empty == 0, f"{n_empty} queries answered with no neighbors"
-        return dt, host_cpu
+        pstats = getattr(runner.engine, "pipeline_stats", None)
+        return dt, host_cpu, (pstats.as_dict() if pstats is not None else None)
 
     # steady state: a streaming engine compiles/warms once at startup
     # and then runs for days — the first pass (reported alongside)
     # still hits one-time costs the warm-up can't reach
-    first_dt, _ = one_pass()
-    dt, host_cpu = one_pass()
+    first_dt, _, _ = one_pass()
+    dt, host_cpu, _ = one_pass()
+    # same steady-state pass through the overlapped epoch pipeline:
+    # epoch N+1's drain/tokenize/stage overlaps epoch N's device time,
+    # so the blocked-on-device remainder should shrink vs depth 1
+    dt2, host_cpu2, pstats = one_pass(depth=2)
     _emit(
         "streaming_tpu_chip_rows_per_sec",
         N / dt,
@@ -694,12 +719,17 @@ def suite_streaming_tpu_chip() -> None:
         host_cpu_s=round(host_cpu, 2),
         device_wait_s=round(max(0.0, dt - host_cpu), 2),
         first_run_wall_s=round(first_dt, 2),
+        pipelined_rows_per_sec=round(N / dt2, 3),
+        pipelined_wall_s=round(dt2, 2),
+        pipelined_device_wait_s=round(max(0.0, dt2 - host_cpu2), 2),
+        overlap_ratio=(pstats or {}).get("overlap_ratio", 0.0),
         mode="single real chip, single worker: text source -> embedder-attached "
         "device index (HBM-resident ingest, fused text queries) through the "
         "engine; 16 standing queries re-answered each epoch, final answers "
         "asserted non-empty; steady-state pass (first engine pass reported as "
         "first_run_wall_s); host_cpu_s itemizes the engine's python time, "
-        "device_wait_s the blocked-on-device remainder",
+        "device_wait_s the blocked-on-device remainder; pipelined_* repeats "
+        "the pass at pipeline_depth=2 (overlapped epoch formation)",
     )
 
 

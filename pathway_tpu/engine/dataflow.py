@@ -430,6 +430,18 @@ class SessionSourceNode(Node):
         self.emit(list(ups), time)
 
     def feed_batch(self, raw: list[Update], time) -> list[Update]:
+        resolved = self.resolve_batch(raw)
+        self.emit(resolved, time)
+        return resolved
+
+    def resolve_batch(self, raw: list[Update]) -> list[Update]:
+        """Resolve connector wire protocol (upsert markers, append-only
+        dedupe) against this source's keyed state WITHOUT emitting —
+        the overlapped epoch pipeline (engine/pipeline.py) resolves and
+        durably logs epoch N+1 while epoch N still executes, then the
+        executor emits the resolved batch at its turn. Resolution order
+        defines the state sequence, so only the single stager thread
+        (or the strict loop) may call this."""
         if self.append_only:
             # declared insert-only: upsert resolution can never trigger,
             # so the old-VALUE dict is skipped; only a key SET remains
@@ -464,7 +476,6 @@ class SessionSourceNode(Node):
                         "but produced a retraction",
                         node=self,
                     )
-            self.emit(out, time)
             return out
         out: list[Update] = []
         for key, row, diff in raw:
@@ -483,9 +494,7 @@ class SessionSourceNode(Node):
                     self.state[key] = row
                 else:
                     self.state.pop(key, None)
-        resolved = consolidate(out)
-        self.emit(resolved, time)
-        return resolved
+        return consolidate(out)
 
     def process(self, time):
         pass
@@ -2012,6 +2021,13 @@ class EngineGraph:
         # per-operator run profiler (internals.profiler.RunProfiler),
         # attached by graph_runner.attach_profiler; None = no timing
         self.profiler = None
+        # overlapped host/device epoch pipeline (engine/pipeline.py):
+        # depth 1 = strict loop (today's behavior), depth >= 2 stages
+        # epoch N+1 (drain/resolve/KIND_FEED/device_put) while epoch N
+        # executes. pipeline_stats is a PipelineStats once running.
+        self.pipeline_depth = 1
+        self.pipeline_stats = None
+        self._stage_commit_lock = None
 
     # --- builder helpers used by the graph runner ---
 
@@ -2278,6 +2294,12 @@ class EngineGraph:
         durable — a snapshot must never cover unfinalized input."""
         import pickle
 
+        # staged device buffers (donated rings) must be committed before
+        # pickling: a mid-transfer alias captured here would be invalid
+        # (or garbage) by restore time
+        from . import device_ring
+
+        device_ring.quiesce_all()
         states = {}
         for n in self.nodes:
             s = n.snapshot_state()
@@ -2316,6 +2338,10 @@ class EngineGraph:
         """Run to completion: replay recovered epochs, then process
         scripted batches in time order, then live sessions until all
         close."""
+        if self.pipeline_depth > 1:
+            from .pipeline import run_pipelined
+
+            return run_pipelined(self, monitoring_callback)
         if self.persistence_config is not None:
             self._setup_persistence()
         if not self._speedrun:
@@ -2375,10 +2401,16 @@ class EngineGraph:
                     # feed offsets ride along durably (KIND_FEED) so a
                     # crash after the sink flush but before ADVANCE can
                     # finalize this epoch on recovery instead of
-                    # re-reading and re-delivering it
+                    # re-reading and re-delivering it. At depth 1 the
+                    # staging-commit point coincides with feed time, so
+                    # the pipeline's chaos sites fire here too.
+                    from ..resilience import chaos as _chaos
+
+                    _chaos.inject("engine.before_stage_commit", time=int(t))
                     self.persistence.log_batch(
                         s.persistent_id, t, resolved, s.last_offsets or {}
                     )
+                    _chaos.inject("engine.after_stage_commit", time=int(t))
             self._topo_pass(t)
             if self.persistence is not None:
                 if session_batches:

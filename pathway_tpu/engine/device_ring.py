@@ -1,0 +1,174 @@
+"""Donated double-buffer ring of engine-owned device buffers.
+
+The overlapped epoch pipeline (``engine/pipeline.py``) stages epoch
+N+1's wire payloads (packed token ids, flat image rows) onto the device
+with a non-blocking ``jax.device_put`` while epoch N's compute is still
+in flight. Left unmanaged, that doubles the HBM footprint of every
+staged tensor each epoch; the ring bounds it: each logical payload
+stream owns ``depth`` slots, and staging into a slot *donates* the
+buffer the slot held two generations ago — the engine deletes its
+handle (``jax.Array.delete()``) once the consuming epoch has retired,
+so at most ``depth`` generations of a stream live in HBM.
+
+Donation rules (documented in README "Performance"):
+
+- a staged handle is **engine-owned**: consumers read through it for
+  exactly one epoch, then the slot may be recycled at any time;
+- after recycling, the old ``jax.Array`` is invalid — holding a
+  reference across epochs is a use-after-donate bug, which
+  :meth:`DeviceRing.stage` enforces by deleting the buffer;
+- operator snapshots must never pickle an aliased/in-flight buffer:
+  :func:`quiesce_all` blocks until every registered ring's staged puts
+  are committed, and runs before state pickling in
+  ``EngineGraph._snapshot_operators`` / ``ShardCluster``.
+
+Everything degrades to plain numpy when jax is unavailable, so the ring
+is safe to use from pure-host test paths.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any
+
+__all__ = ["DeviceRing", "quiesce_all", "active_rings"]
+
+# every live ring, so the snapshot path can quiesce staged transfers it
+# has no direct handle to (model-layer rings inside encoders)
+_registry: "weakref.WeakSet[DeviceRing]" = weakref.WeakSet()
+_registry_lock = threading.Lock()
+
+
+def active_rings() -> list["DeviceRing"]:
+    with _registry_lock:
+        return list(_registry)
+
+
+def quiesce_all() -> None:
+    """Block until no registered ring has an uncommitted device_put in
+    flight. Called before operator-state pickling: a snapshot taken
+    while a donated buffer is mid-transfer must not capture the alias."""
+    for ring in active_rings():
+        ring.sync()
+
+
+def _device_put(arr):
+    try:
+        import jax
+
+        return jax.device_put(arr)
+    except Exception:
+        return arr  # host fallback: the ring still bounds generations
+
+
+def _block(arr) -> None:
+    bur = getattr(arr, "block_until_ready", None)
+    if bur is not None:
+        bur()
+
+
+def _delete(arr) -> None:
+    d = getattr(arr, "delete", None)
+    if d is not None:
+        try:
+            d()
+        except Exception:
+            pass  # already deleted / committed donation
+
+
+class DeviceRing:
+    """A ``depth``-slot ring of staged device buffers for one payload
+    stream.
+
+    ``stage(arrays)`` does a non-blocking ``jax.device_put`` of each
+    array into the next slot and returns the device handles. When the
+    ring wraps, the slot's previous generation is donated back: its
+    buffers are deleted once :meth:`retire` has been called for that
+    generation (or immediately if the consumer never registered — the
+    conservative default keeps them until wrap + retire).
+    """
+
+    def __init__(self, depth: int = 2, name: str = "ring"):
+        self.depth = max(2, int(depth))
+        self.name = name
+        self._slots: list[list[Any] | None] = [None] * self.depth
+        self._retired: list[bool] = [True] * self.depth
+        self._next = 0
+        self._in_flight: list[list[Any]] = []
+        self._lock = threading.Lock()
+        self.staged = 0       # total stage() calls
+        self.donated = 0      # buffers invalidated by slot reuse
+        with _registry_lock:
+            _registry.add(self)
+
+    def stage(self, arrays: list[Any] | tuple[Any, ...] | Any) -> list[Any]:
+        """Non-blocking device_put of ``arrays`` into the next slot;
+        returns device handles valid for one consuming epoch."""
+        single = not isinstance(arrays, (list, tuple))
+        items = [arrays] if single else list(arrays)
+        with self._lock:
+            idx = self._next
+            self._next = (idx + 1) % self.depth
+            prev = self._slots[idx]
+            prev_retired = self._retired[idx]
+        if prev is not None:
+            if not prev_retired:
+                # consumer still reading the old generation: the put
+                # below would donate it out from under them — wait for
+                # the device to drain it first (backpressure, not UB)
+                for a in prev:
+                    _block(a)
+            for a in prev:
+                _delete(a)
+            self.donated += len(prev)
+        handles = [_device_put(a) for a in items]
+        with self._lock:
+            self._slots[idx] = handles
+            self._retired[idx] = False
+            self._in_flight.append(handles)
+            self.staged += 1
+        return handles
+
+    def retire(self, handles: list[Any]) -> None:
+        """The consuming epoch delivered: the slot holding ``handles``
+        may be donated on the next wrap without blocking."""
+        def same(slot) -> bool:
+            return (
+                slot is handles
+                or (
+                    slot is not None
+                    and len(slot) == len(handles)
+                    and all(a is b for a, b in zip(slot, handles))
+                )
+            )
+
+        with self._lock:
+            for i, slot in enumerate(self._slots):
+                if same(slot):
+                    self._retired[i] = True
+            self._in_flight = [hs for hs in self._in_flight if not same(hs)]
+
+    def in_flight(self) -> int:
+        with self._lock:
+            return len(self._in_flight)
+
+    def sync(self) -> None:
+        """Block until every staged-but-unretired transfer is committed
+        on device. After sync, a snapshot observes no aliased buffer."""
+        with self._lock:
+            pending = [a for hs in self._in_flight for a in hs]
+        for a in pending:
+            _block(a)
+
+    def snapshot_view(self, handles: list[Any]) -> list[Any]:
+        """Host-safe copies of staged handles for state pickling: the
+        returned arrays are detached numpy copies, never the donated
+        device buffers themselves."""
+        import numpy as np
+
+        out = []
+        for a in handles:
+            _block(a)
+            out.append(np.asarray(a).copy())
+        return out

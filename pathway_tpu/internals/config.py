@@ -70,6 +70,13 @@ class PathwayConfig:
         return os.environ.get("PATHWAY_PROFILE") or None
 
     @property
+    def pipeline_depth(self) -> int:
+        """Overlapped epoch pipeline depth (PATHWAY_PIPELINE_DEPTH):
+        1 = strict serial epochs (default), >= 2 stages epoch N+1 on
+        the host while epoch N executes (engine/pipeline.py)."""
+        return max(1, _env_int("PATHWAY_PIPELINE_DEPTH", 1))
+
+    @property
     def cluster_accept_timeout(self) -> float | None:
         """Seconds the coordinator waits for all workers to connect
         (PATHWAY_CLUSTER_ACCEPT_TIMEOUT); None = CoordinatorCluster

@@ -170,8 +170,9 @@ class GraphRunner:
     """One-shot compiler + executor (reference GraphRunner._run
     graph_runner/__init__.py:129 → engine run)."""
 
-    def __init__(self, *, debug: bool = False, n_workers: int = 1):
+    def __init__(self, *, debug: bool = False, n_workers: int = 1, pipeline_depth: int = 1):
         self.engine = df.EngineGraph(n_workers=n_workers)
+        self.engine.pipeline_depth = max(1, int(pipeline_depth))
         self.lowered: dict[int, Lowered] = {}
         self.debug = debug
         # worker processes (PATHWAY_PROCESS_ID > 0) build the same graph

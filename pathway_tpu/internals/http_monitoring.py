@@ -105,6 +105,22 @@ class MonitoringHttpServer:
             if lag_lines:
                 lines.append("# TYPE pathway_operator_event_lag_seconds gauge")
                 lines.extend(lag_lines)
+        if getattr(snap, "pipeline_depth", 1) > 1:
+            # overlapped epoch pipeline (pw.run(pipeline_depth=)):
+            # host-prep vs device-wait attribution, previously only
+            # measurable by hand in bench.py
+            lines.extend(
+                [
+                    "# TYPE pathway_host_prep_seconds counter",
+                    f"pathway_host_prep_seconds {snap.host_prep_s:.6f}",
+                    "# TYPE pathway_device_wait_seconds counter",
+                    f"pathway_device_wait_seconds {snap.device_wait_s:.6f}",
+                    "# TYPE pathway_pipeline_overlap_ratio gauge",
+                    f"pathway_pipeline_overlap_ratio {snap.overlap_ratio:.4f}",
+                    "# TYPE pathway_pipeline_depth gauge",
+                    f"pathway_pipeline_depth {snap.pipeline_depth}",
+                ]
+            )
         lines.extend(self._resilience_lines())
         return "\n".join(lines) + "\n"
 

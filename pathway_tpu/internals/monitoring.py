@@ -126,6 +126,13 @@ class StatsSnapshot:
     operator_self_time_s: dict = field(default_factory=dict)
     #: "id:name" -> event-time watermark lag seconds (time-aware nodes)
     operator_event_lag_s: dict = field(default_factory=dict)
+    #: overlapped epoch pipeline (pw.run(pipeline_depth=)): host time
+    #: spent forming epochs, executor time blocked on the device, and
+    #: the fraction of host prep hidden behind device execution
+    pipeline_depth: int = 1
+    host_prep_s: float = 0.0
+    device_wait_s: float = 0.0
+    overlap_ratio: float = 0.0
 
 
 class StatsMonitor:
@@ -168,6 +175,12 @@ class StatsMonitor:
                 snap.operator_self_time_s[key] = agg["self_time_s"]
                 if agg["event_lag_s"] is not None:
                     snap.operator_event_lag_s[key] = agg["event_lag_s"]
+        pipeline = getattr(engine, "pipeline_stats", None)
+        if pipeline is not None:
+            snap.pipeline_depth = pipeline.depth
+            snap.host_prep_s = pipeline.host_prep_s
+            snap.device_wait_s = pipeline.device_wait_s
+            snap.overlap_ratio = pipeline.overlap_ratio
         for node in engine.nodes:
             rows_in, rows_out = node.stats.rows_in, node.stats.rows_out
             key = f"{node.id}:{node.name}"
@@ -274,8 +287,11 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         "Latency is measured as the difference between the time the "
         "operator processed the data and the time pathway acquired it."
     )
-    # profiler-backed columns only appear when a profiler is attached
+    # profiler-backed columns only appear when a profiler is attached;
+    # the overlap column only when the epoch pipeline is on (depth >= 2)
     profiled = monitor.profiler is not None
+    snap = monitor.snapshot
+    pipelined = snap.pipeline_depth > 1
     table = Table(caption=caption, box=box.SIMPLE)
     table.add_column("operator", justify="left")
     table.add_column(r"latency to wall clock \[ms]", justify="right")
@@ -283,9 +299,12 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
     if profiled:
         table.add_column(r"self-time \[ms]", justify="right")
         table.add_column(r"event lag \[s]", justify="right")
+    if pipelined:
+        table.add_column("overlap ratio", justify="right")
+    pad = (2 if profiled else 0) + (1 if pipelined else 0)
 
     def row(*cells):
-        table.add_row(*(cells + ("", "") if profiled else cells))  # pad new cols
+        table.add_row(*(cells + ("",) * pad))
 
     row("input", f"{monitor.input_latency_ms(now)}", "")
     if with_operators:
@@ -303,7 +322,19 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
                     else f"{entry.self_time_s * 1000:.1f}",
                     "" if entry.event_lag_s is None else f"{entry.event_lag_s:.2f}",
                 )
+            if pipelined:
+                cells = cells + ("",)
             table.add_row(*cells)
+    if pipelined:
+        cells = (
+            f"epoch pipeline (depth {snap.pipeline_depth})",
+            "",
+            "",
+        )
+        if profiled:
+            cells = cells + (f"{snap.host_prep_s * 1000:.1f}", "")
+        cells = cells + (f"{snap.overlap_ratio:.2f}",)
+        table.add_row(*cells)
     row("output", f"{monitor.output_latency_ms(now)}", "")
     return table
 

@@ -49,6 +49,7 @@ def run(
     analysis: str | None = None,
     profile: Any = None,
     recovery: Any = None,
+    pipeline_depth: int | None = None,
     cluster_accept_timeout: float | None = None,
     cluster_hello_timeout: float | None = None,
     **kwargs: Any,
@@ -76,7 +77,18 @@ def run(
     ``cluster_accept_timeout`` / ``cluster_hello_timeout``: bound
     multi-process cluster formation on the coordinator (defaults 60 s /
     10 s; also settable via PATHWAY_CLUSTER_ACCEPT_TIMEOUT /
-    PATHWAY_CLUSTER_HELLO_TIMEOUT)."""
+    PATHWAY_CLUSTER_HELLO_TIMEOUT).
+
+    ``pipeline_depth``: overlapped host/device epoch pipeline (also
+    PATHWAY_PIPELINE_DEPTH). 1 (default) keeps today's strict serial
+    epoch loop; ``>= 2`` stages epoch N+1 on the host — connector
+    drain, upsert resolution, the durable KIND_FEED record and
+    non-blocking device staging — while epoch N still executes, so the
+    scheduler only blocks on results a sink actually consumes. Output
+    is identical at any depth (epochs still execute strictly in order);
+    the recovered time shows up as ``overlap_ratio`` on the dashboard
+    and ``pathway_host_prep_seconds`` / ``pathway_device_wait_seconds``
+    on /metrics. See README "Performance"."""
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
         # this point — return before sinks are built or readers started
@@ -111,6 +123,9 @@ def run(
 
     n_workers = max(1, pwcfg.threads)
     processes = max(1, pwcfg.processes)
+    depth = max(
+        1, int(pipeline_depth) if pipeline_depth is not None else pwcfg.pipeline_depth
+    )
     if persistence_config is None:
         # CLI record/replay wiring (reference cli.py:166-193): spawn's
         # --record/--replay-mode flags arrive via PATHWAY_REPLAY_* env
@@ -140,7 +155,7 @@ def run(
         a crashed attempt's engine state is unrecoverable in place —
         the persistence layer replays input snapshots into a clean
         graph instead."""
-        runner = GraphRunner(n_workers=n_workers)
+        runner = GraphRunner(n_workers=n_workers, pipeline_depth=depth)
         # consumed by sinks (e.g. fs.write appends instead of
         # truncating when the supervisor restarts a run)
         runner.recovery_restart = is_restart

@@ -140,6 +140,9 @@ class CLIPEncoder:
         self.vparams = self.vision.init(k1, img)
         self.tparams = self.text.init(k2, ids, msk)
         self.tokenizer = WordPieceTokenizer(vocab_size=self.cfg.vocab_size)
+        # donated double-buffer ring for staged image uploads (lazy:
+        # built on the first _image_batches call)
+        self._ring = None
         # ingest path: images ship as FLAT uint8 rows — 4x fewer bytes
         # than f32 over the host->device link (on tunneled/remote
         # devices the uplink, not the MXU, is the CLIP bottleneck) and
@@ -206,41 +209,77 @@ class CLIPEncoder:
         q = lambda a: np.clip(a + 0.5, 0, 255).astype(np.uint8).reshape(n, -1)
         return np.concatenate([q(y), q(u), q(v)], axis=1)
 
+    #: test hook: when set to a list, the staged loop appends
+    #: "pack:i" / "stage:i" / "dispatch:i" / "complete:i" markers so the
+    #: pack-ahead ordering is assertable without a real device clock
+    _pipeline_events: list | None = None
+
+    def _note(self, tag: str) -> None:
+        ev = self._pipeline_events
+        if ev is not None:
+            ev.append(tag)
+
+    def _pack_image_batch(self, batch):
+        """Host-side prep of one batch: quantize to uint8 (error <=
+        1/510 on [0,1] inputs, far below encoder noise) and pack to
+        flat wire rows at the padded bucket size."""
+        n = len(batch)
+        if np.asarray(batch).dtype != np.uint8:
+            batch = np.clip(
+                np.asarray(batch, np.float32) * 255.0 + 0.5, 0, 255
+            ).astype(np.uint8)
+        else:
+            batch = np.asarray(batch)
+        if self.transport == "yuv420":
+            flat = self._pack_yuv420(batch)
+            fwd = self._vfwd_yuv420
+        else:
+            flat = batch.reshape(n, -1)
+            fwd = self._vfwd_u8
+        B = bucket(n, self._BATCH_BUCKETS)
+        if B > n:
+            flat = np.concatenate([flat, np.zeros((B - n, flat.shape[1]), np.uint8)])
+        return n, flat, fwd
+
     def _image_batches(self, images):
-        """Dispatch all image batches WITHOUT syncing between them.
-        Images quantize to uint8 on host (error <= 1/510 on [0,1]
-        inputs, far below encoder noise) and ship as flat rows; big
-        inputs go in few large dispatches so per-dispatch link
-        overheads amortize (VERDICT r2 Weak #8: the serial
-        upload/compute/fetch loop ran at 22 img/s). ``max_batch`` is an
-        honest cap: memory-bounded deployments can lower it (values
-        above the largest bucket clamp so padding stays effective)."""
+        """Dispatch all image batches WITHOUT syncing between them,
+        staged one batch ahead: pack(i+1) runs between stage(i) — the
+        non-blocking ``device_put`` into the donated ring — and the
+        dispatch of batch i's vision tower, so host packing overlaps
+        the previous batch's transfer AND compute even when the jit
+        dispatch itself blocks (CPU backend). Big inputs go in few
+        large dispatches so per-dispatch link overheads amortize
+        (VERDICT r2 Weak #8: the serial upload/compute/fetch loop ran
+        at 22 img/s). ``max_batch`` is an honest cap: memory-bounded
+        deployments can lower it (values above the largest bucket clamp
+        so padding stays effective). Wire rows ride a 2-deep DeviceRing:
+        slot reuse donates batch i's upload buffer back to the device
+        once batch i+2 stages, bounding HBM at two generations."""
         step = min(self.max_batch, self._BATCH_BUCKETS[-1])
+        spans = list(range(0, len(images), step))
+        if not spans:
+            return []
+        if self._ring is None:
+            from ..engine.device_ring import DeviceRing
+
+            self._ring = DeviceRing(depth=2, name="clip.image")
         pending = []
-        for lo in range(0, len(images), step):
-            batch = images[lo : lo + step]
-            n = len(batch)
-            if np.asarray(batch).dtype != np.uint8:
-                batch = np.clip(
-                    np.asarray(batch, np.float32) * 255.0 + 0.5, 0, 255
-                ).astype(np.uint8)
-            else:
-                batch = np.asarray(batch)
-            if self.transport == "yuv420":
-                flat = self._pack_yuv420(batch)
-                fwd = self._vfwd_yuv420
-            else:
-                flat = batch.reshape(n, -1)
-                fwd = self._vfwd_u8
-            B = bucket(n, self._BATCH_BUCKETS)
-            if B > n:
-                flat = np.concatenate(
-                    [flat, np.zeros((B - n, flat.shape[1]), np.uint8)]
-                )
-            # async device_put: the NEXT batch's host-side packing and
-            # transfer overlap the previous batch's vision-tower compute
-            flat_dev = jax.device_put(flat)
-            pending.append((n, fwd(self.vparams, flat_dev)))
+        self._note("pack:0")
+        nxt = self._pack_image_batch(images[spans[0] : spans[0] + step])
+        for i, lo in enumerate(spans):
+            n, flat, fwd = nxt
+            self._note(f"stage:{i}")
+            (flat_dev,) = self._ring.stage([flat])  # non-blocking put
+            if i + 1 < len(spans):
+                # pack the NEXT batch while this one's transfer is in
+                # flight and before its compute is even dispatched —
+                # the overlap the old comment promised but serialized
+                self._note(f"pack:{i + 1}")
+                nxt = self._pack_image_batch(images[spans[i + 1] : spans[i + 1] + step])
+            self._note(f"dispatch:{i}")
+            emb = fwd(self.vparams, flat_dev)
+            self._ring.retire([flat_dev])  # slot recyclable after dispatch
+            pending.append((n, emb))
         return pending
 
     def encode_image(self, images: np.ndarray) -> np.ndarray:
@@ -250,7 +289,11 @@ class CLIPEncoder:
         if not pending:
             return np.zeros((0, self.dim), np.float32)
         # single sync point: every upload/compute already in flight
-        return np.concatenate([np.asarray(emb)[:n] for n, emb in pending])
+        out = []
+        for i, (n, emb) in enumerate(pending):
+            out.append(np.asarray(emb)[:n])
+            self._note(f"complete:{i}")
+        return np.concatenate(out)
 
     def encode_image_device(self, images: np.ndarray):
         """images -> DEVICE-resident [n, dim] embeddings (feeds the

@@ -436,6 +436,11 @@ class ShardCluster:
         import pickle
         import time as _wall
 
+        from ..engine import device_ring
+
+        # committed device staging only: never pickle a donated
+        # ring buffer that is still mid-transfer
+        device_ring.quiesce_all()
         states = {}
         for shard, e in enumerate(self.engines):
             for n in e.nodes:
@@ -465,6 +470,13 @@ class ShardCluster:
 
     def run(self, monitoring_callback: Callable | None = None) -> None:
         primary = self.engines[0]
+        if primary.pipeline_depth > 1 and type(self) is ShardCluster:
+            # overlapped epoch pipeline: the in-process cluster stages
+            # epoch N+1 (drain/resolve/KIND_FEED) while the sharded
+            # sweep of epoch N runs; the multi-process coordinator
+            # subclass keeps the strict loop (its epoch frontier is a
+            # cluster-wide broadcast)
+            return self._run_pipelined(monitoring_callback)
         self._persistence = None
         self._speedrun = False
         if primary.persistence_config is not None:
@@ -545,10 +557,16 @@ class ShardCluster:
                     and resolved
                 ):
                     # include feed offsets (KIND_FEED): crash between the
-                    # sink flush and ADVANCE finalizes, never re-delivers
+                    # sink flush and ADVANCE finalizes, never re-delivers.
+                    # Depth 1: staging-commit == feed time (same chaos
+                    # sites as the pipelined path).
+                    from ..resilience import chaos as _chaos
+
+                    _chaos.inject("engine.before_stage_commit", time=int(t))
                     self._persistence.log_batch(
                         s.persistent_id, t, resolved, s.last_offsets or {}
                     )
+                    _chaos.inject("engine.after_stage_commit", time=int(t))
             self._deliver_mail()
             self._sweep(t)
             if self._persistence is not None:
@@ -610,6 +628,251 @@ class ShardCluster:
         if not self._speedrun:  # speedrun never started the readers
             for t in primary.connector_threads:
                 t.join(timeout=5.0)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+
+    def _run_pipelined(self, monitoring_callback: Callable | None = None) -> None:
+        """The sharded epoch loop with staged epoch formation
+        (pipeline_depth >= 2): a stager thread drains shard-0 sessions,
+        resolves wire protocols and commits KIND_FEED for epoch N+1
+        while the bulk-synchronous sweep of epoch N is still running.
+        Sweeps execute strictly in staged order, so output is identical
+        to the strict loop."""
+        import queue as _queue
+        import time as _wall
+
+        from ..engine import device_ring
+        from ..engine.pipeline import PipelineStats, StagedEpoch
+        from ..resilience import chaos as _chaos
+
+        primary = self.engines[0]
+        self._persistence = None
+        self._speedrun = False
+        if primary.persistence_config is not None:
+            self._setup_persistence()
+        if not self._speedrun:
+            for th in primary.connector_threads:
+                th.start()
+            primary._threads_started = True
+
+        stats = PipelineStats(depth=primary.pipeline_depth)
+        for e in self.engines:
+            e.pipeline_stats = stats
+        commit_lock = threading.Lock()
+        q: "_queue.Queue" = _queue.Queue(maxsize=max(1, primary.pipeline_depth - 1))
+        sentinel = object()
+        stage_error: list[BaseException] = []
+        stop_staging = threading.Event()
+
+        def stage_loop() -> None:
+            last_time = -1
+            try:
+                while not (stop_staging.is_set() or self._stop or primary._stop):
+                    primary._raise_connector_failure()
+                    times = [s.next_time() for s in primary.static_sources]
+                    replay_pending = False
+                    for s in primary.session_sources:
+                        rt = s.next_replay_time()
+                        if rt is not None:
+                            times.append(rt)
+                            replay_pending = True
+                    times = [tt for tt in times if tt is not None]
+                    scripted_t = min(times) if times else None
+
+                    session_batches = []
+                    if not replay_pending:
+                        if last_time < primary.replay_frontier:
+                            last_time = primary.replay_frontier
+                        for s in primary.session_sources:
+                            b = s.session.drain()
+                            if b:
+                                session_batches.append((s, b))
+                    for e in self.engines[1:]:
+                        for s in e.session_sources:
+                            if s.is_error_log:
+                                b = s.session.drain()
+                                if b:
+                                    session_batches.append((s, b))
+
+                    if scripted_t is None and not session_batches:
+                        if self._speedrun:
+                            break
+                        if (
+                            all(
+                                s.session.closed
+                                for s in primary.session_sources
+                                if not s.is_error_log
+                            )
+                            and self._remote_sources_closed()
+                        ):
+                            break
+                        primary._wake.wait(timeout=0.05)
+                        primary._wake.clear()
+                        continue
+
+                    stats.begin("prep")
+                    t = scripted_t if scripted_t is not None else last_time + 1
+                    if session_batches and scripted_t is not None:
+                        t = max(scripted_t, last_time + 1)
+                    t = max(t, last_time + 1) if t <= last_time else t
+                    ep = StagedEpoch(time=t, scripted=scripted_t is not None)
+                    with commit_lock:
+                        for s, b in session_batches:
+                            resolved = s.resolve_batch(b)
+                            offsets = dict(s.last_offsets or {})
+                            ep.resolved.append((s, resolved))
+                            ep.offsets[id(s)] = offsets
+                            if (
+                                self._persistence is not None
+                                and s.persistent_id is not None
+                                and resolved
+                            ):
+                                _chaos.inject(
+                                    "engine.before_stage_commit", time=int(t)
+                                )
+                                self._persistence.log_batch(
+                                    s.persistent_id, t, resolved, offsets
+                                )
+                                _chaos.inject(
+                                    "engine.after_stage_commit", time=int(t)
+                                )
+                                ep.fed = True
+                    stats.staged_epochs += 1
+                    stats.end("prep")
+                    last_time = t
+                    placed = False
+                    while not (stop_staging.is_set() or self._stop or primary._stop):
+                        try:
+                            q.put(ep, timeout=0.05)
+                            placed = True
+                            break
+                        except _queue.Full:
+                            continue
+                    if placed and ep.scripted:
+                        # scripted feeds (static tables, recovery replay)
+                        # are consumed at execute time: staging ahead
+                        # would re-observe the same pending time and burn
+                        # phantom epoch numbers — hand off synchronously
+                        while not ep.done.wait(timeout=0.05):
+                            if (
+                                stop_staging.is_set()
+                                or self._stop
+                                or primary._stop
+                            ):
+                                return
+            except BaseException as exc:
+                stage_error.append(exc)
+            finally:
+                try:
+                    q.put_nowait(sentinel)
+                except _queue.Full:
+                    pass
+                primary.wake()
+
+        stager = threading.Thread(
+            target=stage_loop, name="pathway-shard-stager", daemon=True
+        )
+        stager.start()
+        last_time = -1
+        try:
+            while not (self._stop or primary._stop):
+                primary._raise_connector_failure()
+                if stage_error:
+                    raise stage_error[0]
+                try:
+                    item = q.get(timeout=0.05)
+                except _queue.Empty:
+                    if not stager.is_alive() and q.empty():
+                        break
+                    continue
+                if item is sentinel:
+                    break
+                t = item.time
+                self._sync_watermarks()
+                for e in self.engines:
+                    e.current_time = t
+                    e._frontier_hooks(t)
+                self.set_epoch_frontier(t)
+                if item.scripted:
+                    for s in primary.static_sources:
+                        s.feed(t)
+                    for s in primary.session_sources:
+                        s.feed_replay(t)
+                for s, resolved in item.resolved:
+                    s.emit(resolved, t)
+                self._deliver_mail()
+                stats.begin("exec")
+                cpu0 = _wall.thread_time()
+                w0 = _wall.perf_counter()
+                self._sweep(t)
+                stats.add_device_wait(
+                    (_wall.perf_counter() - w0) - (_wall.thread_time() - cpu0)
+                )
+                stats.end("exec")
+                if self._persistence is not None:
+                    for s, _resolved in item.resolved:
+                        if s.persistent_id is not None:
+                            self._persistence.advance(
+                                s.persistent_id, t, item.offsets.get(id(s)) or {}
+                            )
+                    if item.resolved:
+                        with commit_lock:
+                            device_ring.quiesce_all()
+                            self._maybe_snapshot_operators(t)
+                stats.executed_epochs += 1
+                item.done.set()
+                if primary.profiler is not None:
+                    primary.profiler.observe_pipeline(stats)
+                last_time = t
+                if monitoring_callback is not None:
+                    monitoring_callback(primary)
+            if stage_error:
+                raise stage_error[0]
+        finally:
+            stop_staging.set()
+            primary.wake()
+            stager.join(timeout=5.0)
+
+        if not (self._stop or primary._stop):
+            primary._raise_connector_failure()
+        if (
+            self._persistence is not None
+            and self._opsnap_ok
+            and last_time >= 0
+            and last_time != self._opsnap_time
+            and primary.session_sources
+        ):
+            self._snapshot_operators(last_time)
+        # end of input: final flush on every shard (strict-loop tail)
+        self._sync_watermarks()
+        for e in self.engines:
+            e.current_time = last_time + 1
+            e._frontier_hooks(df.INF_TIME)
+        self.set_epoch_frontier(df.INF_TIME)
+        self._deliver_mail()
+        if self._flush_needed():
+            self._sweep(last_time + 1)
+        err = []
+        for e in self.engines:
+            for s in e.session_sources:
+                if s.is_error_log:
+                    b = s.session.drain()
+                    if b:
+                        err.append((s, b))
+        if err:
+            for s, b in err:
+                s.feed_batch(b, last_time + 2)
+            self._deliver_mail()
+            self._sweep(last_time + 2)
+        for e in self.engines:
+            for node in e.nodes:
+                node.on_end()
+        self._finish_remote()
+        if self._persistence is not None:
+            self._persistence.close()
+        if not self._speedrun:
+            for th in primary.connector_threads:
+                th.join(timeout=5.0)
         if self._pool is not None:
             self._pool.shutdown(wait=False)
 

@@ -2,9 +2,13 @@
 
 Instrumented code calls :func:`inject` at named *sites* — e.g.
 ``worker.after_feed_log`` right after a KIND_FEED record is made
-durable, or ``coordinator.after_mark_delivered`` between the sink
+durable, ``coordinator.after_mark_delivered`` between the sink
 flush and the worker ADVANCE broadcast in
-``parallel/multiprocess.py``. A *chaos plan* (rules loaded from the
+``parallel/multiprocess.py``, or the staging boundary of the
+overlapped epoch pipeline: ``engine.before_stage_commit`` /
+``engine.after_stage_commit`` bracket the KIND_FEED write at
+staging-commit time (engine/pipeline.py — at ``pipeline_depth=1``
+they fire at feed time, the degenerate staging commit). A *chaos plan* (rules loaded from the
 ``PATHWAY_CHAOS`` environment variable, or activated in-process via
 :func:`activate`) decides whether a given call dies, raises, or
 delays, keyed on the site name, the epoch, the persistence byte

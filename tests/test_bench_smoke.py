@@ -1,0 +1,136 @@
+"""bench_smoke: miniature CPU stand-ins for the chip benchmarks.
+
+Each bench here is a scaled-down version of a ``bench.py`` suite that
+finishes in seconds on the CPU backend, asserting the two properties
+the full benchmark claims: (1) byte-identical outputs between the
+strict depth-1 loop and the overlapped depth-2 pipeline, and (2) the
+overlap instrumentation actually populates (staged epochs, host-prep
+time, ring counters) — so a regression that silently serializes the
+pipeline or drops its accounting fails tier-1, not just a nightly
+chip run.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._connector import input_table_from_reader
+
+pytestmark = pytest.mark.bench_smoke
+
+ROWS = [f"word{i % 7}" for i in range(24)]
+
+
+def _build(out: str, pause: float = 0.01):
+    class S(pw.Schema):
+        word: str
+
+    def reader(ctx):
+        for i, w in enumerate(ROWS):
+            ctx.insert({"word": w}, offsets={"pos": i + 1})
+            ctx.commit()
+            time.sleep(pause)
+
+    t = input_table_from_reader(
+        S, reader, name="bsrc", supports_offsets=True, autocommit_duration_ms=5
+    )
+    c = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+    pw.io.jsonlines.write(c, out)
+
+
+def _run(out: str, depth: int):
+    _build(out)
+    runner = GraphRunner(n_workers=1, pipeline_depth=depth)
+    for table, sink in list(G.outputs):
+        sink["build"](runner, table)
+    t0 = time.perf_counter()
+    runner.run()
+    wall = time.perf_counter() - t0
+    pw.clear_graph()
+    with open(out) as f:
+        return f.read(), wall, runner.engine
+
+
+def test_bench_smoke_streaming(tmp_path):
+    ref, wall1, eng1 = _run(str(tmp_path / "d1.jsonl"), depth=1)
+    got, wall2, eng2 = _run(str(tmp_path / "d2.jsonl"), depth=2)
+    assert ref, "bench produced no output"
+    # net state is depth-invariant regardless of how commits landed in
+    # epochs on this run (epoch boundaries are timing-dependent at
+    # EITHER depth for a live connector)
+    import json
+
+    def net(text):
+        state = {}
+        for line in text.splitlines():
+            rec = json.loads(line)
+            if rec["diff"] > 0:
+                state[rec["word"]] = rec["n"]
+            else:
+                state.pop(rec["word"], None)
+        return state
+
+    assert net(got) == net(ref)
+    assert eng1.pipeline_stats is None
+    stats = eng2.pipeline_stats.as_dict()
+    assert stats["staged_epochs"] >= 2, stats
+    assert stats["executed_epochs"] == stats["staged_epochs"]
+    assert stats["host_prep_s"] > 0.0, stats
+    # both runs are sleep-dominated; depth 2 must not be pathologically
+    # slower than the strict loop (generous bound — this is a smoke
+    # test, not a perf gate)
+    assert wall2 < wall1 * 3 + 1.0, (wall1, wall2)
+
+
+@pytest.fixture(scope="module")
+def tiny_encoder():
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.models.sentence_encoder import SentenceEncoder
+
+    cfg = EncoderConfig(
+        vocab_size=30000,
+        hidden_size=32,
+        num_layers=1,
+        num_heads=2,
+        intermediate_size=64,
+        max_position=64,
+        pooling="mean",
+    )
+    return SentenceEncoder(
+        config=cfg, checkpoint_dir="/nonexistent", max_seq_len=32, max_batch=16
+    )
+
+
+def test_bench_smoke_embedder(tiny_encoder):
+    """Multi-epoch embedder drain: encode_device_many (tokenize batch
+    i+1 while batch i's dispatch is in flight, wire uploads through the
+    donated ring) is byte-identical to per-batch encode_device, and the
+    ring counters show the staging actually happened."""
+    enc = tiny_encoder
+    batches = [
+        [f"document {i} about topic {i % 3}" for i in range(j, j + 5)]
+        for j in range(0, 20, 5)
+    ]
+    singles = [np.asarray(enc.encode_device(b)) for b in batches]
+    many = [np.asarray(a) for a in enc.encode_device_many(batches)]
+    assert len(many) == len(singles)
+    for a, b in zip(many, singles):
+        assert np.array_equal(a, b), "depth-2 embedder drain diverged from depth-1"
+    ring = enc._wire_ring
+    assert ring is not None and ring.staged > 0
+    assert ring.in_flight() == 0
+
+
+def test_bench_smoke_embedder_single_batch_passthrough(tiny_encoder):
+    """< 2 pending batches short-circuits to the per-batch path."""
+    enc = tiny_encoder
+    one = [["just one pending batch of text"]]
+    (a,) = enc.encode_device_many(one)
+    b = enc.encode_device(one[0])
+    assert np.array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,427 @@
+"""Overlapped epoch pipeline: byte-identical outputs at depth 2.
+
+The contract of ``pipeline_depth >= 2`` (engine/pipeline.py) is that
+only epoch *formation* overlaps execution — epochs still execute
+strictly in staged order on one thread — so every output, snapshot and
+recovery artifact is byte-for-byte what the strict depth-1 loop
+produces. These tests pin that equality on scripted streams, live
+connector streams, the 4-way sharded runtime, and the PR-3 exactly-once
+recovery window with KIND_FEED moved to staging-commit time, plus the
+DeviceRing donation rules the model layer relies on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.device_ring import DeviceRing, active_rings, quiesce_all
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.io._connector import input_table_from_reader
+from pathway_tpu.resilience import Recovery, RetryPolicy, chaos
+
+STREAM = """
+  | g | v | __time__ | __diff__
+1 | a | 1 | 2        | 1
+2 | b | 2 | 2        | 1
+3 | a | 3 | 4        | 1
+4 | c | 4 | 4        | 1
+2 | b | 2 | 6        | -1
+5 | a | 5 | 6        | 1
+3 | a | 3 | 8        | -1
+"""
+
+WORDS = ["cat", "dog", "bird", "cat", "dog", "cat", "emu", "dog"]
+FINAL = {"cat": 3, "dog": 3, "bird": 1, "emu": 1}
+
+
+def _scripted_build():
+    t = pw.debug.table_from_markdown(STREAM)
+    return t.groupby(pw.this.g).reduce(
+        pw.this.g,
+        s=pw.reducers.sum(pw.this.v),
+        n=pw.reducers.count(),
+        tup=pw.reducers.sorted_tuple(pw.this.v),
+    )
+
+def _run_captured(build, n_workers: int, depth: int):
+    table = build()
+    runner = GraphRunner(n_workers=n_workers, pipeline_depth=depth)
+    cap, names = runner.capture(table)
+    runner.run()
+    pw.clear_graph()
+    return cap.state, names, runner
+
+
+def _build_wordcount(out: str, store: str | None = None, pause: float = 0.06):
+    """Per-row commit + slow stream + fast autocommit: one epoch per
+    row at either depth, so runs compare byte-for-byte (same idiom as
+    test_chaos_crash_window)."""
+
+    class S(pw.Schema):
+        word: str
+
+    def reader(ctx):
+        start = int(ctx.offsets.get("pos", 0))
+        for i, w in enumerate(WORDS):
+            if i < start:
+                continue
+            ctx.insert({"word": w}, offsets={"pos": i + 1})
+            ctx.commit()
+            time.sleep(pause)
+
+    t = input_table_from_reader(
+        S,
+        reader,
+        name="wsrc",
+        persistent_id="w" if store is not None else None,
+        supports_offsets=True,
+        autocommit_duration_ms=10,
+    )
+    c = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+    pw.io.jsonlines.write(c, out)
+    if store is None:
+        return None
+    return pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(store)
+    )
+
+
+def _net(text: str) -> dict[str, int]:
+    state: dict[str, int] = {}
+    for line in text.splitlines():
+        rec = json.loads(line)
+        if rec["diff"] > 0:
+            state[rec["word"]] = rec["n"]
+        else:
+            state.pop(rec["word"], None)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# byte-identical outputs, depth 1 vs depth 2
+# ---------------------------------------------------------------------------
+
+
+def test_scripted_stream_depth2_byte_identical():
+    s1, n1, _ = _run_captured(_scripted_build, 1, 1)
+    s2, n2, _ = _run_captured(_scripted_build, 1, 2)
+    assert n1 == n2
+    assert s1 == s2
+
+
+def test_live_stream_depth2_byte_identical(tmp_path):
+    out1 = str(tmp_path / "d1.jsonl")
+    _build_wordcount(out1)
+    pw.run(monitoring_level="none", pipeline_depth=1)
+    pw.clear_graph()
+
+    out2 = str(tmp_path / "d2.jsonl")
+    _build_wordcount(out2)
+    pw.run(monitoring_level="none", pipeline_depth=2)
+    pw.clear_graph()
+
+    with open(out1) as f:
+        ref = f.read()
+    with open(out2) as f:
+        got = f.read()
+    assert ref, "depth-1 run produced no output"
+    assert got == ref
+
+
+def test_sharded_depth2_byte_identical():
+    s1, n1, _ = _run_captured(_scripted_build, 4, 1)
+    s2, n2, _ = _run_captured(_scripted_build, 4, 2)
+    assert n1 == n2
+    assert s1 == s2
+
+
+def test_depth1_never_enters_pipeline():
+    _, _, runner = _run_captured(_scripted_build, 1, 1)
+    assert runner.engine.pipeline_stats is None
+
+
+def test_env_var_sets_depth(monkeypatch, tmp_path):
+    monkeypatch.setenv("PATHWAY_PIPELINE_DEPTH", "2")
+    out = str(tmp_path / "env.jsonl")
+    _build_wordcount(out, pause=0.01)
+    pw.run(monitoring_level="none")
+    pw.clear_graph()
+    with open(out) as f:
+        assert _net(f.read()) == FINAL
+
+
+# ---------------------------------------------------------------------------
+# overlap accounting
+# ---------------------------------------------------------------------------
+
+
+def test_depth2_overlap_counters_populated():
+    def build():
+        return _scripted_build()
+
+    _, _, runner = _run_captured(build, 1, 2)
+    stats = runner.engine.pipeline_stats
+    assert stats is not None
+    d = stats.as_dict()
+    assert d["depth"] == 2
+    assert d["staged_epochs"] >= 2
+    assert d["executed_epochs"] == d["staged_epochs"]
+    assert d["host_prep_s"] >= 0.0
+    assert 0.0 <= d["overlap_ratio"]
+    # overlap can never exceed the host prep it hides
+    assert d["overlap_s"] <= d["host_prep_s"] + 1e-9
+
+
+def test_monitoring_snapshot_carries_pipeline_columns(tmp_path):
+    from pathway_tpu.internals.monitoring import StatsMonitor
+    from pathway_tpu.internals.parse_graph import G
+
+    out = str(tmp_path / "mon.jsonl")
+    _build_wordcount(out, pause=0.01)
+    mon = StatsMonitor()
+    runner = GraphRunner(n_workers=1, pipeline_depth=2)
+    for table, sink in list(G.outputs):
+        sink["build"](runner, table)
+    runner.run(monitoring_callback=mon.update)
+    pw.clear_graph()
+    snap = mon.snapshot
+    assert snap.pipeline_depth == 2
+    assert snap.host_prep_s >= 0.0
+    assert snap.device_wait_s >= 0.0
+    assert snap.rows_in > 0
+
+
+def test_dashboard_gains_overlap_column():
+    import io
+    import time as _t
+
+    from rich.console import Console
+
+    from pathway_tpu.internals.monitoring import (
+        OperatorEntry,
+        StatsMonitor,
+        StatsSnapshot,
+        build_dashboard,
+    )
+
+    mon = StatsMonitor()
+    mon.snapshot = StatsSnapshot(
+        time=3, rows_in=10, rows_out=8, pipeline_depth=2,
+        host_prep_s=0.12, device_wait_s=0.4, overlap_ratio=0.83,
+    )
+    entry = OperatorEntry(name="groupby")
+    entry.rows_in, entry.rows_out = 10, 8
+    mon.operators[1] = entry
+    console = Console(file=io.StringIO(), width=200)
+    console.print(build_dashboard(mon, _t.monotonic()))
+    body = console.file.getvalue()
+    assert "overlap ratio" in body
+    assert "epoch pipeline (depth 2)" in body
+    assert "0.83" in body
+
+    # at depth 1 the column stays hidden
+    mon.snapshot = StatsSnapshot(time=3, rows_in=10, rows_out=8)
+    console = Console(file=io.StringIO(), width=200)
+    console.print(build_dashboard(mon, _t.monotonic()))
+    assert "overlap ratio" not in console.file.getvalue()
+
+
+def test_prometheus_exposes_pipeline_series():
+    import urllib.request
+
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+    from pathway_tpu.internals.monitoring import StatsMonitor
+
+    monitor = StatsMonitor()
+    table = _scripted_build()
+    runner = GraphRunner(n_workers=1, pipeline_depth=2)
+    runner.capture(table)
+    server = MonitoringHttpServer(monitor, port=0)
+    server.start()
+    try:
+        runner.run(monitoring_callback=monitor.update)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5
+        ).read().decode()
+        assert "pathway_host_prep_seconds" in body
+        assert "pathway_device_wait_seconds" in body
+        assert "pathway_pipeline_overlap_ratio" in body
+        assert "pathway_pipeline_depth 2" in body
+    finally:
+        server.stop()
+    pw.clear_graph()
+
+
+# ---------------------------------------------------------------------------
+# exactly-once composition: KIND_FEED at staging-commit time
+# ---------------------------------------------------------------------------
+
+
+def _clean_reference(tmp_path) -> str:
+    cfg = _build_wordcount(str(tmp_path / "ref.jsonl"), str(tmp_path / "ref_store"))
+    pw.run(monitoring_level="none", persistence_config=cfg)
+    pw.clear_graph()
+    with open(tmp_path / "ref.jsonl") as f:
+        return f.read()
+
+
+@pytest.mark.parametrize(
+    "rule",
+    [
+        # crash before the staging commit: nothing durable yet, the
+        # epoch's rows re-read from connector offsets on restart
+        {"site": "engine.before_stage_commit", "time": 3, "action": "raise"},
+        # crash after: KIND_FEED durable for a staged-but-never-executed
+        # epoch — recovery must replay and deliver it exactly once
+        {"site": "engine.after_stage_commit", "time": 3, "action": "raise"},
+    ],
+    ids=lambda r: r["site"],
+)
+def test_depth2_staging_crash_recovers_byte_identical(tmp_path, rule):
+    ref = _clean_reference(tmp_path)
+    assert ref, "clean reference run produced no output"
+
+    out = str(tmp_path / "chaos.jsonl")
+    cfg = _build_wordcount(out, str(tmp_path / "chaos_store"))
+    chaos.activate([dict(rule)])
+    try:
+        pw.run(
+            monitoring_level="none",
+            persistence_config=cfg,
+            pipeline_depth=2,
+            recovery=Recovery(
+                max_restarts=3,
+                backoff=RetryPolicy(
+                    first_delay_ms=1, jitter_ms=0, sleep=lambda s: None
+                ),
+            ),
+        )
+    finally:
+        chaos.deactivate()
+        pw.clear_graph()
+    with open(out) as f:
+        assert _net(f.read()) == _net(ref) == FINAL
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+def test_persistence_clean_run_then_replay(tmp_path, depth):
+    """A clean run followed by a restart from the same store behaves
+    identically at both depths: the first run delivers everything, the
+    restart re-delivers nothing (every epoch is behind the delivered
+    frontier, so KIND_FEED-at-staging-time adds no duplicates)."""
+    out = str(tmp_path / "run.jsonl")
+    store = str(tmp_path / "store")
+    cfg = _build_wordcount(out, store)
+    pw.run(monitoring_level="none", persistence_config=cfg, pipeline_depth=depth)
+    pw.clear_graph()
+    with open(out) as f:
+        first = f.read()
+    assert _net(first) == FINAL
+
+    cfg = _build_wordcount(out, store)
+    pw.run(monitoring_level="none", persistence_config=cfg, pipeline_depth=depth)
+    pw.clear_graph()
+    with open(out) as f:
+        assert f.read() == "", "restart re-delivered an already-delivered epoch"
+
+
+def test_depth2_snapshot_while_staging_in_flight(tmp_path):
+    """Satellite: a snapshot taken while the stager holds a ring buffer
+    in flight must not capture aliased state. The chaos delay pins the
+    stager inside the staging commit (between KIND_FEED chaos sites)
+    while the executor snapshots, and recovery replay stays
+    byte-identical in net state."""
+    ref = _clean_reference(tmp_path)
+
+    out = str(tmp_path / "delay.jsonl")
+    cfg = _build_wordcount(out, str(tmp_path / "delay_store"))
+    chaos.activate(
+        [
+            {
+                "site": "engine.before_stage_commit",
+                "action": "delay",
+                "delay_s": 0.03,
+                "repeat": True,
+            }
+        ]
+    )
+    try:
+        pw.run(monitoring_level="none", persistence_config=cfg, pipeline_depth=2)
+    finally:
+        chaos.deactivate()
+        pw.clear_graph()
+    with open(out) as f:
+        assert _net(f.read()) == _net(ref) == FINAL
+
+
+# ---------------------------------------------------------------------------
+# DeviceRing donation rules
+# ---------------------------------------------------------------------------
+
+
+def test_device_ring_stage_and_retire_rebuilt_list():
+    ring = DeviceRing(depth=2, name="test")
+    a = np.arange(4, dtype=np.int32)
+    (ha,) = ring.stage([a])
+    assert ring.in_flight() == 1
+    # callers destructure stage()'s return and pass a NEW list: retire
+    # must match element-wise, not by list identity
+    ring.retire([ha])
+    assert ring.in_flight() == 0
+    assert ring.staged == 1
+
+
+def test_device_ring_wrap_donates_prior_generation():
+    ring = DeviceRing(depth=2, name="test")
+    gens = []
+    for i in range(4):
+        (h,) = ring.stage([np.full(3, i, np.int32)])
+        gens.append(h)
+        ring.retire([h])
+    # 4 stages through 2 slots: generations 0 and 1 were donated when
+    # their slots were reused by 2 and 3
+    assert ring.staged == 4
+    assert ring.donated == 2
+
+
+def test_device_ring_unretired_slot_blocks_not_corrupts():
+    ring = DeviceRing(depth=2, name="test")
+    (h0,) = ring.stage([np.arange(5, dtype=np.int32)])
+    (h1,) = ring.stage([np.arange(5, 10, dtype=np.int32)])
+    # slot 0 is still unretired; staging its replacement must first
+    # drain h0 (backpressure) rather than invalidating it mid-read
+    (h2,) = ring.stage([np.arange(10, 15, dtype=np.int32)])
+    assert np.asarray(h2).tolist() == [10, 11, 12, 13, 14]
+    ring.retire([h1])
+    ring.retire([h2])
+
+
+def test_device_ring_snapshot_view_is_detached_copy():
+    ring = DeviceRing(depth=2, name="test")
+    payload = np.arange(6, dtype=np.int32)
+    (h,) = ring.stage([payload])
+    # snapshot while the buffer is in flight (unretired)
+    (view,) = ring.snapshot_view([h])
+    assert isinstance(view, np.ndarray)
+    before = view.copy()
+    # wrap the ring so h's slot is donated (deleted) twice over
+    for i in range(3):
+        (hn,) = ring.stage([np.full(6, 90 + i, np.int32)])
+        ring.retire([hn])
+    # the snapshot copy must be unaffected by the donation
+    assert np.array_equal(view, before)
+    assert view.tolist() == list(range(6))
+
+
+def test_quiesce_all_covers_registered_rings():
+    ring = DeviceRing(depth=2, name="test-quiesce")
+    (h,) = ring.stage([np.arange(3, dtype=np.int32)])
+    assert ring in active_rings()
+    quiesce_all()  # must not raise / deadlock with a buffer in flight
+    ring.retire([h])
