@@ -70,7 +70,7 @@ from .internals.table import (
     Table,
 )
 from .internals.thisclass import left, right, this
-from .internals.run import run, run_all
+from .internals.run import RunResult, run, run_all
 from .internals.parse_graph import G as parse_graph, clear_graph
 from .internals import udfs
 from .internals.udfs import UDF, udf
@@ -258,4 +258,5 @@ __all__ = [
     "udfs", "unpack_col", "unsafe_make_pointer", "unwrap", "utils",
     "wrap_py_object", "xpacks", "universes", "LiveTable", "analysis",
     "resilience", "Recovery", "RecoveryEscalated", "RetryPolicy",
+    "RunResult",
 ]

@@ -62,6 +62,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL004": (Severity.WARNING, "impure jit-batched UDF"),
     "PWL005": (Severity.INFO, "dead column (never read downstream)"),
     "PWL006": (Severity.INFO, "unconnected table / engine node"),
+    "PWL007": (Severity.WARNING, "recovery enabled with monitoring fully off"),
 }
 
 _MUTABLE_TYPES = (list, dict, set, bytearray)
@@ -660,6 +661,47 @@ def check_unconnected(view: GraphView) -> list[Diagnostic]:
     return out
 
 
+# --------------------------------------------------------------------------
+# PWL007 — recovery without observability
+
+
+def check_recovery_observability(view: GraphView) -> list[Diagnostic]:
+    """``pw.run(recovery=...)`` with monitoring fully off: crashes are
+    restarted silently — no dashboard, no /metrics, no restart counters
+    anyone can scrape — so a flapping run is both unobserved and, once
+    the budget escalates, unexplained. The run configuration is recorded
+    on the parse graph by ``pw.run`` (``run_context``) before the
+    analyze-only return, so ``pathway analyze`` sees it too."""
+    ctx = getattr(view.graph, "run_context", None)
+    if not ctx or not ctx.get("recovery"):
+        return []
+    from ..internals.monitoring import MonitoringLevel
+
+    level = ctx.get("monitoring_level")
+    # MonitoringLevel.coerce maps None/False straight to NONE, so the
+    # bare default counts as off; AUTO resolves per-tty at runtime and
+    # counts as configured. Any Prometheus endpoint silences the rule.
+    monitoring_off = (
+        level is None
+        or level is False
+        or level is MonitoringLevel.NONE
+        or (isinstance(level, str) and level.lower() == "none")
+    )
+    if not monitoring_off or ctx.get("with_http_server"):
+        return []
+    return [
+        _diag(
+            "PWL007",
+            "pw.run(recovery=...) with monitoring fully off: restarts "
+            "and escalations will be invisible — pass "
+            "monitoring_level=... or with_http_server=True so crash "
+            "loops are observable (the flight recorder still dumps on "
+            "escalation, but nothing surfaces restart counts live)",
+            detail={"run_context": {k: repr(v) for k, v in ctx.items()}},
+        )
+    ]
+
+
 LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dtype_consistency,
     check_unbounded_state,
@@ -667,4 +709,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_jax_udf_purity,
     check_dead_columns,
     check_unconnected,
+    check_recovery_observability,
 ]

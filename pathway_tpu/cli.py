@@ -204,6 +204,86 @@ def profile(output, program):
     sys.exit(rc)
 
 
+@cli.group()
+def blackbox():
+    """Inspect black-box flight-recorder dumps.
+
+    Every run keeps a bounded in-memory ring of engine events (epoch
+    transitions, connector commits, retry attempts, chaos hits); on a
+    crash, a worker death, or recovery escalation the ring is written
+    to a timestamped JSON file. These commands list, render, and
+    compare those dumps.
+    """
+
+
+@blackbox.command(name="list")
+@click.option(
+    "--dir",
+    "directory",
+    default=None,
+    help="dump directory [default: PATHWAY_FLIGHT_RECORDER_DIR or "
+    "<tmp>/pathway-blackbox]",
+)
+def blackbox_list(directory):
+    """List flight-recorder dumps, oldest first."""
+    from .internals import flight_recorder as fr
+
+    directory = directory or fr.default_dump_dir()
+    paths = fr.list_dumps(directory)
+    if not paths:
+        click.echo(f"no dumps in {directory}")
+        return
+    for path in paths:
+        try:
+            data = fr.load_dump(path)
+        except Exception as exc:
+            click.echo(f"{path}  <unreadable: {exc}>")
+            continue
+        last = fr.last_epoch(data)
+        click.echo(
+            f"{path}  reason={data.get('reason', '?')}"
+            f" pid={data.get('pid', '?')}"
+            f" events={len(data.get('events', []))}"
+            + (f" last_epoch={last}" if last is not None else "")
+        )
+
+
+@blackbox.command(name="show")
+@click.option(
+    "--tail-epochs",
+    default=3,
+    show_default=True,
+    help="how many trailing epoch transitions to highlight",
+)
+@click.argument("path", required=True)
+def blackbox_show(tail_epochs, path):
+    """Render one dump: header, the last epoch transitions before the
+    crash, then the full event log."""
+    from .internals import flight_recorder as fr
+
+    try:
+        data = fr.load_dump(path)
+    except Exception as exc:
+        raise click.ClickException(f"cannot read {path}: {exc}")
+    click.echo(fr.render(data, tail_epochs=tail_epochs))
+
+
+@blackbox.command(name="diff")
+@click.argument("path_a", required=True)
+@click.argument("path_b", required=True)
+def blackbox_diff(path_a, path_b):
+    """Compare two dumps by event-kind counts (e.g. the dumps of two
+    workers of the same crashed cluster)."""
+    from .internals import flight_recorder as fr
+
+    try:
+        a = fr.load_dump(path_a)
+        b = fr.load_dump(path_b)
+    except Exception as exc:
+        raise click.ClickException(str(exc))
+    click.echo(fr.diff(a, b))
+
+
 def main() -> None:
     cli()
 

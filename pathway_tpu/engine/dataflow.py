@@ -42,6 +42,7 @@ from .value import (
 # Eager import so the one-time g++ build of the native runtime happens at
 # engine load, never mid-epoch inside the hot loop.
 from .. import native as _native
+from ..internals import flight_recorder
 
 # Update = (key: int, row: tuple, diff: int)
 Update = tuple
@@ -2390,6 +2391,9 @@ class EngineGraph:
                 t = max(scripted_t, last_time + 1)
             t = max(t, last_time + 1) if t <= last_time else t
             self.current_time = t
+            flight_recorder.record(
+                "epoch.begin", t=int(t), worker=self.worker_id, batches=len(session_batches)
+            )
             self._frontier_hooks(t)
             for s in self.static_sources:
                 s.feed(t)
@@ -2428,6 +2432,7 @@ class EngineGraph:
                 if session_batches:
                     self._maybe_snapshot_operators(t)
             last_time = t
+            flight_recorder.record("epoch.advance", t=int(t), worker=self.worker_id)
             if monitoring_callback is not None:
                 monitoring_callback(self)
 
@@ -2479,6 +2484,9 @@ class EngineGraph:
     def _raise_connector_failure(self) -> None:
         if self.connector_failures:
             name, exc = self.connector_failures[0]
+            flight_recorder.record(
+                "connector.failed", connector=name, error=type(exc).__name__
+            )
             raise EngineError(f"connector {name!r} failed: {exc}") from exc
 
     def stop(self):

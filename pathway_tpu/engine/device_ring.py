@@ -122,6 +122,11 @@ class DeviceRing:
             for a in prev:
                 _delete(a)
             self.donated += len(prev)
+            from ..internals import flight_recorder
+
+            flight_recorder.record(
+                "ring.donate", ring=self.name, buffers=len(prev), total=self.donated
+            )
         handles = [_device_put(a) for a in items]
         with self._lock:
             self._slots[idx] = handles

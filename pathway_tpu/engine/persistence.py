@@ -43,6 +43,7 @@ import zlib
 from typing import Any, Iterator
 
 from .. import native as _native
+from ..internals import flight_recorder
 
 KIND_DATA = 1
 KIND_ADVANCE = 2
@@ -735,6 +736,9 @@ class EnginePersistence:
             # recovery (see recover_source delivered_frontier)
             w.append(KIND_FEED, time, 0, pickle.dumps(offsets or {}, protocol=4))
             w.flush()
+            flight_recorder.record(
+                "feed.commit", source=source_id, t=int(time), rows=len(updates)
+            )
 
     def advance(self, source_id: str, time: int, offsets: dict) -> None:
         import pickle
@@ -749,6 +753,7 @@ class EnginePersistence:
         w = self.writer_for(source_id)
         w.append(KIND_ADVANCE, time, 0, pickle.dumps(offsets or {}, protocol=4))
         w.flush()
+        flight_recorder.record("offsets.advance", source=source_id, t=int(time))
 
     OPS_SOURCE = "__operators__"
     DELIVERED_SOURCE = "__delivered__"
@@ -762,6 +767,7 @@ class EnginePersistence:
         w = self.writer_for(self.DELIVERED_SOURCE)
         w.append(KIND_ADVANCE, int(time), 0, b"")
         w.flush()
+        flight_recorder.record("epoch.delivered", t=int(time))
         self._delivered_appends = getattr(self, "_delivered_appends", 0) + 1
         if self._delivered_appends >= 4096:
             # bound the marker log: only the max time matters

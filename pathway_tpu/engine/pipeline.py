@@ -49,6 +49,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from . import device_ring
+from ..internals import flight_recorder
 
 __all__ = ["PipelineStats", "StagedEpoch", "run_pipelined"]
 
@@ -159,11 +160,19 @@ class _Stager(threading.Thread):
         self.engine.wake()
 
     def _put(self, item) -> bool:
+        stalled = False
         while not (self._halt or self.engine._stop):
             try:
                 self.q.put(item, timeout=0.05)
                 return True
             except queue.Full:
+                if not stalled and item is not _SENTINEL:
+                    # depth budget exhausted: staging is running ahead of
+                    # execution — the transition (not each retry) is ringed
+                    stalled = True
+                    flight_recorder.record(
+                        "pipeline.stall", t=getattr(item, "time", None)
+                    )
                 continue
         return False
 
@@ -249,6 +258,9 @@ class _Stager(threading.Thread):
                         ep.fed = True
             self.stats.staged_epochs += 1
             self.stats.end("prep")
+            flight_recorder.record(
+                "pipeline.staged", t=int(t), fed=ep.fed, scripted=ep.scripted
+            )
             last_time = t
             if not self._put(ep):
                 break
@@ -296,6 +308,7 @@ def _execute_epoch(engine, ep: StagedEpoch, stats: PipelineStats) -> None:
             if s.persistent_id is not None:
                 engine.persistence.advance(s.persistent_id, t, ep.offsets.get(id(s)) or {})
     stats.executed_epochs += 1
+    flight_recorder.record("pipeline.executed", t=int(t))
     prof = engine.profiler
     if prof is not None:
         prof.observe_pipeline(stats)

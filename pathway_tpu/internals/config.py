@@ -77,6 +77,26 @@ class PathwayConfig:
         return max(1, _env_int("PATHWAY_PIPELINE_DEPTH", 1))
 
     @property
+    def flight_recorder(self) -> bool:
+        """Black-box flight recorder on/off (PATHWAY_FLIGHT_RECORDER;
+        default on — recording is an in-memory ring append)."""
+        v = os.environ.get("PATHWAY_FLIGHT_RECORDER")
+        if v is None or v == "":
+            return True
+        return v.lower() not in ("0", "false", "off", "no")
+
+    @property
+    def flight_recorder_size(self) -> int:
+        """Ring capacity in events (PATHWAY_FLIGHT_RECORDER_SIZE)."""
+        return max(16, _env_int("PATHWAY_FLIGHT_RECORDER_SIZE", 512))
+
+    @property
+    def flight_recorder_dir(self) -> str | None:
+        """Crash-dump directory (PATHWAY_FLIGHT_RECORDER_DIR); None =
+        <tmp>/pathway-blackbox."""
+        return os.environ.get("PATHWAY_FLIGHT_RECORDER_DIR") or None
+
+    @property
     def cluster_accept_timeout(self) -> float | None:
         """Seconds the coordinator waits for all workers to connect
         (PATHWAY_CLUSTER_ACCEPT_TIMEOUT); None = CoordinatorCluster

@@ -56,26 +56,68 @@ class MonitoringHttpServer:
     def _prometheus(self) -> str:
         snap = self.monitor.snapshot
         now = time.monotonic()
-        lines = [
-            "# TYPE pathway_epoch gauge",
-            f"pathway_epoch {snap.time}",
-            "# TYPE pathway_rows_input_total counter",
-            f"pathway_rows_input_total {snap.rows_in}",
-            "# TYPE pathway_rows_output_total counter",
-            f"pathway_rows_output_total {snap.rows_out}",
-            "# TYPE pathway_input_latency_ms gauge",
-            f"pathway_input_latency_ms {self.monitor.input_latency_ms(now)}",
-            "# TYPE pathway_output_latency_ms gauge",
-            f"pathway_output_latency_ms {self.monitor.output_latency_ms(now)}",
-            "# TYPE pathway_operator_rows_total counter",
-        ]
+        workers = getattr(snap, "workers", {}) or {}
+        # cluster runs label EVERY series with worker=<global shard id>;
+        # process-scoped series carry this process's primary shard.
+        # single-process output stays byte-identical (wl == "").
+        wl = (
+            f'worker="{getattr(snap, "primary_worker", 0)}"' if workers else ""
+        )
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+
+        lines = ["# TYPE pathway_epoch gauge"]
+        if workers:
+            for wid in sorted(workers):
+                lines.append(
+                    f'pathway_epoch{{worker="{wid}"}} {workers[wid].get("epoch", 0)}'
+                )
+        else:
+            lines.append(f"pathway_epoch {snap.time}")
+        lines.append("# TYPE pathway_rows_input_total counter")
+        if workers:
+            for wid in sorted(workers):
+                lines.append(
+                    f'pathway_rows_input_total{{worker="{wid}"}} '
+                    f'{workers[wid].get("rows_in", 0)}'
+                )
+        else:
+            lines.append(f"pathway_rows_input_total {snap.rows_in}")
+        lines.append("# TYPE pathway_rows_output_total counter")
+        if workers:
+            for wid in sorted(workers):
+                lines.append(
+                    f'pathway_rows_output_total{{worker="{wid}"}} '
+                    f'{workers[wid].get("rows_out", 0)}'
+                )
+        else:
+            lines.append(f"pathway_rows_output_total {snap.rows_out}")
+        lines.extend(
+            [
+                "# TYPE pathway_input_latency_ms gauge",
+                series("pathway_input_latency_ms", self.monitor.input_latency_ms(now)),
+                "# TYPE pathway_output_latency_ms gauge",
+                series("pathway_output_latency_ms", self.monitor.output_latency_ms(now)),
+                "# TYPE pathway_operator_rows_total counter",
+            ]
+        )
         for op_name, (rows_in, rows_out) in sorted(snap.operators.items()):
             label = _escape_label(op_name)
             lines.append(
-                f'pathway_operator_rows_total{{operator="{label}",direction="in"}} {rows_in}'
+                series(
+                    "pathway_operator_rows_total",
+                    rows_in,
+                    f'operator="{label}",direction="in"',
+                )
             )
             lines.append(
-                f'pathway_operator_rows_total{{operator="{label}",direction="out"}} {rows_out}'
+                series(
+                    "pathway_operator_rows_total",
+                    rows_out,
+                    f'operator="{label}",direction="out"',
+                )
             )
         profiler = self.monitor.profiler
         if profiler is not None:
@@ -87,20 +129,36 @@ class MonitoringHttpServer:
                 hist = agg["histogram"]
                 for le, count in hist.cumulative():
                     lines.append(
-                        f'pathway_operator_self_time_seconds_bucket{{operator="{label}",le="{le}"}} {count}'
+                        series(
+                            "pathway_operator_self_time_seconds_bucket",
+                            count,
+                            f'operator="{label}",le="{le}"',
+                        )
                     )
                 lines.append(
-                    f'pathway_operator_self_time_seconds_sum{{operator="{label}"}} {hist.total:.9f}'
+                    series(
+                        "pathway_operator_self_time_seconds_sum",
+                        f"{hist.total:.9f}",
+                        f'operator="{label}"',
+                    )
                 )
                 lines.append(
-                    f'pathway_operator_self_time_seconds_count{{operator="{label}"}} {hist.count}'
+                    series(
+                        "pathway_operator_self_time_seconds_count",
+                        hist.count,
+                        f'operator="{label}"',
+                    )
                 )
             lag_lines = []
             for key in sorted(by_op):
                 lag = by_op[key]["event_lag_s"]
                 if lag is not None:
                     lag_lines.append(
-                        f'pathway_operator_event_lag_seconds{{operator="{_escape_label(key)}"}} {lag:.6f}'
+                        series(
+                            "pathway_operator_event_lag_seconds",
+                            f"{lag:.6f}",
+                            f'operator="{_escape_label(key)}"',
+                        )
                     )
             if lag_lines:
                 lines.append("# TYPE pathway_operator_event_lag_seconds gauge")
@@ -112,23 +170,69 @@ class MonitoringHttpServer:
             lines.extend(
                 [
                     "# TYPE pathway_host_prep_seconds counter",
-                    f"pathway_host_prep_seconds {snap.host_prep_s:.6f}",
+                    series("pathway_host_prep_seconds", f"{snap.host_prep_s:.6f}"),
                     "# TYPE pathway_device_wait_seconds counter",
-                    f"pathway_device_wait_seconds {snap.device_wait_s:.6f}",
+                    series("pathway_device_wait_seconds", f"{snap.device_wait_s:.6f}"),
                     "# TYPE pathway_pipeline_overlap_ratio gauge",
-                    f"pathway_pipeline_overlap_ratio {snap.overlap_ratio:.4f}",
+                    series(
+                        "pathway_pipeline_overlap_ratio", f"{snap.overlap_ratio:.4f}"
+                    ),
                     "# TYPE pathway_pipeline_depth gauge",
-                    f"pathway_pipeline_depth {snap.pipeline_depth}",
+                    series("pathway_pipeline_depth", snap.pipeline_depth),
                 ]
             )
-        lines.extend(self._resilience_lines())
+        if workers:
+            lines.extend(self._worker_lines(workers))
+        lines.extend(self._resilience_lines(wl))
         return "\n".join(lines) + "\n"
 
     @staticmethod
-    def _resilience_lines() -> list[str]:
+    def _worker_lines(workers: dict) -> list[str]:
+        """Cluster telemetry plane: per-worker gauges aggregated from
+        local shards and remote workers' piggybacked stats."""
+        lines = ["# TYPE pathway_worker_rows_per_second gauge"]
+        for wid in sorted(workers):
+            lines.append(
+                f'pathway_worker_rows_per_second{{worker="{wid}"}} '
+                f'{workers[wid].get("rows_per_s", 0.0):.3f}'
+            )
+        lag_lines = [
+            f'pathway_worker_event_lag_seconds{{worker="{wid}"}} '
+            f'{workers[wid]["event_lag_s"]:.6f}'
+            for wid in sorted(workers)
+            if workers[wid].get("event_lag_s") is not None
+        ]
+        if lag_lines:
+            lines.append("# TYPE pathway_worker_event_lag_seconds gauge")
+            lines.extend(lag_lines)
+        overlap_lines = [
+            f'pathway_worker_overlap_ratio{{worker="{wid}"}} '
+            f'{workers[wid]["overlap_ratio"]:.4f}'
+            for wid in sorted(workers)
+            if workers[wid].get("overlap_ratio") is not None
+        ]
+        if overlap_lines:
+            lines.append("# TYPE pathway_worker_overlap_ratio gauge")
+            lines.extend(overlap_lines)
+        lines.append("# TYPE pathway_worker_restarts_total counter")
+        for wid in sorted(workers):
+            lines.append(
+                f'pathway_worker_restarts_total{{worker="{wid}"}} '
+                f'{workers[wid].get("restarts", 0)}'
+            )
+        return lines
+
+    @staticmethod
+    def _resilience_lines(wl: str = "") -> list[str]:
         """Retry-policy attempt counters and supervisor restart counters
-        (reference telemetry: one series per connector/udf scope)."""
+        (reference telemetry: one series per connector/udf scope).
+        ``wl`` is the worker label in cluster runs (these registries are
+        process-scoped, so they carry the process's primary shard id)."""
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
 
         lines: list[str] = []
         retries = RETRY_METRICS.snapshot()
@@ -137,20 +241,26 @@ class MonitoringHttpServer:
                 lines.append(f"# TYPE pathway_retry_{metric}_total counter")
                 for scope in sorted(retries):
                     lines.append(
-                        f'pathway_retry_{metric}_total{{scope="{_escape_label(scope)}"}} '
-                        f"{retries[scope][metric]}"
+                        series(
+                            f"pathway_retry_{metric}_total",
+                            retries[scope][metric],
+                            f'scope="{_escape_label(scope)}"',
+                        )
                     )
         sup = SUPERVISOR_METRICS.snapshot()
         if sup["restarts_total"] or sup["escalations"]:
             lines.append("# TYPE pathway_supervisor_restarts_total counter")
             for cause in sorted(sup["restarts"]):
                 lines.append(
-                    f'pathway_supervisor_restarts_total{{cause="{_escape_label(cause)}"}} '
-                    f"{sup['restarts'][cause]}"
+                    series(
+                        "pathway_supervisor_restarts_total",
+                        sup["restarts"][cause],
+                        f'cause="{_escape_label(cause)}"',
+                    )
                 )
             lines.append("# TYPE pathway_supervisor_escalations_total counter")
             lines.append(
-                f"pathway_supervisor_escalations_total {sup['escalations']}"
+                series("pathway_supervisor_escalations_total", sup["escalations"])
             )
         return lines
 
@@ -158,18 +268,31 @@ class MonitoringHttpServer:
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
 
         snap = self.monitor.snapshot
-        return json.dumps(
-            {
-                "epoch": snap.time,
-                "rows_in": snap.rows_in,
-                "rows_out": snap.rows_out,
-                "operators": snap.operators,
-                "operator_self_time_s": snap.operator_self_time_s,
-                "operator_event_lag_s": snap.operator_event_lag_s,
-                "retries": RETRY_METRICS.snapshot(),
-                "supervisor": SUPERVISOR_METRICS.snapshot(),
-            }
-        )
+        sup = SUPERVISOR_METRICS.snapshot()
+        status: dict = {
+            "epoch": snap.time,
+            "rows_in": snap.rows_in,
+            "rows_out": snap.rows_out,
+            "operators": snap.operators,
+            "operator_self_time_s": snap.operator_self_time_s,
+            "operator_event_lag_s": snap.operator_event_lag_s,
+            # one JSON poll gives run health: the resilience + pipeline
+            # state already rendered on /metrics
+            "restarts_total": sup["restarts_total"],
+            "retries": RETRY_METRICS.snapshot(),
+            "supervisor": sup,
+            "pipeline": {
+                "depth": getattr(snap, "pipeline_depth", 1),
+                "host_prep_s": getattr(snap, "host_prep_s", 0.0),
+                "device_wait_s": getattr(snap, "device_wait_s", 0.0),
+                "overlap_ratio": getattr(snap, "overlap_ratio", 0.0),
+            },
+            "monitoring_http_port": self.port,
+        }
+        workers = getattr(snap, "workers", {}) or {}
+        if workers:
+            status["workers"] = {str(wid): workers[wid] for wid in sorted(workers)}
+        return json.dumps(status)
 
     # -- lifecycle --
 

@@ -133,6 +133,47 @@ class StatsSnapshot:
     host_prep_s: float = 0.0
     device_wait_s: float = 0.0
     overlap_ratio: float = 0.0
+    #: cluster telemetry plane: worker_id -> per-worker stats dict
+    #: (epoch, rows_in, rows_out, rows_per_s, event_lag_s,
+    #: overlap_ratio, restarts, pid). Empty outside sharded /
+    #: multiprocess runs, so single-process /metrics output is
+    #: byte-identical to before.
+    workers: dict = field(default_factory=dict)
+    #: worker id of the engine this snapshot was sampled from
+    primary_worker: int = 0
+
+
+def sample_worker(engine) -> dict:
+    """Compact per-shard stats dict for the cluster telemetry plane.
+
+    In-process shards are sampled directly off their engines;
+    multiprocess workers build the same shape and piggyback it on their
+    protocol replies over the already-authenticated cluster channel
+    (parallel/multiprocess.py) — workers never open their own
+    unauthenticated listener."""
+    rows_in = rows_out = 0
+    for node in engine.nodes:
+        rows_in += node.stats.rows_in
+        rows_out += node.stats.rows_out
+    out: dict = {
+        "epoch": int(getattr(engine, "current_time", 0) or 0),
+        "rows_in": rows_in,
+        "rows_out": rows_out,
+        "pid": os.getpid(),
+    }
+    profiler = getattr(engine, "profiler", None)
+    if profiler is not None:
+        lags = [
+            agg["event_lag_s"]
+            for agg in profiler.by_operator().values()
+            if agg["event_lag_s"] is not None
+        ]
+        if lags:
+            out["event_lag_s"] = max(lags)
+    pipeline = getattr(engine, "pipeline_stats", None)
+    if pipeline is not None:
+        out["overlap_ratio"] = pipeline.overlap_ratio
+    return out
 
 
 class StatsMonitor:
@@ -149,6 +190,11 @@ class StatsMonitor:
         self.dashboard: "LiveDashboard | None" = None
         #: RunProfiler picked up from the engine on update() (if attached)
         self.profiler = None
+        #: the actually-bound /metrics port, set by pw.run once the
+        #: monitoring HTTP server is up (ephemeral-port fallback included)
+        self.http_port: int | None = None
+        # per-worker (last_sample_wall, last_rows_in) for rows/s rates
+        self._worker_rates: dict[int, tuple[float, int]] = {}
         # wall-clock of the last observed input/output row-count change,
         # for the latency gauges (reference telemetry.rs:41-45)
         self._last_in_change = time.monotonic()
@@ -212,6 +258,10 @@ class StatsMonitor:
                         conn.finished = session.closed
                     except Exception:
                         pass
+        snap.primary_worker = int(getattr(engine, "worker_id", 0) or 0)
+        cluster = getattr(engine, "cluster", None)
+        if cluster is not None and getattr(cluster, "world", 1) > 1:
+            self._sample_cluster(snap, cluster, now)
         if snap.rows_in != self.snapshot.rows_in:
             self._last_in_change = now
         if snap.rows_out != self.snapshot.rows_out:
@@ -226,6 +276,31 @@ class StatsMonitor:
         elif self.render and now - self._last_render > self.interval:
             self._render()
             self._last_render = now
+
+    def _sample_cluster(self, snap: StatsSnapshot, cluster, now: float) -> None:
+        """Populate ``snap.workers``: every in-process shard is sampled
+        directly; remote multiprocess workers are merged from the stats
+        they piggybacked on the coordinator's protocol replies
+        (``cluster.worker_telemetry``)."""
+        from ..resilience import SUPERVISOR_METRICS
+
+        restarts = SUPERVISOR_METRICS.snapshot()["restarts_total"]
+        workers: dict[int, dict] = {}
+        for e in cluster.engines:
+            w = sample_worker(e)
+            w["restarts"] = restarts
+            workers[int(e.worker_id)] = w
+        for wid, stats in getattr(cluster, "worker_telemetry", {}).items():
+            workers.setdefault(int(wid), dict(stats))
+        for wid, w in workers.items():
+            prev = self._worker_rates.get(wid)
+            rows = int(w.get("rows_in", 0))
+            if prev is not None and now > prev[0]:
+                w["rows_per_s"] = max(0.0, (rows - prev[1]) / (now - prev[0]))
+            else:
+                w["rows_per_s"] = 0.0
+            self._worker_rates[wid] = (now, rows)
+        snap.workers = workers
 
     def _render(self) -> None:  # pragma: no cover
         try:
@@ -339,9 +414,38 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
     return table
 
 
+def _workers_table(monitor: StatsMonitor, now: float):
+    """Cluster telemetry plane: one dashboard row per worker shard
+    (local shards + remote multiprocess workers)."""
+    from rich import box
+    from rich.table import Table
+
+    table = Table(title="WORKERS", box=box.SIMPLE)
+    table.add_column("worker", justify="right")
+    table.add_column("epoch", justify="right")
+    table.add_column("rows/s", justify="right")
+    table.add_column(r"event lag \[s]", justify="right")
+    table.add_column("overlap", justify="right")
+    table.add_column("restarts", justify="right")
+    for wid in sorted(monitor.snapshot.workers):
+        w = monitor.snapshot.workers[wid]
+        lag = w.get("event_lag_s")
+        overlap = w.get("overlap_ratio")
+        table.add_row(
+            str(wid),
+            str(w.get("epoch", "")),
+            f"{w.get('rows_per_s', 0.0):.1f}",
+            "" if lag is None else f"{lag:.2f}",
+            "" if overlap is None else f"{overlap:.2f}",
+            str(w.get("restarts", 0)),
+        )
+    return table
+
+
 def build_dashboard(monitor: StatsMonitor, now: float, with_operators: bool = True):
     """The PROGRESS DASHBOARD renderable (reference MonitoringOutput
-    :55-162): connectors beside operators."""
+    :55-162): connectors beside operators, plus a per-worker table in
+    cluster runs."""
     from rich import box
     from rich.align import Align
     from rich.layout import Layout
@@ -353,11 +457,16 @@ def build_dashboard(monitor: StatsMonitor, now: float, with_operators: bool = Tr
     layout["operators"].update(
         Align.center(_operators_table(monitor, now, with_operators))
     )
-    return Panel(
+    panel = Panel(
         layout,
         title=f"PATHWAY PROGRESS DASHBOARD @ t={monitor.snapshot.time}",
         box=box.MINIMAL,
     )
+    if monitor.snapshot.workers:
+        from rich.console import Group
+
+        return Group(panel, Align.center(_workers_table(monitor, now)))
+    return panel
 
 
 class LiveDashboard:

@@ -18,6 +18,10 @@ class ParseGraph:
         self.outputs: list[tuple["Table", dict]] = []  # (table, sink spec)
         self.subscriptions: list[dict] = []
         self.error_log_tables: list["Table"] = []
+        # pw.run() records its effective observability/resilience args
+        # here before building anything; analysis rules that reason
+        # about *run* configuration (PWL007) read it off the graph
+        self.run_context: dict | None = None
         # bumped on every clear(): per-program caches (e.g. the shared
         # utc_now clock table) key on this so a cleared graph never
         # serves tables built for a discarded program
@@ -37,6 +41,7 @@ class ParseGraph:
         self.outputs.clear()
         self.subscriptions.clear()
         self.error_log_tables.clear()
+        self.run_context = None
         self.generation += 1
 
 

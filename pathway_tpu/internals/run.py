@@ -4,12 +4,31 @@ Rebuild of /root/reference/python/pathway/internals/run.py (:12,:56)."""
 
 from __future__ import annotations
 
+import logging
 import os
 import sys
+from dataclasses import dataclass, field
 from typing import Any
 
 from .graph_runner import GraphRunner
 from .parse_graph import G
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class RunResult:
+    """What ``pw.run`` hands back after the graph completes.
+
+    ``monitoring_http_port`` is the port the /metrics server actually
+    bound (the ephemeral-port fallback and ``monitoring_http_port=0``
+    resolve here), so tests and operators can discover the scrape
+    endpoint programmatically; None when no HTTP server was requested.
+    ``flight_recorder_dumps`` lists black-box dump files written during
+    this run (supervisor restarts that later succeeded, etc.)."""
+
+    monitoring_http_port: int | None = None
+    flight_recorder_dumps: list[str] = field(default_factory=list)
 
 
 def _run_analysis(mode: str | None) -> None:
@@ -53,7 +72,7 @@ def run(
     cluster_accept_timeout: float | None = None,
     cluster_hello_timeout: float | None = None,
     **kwargs: Any,
-) -> None:
+) -> RunResult | None:
     """Execute all registered outputs/subscriptions to completion
     (static sources) or until all streaming connectors close.
 
@@ -89,10 +108,18 @@ def run(
     the recovered time shows up as ``overlap_ratio`` on the dashboard
     and ``pathway_host_prep_seconds`` / ``pathway_device_wait_seconds``
     on /metrics. See README "Performance"."""
+    # recorded BEFORE the analyze-only return so `pathway analyze` sees
+    # the run configuration too (rule PWL007 reads it off the graph)
+    G.run_context = {
+        "recovery": bool(recovery),
+        "monitoring_level": monitoring_level,
+        "with_http_server": bool(with_http_server),
+        "persistence": persistence_config is not None,
+    }
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
         # this point — return before sinks are built or readers started
-        return
+        return None
     _run_analysis(analysis)
     from .config import get_pathway_config, pathway_config
     from .licensing import License, check_worker_count
@@ -201,6 +228,10 @@ def run(
         if need_monitor
         else contextlib.nullcontext(None)
     )
+    from . import flight_recorder
+
+    result = RunResult()
+    dumps_before = len(flight_recorder.RECORDER._dumped_paths)
     with mon_ctx as monitor:
         http_server = None
         if with_http_server:
@@ -210,6 +241,11 @@ def run(
 
             http_server = MonitoringHttpServer(monitor, port=monitoring_http_port)
             http_server.start()
+            # the actually-bound port (explicit, default, or the
+            # ephemeral fallback) — discoverable programmatically
+            result.monitoring_http_port = http_server.port
+            if monitor is not None:
+                monitor.http_port = http_server.port
         run_span = None
 
         def _attempt(is_restart: bool) -> None:
@@ -230,12 +266,12 @@ def run(
             else:
                 runner.run(monitoring_callback=monitor.update if monitor else None)
 
+        from ..resilience import Recovery, RecoveryEscalated, Supervisor
+
         try:
             with telemetry.span(
                 "graph_runner.run", workers=pwcfg.n_workers
             ) as run_span:
-                from ..resilience import Recovery, Supervisor
-
                 rec = Recovery.coerce(recovery)
                 if rec is None:
                     _attempt(False)
@@ -252,6 +288,15 @@ def run(
                             stacklevel=2,
                         )
                     Supervisor(rec).run(_attempt)
+        except RecoveryEscalated:
+            raise  # the supervisor already dumped + attached the path
+        except Exception as exc:
+            # unsupervised crash: preserve the last seconds of engine
+            # events before the traceback unwinds the run
+            path = flight_recorder.dump("crash", exc)
+            if path:
+                logger.error("flight recorder dump written to %s", path)
+            raise
         finally:
             if profiler is not None:
                 set_current_profiler(None)
@@ -267,7 +312,11 @@ def run(
                 profiler.write_chrome_trace(profile_path)
             if http_server is not None:
                 http_server.stop()
+            result.flight_recorder_dumps = list(
+                flight_recorder.RECORDER._dumped_paths[dumps_before:]
+            )
+    return result
 
 
-def run_all(**kwargs: Any) -> None:
-    run(**kwargs)
+def run_all(**kwargs: Any) -> RunResult | None:
+    return run(**kwargs)

@@ -78,6 +78,7 @@ import time as _wall
 from typing import Any
 
 from ..engine import dataflow as df
+from ..internals import flight_recorder
 from ..resilience import chaos
 from .sharded import ShardCluster
 
@@ -138,6 +139,22 @@ def _group_by_process(boxes: dict[int, list], threads: int) -> dict[int, dict[in
     out: dict[int, dict[int, list]] = {}
     for shard, box in boxes.items():
         out.setdefault(shard // threads, {})[shard] = box
+    return out
+
+
+def _telemetry_stats(cluster: ShardCluster) -> dict[int, dict]:
+    """Per-shard telemetry piggybacked on worker protocol replies — the
+    cluster channel is already token-authenticated, so workers never
+    open a listener of their own for the telemetry plane."""
+    from ..internals.monitoring import sample_worker
+    from ..resilience import SUPERVISOR_METRICS
+
+    restarts = SUPERVISOR_METRICS.snapshot()["restarts_total"]
+    out: dict[int, dict] = {}
+    for e in cluster.engines:
+        w = sample_worker(e)
+        w["restarts"] = restarts
+        out[int(e.worker_id)] = w
     return out
 
 
@@ -221,6 +238,10 @@ class CoordinatorCluster(ShardCluster):
         self._epoch_frontier: Any = None
         self._poll_replies: dict[int, dict] | None = None
         self._last_poll = 0.0
+        # telemetry plane: latest per-shard stats piggybacked on worker
+        # replies, keyed by global shard id; StatsMonitor merges this
+        # into its snapshot's `workers` map (engine.cluster == self)
+        self.worker_telemetry: dict[int, dict] = {}
 
     # -- protocol helpers --
 
@@ -261,7 +282,13 @@ class CoordinatorCluster(ShardCluster):
         if self._poll_replies is None or now - self._last_poll >= 0.1:
             self._last_poll = now
             self._poll_replies = self._broadcast({"op": "poll"})
+            self._capture_telemetry(self._poll_replies)
         return self._poll_replies
+
+    def _capture_telemetry(self, replies: dict[int, dict]) -> None:
+        for r in replies.values():
+            for wid, stats in (r.get("stats") or {}).items():
+                self.worker_telemetry[int(wid)] = stats
 
     def _speedrun_supported(self) -> bool:
         return False  # worker-process logs are not visible to process 0
@@ -355,7 +382,10 @@ class CoordinatorCluster(ShardCluster):
             # epochs at or below this marker), never re-deliver it
             self._persistence.mark_delivered(int(time))
         chaos.inject("coordinator.after_mark_delivered", time=int(time))
-        self._broadcast({"op": "time_end", "t": time})
+        # the time_end acks carry each worker's latest telemetry sample
+        # (previously discarded) — this is what puts remote workers on
+        # the coordinator's /metrics under their worker= labels
+        self._capture_telemetry(self._broadcast({"op": "time_end", "t": time}))
         # the feed round consumed worker input: a cached pending=True
         # would spin empty epochs until the cache expired
         self._poll_replies = None
@@ -627,6 +657,10 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
             if op == "round":
                 t = msg["t"]
                 had = False
+                if msg.get("feed"):
+                    # one feed round per epoch: the worker-side epoch
+                    # boundary for the black-box ring
+                    flight_recorder.record("epoch.begin", t=t, pid=pid)
                 if msg.get("frontier") is not None:
                     for e in cluster.engines:
                         e.current_time = t
@@ -677,17 +711,19 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                         "op": "poll_reply",
                         "pending": any(s.session.pending() for s in srcs),
                         "closed": all(s.session.closed for s in srcs),
+                        "stats": _telemetry_stats(cluster),
                     },
                 )
             elif op == "time_end":
                 cluster._time_end_all(msg["t"])
+                flight_recorder.record("epoch.time_end", t=msg["t"], pid=pid)
                 chaos.inject("worker.before_advance", time=int(msg["t"]))
                 if wp is not None and pending_advance:
                     for sid, (at, offs) in pending_advance.items():
                         wp.advance(sid, at, offs)
                     pending_advance.clear()
                 chaos.inject("worker.after_advance", time=int(msg["t"]))
-                _send(sock, {"op": "ok"})
+                _send(sock, {"op": "ok", "stats": _telemetry_stats(cluster)})
             elif op == "snapshot":
                 states = {}
                 for i, e in enumerate(cluster.engines):
@@ -735,9 +771,11 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                 raise RuntimeError(msg["error"])
             else:
                 raise RuntimeError(f"unknown op {op!r}")
-    except Exception:
+    except Exception as exc:
         import traceback
 
+        flight_recorder.record("worker.error", pid=pid, error=type(exc).__name__)
+        flight_recorder.dump("worker_crash", exc)
         try:
             _send(sock, {"op": "error", "traceback": traceback.format_exc()})
         except Exception:

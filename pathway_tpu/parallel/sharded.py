@@ -29,6 +29,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
 from ..engine import dataflow as df
+from ..internals import flight_recorder
 
 
 def recover_sources(
@@ -540,6 +541,9 @@ class ShardCluster:
             if session_batches and scripted_t is not None:
                 t = max(scripted_t, last_time + 1)
             t = max(t, last_time + 1) if t <= last_time else t
+            flight_recorder.record(
+                "epoch.begin", t=int(t), world=self.world, batches=len(session_batches)
+            )
             self._sync_watermarks()
             for e in self.engines:
                 e.current_time = t
@@ -578,6 +582,7 @@ class ShardCluster:
                 if session_batches:
                     self._maybe_snapshot_operators(t)
             last_time = t
+            flight_recorder.record("epoch.advance", t=int(t), world=self.world)
             if monitoring_callback is not None:
                 monitoring_callback(primary)
 

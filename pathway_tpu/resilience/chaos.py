@@ -116,14 +116,23 @@ class ChaosPlan:
     def _act(
         self, rule: dict[str, Any], site: str, time: int | None, offset: int | None
     ) -> None:
+        from ..internals import flight_recorder
+
         action = rule["action"]
+        flight_recorder.record(
+            "chaos.hit", site=site, action=action, t=time, offset=offset
+        )
         if action in _SIGNALS:
+            # the injector runs in-process, so this is the last chance
+            # to preserve evidence: dump the ring before the signal
+            flight_recorder.dump(f"chaos.{action}", ChaosInjected(site))
             os.kill(os.getpid(), _SIGNALS[action])
             # SIGKILL is not deliverable to ourselves synchronously on
             # every platform; make sure we do not keep running
             _time.sleep(5.0)
             os._exit(int(rule.get("code", 17)))
         if action == "exit":
+            flight_recorder.dump("chaos.exit", ChaosInjected(site))
             os._exit(int(rule.get("code", 17)))
         if action == "delay":
             _time.sleep(float(rule.get("delay_s", 0.1)))

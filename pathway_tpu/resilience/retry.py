@@ -51,6 +51,11 @@ class RetryMetrics:
     def record_retry(self, scope: str) -> None:
         with self._lock:
             self._bucket(scope)["retries"] += 1
+        # black-box visibility: retries are the early warning of a
+        # degrading dependency, worth their slot in the crash ring
+        from ..internals import flight_recorder
+
+        flight_recorder.record("retry.attempt", scope=scope)
 
     def record_success(self, scope: str) -> None:
         with self._lock:
@@ -59,6 +64,9 @@ class RetryMetrics:
     def record_failure(self, scope: str) -> None:
         with self._lock:
             self._bucket(scope)["failures"] += 1
+        from ..internals import flight_recorder
+
+        flight_recorder.record("retry.failure", scope=scope)
 
     def snapshot(self) -> dict[str, dict[str, int]]:
         with self._lock:

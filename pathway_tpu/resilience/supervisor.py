@@ -27,7 +27,12 @@ logger = logging.getLogger(__name__)
 class RecoveryEscalated(RuntimeError):
     """Restart budget exhausted; the run failed for good.
 
-    ``__cause__`` is the final underlying failure."""
+    ``__cause__`` is the final underlying failure.
+    ``flight_recorder_dump`` (when set) is the path of the black-box
+    dump written at escalation time (``pathway blackbox show <path>``).
+    """
+
+    flight_recorder_dump: str | None = None
 
 
 class SupervisorMetrics:
@@ -135,16 +140,36 @@ class Supervisor:
             try:
                 return attempt(restarts > 0)
             except restart_on as exc:
+                from ..internals import flight_recorder
+
                 cause = type(exc).__name__
                 if restarts >= self.recovery.max_restarts:
                     SUPERVISOR_METRICS.record_escalation()
-                    raise RecoveryEscalated(
+                    escalated = RecoveryEscalated(
                         f"{self.label}: restart budget exhausted after "
                         f"{self.recovery.max_restarts} restart(s); "
                         f"last failure: {cause}: {exc}"
-                    ) from exc
+                    )
+                    flight_recorder.record(
+                        "supervisor.escalated", cause=cause, restarts=restarts
+                    )
+                    dump_path = flight_recorder.dump("recovery_escalated", exc)
+                    escalated.flight_recorder_dump = dump_path
+                    if dump_path:
+                        logger.error(
+                            "%s: flight recorder dump written to %s",
+                            self.label,
+                            dump_path,
+                        )
+                    raise escalated from exc
                 restarts += 1
                 SUPERVISOR_METRICS.record_restart(cause)
+                flight_recorder.record(
+                    "supervisor.restart",
+                    cause=cause,
+                    restart=restarts,
+                    budget=self.recovery.max_restarts,
+                )
                 delay = schedule.wait_duration_before_retry()
                 logger.warning(
                     "%s: attempt failed (%s: %s); restarting from last "

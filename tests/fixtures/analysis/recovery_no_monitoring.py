@@ -1,0 +1,19 @@
+"""Analysis fixture: supervised run with monitoring fully off — the
+verifier must flag PWL007 (warning): restarts and escalations would be
+invisible, no dashboard and no /metrics to scrape."""
+
+import pathway_tpu as pw
+
+t = pw.debug.table_from_markdown(
+    """
+    | word
+  1 | cat
+  2 | dog
+    """
+)
+
+counts = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+
+pw.io.null.write(counts)
+
+pw.run(recovery=True, monitoring_level="none")

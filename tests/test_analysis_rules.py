@@ -427,3 +427,72 @@ def test_json_output_is_stable():
         ],
         "summary": {"error": 1, "info": 1, "warning": 0},
     }
+
+
+# ---------------------------------------------------------------- PWL007
+
+
+def _describe_run(monkeypatch, **run_kwargs):
+    """Record pw.run's configuration on the graph without executing it
+    (the same analyze-only path `pathway analyze` uses)."""
+    monkeypatch.setenv("PATHWAY_ANALYZE_ONLY", "1")
+    assert pw.run(**run_kwargs) is None
+
+
+def _null_sink():
+    t = _static("""
+        | x
+      1 | 1
+    """)
+    pw.io.null.write(t.select(pw.this.x))
+
+
+def test_pwl007_recovery_with_monitoring_off(monkeypatch):
+    _null_sink()
+    _describe_run(monkeypatch, recovery=True, monitoring_level="none")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL007"]
+    assert hits and hits[0].severity is Severity.WARNING
+    assert "recovery" in hits[0].message
+
+
+def test_pwl007_fires_on_bare_default_monitoring(monkeypatch):
+    # MonitoringLevel.coerce(None) is NONE: the bare default IS off
+    _null_sink()
+    _describe_run(monkeypatch, recovery=pw.Recovery(max_restarts=2))
+    assert "PWL007" in _rules(pw.analysis.analyze())
+
+
+def test_pwl007_enum_none_counts_as_off(monkeypatch):
+    _null_sink()
+    _describe_run(
+        monkeypatch, recovery=True, monitoring_level=pw.MonitoringLevel.NONE
+    )
+    assert "PWL007" in _rules(pw.analysis.analyze())
+
+
+def test_pwl007_negative_http_server_silences(monkeypatch):
+    _null_sink()
+    _describe_run(
+        monkeypatch, recovery=True, monitoring_level="none", with_http_server=True
+    )
+    assert "PWL007" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl007_negative_monitoring_configured(monkeypatch):
+    _null_sink()
+    _describe_run(
+        monkeypatch, recovery=True, monitoring_level=pw.MonitoringLevel.IN_OUT
+    )
+    assert "PWL007" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl007_negative_no_recovery(monkeypatch):
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="none")
+    assert "PWL007" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl007_negative_without_run_context():
+    # `pw.analysis.analyze()` before any pw.run: nothing recorded, no rule
+    _null_sink()
+    assert "PWL007" not in _rules(pw.analysis.analyze())

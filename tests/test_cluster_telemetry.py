@@ -1,0 +1,334 @@
+"""Cluster telemetry plane: one /metrics endpoint, every worker labeled.
+
+In-process sharded runs sample every shard engine directly; multi-
+process workers piggyback the same per-worker stats dict on their
+authenticated protocol replies (parallel/multiprocess.py — workers
+never open a listener of their own), and the coordinator's /metrics
+renders all of them under ``worker=`` labels. The chaos test kills a
+worker mid-epoch and asserts the black-box flight recorder preserved
+its last fed epochs."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+from pathway_tpu.internals.monitoring import StatsMonitor
+from pathway_tpu.internals.parse_graph import G
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _series_lines(body: str) -> list[str]:
+    return [ln for ln in body.splitlines() if ln and not ln.startswith("#")]
+
+
+# ---------------------------------------------------------------------------
+# in-process sharded run: every shard under worker= labels
+# ---------------------------------------------------------------------------
+
+
+def _run_sharded_monitored(tmp_path, n_workers: int) -> StatsMonitor:
+    t = pw.debug.table_from_markdown(
+        """
+        | word
+      1 | cat
+      2 | dog
+      3 | cat
+      4 | emu
+      5 | dog
+      6 | cat
+        """
+    )
+    c = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+    pw.io.jsonlines.write(c, str(tmp_path / "out.jsonl"))
+    runner = GraphRunner(n_workers=n_workers)
+    for table, sink in list(G.outputs):
+        sink["build"](runner, table)
+    monitor = StatsMonitor()
+    runner.run(monitoring_callback=monitor.update)
+    pw.clear_graph()
+    return monitor
+
+
+def test_sharded_metrics_label_every_worker(tmp_path):
+    monitor = _run_sharded_monitored(tmp_path, n_workers=2)
+    workers = monitor.snapshot.workers
+    assert sorted(workers) == [0, 1]
+    for w in workers.values():
+        assert {"epoch", "rows_in", "rows_out", "pid", "rows_per_s"} <= set(w)
+
+    body = MonitoringHttpServer(monitor, port=0)._prometheus()
+    # acceptance: EVERY series carries a worker label
+    lines = _series_lines(body)
+    assert lines and all('worker="' in ln for ln in lines), body
+    for wid in (0, 1):
+        assert f'pathway_epoch{{worker="{wid}"}}' in body
+        assert f'pathway_rows_input_total{{worker="{wid}"}}' in body
+        assert f'pathway_worker_restarts_total{{worker="{wid}"}}' in body
+
+
+def test_sharded_status_json_has_workers_and_resilience(tmp_path):
+    monitor = _run_sharded_monitored(tmp_path, n_workers=2)
+    status = json.loads(MonitoringHttpServer(monitor, port=0)._status())
+    assert sorted(status["workers"]) == ["0", "1"]
+    assert "restarts_total" in status
+    assert "retries" in status
+    assert status["pipeline"]["depth"] == 1
+    assert "overlap_ratio" in status["pipeline"]
+
+
+def test_single_process_metrics_have_no_worker_labels(tmp_path):
+    monitor = _run_sharded_monitored(tmp_path, n_workers=1)
+    assert monitor.snapshot.workers == {}
+    body = MonitoringHttpServer(monitor, port=0)._prometheus()
+    assert 'worker="' not in body
+    assert "pathway_epoch " in body
+
+
+# ---------------------------------------------------------------------------
+# multiprocess cluster: scrape the coordinator mid-flight
+# ---------------------------------------------------------------------------
+
+MP_STREAM_PROGRAM = textwrap.dedent(
+    """
+    import os, threading, time, json
+    import pathway_tpu as pw
+
+    class S(pw.Schema):
+        word: str
+
+    t = pw.io.jsonlines.read(
+        os.environ["WC_IN"], schema=S, mode="streaming",
+        autocommit_duration_ms=100,
+    )
+    c = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+    pid = os.environ.get("PATHWAY_PROCESS_ID", "0")
+    pw.io.jsonlines.write(c, os.environ["WC_OUT"] + "." + pid)
+
+    def stop():
+        time.sleep(4.0)
+        os._exit(0)
+
+    threading.Thread(target=stop, daemon=True).start()
+    pw.run(
+        monitoring_level="none",
+        with_http_server=pid == "0",
+        monitoring_http_port=int(os.environ["MET_PORT"]),
+    )
+    """
+)
+
+
+def _spawn_cluster(tmp_path, program: str, extra_env=None, processes=2):
+    prog = tmp_path / "prog.py"
+    prog.write_text(program)
+    port = _free_port()
+    procs = []
+    for pid in range(processes):
+        env = dict(os.environ)
+        env.pop("PATHWAY_CHAOS", None)
+        env.update(
+            WC_IN=str(tmp_path / "in"),
+            WC_OUT=str(tmp_path / "out.jsonl"),
+            JAX_PLATFORMS="cpu",
+            PATHWAY_THREADS="1",
+            PATHWAY_PROCESSES=str(processes),
+            PATHWAY_PROCESS_ID=str(pid),
+            PATHWAY_FIRST_PORT=str(port),
+            PATHWAY_CLUSTER_TOKEN="telemetry-test",
+            PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        )
+        env.update(extra_env or {})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(prog)],
+                env=env,
+                cwd=str(tmp_path),
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    return procs
+
+
+@pytest.fixture()
+def wc_input(tmp_path):
+    d = tmp_path / "in"
+    d.mkdir()
+    words = ["cat", "dog", "cat", "bird", "dog", "cat", "emu", "fox"] * 6
+    with open(d / "words.jsonl", "w") as f:
+        for w in words:
+            f.write(json.dumps({"word": w}) + "\n")
+    return tmp_path
+
+
+def test_multiprocess_scrape_covers_every_worker(wc_input):
+    """Scrape the coordinator's /metrics while a 2-process cluster is
+    live: worker 1's stats arrived piggybacked on its protocol replies,
+    so both shards show up under worker= labels on the ONE endpoint."""
+    tmp = wc_input
+    met_port = _free_port()
+    procs = _spawn_cluster(tmp, MP_STREAM_PROGRAM, {"MET_PORT": str(met_port)})
+    body = None
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{met_port}/metrics", timeout=2
+                ) as resp:
+                    candidate = resp.read().decode()
+            except OSError:
+                time.sleep(0.1)
+                continue
+            if 'worker="0"' in candidate and 'worker="1"' in candidate:
+                body = candidate
+                break
+            time.sleep(0.1)
+        assert body is not None, f"never saw both workers:\n{candidate!r}"
+        lines = _series_lines(body)
+        assert all('worker="' in ln for ln in lines), body
+        for wid in (0, 1):
+            assert f'pathway_epoch{{worker="{wid}"}}' in body
+        # /status mirrors the same per-worker stats as JSON
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{met_port}/status", timeout=2
+        ) as resp:
+            status = json.loads(resp.read().decode())
+        assert sorted(status["workers"]) == ["0", "1"]
+        assert status["workers"]["1"]["pid"] != os.getpid()
+    finally:
+        for p in procs:
+            try:
+                p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+# ---------------------------------------------------------------------------
+# chaos: killed worker leaves a black-box dump behind
+# ---------------------------------------------------------------------------
+
+MP_CHAOS_PROGRAM = textwrap.dedent(
+    """
+    import os, time
+    import pathway_tpu as pw
+    from pathway_tpu.io._connector import input_table_from_reader
+
+    NPROC = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+    WORDS = ["cat", "dog", "bird"]
+
+    class S(pw.Schema):
+        word: str
+
+    def reader(ctx):
+        for i in range(90):
+            if i % NPROC != ctx.process_id:
+                continue
+            ctx.insert({"word": WORDS[i % 3]}, offsets={"pos": i + 1})
+            ctx.commit()
+            time.sleep(0.01)
+
+    t = input_table_from_reader(
+        S, reader, name="slow_src", parallel_readers=True,
+        persistent_id="ct", supports_offsets=True,
+        autocommit_duration_ms=50,
+    )
+    c = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+    pid = os.environ.get("PATHWAY_PROCESS_ID", "0")
+    pw.io.jsonlines.write(c, os.environ["WC_OUT"] + "." + pid)
+    pw.run(
+        monitoring_level="none",
+        persistence_config=pw.persistence.Config.simple_config(
+            pw.persistence.Backend.filesystem(os.environ["WC_STORE"])
+        ),
+    )
+    """
+)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_killed_worker_leaves_flight_recorder_dump(tmp_path):
+    """SIGKILL worker process 1 right after it fed an epoch: the chaos
+    injector dumps the ring in-process before raising the signal, so a
+    blackbox file survives naming the killed worker and its last fed
+    epochs, and ``pathway blackbox show`` renders the trailing epoch
+    transitions."""
+    bb_dir = tmp_path / "blackbox"
+    spec = json.dumps(
+        {"site": "worker.after_feed_log", "process": 1, "hit": 3, "action": "kill"}
+    )
+    procs = _spawn_cluster(
+        tmp_path,
+        MP_CHAOS_PROGRAM,
+        {
+            "PATHWAY_CHAOS": spec,
+            "PATHWAY_FLIGHT_RECORDER_DIR": str(bb_dir),
+            "WC_STORE": str(tmp_path / "store"),
+        },
+    )
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if procs[1].poll() is not None:
+                break
+            time.sleep(0.1)
+        assert procs[1].poll() is not None, "chaos kill never fired"
+        assert procs[1].returncode == -signal.SIGKILL
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.communicate()
+
+    from pathway_tpu.internals import flight_recorder as fr
+
+    dumps = fr.list_dumps(str(bb_dir))
+    assert dumps, f"no blackbox dump in {bb_dir}"
+    killed = [
+        (p, d) for p in dumps for d in [fr.load_dump(p)] if d["process_id"] == 1
+    ]
+    assert killed, "no dump names the killed worker"
+    path, data = killed[-1]
+    assert data["reason"] == "chaos.kill"
+    kinds = [e["kind"] for e in data["events"]]
+    assert "feed.commit" in kinds, kinds
+    assert "chaos.hit" in kinds
+    assert fr.last_epoch(data) is not None  # the last fed epoch
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", "blackbox", "show", path],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "epoch transitions:" in proc.stdout
+    assert "reason=chaos.kill" in proc.stdout

@@ -1,0 +1,251 @@
+"""Black-box flight recorder: bounded ring, crash dumps, CLI.
+
+The recorder (pathway_tpu.internals.flight_recorder) rings recent
+engine events in every process and dumps them to JSON on a crash,
+chaos kill, or recovery escalation; the ``pathway blackbox`` CLI
+lists/renders/diffs the dumps. These tests cover the ring semantics,
+the dump file contract, the run-level integration (RunResult,
+supervisor escalation attaching its dump path), and that enabling the
+recorder leaves sink output byte-identical."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+from click.testing import CliRunner
+
+import pathway_tpu as pw
+from pathway_tpu.cli import cli
+from pathway_tpu.internals import flight_recorder as fr
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ring_is_bounded_and_keeps_newest():
+    rec = fr.FlightRecorder(size=16, enabled=True)
+    for i in range(100):
+        rec.record("epoch.begin", t=i)
+    events = rec.events()
+    assert len(events) == 16
+    # the ring keeps the newest events, with monotonic sequence numbers
+    assert [e["t"] for e in events] == list(range(84, 100))
+    assert [e["seq"] for e in events] == list(range(85, 101))
+    rec.clear()
+    assert len(rec) == 0
+
+
+def test_disabled_recorder_records_and_dumps_nothing(tmp_path):
+    rec = fr.FlightRecorder(size=16, enabled=False)
+    rec.record("epoch.begin", t=0)
+    assert len(rec) == 0
+    assert rec.dump("test", directory=str(tmp_path)) is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_env_controls(monkeypatch):
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER", "0")
+    assert not fr.FlightRecorder().enabled
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER", "1")
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER_SIZE", "64")
+    rec = fr.FlightRecorder()
+    assert rec.enabled and rec._ring.maxlen == 64
+    # floor: a ring too small to hold one epoch's transitions is useless
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER_SIZE", "2")
+    assert fr.FlightRecorder()._ring.maxlen == 16
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER_DIR", "/some/dir")
+    assert fr.default_dump_dir() == "/some/dir"
+
+
+def test_record_swallows_unserializable_fields(tmp_path):
+    rec = fr.FlightRecorder(size=16, enabled=True)
+    rec.record("connector.failed", error=ValueError("boom"), obj=object())
+    path = rec.dump("test", directory=str(tmp_path))
+    assert path is not None
+    data = fr.load_dump(path)  # default=repr made it JSON-clean
+    assert data["events"][0]["kind"] == "connector.failed"
+
+
+# ---------------------------------------------------------------------------
+# dump files: roundtrip, render, diff
+# ---------------------------------------------------------------------------
+
+
+def _dump_with_epochs(tmp_path, n_epochs=5, reason="crash") -> str:
+    rec = fr.FlightRecorder(size=64, enabled=True)
+    for t in range(n_epochs):
+        rec.record("epoch.begin", t=t, worker=0)
+        rec.record("feed.commit", source=1, t=t, rows=3)
+        rec.record("epoch.delivered", t=t)
+        rec.record("epoch.advance", t=t, worker=0)
+    path = rec.dump(reason, RuntimeError("engine died"), directory=str(tmp_path))
+    assert path is not None
+    return path
+
+
+def test_dump_load_roundtrip(tmp_path):
+    path = _dump_with_epochs(tmp_path)
+    assert os.path.basename(path).startswith("blackbox-")
+    data = fr.load_dump(path)
+    assert data["version"] == fr.DUMP_FORMAT_VERSION
+    assert data["reason"] == "crash"
+    assert data["pid"] == os.getpid()
+    assert data["error"] == {"type": "RuntimeError", "message": "engine died"}
+    assert len(data["events"]) == 20
+    assert fr.last_epoch(data) == 4
+
+
+def test_render_highlights_last_epoch_transitions(tmp_path):
+    data = fr.load_dump(_dump_with_epochs(tmp_path))
+    text = fr.render(data, tail_epochs=3)
+    assert "reason=crash" in text
+    assert "error: RuntimeError: engine died" in text
+    assert "last 3 epoch transitions:" in text
+    tail = text.split("last 3 epoch transitions:")[1].split("events (")[0]
+    # the three newest epoch-boundary events, in order
+    assert tail.index("epoch.delivered") < tail.index("epoch.advance")
+    assert "t=4" in tail and "t=0" not in tail
+    assert "events (20 ringed):" in text
+
+
+def test_list_dumps_and_diff(tmp_path):
+    a = _dump_with_epochs(tmp_path, n_epochs=2, reason="first")
+    b = _dump_with_epochs(tmp_path, n_epochs=5, reason="second")
+    assert fr.list_dumps(str(tmp_path)) == sorted([a, b])
+    text = fr.diff(fr.load_dump(a), fr.load_dump(b))
+    assert "epoch.begin" in text
+    assert "last_epoch=1" in text and "last_epoch=4" in text
+    assert fr.list_dumps(str(tmp_path / "missing")) == []
+
+
+def test_load_dump_rejects_non_dump_json(tmp_path):
+    p = tmp_path / "blackbox-notadump.json"
+    p.write_text(json.dumps({"foo": 1}))
+    with pytest.raises(ValueError):
+        fr.load_dump(str(p))
+
+
+# ---------------------------------------------------------------------------
+# pathway blackbox CLI
+# ---------------------------------------------------------------------------
+
+
+def test_blackbox_cli(tmp_path):
+    a = _dump_with_epochs(tmp_path, n_epochs=2, reason="first")
+    b = _dump_with_epochs(tmp_path, n_epochs=5, reason="second")
+    runner = CliRunner()
+
+    res = runner.invoke(cli, ["blackbox", "list", "--dir", str(tmp_path)])
+    assert res.exit_code == 0, res.output
+    assert a in res.output and b in res.output
+    assert "reason=first" in res.output and "last_epoch=4" in res.output
+
+    res = runner.invoke(cli, ["blackbox", "show", b])
+    assert res.exit_code == 0, res.output
+    assert "last 3 epoch transitions:" in res.output
+    assert "epoch.advance" in res.output
+
+    res = runner.invoke(cli, ["blackbox", "diff", a, b])
+    assert res.exit_code == 0, res.output
+    assert "epoch.begin" in res.output
+
+    res = runner.invoke(cli, ["blackbox", "show", str(tmp_path / "nope.json")])
+    assert res.exit_code != 0
+
+    res = runner.invoke(cli, ["blackbox", "list", "--dir", str(tmp_path / "empty")])
+    assert res.exit_code == 0 and "no dumps" in res.output
+
+
+# ---------------------------------------------------------------------------
+# run-level integration
+# ---------------------------------------------------------------------------
+
+
+def _wordcount(out: str):
+    t = pw.debug.table_from_markdown(
+        """
+        | word
+      1 | cat
+      2 | dog
+      3 | cat
+        """
+    )
+    c = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+    pw.io.jsonlines.write(c, out)
+
+
+def test_run_returns_bound_monitoring_port(tmp_path):
+    _wordcount(str(tmp_path / "out.jsonl"))
+    result = pw.run(
+        monitoring_level="none", with_http_server=True, monitoring_http_port=0
+    )
+    pw.clear_graph()
+    assert isinstance(result, pw.RunResult)
+    # port 0 resolved to the actually-bound ephemeral port
+    assert result.monitoring_http_port and result.monitoring_http_port > 0
+    assert result.flight_recorder_dumps == []
+
+
+def test_recorder_leaves_output_byte_identical(tmp_path, monkeypatch):
+    out_on = str(tmp_path / "on.jsonl")
+    _wordcount(out_on)
+    pw.run(monitoring_level="none")
+    pw.clear_graph()
+
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER", "0")
+    rec_off = fr.FlightRecorder()  # env honored for fresh recorders
+    assert not rec_off.enabled
+    monkeypatch.setattr(fr, "RECORDER", rec_off)
+    out_off = str(tmp_path / "off.jsonl")
+    _wordcount(out_off)
+    pw.run(monitoring_level="none")
+    pw.clear_graph()
+
+    with open(out_on) as f_on, open(out_off) as f_off:
+        assert f_on.read() == f_off.read()
+
+
+def test_engine_seams_ring_epoch_events(tmp_path):
+    before = fr.RECORDER._seq
+    _wordcount(str(tmp_path / "out.jsonl"))
+    pw.run(monitoring_level="none")
+    pw.clear_graph()
+    kinds = {e["kind"] for e in fr.RECORDER.events() if e["seq"] > before}
+    assert "epoch.begin" in kinds
+    assert "epoch.advance" in kinds
+
+
+def test_escalation_attaches_dump_path(tmp_path, monkeypatch):
+    from pathway_tpu.resilience import (
+        Recovery,
+        RecoveryEscalated,
+        RetryPolicy,
+        Supervisor,
+    )
+
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER_DIR", str(tmp_path / "bb"))
+    fr.record("epoch.begin", t=7)
+
+    def attempt(is_restart):
+        raise OSError("worker socket died")
+
+    sup = Supervisor(
+        Recovery(
+            max_restarts=1,
+            backoff=RetryPolicy(first_delay_ms=1, jitter_ms=0, sleep=lambda s: None),
+        )
+    )
+    with pytest.raises(RecoveryEscalated) as ei:
+        sup.run(attempt)
+    path = ei.value.flight_recorder_dump
+    assert path and os.path.exists(path)
+    data = fr.load_dump(path)
+    assert data["reason"] == "recovery_escalated"
+    kinds = [e["kind"] for e in data["events"]]
+    # the restart and the escalation themselves are on the record
+    assert "supervisor.restart" in kinds
+    assert "supervisor.escalated" in kinds

@@ -93,3 +93,28 @@ def test_rag_template_lints_clean_in_process():
         assert not errors, [d.render() for d in errors]
     finally:
         pw.clear_graph()
+
+
+def test_recovery_without_monitoring_warns_pwl007():
+    """recovery= with monitoring fully off: a warning (exit 0), nonzero
+    only under --strict-warnings — the CLI sees the run configuration
+    because pw.run records it before the analyze-only return."""
+    fixture = os.path.join(FIXTURES, "recovery_no_monitoring.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL007" in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--strict-warnings")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl007_json_carries_run_context():
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "recovery_no_monitoring.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL007"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["run_context"]["recovery"] == "True"
