@@ -860,11 +860,155 @@ def suite_etl() -> None:
     )
 
 
+def suite_serving_qps() -> None:
+    """Sustained-QPS overload suite for the serving plane: bursty
+    arrivals (24 queries every 50ms, ~480/s offered) against a
+    simulated device whose fused dispatch costs base+per-item time,
+    with a periodic slow-device chaos injection. Run twice:
+
+    - shed ON: admission control (bounded queue, 100ms deadlines) +
+      adaptive batching. Expect bounded p99 on completed queries and an
+      explicit shed_rate.
+    - shed OFF (control): same arrivals, no admission, unbounded queue,
+      no deadlines. Expect the queue to grow and p99 to blow up —
+      quantifying what the admission plane buys.
+    """
+    import threading as _threading
+
+    from pathway_tpu.resilience import chaos as _chaos
+    from pathway_tpu.serving import (
+        AdaptiveBatcher,
+        AdmissionController,
+        Deadline,
+        OverloadError,
+        ServingConfig,
+    )
+    from pathway_tpu.serving.metrics import ServingMetrics
+
+    BURST, PERIOD_S, ROUNDS = 24, 0.05, 50  # ~480 q/s offered for 2.5s
+    BUDGET_MS = 100.0
+    BASE_S, PER_ITEM_S = 0.003, 0.0015  # fused dispatch: 3ms + 1.5ms/item
+
+    def run_once(shed: bool):
+        latencies: list[float] = []
+        shed_count = [0]
+        lock = _threading.Lock()
+        metrics = ServingMetrics()
+        cfg = ServingConfig(
+            max_queue=32 if shed else 1_000_000,
+            default_deadline_ms=BUDGET_MS if shed else None,
+            batch_max=8,
+            batch_window_ms=2.0,
+            latency_budget_ms=BUDGET_MS,
+            query_share=0.5,
+        )
+        ctl = AdmissionController(cfg, metrics=metrics) if shed else None
+
+        def dispatch(items):
+            time.sleep(BASE_S + PER_ITEM_S * len(items))
+            done = time.monotonic()
+            with lock:
+                for arrival, ticket in items:
+                    latencies.append((done - arrival) * 1e3)
+                    if ctl is not None and ticket is not None:
+                        ctl.release(ticket)
+
+        def on_expired(item):
+            _arrival, ticket = item
+            with lock:
+                shed_count[0] += 1
+            if ctl is not None and ticket is not None:
+                ctl.release(ticket)
+
+        batcher = AdaptiveBatcher(
+            dispatch, config=cfg, metrics=metrics, on_expired=on_expired
+        )
+        # periodic slow-device injection: every 5th dispatch stalls 20ms
+        _chaos.activate(
+            {
+                "site": "serving.before_dispatch",
+                "action": "delay",
+                "delay_s": 0.02,
+                "hit": 5,
+                "repeat": True,
+            }
+        )
+        t0 = time.perf_counter()
+        try:
+            for _ in range(ROUNDS):
+                for _ in range(BURST):
+                    deadline = Deadline(cfg.default_deadline_ms)
+                    ticket = None
+                    if ctl is not None:
+                        try:
+                            ticket = ctl.admit(deadline)
+                        except OverloadError:
+                            with lock:
+                                shed_count[0] += 1
+                            continue
+                    batcher.submit((time.monotonic(), ticket), deadline)
+                time.sleep(PERIOD_S)
+            # drain: give in-flight work (bounded when shedding) time out
+            drain_until = time.monotonic() + (2.0 if shed else 10.0)
+            while batcher.pending() and time.monotonic() < drain_until:
+                time.sleep(0.02)
+        finally:
+            _chaos.deactivate()
+            batcher.stop()
+        wall = time.perf_counter() - t0
+        offered = BURST * ROUNDS
+        lat = sorted(latencies)
+
+        def pct(p):
+            return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else float("inf")
+
+        return {
+            "offered": offered,
+            "completed": len(lat),
+            "shed": shed_count[0],
+            "shed_rate": shed_count[0] / offered,
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+            "goodput_qps": len(lat) / wall,
+            "wall_s": wall,
+        }
+
+    on = run_once(shed=True)
+    off = run_once(shed=False)
+
+    _emit(
+        "serving_qps_at_p99_budget",
+        on["goodput_qps"] if on["p99_ms"] <= BUDGET_MS * 1.5 else 0.0,
+        "queries/s",
+        p50_ms=round(on["p50_ms"], 2),
+        p99_ms=round(on["p99_ms"], 2),
+        p99_budget_ms=BUDGET_MS,
+        shed_rate=round(on["shed_rate"], 4),
+        offered_qps=round(BURST / PERIOD_S, 1),
+        completed=on["completed"],
+        mode="admission(max_queue=32) + 100ms deadlines + adaptive "
+        "batching, bursty 24q/50ms arrivals, periodic 20ms slow-device "
+        "chaos on serving.before_dispatch",
+    )
+    _emit(
+        "serving_shed_off_p99_blowup",
+        (off["p99_ms"] / on["p99_ms"]) if on["p99_ms"] > 0 else float("inf"),
+        "ratio",
+        shed_on_p99_ms=round(on["p99_ms"], 2),
+        shed_off_p99_ms=round(off["p99_ms"], 2),
+        shed_off_completed=off["completed"],
+        note="control: same arrivals with no admission/deadlines — the "
+        "unbounded queue's p99 vs the shed-on bounded p99; >1 means the "
+        "admission plane is buying bounded latency, not hiding work",
+    )
+
+
 def run_suite() -> None:
     import traceback
 
     for fn in (
         suite_etl,
+        suite_serving_qps,
         suite_knn_10k,
         suite_vector_store_ingest,
         suite_adaptive_rag_p50,

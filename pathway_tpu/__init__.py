@@ -226,6 +226,8 @@ from .stdlib import temporal as window  # pw.window.tumbling(...) namespace
 from . import analysis  # pw.analysis.analyze / suppress (static verifier)
 from . import resilience  # retry policy / run supervisor / chaos harness
 from .resilience import Recovery, RecoveryEscalated, RetryPolicy
+from . import serving  # overload-safe query plane (admission/deadlines/batching)
+from .serving import ServingConfig
 
 
 def __getattr__(name):
@@ -258,5 +260,5 @@ __all__ = [
     "udfs", "unpack_col", "unsafe_make_pointer", "unwrap", "utils",
     "wrap_py_object", "xpacks", "universes", "LiveTable", "analysis",
     "resilience", "Recovery", "RecoveryEscalated", "RetryPolicy",
-    "RunResult",
+    "RunResult", "serving", "ServingConfig",
 ]

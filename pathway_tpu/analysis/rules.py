@@ -22,6 +22,11 @@ PWL004 (warning) JAX UDF purity: jit-batched UDFs that close over JAX
 PWL005 (info)    dead columns: columns never read by any consumer on
                  the way to an output (wasted exchange bandwidth).
 PWL006 (info)    unconnected tables/nodes: built but feeding no output.
+PWL007 (warning) recovery enabled with monitoring fully off.
+PWL008 (warning) serving endpoint without overload protection in a run
+                 configured for resilience/throughput (recovery or
+                 pipeline_depth>1): no admission control, deadlines or
+                 load shedding on the query path.
 """
 
 from __future__ import annotations
@@ -63,6 +68,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL005": (Severity.INFO, "dead column (never read downstream)"),
     "PWL006": (Severity.INFO, "unconnected table / engine node"),
     "PWL007": (Severity.WARNING, "recovery enabled with monitoring fully off"),
+    "PWL008": (Severity.WARNING, "serving endpoint without overload protection"),
 }
 
 _MUTABLE_TYPES = (list, dict, set, bytearray)
@@ -702,6 +708,50 @@ def check_recovery_observability(view: GraphView) -> list[Diagnostic]:
     ]
 
 
+# --------------------------------------------------------------------------
+# PWL008 — serving endpoint without overload protection
+
+
+def check_serving_overload(view: GraphView) -> list[Diagnostic]:
+    """A ``rest_connector`` endpoint registered without ``serving=``
+    (no admission control, per-request deadlines, or shed policy) in a
+    run that is otherwise configured for production pressure —
+    ``recovery=`` (the process is expected to crash and keep going) or
+    ``pipeline_depth > 1`` (the device is expected to be saturated).
+    Under overload such an endpoint queues unboundedly inside the
+    engine and times out holding memory instead of shedding early with
+    a typed 429/503. Endpoints are recorded on the parse graph by
+    ``rest_connector`` (``serving_endpoints``); the run configuration by
+    ``pw.run`` (``run_context``)."""
+    endpoints = getattr(view.graph, "serving_endpoints", None) or []
+    unprotected = [e for e in endpoints if not e.get("protected")]
+    if not unprotected:
+        return []
+    ctx = getattr(view.graph, "run_context", None) or {}
+    pressured = bool(ctx.get("recovery")) or int(ctx.get("pipeline_depth") or 1) > 1
+    if not pressured:
+        return []
+    routes = ", ".join(sorted(e.get("route", "?") for e in unprotected))
+    return [
+        _diag(
+            "PWL008",
+            f"serving endpoint(s) {routes} have no overload protection "
+            "(no serving= config: no admission control, per-request "
+            "deadlines, or shed policy) while the run is configured for "
+            "sustained pressure (recovery= or pipeline_depth>1) — under "
+            "overload these endpoints queue unboundedly and time out "
+            "instead of shedding early; pass "
+            "serving=pw.ServingConfig(...) to rest_connector or the "
+            "REST server",
+            detail={
+                "endpoints": unprotected,
+                "recovery": bool(ctx.get("recovery")),
+                "pipeline_depth": int(ctx.get("pipeline_depth") or 1),
+            },
+        )
+    ]
+
+
 LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dtype_consistency,
     check_unbounded_state,
@@ -710,4 +760,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dead_columns,
     check_unconnected,
     check_recovery_observability,
+    check_serving_overload,
 ]

@@ -2029,6 +2029,11 @@ class EngineGraph:
         self.pipeline_depth = 1
         self.pipeline_stats = None
         self._stage_commit_lock = None
+        # serving query-dispatch slots (pathway_tpu.serving.batching):
+        # every executed epoch reports (time, wall_s) to registered
+        # observers — the adaptive query batcher sizes fused dispatches
+        # from this and treats epoch boundaries as dispatch slots
+        self.epoch_observers: list[Callable[[int, float], None]] = []
 
     # --- builder helpers used by the graph runner ---
 
@@ -2037,6 +2042,15 @@ class EngineGraph:
 
     def wake(self):
         self._wake.set()
+
+    def _notify_epoch_observers(self, time: int, wall_s: float) -> None:
+        """Epoch-completion fan-out to serving-plane observers; a
+        broken observer must never take the epoch loop down with it."""
+        for obs in self.epoch_observers:
+            try:
+                obs(time, wall_s)
+            except Exception:  # pragma: no cover - observer bug guard
+                pass
 
     def report_row_error(self, origin: "Node", exc: BaseException):
         """Route a row-level failure: abort (terminate_on_error) or log
@@ -2415,7 +2429,10 @@ class EngineGraph:
                         s.persistent_id, t, resolved, s.last_offsets or {}
                     )
                     _chaos.inject("engine.after_stage_commit", time=int(t))
+            _sweep0 = _wall.perf_counter()
             self._topo_pass(t)
+            if self.epoch_observers:
+                self._notify_epoch_observers(int(t), _wall.perf_counter() - _sweep0)
             if self.persistence is not None:
                 if session_batches:
                     # sinks flushed this epoch's output in the topo pass;

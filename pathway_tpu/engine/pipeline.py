@@ -297,6 +297,11 @@ def _execute_epoch(engine, ep: StagedEpoch, stats: PipelineStats) -> None:
     cpu = _wall.thread_time() - cpu0
     stats.add_device_wait(wall - cpu)
     stats.end("exec")
+    if engine.epoch_observers:
+        # query-dispatch slot: epoch N just finished on the device —
+        # the serving batcher observes its wall time (EWMA sizing) and
+        # may flush a fused query batch before epoch N+1 executes
+        engine._notify_epoch_observers(int(t), wall)
 
     if engine.persistence is not None:
         if ep.resolved:

@@ -184,6 +184,7 @@ class MonitoringHttpServer:
         if workers:
             lines.extend(self._worker_lines(workers))
         lines.extend(self._resilience_lines(wl))
+        lines.extend(self._serving_lines(wl))
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -264,6 +265,96 @@ class MonitoringHttpServer:
             )
         return lines
 
+    @staticmethod
+    def _serving_lines(wl: str = "") -> list[str]:
+        """Overload-safe serving plane counters/gauges
+        (``pathway_serving_*``). Rendered only once a serving-enabled
+        endpoint has seen traffic — ``/metrics`` output stays
+        byte-identical for pipelines that never configure serving."""
+        from ..serving import SERVING_METRICS
+
+        if not SERVING_METRICS.active():
+            return []
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+
+        snap = SERVING_METRICS.snapshot()
+        lines = [
+            "# TYPE pathway_serving_admitted_total counter",
+            series("pathway_serving_admitted_total", snap["admitted_total"]),
+            "# TYPE pathway_serving_degraded_total counter",
+            series("pathway_serving_degraded_total", snap["degraded_total"]),
+            "# TYPE pathway_serving_deadline_expired_total counter",
+            series(
+                "pathway_serving_deadline_expired_total",
+                snap["deadline_expired_total"],
+            ),
+        ]
+        lines.append("# TYPE pathway_serving_shed_total counter")
+        for reason in sorted(snap["shed_total"]):
+            lines.append(
+                series(
+                    "pathway_serving_shed_total",
+                    snap["shed_total"][reason],
+                    f'reason="{_escape_label(reason)}"',
+                )
+            )
+        lines.extend(
+            [
+                "# TYPE pathway_serving_queue_depth gauge",
+                series("pathway_serving_queue_depth", snap["queue_depth"]),
+                "# TYPE pathway_serving_inflight gauge",
+                series("pathway_serving_inflight", snap["inflight"]),
+                "# TYPE pathway_serving_batches_total counter",
+                series("pathway_serving_batches_total", snap["batches_total"]),
+                "# TYPE pathway_serving_batched_queries_total counter",
+                series(
+                    "pathway_serving_batched_queries_total",
+                    snap["batched_queries_total"],
+                ),
+                "# TYPE pathway_serving_batch_size gauge",
+                series("pathway_serving_batch_size", snap["last_batch_size"]),
+                "# TYPE pathway_serving_ewma_item_seconds gauge",
+                series(
+                    "pathway_serving_ewma_item_seconds",
+                    f"{snap['ewma_item_s']:.6f}",
+                ),
+            ]
+        )
+        stage_lines = []
+        for stage in sorted(SERVING_METRICS.stages):
+            hist = SERVING_METRICS.stages[stage]
+            if not hist.count:
+                continue
+            for le, cum in hist.cumulative():
+                stage_lines.append(
+                    series(
+                        "pathway_serving_stage_seconds_bucket",
+                        cum,
+                        f'stage="{stage}",le="{le}"',
+                    )
+                )
+            stage_lines.append(
+                series(
+                    "pathway_serving_stage_seconds_sum",
+                    f"{hist.total:.9f}",
+                    f'stage="{stage}"',
+                )
+            )
+            stage_lines.append(
+                series(
+                    "pathway_serving_stage_seconds_count",
+                    hist.count,
+                    f'stage="{stage}"',
+                )
+            )
+        if stage_lines:
+            lines.append("# TYPE pathway_serving_stage_seconds histogram")
+            lines.extend(stage_lines)
+        return lines
+
     def _status(self) -> str:
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
 
@@ -292,6 +383,10 @@ class MonitoringHttpServer:
         workers = getattr(snap, "workers", {}) or {}
         if workers:
             status["workers"] = {str(wid): workers[wid] for wid in sorted(workers)}
+        from ..serving import SERVING_METRICS
+
+        if SERVING_METRICS.active():
+            status["serving"] = SERVING_METRICS.snapshot()
         return json.dumps(status)
 
     # -- lifecycle --

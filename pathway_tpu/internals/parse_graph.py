@@ -20,8 +20,12 @@ class ParseGraph:
         self.error_log_tables: list["Table"] = []
         # pw.run() records its effective observability/resilience args
         # here before building anything; analysis rules that reason
-        # about *run* configuration (PWL007) read it off the graph
+        # about *run* configuration (PWL007/PWL008) read it off the graph
         self.run_context: dict | None = None
+        # serving endpoints built in this program (rest_connector /
+        # llm servers): {"route", "kind", "protected"} records for
+        # PWL008 (endpoint without overload protection)
+        self.serving_endpoints: list[dict] = []
         # bumped on every clear(): per-program caches (e.g. the shared
         # utc_now clock table) key on this so a cleared graph never
         # serves tables built for a discarded program
@@ -42,6 +46,7 @@ class ParseGraph:
         self.subscriptions.clear()
         self.error_log_tables.clear()
         self.run_context = None
+        self.serving_endpoints.clear()
         self.generation += 1
 
 

@@ -25,10 +25,15 @@ class RunResult:
     resolve here), so tests and operators can discover the scrape
     endpoint programmatically; None when no HTTP server was requested.
     ``flight_recorder_dumps`` lists black-box dump files written during
-    this run (supervisor restarts that later succeeded, etc.)."""
+    this run (supervisor restarts that later succeeded, etc.).
+    ``serving_http_ports`` lists the ports the run's serving endpoints
+    (``rest_connector`` / ``PathwayWebserver``) actually bound —
+    explicit ports, ``port=0``, and the ephemeral-port fallback all
+    resolve here."""
 
     monitoring_http_port: int | None = None
     flight_recorder_dumps: list[str] = field(default_factory=list)
+    serving_http_ports: list[int] = field(default_factory=list)
 
 
 def _run_analysis(mode: str | None) -> None:
@@ -109,12 +114,23 @@ def run(
     and ``pathway_host_prep_seconds`` / ``pathway_device_wait_seconds``
     on /metrics. See README "Performance"."""
     # recorded BEFORE the analyze-only return so `pathway analyze` sees
-    # the run configuration too (rule PWL007 reads it off the graph)
+    # the run configuration too (rules PWL007/PWL008 read it off the
+    # graph). The env fallback mirrors pwcfg.pipeline_depth, which is
+    # not importable this early on the analyze-only path.
+    try:
+        _depth_ctx = (
+            int(pipeline_depth)
+            if pipeline_depth is not None
+            else int(os.environ.get("PATHWAY_PIPELINE_DEPTH") or 1)
+        )
+    except ValueError:
+        _depth_ctx = 1
     G.run_context = {
         "recovery": bool(recovery),
         "monitoring_level": monitoring_level,
         "with_http_server": bool(with_http_server),
         "persistence": persistence_config is not None,
+        "pipeline_depth": max(1, _depth_ctx),
     }
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
@@ -315,6 +331,12 @@ def run(
             result.flight_recorder_dumps = list(
                 flight_recorder.RECORDER._dumped_paths[dumps_before:]
             )
+    try:
+        from ..io.http._server import bound_serving_ports
+
+        result.serving_http_ports = bound_serving_ports()
+    except ImportError:  # aiohttp not installed — no serving surface
+        pass
     return result
 
 

@@ -65,7 +65,15 @@ class RequestRunner:
         headers: dict[str, str] | None = None,
         data: Any = None,
         stream: bool = False,
+        deadline: Any = None,
     ):
+        """``deadline=`` (a ``pathway_tpu.serving.Deadline`` or float
+        seconds) stops the retry loop early: a backoff that would sleep
+        past the remaining budget is skipped and the last response /
+        exception is surfaced immediately."""
+        from ...serving.deadline import coerce_deadline
+
+        deadline = coerce_deadline(deadline)
         policy = self._policy_factory()
         last_exc: Exception | None = None
         response = None
@@ -91,6 +99,8 @@ class RequestRunner:
             if attempt == self._n_retries:
                 break
             wait = policy.wait_duration_before_retry()
+            if deadline is not None and wait >= deadline.remaining():
+                break
             self.backoffs.append((attempt, wait))
             self._sleep(wait)
         if last_exc is not None:

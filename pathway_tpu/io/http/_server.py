@@ -14,8 +14,10 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import threading
 import uuid
+import weakref
 from typing import Any
 
 from ...engine.value import Json, Pointer, ref_scalar
@@ -35,6 +37,19 @@ try:
     from aiohttp import web
 except ImportError:  # pragma: no cover
     web = None
+
+logger = logging.getLogger(__name__)
+
+#: Every started webserver registers here so ``pw.run`` can surface
+#: the actually-bound serving ports on RunResult (parity with the
+#: monitoring server's ``monitoring_http_port``).
+_ACTIVE_WEBSERVERS: "weakref.WeakSet[PathwayWebserver]" = weakref.WeakSet()
+
+
+def bound_serving_ports() -> list[int]:
+    """Ports of all currently-started PathwayWebservers (explicit,
+    or resolved from ``port=0`` / the ephemeral-port fallback)."""
+    return sorted({ws.port for ws in _ACTIVE_WEBSERVERS if ws._started.is_set()})
 
 
 class PathwayWebserver:
@@ -87,9 +102,27 @@ class PathwayWebserver:
         async def init():
             runner = web.AppRunner(self._app)
             await runner.setup()
-            site = web.TCPSite(runner, self.host, self.port)
-            await site.start()
+            try:
+                site = web.TCPSite(runner, self.host, self.port)
+                await site.start()
+            except OSError as exc:
+                # the requested port is taken (two servers on one box):
+                # fall back to an ephemeral port and say where we are —
+                # the bound port is surfaced on RunResult
+                site = web.TCPSite(runner, self.host, 0)
+                await site.start()
+                logger.warning(
+                    "serving port %d unavailable (%s); endpoint bound to an "
+                    "ephemeral port instead",
+                    self.port,
+                    exc,
+                )
+            srv = getattr(site, "_server", None)
+            if srv is not None and getattr(srv, "sockets", None):
+                # resolves port=0 / the fallback to the actually-bound port
+                self.port = srv.sockets[0].getsockname()[1]
             self._runner = runner
+            _ACTIVE_WEBSERVERS.add(self)
             self._started.set()
 
         loop.run_until_complete(init())
@@ -116,6 +149,7 @@ def rest_connector(
     request_validator=None,
     validate_schema: bool | None = None,
     documentation: EndpointDocumentation | None = None,
+    serving=None,  # pathway_tpu.serving.ServingConfig
 ) -> tuple[Table, Any]:
     """Expose an HTTP endpoint as an input table. Returns
     (query_table, response_writer); call response_writer(result_table)
@@ -129,7 +163,17 @@ def rest_connector(
     schema (missing required fields, scalar type mismatches); defaults
     to on for ``custom``-format endpoints with an explicit schema.
     Every request logs one structured JSON access record (reference
-    :403-420)."""
+    :403-420).
+
+    ``serving``: a :class:`pathway_tpu.serving.ServingConfig` puts the
+    endpoint behind the overload-safe serving plane — admission control
+    (bounded deadline-ordered queue, optional token-bucket rate limit),
+    per-request deadlines (``X-Pathway-Deadline-Ms`` header or the
+    config's ``default_deadline_ms``), load shedding with typed 429/503
+    responses, and adaptive batching of queries into fused engine
+    commits. Without it the endpoint still honors a client deadline
+    header (expiry answers a typed 503), but nothing bounds the queue.
+    """
     if webserver is None:
         assert host is not None and port is not None
         webserver = PathwayWebserver(host, port)
@@ -153,14 +197,85 @@ def rest_connector(
     ctx_holder: dict[str, StreamingContext] = {}
     started = threading.Event()
 
+    from ...serving import (
+        DEADLINE_HEADER,
+        AdmissionController,
+        Deadline,
+        DeadlineExceeded,
+        OverloadError,
+        SERVING_METRICS,
+        AdaptiveBatcher,
+    )
+
+    # the analysis rule PWL008 reads this registry off the parse graph:
+    # a serving endpoint with no overload protection on a recovering or
+    # pipelined run is worth a warning before it melts under load
+    G.serving_endpoints.append(
+        {"route": route, "kind": "rest_connector", "protected": serving is not None}
+    )
+
+    admission = (
+        AdmissionController(serving, route=route) if serving is not None else None
+    )
+
+    def _dispatch(items: list[tuple[int, tuple]]) -> None:
+        """Fused engine dispatch: one commit for a whole batch of
+        queries (runs on the batcher worker thread)."""
+        ctx = ctx_holder.get("ctx")
+        if ctx is None:
+            raise RuntimeError("pipeline not running")
+        for key, row in items:
+            ctx.session.insert(key, row)
+        ctx.session.commit()
+
+    batcher = (
+        AdaptiveBatcher(_dispatch, config=serving, name=f"rest:{route}")
+        if serving is not None
+        else None
+    )
+
+    def _overload_response(respond, exc: OverloadError):
+        headers = {}
+        if exc.retry_after_s is not None:
+            headers["Retry-After"] = f"{max(0.0, exc.retry_after_s):.3f}"
+        return respond(exc.to_response(), status=exc.status, headers=headers)
+
     async def handler(request):
         qid = str(uuid.uuid4())
         log_ctx = _LoggingContext(request, qid)
+        t_start = asyncio.get_running_loop().time()
 
-        def respond(data, status=200):
+        def respond(data, status=200, headers=None):
             log_ctx.log_response(status)
-            return web.json_response(data, status=status)
+            return web.json_response(data, status=status, headers=headers)
 
+        # per-request deadline: client header wins, then the serving
+        # config's server default, then unbounded
+        deadline = Deadline.from_header(
+            request.headers.get(DEADLINE_HEADER),
+            serving.default_deadline_ms if serving is not None else None,
+        )
+
+        ticket = None
+        if admission is not None:
+            if batcher.error is not None:
+                return respond(
+                    {"error": f"serving plane failed: {batcher.error!r}"}, status=500
+                )
+            try:
+                ticket = admission.admit(deadline)
+            except OverloadError as exc:
+                return _overload_response(respond, exc)
+        try:
+            return await _serve_admitted(request, respond, deadline, ticket, qid)
+        finally:
+            if admission is not None and ticket is not None:
+                admission.release(ticket)
+                SERVING_METRICS.observe_stage(
+                    "total", asyncio.get_running_loop().time() - t_start
+                )
+
+    async def _serve_admitted(request, respond, deadline, ticket, qid):
         if request.method == "GET":
             payload = dict(request.rel_url.query)
         elif format == "raw":
@@ -192,6 +307,15 @@ def rest_connector(
             if dt.unoptionalize(dtypes[n]) is dt.JSON and not isinstance(v, Json):
                 v = Json(v)
             values[n] = v
+        degraded = ticket is not None and ticket.degraded
+        if degraded and serving is not None:
+            # shed="degrade": serve reduced top-k instead of rejecting —
+            # clamp the retrieval fan-out fields RAG endpoints carry
+            k = values.get("k")
+            if isinstance(k, int) and k > serving.degrade_top_k:
+                values["k"] = serving.degrade_top_k
+            if isinstance(values.get("rerank"), bool):
+                values["rerank"] = False
         key = int(ref_scalar(qid))
 
         fut = asyncio.get_running_loop().create_future()
@@ -202,12 +326,31 @@ def rest_connector(
         if ctx is None:
             return respond({"error": "pipeline not running"}, status=503)
         row = tuple(values.get(n) for n in names)
-        ctx.session.insert(key, row)
-        ctx.session.commit()
+        if batcher is not None:
+            # adaptive batching: the batcher fuses concurrent queries
+            # into one engine commit, sized by observed device latency
+            batcher.submit((key, row), deadline)
+        else:
+            ctx.session.insert(key, row)
+            ctx.session.commit()
+        # the response wait is bounded by the request's remaining
+        # budget; unbounded deadlines keep the legacy 120 s backstop
+        remaining = deadline.remaining()
+        timeout = min(remaining, 120.0)
         try:
-            result = await asyncio.wait_for(fut, timeout=120)
+            result = await asyncio.wait_for(fut, timeout=timeout)
         except asyncio.TimeoutError:
-            return respond({"error": "timeout"}, status=504)
+            if remaining >= 120.0:
+                return respond({"error": "timeout"}, status=504)
+            # typed mid-pipeline budget expiry (recorded in the
+            # admission ledger when the serving plane is on)
+            if admission is not None and ticket is not None:
+                exc = admission.expire(ticket)
+            else:
+                exc = DeadlineExceeded(
+                    "deadline expired before the pipeline produced a response"
+                )
+            return _overload_response(respond, exc)
         finally:
             with pending_lock:
                 pending.pop(key, None)
@@ -215,7 +358,8 @@ def rest_connector(
             result = result.value
         from ..fs import _jsonable
 
-        return respond(_jsonable(result))
+        headers = {"X-Pathway-Degraded": "1"} if degraded else None
+        return respond(_jsonable(result), headers=headers)
 
     docs: dict = {}
     for m in methods:
@@ -224,6 +368,12 @@ def rest_connector(
 
     def reader(ctx: StreamingContext) -> None:
         ctx_holder["ctx"] = ctx
+        if batcher is not None:
+            # query-dispatch slots: epoch completions feed the
+            # batcher's device-latency EWMA and wake its worker
+            eng = getattr(getattr(ctx.session, "node", None), "graph", None)
+            if eng is not None:
+                batcher.attach_engine(eng)
         started.set()
         webserver.start()
         # serve until the process ends

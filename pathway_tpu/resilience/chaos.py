@@ -8,7 +8,14 @@ flush and the worker ADVANCE broadcast in
 overlapped epoch pipeline: ``engine.before_stage_commit`` /
 ``engine.after_stage_commit`` bracket the KIND_FEED write at
 staging-commit time (engine/pipeline.py — at ``pipeline_depth=1``
-they fire at feed time, the degenerate staging commit). A *chaos plan* (rules loaded from the
+they fire at feed time, the degenerate staging commit). The serving
+plane adds the overload sites: ``serving.admit`` (inside
+``AdmissionController.admit``, before any shed decision — delay here
+models a burst piling up at the front door), ``serving.before_dispatch``
+(just before the adaptive batcher hands a fused batch to the engine —
+delay models a slow device) and ``serving.batch_inflight`` (after
+dispatch returns, while request futures are still pending — a raise
+here models a stuck batch). A *chaos plan* (rules loaded from the
 ``PATHWAY_CHAOS`` environment variable, or activated in-process via
 :func:`activate`) decides whether a given call dies, raises, or
 delays, keyed on the site name, the epoch, the persistence byte

@@ -164,14 +164,25 @@ class RetryPolicy:
         scope: str = "default",
         retryable: Callable[[BaseException], bool] | None = None,
         metrics: RetryMetrics | None = None,
+        deadline: Any = None,
         **kwargs: Any,
     ) -> Any:
         """Run ``fn`` under this policy; at most ``max_retries + 1``
         attempts. ``retryable(exc) -> bool`` filters which exceptions
         qualify (default: any ``Exception``). Attempt history lands in
-        ``metrics`` (default :data:`RETRY_METRICS`) under ``scope``."""
+        ``metrics`` (default :data:`RETRY_METRICS`) under ``scope``.
+
+        ``deadline=`` (a :class:`pathway_tpu.serving.Deadline` or a
+        float budget in seconds) makes the policy budget-aware: a
+        backoff sleep that would overrun the remaining budget is never
+        taken — the last attempt's exception is raised immediately
+        instead, so the caller can still shed the request inside its
+        deadline rather than time out holding a queue slot."""
         if metrics is None:
             metrics = RETRY_METRICS
+        from ..serving.deadline import coerce_deadline
+
+        deadline = coerce_deadline(deadline)
         schedule = self.spawn()
         attempt = 0
         while True:
@@ -185,25 +196,36 @@ class RetryPolicy:
                 ):
                     metrics.record_failure(scope)
                     raise
+                wait = schedule.wait_duration_before_retry()
+                if deadline is not None and wait >= deadline.remaining():
+                    metrics.record_failure(scope)
+                    raise
                 metrics.record_retry(scope)
-                schedule.sleep_before_retry()
+                self._sleep(wait)
             else:
                 metrics.record_success(scope)
                 return result
 
-    def as_async_strategy(self, scope: str = "udf") -> "_AsyncPolicyAdapter":
+    def as_async_strategy(
+        self, scope: str = "udf", deadline: Any = None
+    ) -> "_AsyncPolicyAdapter":
         """Adapter with the ``AsyncRetryStrategy`` interface
         (``async invoke(fn, *args, **kwargs)``) so a shared policy can
-        be handed to ``udfs.async_executor`` / ``AsyncTransformer``."""
-        return _AsyncPolicyAdapter(self, scope)
+        be handed to ``udfs.async_executor`` / ``AsyncTransformer``.
+        ``deadline=`` carries the same budget-gating semantics as
+        :meth:`execute`."""
+        return _AsyncPolicyAdapter(self, scope, deadline=deadline)
 
 
 class _AsyncPolicyAdapter:
     """Duck-typed ``udfs.AsyncRetryStrategy`` backed by a RetryPolicy."""
 
-    def __init__(self, policy: RetryPolicy, scope: str) -> None:
+    def __init__(self, policy: RetryPolicy, scope: str, deadline: Any = None) -> None:
+        from ..serving.deadline import coerce_deadline
+
         self._policy = policy
         self._scope = scope
+        self._deadline = coerce_deadline(deadline)
 
     async def invoke(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
         import asyncio
@@ -219,8 +241,15 @@ class _AsyncPolicyAdapter:
                 if attempt > self._policy.max_retries:
                     RETRY_METRICS.record_failure(self._scope)
                     raise
+                wait = schedule.wait_duration_before_retry()
+                if (
+                    self._deadline is not None
+                    and wait >= self._deadline.remaining()
+                ):
+                    RETRY_METRICS.record_failure(self._scope)
+                    raise
                 RETRY_METRICS.record_retry(self._scope)
-                await asyncio.sleep(schedule.wait_duration_before_retry())
+                await asyncio.sleep(wait)
             else:
                 RETRY_METRICS.record_success(self._scope)
                 return result

@@ -1,0 +1,76 @@
+"""Overload-safe serving plane for the query path.
+
+``pathway_tpu.serving`` sits between the HTTP surfaces
+(``pw.io.http.rest_connector``, the LLM xpack REST servers) and the
+engine, and makes the query path robust under overload:
+
+- **per-request deadlines** (:mod:`.deadline`) propagated end-to-end:
+  client ``X-Pathway-Deadline-Ms`` header / server default → admission
+  → batch dispatch → response wait; a request that cannot meet its
+  remaining budget is rejected early with a typed 429/503;
+- **admission control** (:mod:`.admission`): bounded deadline-ordered
+  queue, token-bucket rate limiting, and an explicit shed policy
+  (``shed="reject"`` or ``"degrade"`` — degraded requests serve
+  reduced top-k instead of being dropped);
+- **adaptive batching** (:mod:`.batching`): in-flight queries coalesce
+  into fused engine dispatches sized by an EWMA of observed device
+  latency, with chip time partitioned between the ingest and query
+  streams;
+- **metrics** (:mod:`.metrics`): ``pathway_serving_*`` series on
+  ``/metrics`` (queue depth, shed counters, per-stage latency
+  histograms) and a ``serving`` block on ``/status``.
+
+Enable it per endpoint::
+
+    queries, writer = pw.io.http.rest_connector(
+        host="0.0.0.0", port=8080, schema=QuerySchema,
+        serving=pw.serving.ServingConfig(
+            max_queue=128, default_deadline_ms=250,
+            rate_limit_qps=500, shed="degrade",
+        ),
+    )
+
+See the README "Serving under load" section for the full knob list and
+the sustained-QPS benchmark (``qps_at_p99_budget``).
+"""
+
+from __future__ import annotations
+
+from .admission import (
+    AdmissionController,
+    DeadlineExceeded,
+    OverloadError,
+    QueueFull,
+    RateLimited,
+    ServingConfig,
+    Ticket,
+    TokenBucket,
+)
+from .batching import AdaptiveBatcher
+from .deadline import (
+    DEADLINE_HEADER,
+    Deadline,
+    bind_deadline,
+    coerce_deadline,
+    current_deadline,
+)
+from .metrics import SERVING_METRICS, ServingMetrics
+
+__all__ = [
+    "AdaptiveBatcher",
+    "AdmissionController",
+    "DEADLINE_HEADER",
+    "Deadline",
+    "DeadlineExceeded",
+    "OverloadError",
+    "QueueFull",
+    "RateLimited",
+    "SERVING_METRICS",
+    "ServingConfig",
+    "ServingMetrics",
+    "Ticket",
+    "TokenBucket",
+    "bind_deadline",
+    "coerce_deadline",
+    "current_deadline",
+]

@@ -1,0 +1,254 @@
+"""Continuous/adaptive batching of in-flight queries.
+
+Under load, dispatching each HTTP query as its own engine commit makes
+every query pay a full epoch round trip and starves the ingest stream
+of chip time. The :class:`AdaptiveBatcher` coalesces admitted queries
+into *fused* dispatches instead:
+
+- queries wait in a deadline-ordered pending heap for at most
+  ``batch_window_ms`` (a burst coalesces into one engine commit);
+- the batch size tracks observed device latency: an EWMA of per-item
+  dispatch time (blended with the engine's own epoch wall time via the
+  epoch-observer slot, see ``EngineGraph.epoch_observers``) sizes the
+  next batch so one fused dispatch fits inside
+  ``latency_budget_ms × query_share``;
+- ``query_share`` partitions chip time between the query stream and
+  the ingest stream: after each query dispatch the batcher yields the
+  remainder of the slot, so ingest epochs keep landing while queries
+  burst (``query_share=1.0`` disables the yield);
+- queries whose deadline expired while queued are *dropped*, not
+  dispatched — dead work never reaches the device.
+
+Chaos sites (``resilience/chaos.py`` rules target these):
+``serving.before_dispatch`` — a ``delay`` rule here is the
+slow-device injection; ``serving.batch_inflight`` — fires while a
+fused batch is logically on the device (a long ``delay`` is the
+stuck-batch injection); ``serving.admit`` (admission.py) — burst
+arrival shaping.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time as _time
+from typing import Any, Callable, Optional
+
+from .admission import ServingConfig
+from .deadline import Deadline
+from .metrics import SERVING_METRICS, ServingMetrics
+
+__all__ = ["AdaptiveBatcher"]
+
+#: EWMA smoothing for observed per-item dispatch latency.
+_ALPHA = 0.3
+#: Cap on the ingest-share yield after a dispatch, so a pathological
+#: latency spike cannot stall the query stream for seconds.
+_MAX_YIELD_S = 0.25
+
+
+class AdaptiveBatcher:
+    """Coalesces submitted items into fused ``dispatch(list)`` calls.
+
+    ``dispatch`` receives the items of one batch in deadline order and
+    runs on the batcher's worker thread (for the REST connector it
+    inserts every row into the engine session and commits once).
+    ``on_expired`` (optional) is called with items dropped because
+    their deadline passed while they were queued.
+    """
+
+    def __init__(
+        self,
+        dispatch: Callable[[list[Any]], None],
+        *,
+        config: ServingConfig | None = None,
+        metrics: ServingMetrics | None = None,
+        on_expired: Callable[[Any], None] | None = None,
+        name: str = "query",
+    ):
+        self.config = config or ServingConfig()
+        self.metrics = metrics if metrics is not None else SERVING_METRICS
+        self._dispatch = dispatch
+        self._on_expired = on_expired
+        self.name = name
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self._heap: list[tuple[float, int, Any, float]] = []
+        # (expires_at, seq, item, enqueued_at)
+        self._wake = threading.Event()
+        self._halt = False
+        self._thread: Optional[threading.Thread] = None
+        self._ewma_item_s = 0.0  # observed per-item dispatch latency
+        self._engine_epoch_s = 0.0  # EWMA of engine epoch wall (slot feed)
+        self.dispatched_total = 0
+        self.dropped_expired_total = 0
+        self.error: BaseException | None = None
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name=f"pathway_tpu:batcher:{self.name}"
+            )
+            self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._halt = True
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+
+    # -- producer side --
+
+    def submit(self, item: Any, deadline: Deadline | None = None) -> None:
+        """Queue one item for the next fused dispatch (starts the
+        worker on first use)."""
+        if deadline is None:
+            deadline = Deadline.none()
+        with self._lock:
+            heapq.heappush(
+                self._heap,
+                (deadline.expires_at, next(self._seq), item, _time.monotonic()),
+            )
+        self.start()
+        self._wake.set()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    # -- engine integration --
+
+    def attach_engine(self, engine) -> None:
+        """Register for the engine's query-dispatch slots: after every
+        executed epoch the engine reports its wall time, which (a)
+        feeds the device-latency EWMA that sizes batches and (b) wakes
+        the worker — an epoch boundary is a natural dispatch slot."""
+        observers = getattr(engine, "epoch_observers", None)
+        if observers is not None and self._on_epoch not in observers:
+            observers.append(self._on_epoch)
+
+    def _on_epoch(self, time: int, wall_s: float) -> None:
+        if wall_s > 0.0:
+            if self._engine_epoch_s == 0.0:
+                self._engine_epoch_s = wall_s
+            else:
+                self._engine_epoch_s = (
+                    1.0 - _ALPHA
+                ) * self._engine_epoch_s + _ALPHA * wall_s
+        self._wake.set()
+
+    # -- sizing --
+
+    def current_batch_size(self) -> int:
+        """Items per fused dispatch such that the batch fits inside the
+        query stream's share of the latency budget, per the observed
+        per-item EWMA. With no observations yet, the full ``batch_max``
+        (first batch calibrates the EWMA)."""
+        cfg = self.config
+        per_item = self._ewma_item_s
+        if per_item <= 0.0:
+            return cfg.batch_max
+        budget_s = (cfg.latency_budget_ms / 1000.0) * cfg.query_share
+        return max(1, min(cfg.batch_max, int(budget_s / per_item)))
+
+    # -- worker --
+
+    def _take_batch(self) -> tuple[list[Any], list[float]]:
+        """Pop up to current_batch_size() live items in deadline order;
+        expired items are dropped (never dispatched)."""
+        limit = self.current_batch_size()
+        now = _time.monotonic()
+        items: list[Any] = []
+        enqueued: list[float] = []
+        expired: list[Any] = []
+        with self._lock:
+            while self._heap and len(items) < limit:
+                expires_at, _seq, item, enq = heapq.heappop(self._heap)
+                if expires_at <= now:
+                    expired.append(item)
+                else:
+                    items.append(item)
+                    enqueued.append(enq)
+        for item in expired:
+            self.dropped_expired_total += 1
+            self.metrics.record_deadline_expired()
+            if self._on_expired is not None:
+                try:
+                    self._on_expired(item)
+                except Exception:
+                    pass
+        return items, enqueued
+
+    def _loop(self) -> None:
+        from ..internals import flight_recorder
+        from ..resilience import chaos as _chaos
+
+        cfg = self.config
+        window_s = max(0.0, cfg.batch_window_ms / 1000.0)
+        try:
+            while not self._halt:
+                if not self._wake.wait(timeout=0.05):
+                    continue
+                self._wake.clear()
+                if self._halt:
+                    break
+                # coalescing window: give a burst the chance to fuse
+                # into one dispatch (skip once a full batch is waiting)
+                if window_s > 0.0 and self.pending() < self.current_batch_size():
+                    _time.sleep(window_s)
+                while not self._halt:
+                    items, enqueued = self._take_batch()
+                    if not items:
+                        break
+                    now = _time.monotonic()
+                    for enq in enqueued:
+                        self.metrics.observe_stage("queue", now - enq)
+                    # slow-device chaos site: a delay rule here models a
+                    # device that stopped keeping up
+                    _chaos.inject("serving.before_dispatch")
+                    w0 = _time.monotonic()
+                    self._dispatch(items)
+                    # stuck-batch chaos site: the batch is logically in
+                    # flight on the device at this point
+                    _chaos.inject("serving.batch_inflight")
+                    wall = _time.monotonic() - w0
+                    per_item = wall / len(items)
+                    if self._ewma_item_s == 0.0:
+                        self._ewma_item_s = per_item
+                    else:
+                        self._ewma_item_s = (
+                            1.0 - _ALPHA
+                        ) * self._ewma_item_s + _ALPHA * per_item
+                    # the engine epoch EWMA (query-dispatch slots) pulls
+                    # the estimate toward actually-observed device time
+                    if self._engine_epoch_s > 0.0 and items:
+                        self._ewma_item_s = max(
+                            self._ewma_item_s,
+                            min(self._engine_epoch_s / len(items), self._ewma_item_s * 4),
+                        )
+                    self.dispatched_total += len(items)
+                    self.metrics.record_batch(len(items), self._ewma_item_s)
+                    self.metrics.observe_stage("dispatch", wall)
+                    flight_recorder.record(
+                        "serving.batch",
+                        name=self.name,
+                        size=len(items),
+                        wall_ms=round(wall * 1000.0, 3),
+                    )
+                    # chip-time partitioning: yield the ingest stream's
+                    # share of the slot before the next query dispatch
+                    if cfg.query_share < 1.0 and wall > 0.0:
+                        _time.sleep(
+                            min(wall * (1.0 / cfg.query_share - 1.0), _MAX_YIELD_S)
+                        )
+        except BaseException as exc:  # surfaced via .error by the endpoint
+            self.error = exc
+            flight_recorder.record(
+                "serving.batcher_error", name=self.name, error=repr(exc)
+            )

@@ -1,0 +1,137 @@
+"""Per-request deadlines for the serving plane.
+
+A :class:`Deadline` is an absolute point on the monotonic clock plus
+the budget it was created with. It is carried with a request from HTTP
+admission through batch dispatch to the response wait, so every layer
+asks the same object "how much time is left?" instead of each applying
+its own unrelated timeout (the old query path hardcoded 120 s at the
+response wait and nothing anywhere else).
+
+Clients set the budget with the ``X-Pathway-Deadline-Ms`` header; the
+server default comes from
+:class:`~pathway_tpu.serving.admission.ServingConfig.default_deadline_ms`.
+``Deadline.none()`` means "no budget" (``remaining()`` is ``inf``) so
+code never needs a ``None`` branch.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import math
+import time as _time
+from typing import Optional
+
+#: HTTP request header carrying the client's total budget in
+#: milliseconds. Parsed by ``rest_connector`` at admission.
+DEADLINE_HEADER = "X-Pathway-Deadline-Ms"
+
+
+class Deadline:
+    """Remaining-time budget anchored to the monotonic clock.
+
+    ``budget_ms=None`` builds an infinite deadline: ``remaining()``
+    returns ``inf`` and ``expired()`` is always False. ``start=``
+    (a ``time.monotonic()`` value) is injectable for tests.
+    """
+
+    __slots__ = ("budget_ms", "start")
+
+    def __init__(self, budget_ms: float | None, *, start: float | None = None):
+        if budget_ms is not None:
+            budget_ms = float(budget_ms)
+            if budget_ms < 0:
+                budget_ms = 0.0
+        self.budget_ms = budget_ms
+        self.start = _time.monotonic() if start is None else start
+
+    # -- constructors --
+
+    @classmethod
+    def after_ms(cls, budget_ms: float) -> "Deadline":
+        return cls(budget_ms)
+
+    @classmethod
+    def none(cls) -> "Deadline":
+        return cls(None)
+
+    @classmethod
+    def from_header(
+        cls, header_value: str | None, default_ms: float | None = None
+    ) -> "Deadline":
+        """Build the request deadline from the raw header value, falling
+        back to the server default. An unparsable header counts as
+        absent (the request is served, not rejected, on a bad header)."""
+        if header_value is not None:
+            try:
+                return cls(float(header_value))
+            except (TypeError, ValueError):
+                pass
+        return cls(default_ms)
+
+    # -- queries --
+
+    @property
+    def expires_at(self) -> float:
+        """Monotonic-clock expiry; ``inf`` for an unbounded deadline.
+        The admission queue and the batcher order requests by this."""
+        if self.budget_ms is None:
+            return math.inf
+        return self.start + self.budget_ms / 1000.0
+
+    def remaining(self) -> float:
+        """Seconds left; ``inf`` when unbounded, floored at 0.0."""
+        if self.budget_ms is None:
+            return math.inf
+        return max(0.0, self.expires_at - _time.monotonic())
+
+    def remaining_ms(self) -> float:
+        rem = self.remaining()
+        return rem if math.isinf(rem) else rem * 1000.0
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        if self.budget_ms is None:
+            return "Deadline(none)"
+        return f"Deadline({self.budget_ms:.0f}ms, remaining={self.remaining_ms():.0f}ms)"
+
+
+#: In-context propagation: the serving handler binds the request
+#: deadline here so same-thread/task callees (retry policies, xpack
+#: helpers) can pick it up without explicit threading.
+_CURRENT: contextvars.ContextVar[Optional[Deadline]] = contextvars.ContextVar(
+    "pathway_serving_deadline", default=None
+)
+
+
+def current_deadline() -> Deadline | None:
+    """The deadline bound to the current context, if any."""
+    return _CURRENT.get()
+
+
+class bind_deadline:
+    """``with bind_deadline(d): ...`` — scope a deadline to the current
+    context so :func:`current_deadline` (and the deadline-aware
+    RetryPolicy fallback) sees it."""
+
+    def __init__(self, deadline: Deadline | None):
+        self._deadline = deadline
+        self._token = None
+
+    def __enter__(self) -> Deadline | None:
+        self._token = _CURRENT.set(self._deadline)
+        return self._deadline
+
+    def __exit__(self, *exc) -> None:
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+            self._token = None
+
+
+def coerce_deadline(value) -> Deadline | None:
+    """Accept a :class:`Deadline`, a plain number of *seconds* from
+    now, or None — the shapes the retry layer takes."""
+    if value is None or isinstance(value, Deadline):
+        return value
+    return Deadline(float(value) * 1000.0)

@@ -519,6 +519,10 @@ class RAGClient:
         host/port or url: where the server listens (exactly one form).
         timeout: per-request seconds, default 90.
         additional_headers: sent with every request.
+        deadline_ms: per-request serving deadline propagated to the
+            server via the ``X-Pathway-Deadline-Ms`` header; servers
+            running with a ``ServingConfig`` shed the request with a
+            typed 503 once the budget is exhausted.
     """
 
     def __init__(
@@ -528,6 +532,7 @@ class RAGClient:
         url: str | None = None,
         timeout: int | None = 90,
         additional_headers: dict | None = None,
+        deadline_ms: float | None = None,
     ):
         from ._http import derive_url
         from .vector_store import VectorStoreClient
@@ -535,6 +540,10 @@ class RAGClient:
         self.url = derive_url(host, port, url)
         self.timeout = timeout
         self.additional_headers = additional_headers or {}
+        if deadline_ms is not None:
+            from ...serving import DEADLINE_HEADER
+
+            self.additional_headers.setdefault(DEADLINE_HEADER, str(deadline_ms))
         self.index_client = VectorStoreClient(
             url=self.url,
             timeout=self.timeout,

@@ -21,12 +21,19 @@ logger = logging.getLogger(__name__)
 
 
 class BaseRestServer:
-    def __init__(self, host: str, port: int, **rest_kwargs):
+    def __init__(self, host: str, port: int, serving=None, **rest_kwargs):
+        """``serving=`` (a :class:`pathway_tpu.serving.ServingConfig`)
+        puts every endpoint of this server behind the overload-safe
+        serving plane: admission control with a bounded deadline-ordered
+        queue, per-request deadlines (``X-Pathway-Deadline-Ms``), typed
+        429/503 load shedding, and adaptive query batching. Individual
+        ``serve()`` calls may override it per endpoint."""
         from ...io.http import PathwayWebserver
 
         self.host = host
         self.port = port
         self.webserver = PathwayWebserver(host=host, port=port)
+        self.serving = serving
         self.rest_kwargs = rest_kwargs
 
     def serve(
@@ -40,6 +47,7 @@ class BaseRestServer:
         """Wire one endpoint: requests → handler table → responses."""
         from ...io.http import rest_connector
 
+        additional_endpoint_kwargs.setdefault("serving", self.serving)
         queries, writer = rest_connector(
             webserver=self.webserver,
             route=route,

@@ -402,10 +402,14 @@ class VectorStoreServer:
         threaded: bool = False,
         with_cache: bool = True,
         cache_backend=None,
+        serving=None,  # pathway_tpu.serving.ServingConfig
         **kwargs,
     ):
         """Expose /v1/retrieve, /v1/statistics, /v1/inputs (reference
-        :478-585)."""
+        :478-585). ``serving=`` puts the query endpoint behind the
+        overload-safe serving plane (admission control, per-request
+        deadlines, adaptive batching; under ``shed="degrade"`` a loaded
+        server clamps retrieval top-``k`` instead of rejecting)."""
         from ...io.http import PathwayWebserver, rest_connector
 
         webserver = PathwayWebserver(host=host, port=port)
@@ -416,6 +420,7 @@ class VectorStoreServer:
             methods=["GET", "POST"],
             schema=self.RetrieveQuerySchema,
             delete_completed_queries=False,
+            serving=serving,
         )
         retrieval_writer(self.retrieve_query(retrieval_queries))
 
@@ -425,6 +430,7 @@ class VectorStoreServer:
             methods=["GET", "POST"],
             schema=self.StatisticsQuerySchema,
             delete_completed_queries=False,
+            serving=serving,
         )
         stats_writer(self.statistics_query(stats_queries))
 
@@ -434,6 +440,7 @@ class VectorStoreServer:
             methods=["GET", "POST"],
             schema=self.InputsQuerySchema,
             delete_completed_queries=False,
+            serving=serving,
         )
         inputs_writer(self.inputs_query(inputs_queries))
 

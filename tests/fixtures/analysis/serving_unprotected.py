@@ -1,0 +1,18 @@
+"""Analysis fixture: a REST query endpoint with no ``serving=`` config
+(no admission control, deadlines, or shed policy) in a run configured
+for sustained pressure (recovery + overlapped pipeline) — the verifier
+must flag PWL008 (warning). Monitoring is on, so PWL007 stays quiet."""
+
+import pathway_tpu as pw
+
+
+class QuerySchema(pw.Schema):
+    value: int
+
+
+queries, response_writer = pw.io.http.rest_connector(
+    host="127.0.0.1", port=0, schema=QuerySchema, delete_completed_queries=False
+)
+response_writer(queries.select(result=pw.this.value * 2))
+
+pw.run(recovery=True, monitoring_level="in_out", pipeline_depth=2)

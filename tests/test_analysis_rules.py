@@ -496,3 +496,55 @@ def test_pwl007_negative_without_run_context():
     # `pw.analysis.analyze()` before any pw.run: nothing recorded, no rule
     _null_sink()
     assert "PWL007" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL008
+
+
+class _RestQuerySchema(pw.Schema):
+    value: int
+
+
+def _rest_endpoint(serving=None):
+    queries, writer = pw.io.http.rest_connector(
+        host="127.0.0.1",
+        port=0,
+        schema=_RestQuerySchema,
+        delete_completed_queries=False,
+        serving=serving,
+    )
+    writer(queries.select(result=pw.this.value * 2))
+
+
+def test_pwl008_unprotected_endpoint_under_recovery(monkeypatch):
+    _rest_endpoint()
+    _describe_run(monkeypatch, recovery=True, monitoring_level="in_out")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL008"]
+    assert hits and hits[0].severity is Severity.WARNING
+    assert "overload" in hits[0].message
+
+
+def test_pwl008_unprotected_endpoint_under_pipelining(monkeypatch):
+    _rest_endpoint()
+    _describe_run(monkeypatch, pipeline_depth=2, monitoring_level="in_out")
+    assert "PWL008" in _rules(pw.analysis.analyze())
+
+
+def test_pwl008_negative_serving_config_silences(monkeypatch):
+    _rest_endpoint(serving=pw.ServingConfig(max_queue=8))
+    _describe_run(monkeypatch, recovery=True, monitoring_level="in_out")
+    assert "PWL008" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl008_negative_no_pressure(monkeypatch):
+    # plain single-depth run without recovery: an unprotected endpoint
+    # is fine for a dev loop, no warning
+    _rest_endpoint()
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL008" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl008_negative_no_endpoints(monkeypatch):
+    _null_sink()
+    _describe_run(monkeypatch, recovery=True, monitoring_level="in_out")
+    assert "PWL008" not in _rules(pw.analysis.analyze())

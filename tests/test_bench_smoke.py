@@ -160,3 +160,44 @@ def test_bench_smoke_flight_recorder_overhead(tmp_path, monkeypatch):
     # min-of-3 vs min-of-3 plus a small absolute epsilon so scheduler
     # noise on a loaded CI box cannot fail a microsecond-scale claim
     assert wall_on <= wall_off * 1.05 + 0.05, (wall_on, wall_off)
+
+
+def test_bench_smoke_serving_admission_overhead():
+    """At low load the admission path (deadline build + ticket ledger +
+    metrics + recorder event per request) costs <5% on top of the
+    service time itself — overload protection must be free when there
+    is no overload."""
+    from pathway_tpu.serving import AdmissionController, Deadline, ServingConfig
+    from pathway_tpu.serving.metrics import ServingMetrics
+
+    N = 200
+
+    def service():
+        time.sleep(0.0005)
+
+    def run_plain():
+        t0 = time.perf_counter()
+        for _ in range(N):
+            service()
+        return time.perf_counter() - t0
+
+    last_ctl = {}
+
+    def run_admitted():
+        ctl = AdmissionController(
+            ServingConfig(max_queue=64, default_deadline_ms=5000.0),
+            metrics=ServingMetrics(),
+        )
+        last_ctl["ctl"] = ctl
+        t0 = time.perf_counter()
+        for _ in range(N):
+            ticket = ctl.admit(Deadline(5000.0))
+            service()
+            ctl.release(ticket)
+        return time.perf_counter() - t0
+
+    wall_off = min(run_plain() for _ in range(3))
+    wall_on = min(run_admitted() for _ in range(3))
+    ctl = last_ctl["ctl"]
+    assert ctl.metrics.admitted_total == N and ctl.depth == 0
+    assert wall_on <= wall_off * 1.05 + 0.05, (wall_on, wall_off)

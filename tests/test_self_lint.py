@@ -118,3 +118,31 @@ def test_pwl007_json_carries_run_context():
     (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL007"]
     assert diag["severity"] == "warning"
     assert diag["detail"]["run_context"]["recovery"] == "True"
+
+
+def test_unprotected_serving_endpoint_warns_pwl008():
+    """rest_connector without serving= in a recovery/pipelined run: a
+    warning (exit 0), nonzero only under --strict-warnings. The CLI
+    sees the endpoint because rest_connector records it on the parse
+    graph (serving_endpoints) at build time."""
+    fixture = os.path.join(FIXTURES, "serving_unprotected.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL008" in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--strict-warnings")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl008_json_names_route_and_pressure():
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "serving_unprotected.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL008"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["endpoints"][0]["route"] == "/"
+    assert diag["detail"]["recovery"] is True
+    assert diag["detail"]["pipeline_depth"] == 2

@@ -1,0 +1,844 @@
+"""Overload-safe serving plane: admission control, per-request
+deadlines, adaptive batching, and graceful load shedding.
+
+Unit coverage for pathway_tpu.serving (Deadline, TokenBucket,
+AdmissionController, AdaptiveBatcher), the deadline-aware retry layer
+(resilience.retry, io/http/_retry), and end-to-end overload semantics
+through the REST connector: a burst against a chaos-slowed engine is
+shed with typed 429/503 responses while queue depth stays bounded, the
+serving plane does not change results (serving on == serving off), and
+admission/shed/deadline events land in the black-box flight recorder.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import flight_recorder as fr
+from pathway_tpu.resilience import chaos
+from pathway_tpu.serving import (
+    DEADLINE_HEADER,
+    AdaptiveBatcher,
+    AdmissionController,
+    Deadline,
+    DeadlineExceeded,
+    OverloadError,
+    QueueFull,
+    RateLimited,
+    SERVING_METRICS,
+    ServingConfig,
+    TokenBucket,
+    bind_deadline,
+    coerce_deadline,
+    current_deadline,
+)
+from pathway_tpu.serving.metrics import ServingMetrics
+
+
+@pytest.fixture(autouse=True)
+def _reset_serving_state():
+    SERVING_METRICS.reset()
+    yield
+    chaos.deactivate()
+    SERVING_METRICS.reset()
+
+
+# ------------------------------------------------------------- deadlines
+
+
+def test_deadline_budget_and_expiry():
+    d = Deadline(50.0, start=time.monotonic() - 1.0)  # issued 1s ago
+    assert d.expired()
+    assert d.remaining() == 0.0
+    live = Deadline(10_000.0)
+    assert not live.expired()
+    assert 0.0 < live.remaining() <= 10.0
+
+
+def test_deadline_none_is_unbounded():
+    d = Deadline.none()
+    assert not d.expired()
+    assert math.isinf(d.remaining())
+    assert math.isinf(d.expires_at)
+
+
+def test_deadline_from_header():
+    assert Deadline.from_header("250").budget_ms == 250.0
+    # bad header counts as absent: fall back to the server default
+    assert Deadline.from_header("not-a-number", 500.0).budget_ms == 500.0
+    assert Deadline.from_header(None, None).budget_ms is None
+
+
+def test_deadline_negative_budget_floors_to_zero():
+    assert Deadline(-5.0).budget_ms == 0.0
+    assert Deadline(-5.0).expired()
+
+
+def test_coerce_deadline_shapes():
+    d = Deadline(100.0)
+    assert coerce_deadline(d) is d
+    assert coerce_deadline(None) is None
+    coerced = coerce_deadline(1.5)  # seconds
+    assert coerced.budget_ms == 1500.0
+
+
+def test_bind_deadline_contextvar():
+    assert current_deadline() is None
+    d = Deadline(100.0)
+    with bind_deadline(d):
+        assert current_deadline() is d
+    assert current_deadline() is None
+
+
+# ----------------------------------------------------------- token bucket
+
+
+def test_token_bucket_burst_then_refill():
+    now = [0.0]
+    bucket = TokenBucket(qps=10.0, burst=2, clock=lambda: now[0])
+    assert bucket.try_acquire()
+    assert bucket.try_acquire()
+    assert not bucket.try_acquire()
+    assert bucket.retry_after() == pytest.approx(0.1, abs=0.02)
+    now[0] += 0.1  # one token refills at 10 qps
+    assert bucket.try_acquire()
+    assert not bucket.try_acquire()
+
+
+# ------------------------------------------------------------- admission
+
+
+def _controller(**cfg_kwargs):
+    metrics = ServingMetrics()
+    ctl = AdmissionController(
+        ServingConfig(**cfg_kwargs), metrics=metrics, route="/test"
+    )
+    return ctl, metrics
+
+
+def test_admission_admit_release_tracks_depth():
+    ctl, metrics = _controller(max_queue=4)
+    t1 = ctl.admit(Deadline(5000.0))
+    t2 = ctl.admit(Deadline(5000.0))
+    assert ctl.depth == 2
+    assert metrics.admitted_total == 2
+    ctl.release(t1)
+    ctl.release(t2)
+    assert ctl.depth == 0
+    assert metrics.queue_depth == 0
+
+
+def test_admission_queue_full_is_typed_503():
+    ctl, metrics = _controller(max_queue=2)
+    ctl.admit(Deadline(5000.0))
+    ctl.admit(Deadline(5000.0))
+    with pytest.raises(QueueFull) as ei:
+        ctl.admit(Deadline(5000.0))
+    assert ei.value.status == 503
+    body = ei.value.to_response()
+    assert body["reason"] == "queue_full"
+    assert metrics.shed_total["queue_full"] == 1
+    # shedding never grows the ledger
+    assert ctl.depth == 2
+
+
+def test_admission_rate_limited_is_typed_429():
+    ctl, metrics = _controller(rate_limit_qps=0.5, rate_limit_burst=1)
+    ctl.admit(Deadline(5000.0))
+    with pytest.raises(RateLimited) as ei:
+        ctl.admit(Deadline(5000.0))
+    assert ei.value.status == 429
+    assert ei.value.retry_after_s > 0.0
+    assert ei.value.to_response()["reason"] == "rate_limited"
+    assert metrics.shed_total["rate_limited"] == 1
+
+
+def test_admission_expired_deadline_rejected_early():
+    ctl, metrics = _controller()
+    with pytest.raises(DeadlineExceeded) as ei:
+        ctl.admit(Deadline(0.0))
+    assert ei.value.status == 503
+    assert ei.value.to_response()["reason"] == "deadline_exceeded"
+    assert metrics.deadline_expired_total == 1
+
+
+def test_admission_min_service_floor():
+    # a request with 10ms left cannot be answered when the endpoint
+    # needs at least 50ms: reject at the door, don't queue it to death
+    ctl, _ = _controller(min_service_ms=50.0)
+    with pytest.raises(DeadlineExceeded):
+        ctl.admit(Deadline(10.0))
+    ctl.admit(Deadline(5000.0))  # plenty of budget: admitted
+
+
+def test_admission_degrade_band_flags_tickets():
+    ctl, metrics = _controller(shed="degrade", max_queue=4, degrade_watermark=0.5)
+    t1 = ctl.admit(Deadline(5000.0))
+    t2 = ctl.admit(Deadline(5000.0))
+    assert not t1.degraded and not t2.degraded
+    t3 = ctl.admit(Deadline(5000.0))  # depth 2 >= 0.5*4: degraded service
+    assert t3.degraded
+    assert metrics.degraded_total == 1
+    t4 = ctl.admit(Deadline(5000.0))
+    assert t4.degraded
+    with pytest.raises(QueueFull):  # full queue still rejects
+        ctl.admit(Deadline(5000.0))
+
+
+def test_admission_next_expiry_orders_by_deadline():
+    ctl, _ = _controller()
+    late = ctl.admit(Deadline(60_000.0))
+    soon = ctl.admit(Deadline(1_000.0))
+    assert ctl.next_expiry() == pytest.approx(soon.deadline.expires_at, abs=1e-6)
+    ctl.release(soon)  # lazy deletion: heap pops stale entries
+    assert ctl.next_expiry() == pytest.approx(late.deadline.expires_at, abs=1e-6)
+    ctl.release(late)
+    assert ctl.next_expiry() is None
+
+
+def test_serving_config_validation():
+    with pytest.raises(ValueError):
+        ServingConfig(shed="explode")
+    with pytest.raises(ValueError):
+        ServingConfig(max_queue=0)
+    with pytest.raises(ValueError):
+        ServingConfig(query_share=0.0)
+
+
+# ------------------------------------------------------ adaptive batching
+
+
+def _idle_batcher(dispatch=lambda items: None, **cfg_kwargs):
+    """A batcher whose worker thread exits immediately, so the queue
+    and _take_batch can be driven synchronously from the test."""
+    b = AdaptiveBatcher(
+        dispatch, config=ServingConfig(**cfg_kwargs), metrics=ServingMetrics()
+    )
+    b._halt = True
+    return b
+
+
+def test_batcher_take_batch_deadline_order():
+    b = _idle_batcher(batch_max=8)
+    b.submit("late", Deadline(60_000.0))
+    b.submit("soon", Deadline(1_000.0))
+    b.submit("mid", Deadline(10_000.0))
+    items, _ = b._take_batch()
+    assert items == ["soon", "mid", "late"]
+
+
+def test_batcher_drops_expired_instead_of_dispatching():
+    expired_seen = []
+    b = _idle_batcher(batch_max=8)
+    b._on_expired = expired_seen.append
+    b.submit("dead", Deadline(0.0))
+    b.submit("live", Deadline(60_000.0))
+    items, _ = b._take_batch()
+    assert items == ["live"]
+    assert expired_seen == ["dead"]
+    assert b.dropped_expired_total == 1
+    assert b.metrics.deadline_expired_total == 1
+
+
+def test_batcher_size_tracks_ewma_latency():
+    b = _idle_batcher(batch_max=16, latency_budget_ms=100.0, query_share=0.5)
+    assert b.current_batch_size() == 16  # no observations: calibrate big
+    b._ewma_item_s = 0.01  # 10ms/item into a 50ms share: 5 items
+    assert b.current_batch_size() == 5
+    b._ewma_item_s = 1.0  # device is slow: floor at 1
+    assert b.current_batch_size() == 1
+
+
+def test_batcher_fuses_burst_into_few_dispatches():
+    calls: list[list] = []
+    done = threading.Event()
+
+    def dispatch(items):
+        calls.append(list(items))
+        if sum(len(c) for c in calls) >= 6:
+            done.set()
+
+    b = AdaptiveBatcher(
+        dispatch,
+        config=ServingConfig(batch_max=8, batch_window_ms=40.0, query_share=1.0),
+        metrics=ServingMetrics(),
+    )
+    try:
+        for i in range(6):
+            b.submit(i, Deadline(30_000.0))
+        assert done.wait(timeout=5.0)
+        assert sorted(x for c in calls for x in c) == list(range(6))
+        # the coalescing window fused the burst instead of 6 singletons
+        assert len(calls) <= 3
+        assert b.metrics.batches_total == len(calls)
+    finally:
+        b.stop()
+
+
+def test_batcher_engine_epoch_observer_feeds_ewma():
+    b = _idle_batcher()
+
+    class FakeEngine:
+        epoch_observers: list = []
+
+    eng = FakeEngine()
+    eng.epoch_observers = []
+    b.attach_engine(eng)
+    b.attach_engine(eng)  # idempotent
+    assert eng.epoch_observers == [b._on_epoch]
+    b._on_epoch(1, 0.08)
+    assert b._engine_epoch_s == pytest.approx(0.08)
+    b._on_epoch(2, 0.04)
+    assert 0.04 < b._engine_epoch_s < 0.08  # EWMA, not last-write
+
+
+def test_batcher_yields_chip_time_to_ingest():
+    """query_share partitions the slot: after a dispatch that took W
+    seconds, the batcher sleeps ~W*(1/share - 1) before the next one."""
+    starts: list[float] = []
+    done = threading.Event()
+
+    def dispatch(items):
+        starts.append(time.monotonic())
+        time.sleep(0.05)
+        if len(starts) >= 2:
+            done.set()
+
+    b = AdaptiveBatcher(
+        dispatch,
+        config=ServingConfig(batch_max=1, batch_window_ms=0.0, query_share=0.5),
+        metrics=ServingMetrics(),
+    )
+    try:
+        b.submit("a", Deadline(30_000.0))
+        b.submit("b", Deadline(30_000.0))
+        assert done.wait(timeout=5.0)
+        # dispatch wall ~50ms + ingest yield ~50ms between batch starts
+        assert starts[1] - starts[0] >= 0.08
+    finally:
+        b.stop()
+
+
+def test_batcher_error_is_surfaced_not_swallowed():
+    def dispatch(items):
+        raise RuntimeError("device fell over")
+
+    b = AdaptiveBatcher(dispatch, config=ServingConfig(), metrics=ServingMetrics())
+    try:
+        b.submit("x", Deadline(30_000.0))
+        deadline = time.monotonic() + 5.0
+        while b.error is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert isinstance(b.error, RuntimeError)
+    finally:
+        b.stop()
+
+
+# ------------------------------------------------- deadline-aware retries
+
+
+def test_retry_policy_never_sleeps_past_deadline():
+    sleeps: list[float] = []
+    policy = pw.RetryPolicy(
+        first_delay_ms=200, backoff_factor=2.0, jitter_ms=0, max_retries=5,
+        sleep=sleeps.append,
+    )
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("boom")
+
+    # 50ms of budget < 200ms first backoff: fail fast with the original
+    # exception instead of sleeping into a guaranteed timeout
+    with pytest.raises(ValueError):
+        policy.execute(fn, scope="test:deadline", deadline=0.05)
+    assert len(calls) == 1
+    assert sleeps == []
+
+
+def test_retry_policy_retries_inside_generous_budget():
+    sleeps: list[float] = []
+    policy = pw.RetryPolicy(
+        first_delay_ms=1, backoff_factor=1.0, jitter_ms=0, max_retries=2,
+        sleep=sleeps.append,
+    )
+    attempts = []
+
+    def fn():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    assert policy.execute(fn, scope="test:budget", deadline=10.0) == "ok"
+    assert len(attempts) == 3
+    assert len(sleeps) == 2
+
+
+def test_retry_policy_accepts_deadline_object():
+    policy = pw.RetryPolicy(
+        first_delay_ms=500, jitter_ms=0, max_retries=3, sleep=lambda s: None
+    )
+    with pytest.raises(ValueError):
+        policy.execute(
+            lambda: (_ for _ in ()).throw(ValueError("x")),
+            scope="test:obj",
+            deadline=Deadline(100.0),
+        )
+
+
+def test_async_retry_strategy_respects_deadline():
+    policy = pw.RetryPolicy(first_delay_ms=500, jitter_ms=0, max_retries=5)
+    strategy = policy.as_async_strategy(scope="test:async", deadline=0.05)
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        raise ValueError("boom")
+
+    t0 = time.monotonic()
+    with pytest.raises(ValueError):
+        asyncio.run(strategy.invoke(fn))
+    assert len(calls) == 1
+    assert time.monotonic() - t0 < 0.4  # never slept the 500ms backoff
+
+
+def test_request_runner_send_respects_deadline():
+    from pathway_tpu.io.http._retry import RequestRunner
+
+    class FailingSession:
+        def request(self, *a, **k):
+            raise ConnectionError("down")
+
+    sleeps: list[float] = []
+    runner = RequestRunner(
+        FailingSession(),
+        n_retries=5,
+        retry_policy_factory=lambda: pw.RetryPolicy(
+            first_delay_ms=300, jitter_ms=0
+        ),
+        sleep=sleeps.append,
+    )
+    with pytest.raises(ConnectionError):
+        runner.send("GET", "http://example.invalid/", deadline=0.05)
+    assert sleeps == []
+    assert runner.backoffs == []
+
+
+# ----------------------------------------------------- metrics rendering
+
+
+def test_serving_metrics_inactive_renders_nothing():
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+
+    assert not SERVING_METRICS.active()
+    # /metrics stays byte-identical for pipelines without serving
+    assert MonitoringHttpServer._serving_lines() == []
+
+
+def test_serving_metrics_prometheus_lines():
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+
+    SERVING_METRICS.record_admit()
+    SERVING_METRICS.record_admit(degraded=True)
+    SERVING_METRICS.record_shed("queue_full")
+    SERVING_METRICS.record_batch(4, 0.002)
+    SERVING_METRICS.observe_stage("dispatch", 0.008)
+    text = "\n".join(MonitoringHttpServer._serving_lines('worker="0"'))
+    assert 'pathway_serving_admitted_total{worker="0"} 2' in text
+    assert 'pathway_serving_degraded_total{worker="0"} 1' in text
+    assert 'pathway_serving_shed_total{reason="queue_full",worker="0"} 1' in text
+    assert 'pathway_serving_batch_size{worker="0"} 4' in text
+    assert 'pathway_serving_stage_seconds_bucket{stage="dispatch",le="0.01"' in text
+    assert 'pathway_serving_stage_seconds_count{stage="dispatch",worker="0"} 1' in text
+
+
+# --------------------------------------------------------- HTTP end-to-end
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(url: str, payload: dict, headers: dict | None = None, timeout: float = 15):
+    """POST json; returns (status, decoded_body) for 2xx and error
+    statuses alike (typed shed responses carry a JSON body)."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        body = exc.read().decode()
+        try:
+            return exc.code, json.loads(body)
+        except json.JSONDecodeError:
+            return exc.code, {"raw": body}
+
+
+class _QuerySchema(pw.Schema):
+    value: int
+
+
+def _run_rest_pipeline(client, serving=None, transform=None):
+    """Build a double-the-value REST pipeline and drive `client(port)`
+    against it on a thread while the engine runs (the client must
+    outlive its requests; the engine is stopped afterwards).
+    ``transform`` overrides the query table -> result table step."""
+    port = _free_port()
+    queries, response_writer = pw.io.http.rest_connector(
+        host="127.0.0.1",
+        port=port,
+        schema=_QuerySchema,
+        delete_completed_queries=False,
+        serving=serving,
+    )
+    if transform is None:
+        transform = lambda q: q.select(result=pw.this.value * 2)
+    response_writer(transform(queries))
+
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    runner = GraphRunner()
+    for table, sink in list(pw.parse_graph.outputs):
+        build = sink.get("build")
+        if build is not None:
+            build(runner, table)
+    for spec in list(pw.parse_graph.subscriptions):
+        runner.subscribe(
+            spec["table"],
+            on_change=spec.get("on_change"),
+            on_time_end=spec.get("on_time_end"),
+            on_end=spec.get("on_end"),
+        )
+
+    errors: list[BaseException] = []
+
+    def _client():
+        try:
+            # wait for the webserver to come up
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    status, _ = _post(
+                        f"http://127.0.0.1:{port}/", {"value": 0}, timeout=2
+                    )
+                    if status == 200:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            client(port)
+        except BaseException as exc:  # pragma: no cover - surfaced below
+            errors.append(exc)
+        finally:
+            runner.engine.stop()
+
+    t = threading.Thread(target=_client, daemon=True)
+    t.start()
+    runner.run()
+    t.join(timeout=60)
+    pw.clear_graph()
+    assert not errors, errors
+
+
+def test_serving_roundtrip_and_parity_with_unprotected_path():
+    """The serving plane is transparent at low load: the same queries
+    produce byte-identical responses with and without serving=."""
+    values = [1, 7, 21]
+
+    def collect(port):
+        return [
+            _post(f"http://127.0.0.1:{port}/", {"value": v}) for v in values
+        ]
+
+    results: dict[str, list] = {}
+
+    def client_on(port):
+        results["on"] = collect(port)
+
+    def client_off(port):
+        results["off"] = collect(port)
+
+    _run_rest_pipeline(
+        client_on,
+        serving=ServingConfig(max_queue=16, default_deadline_ms=30_000.0),
+    )
+    assert SERVING_METRICS.admitted_total >= len(values)
+    assert SERVING_METRICS.batches_total >= 1  # fused engine dispatches
+    _run_rest_pipeline(client_off, serving=None)
+
+    assert results["on"] == results["off"]
+    assert [s for s, _ in results["on"]] == [200] * len(values)
+    assert [b for _, b in results["on"]] == [2, 14, 42]
+
+
+@pytest.mark.chaos
+def test_overload_burst_sheds_typed_and_bounds_queue():
+    """Burst arrival against a chaos-slowed device: beyond max_queue the
+    endpoint sheds with typed 503 queue_full responses (never hangs,
+    never queues unboundedly), admitted requests still answer
+    correctly, and the endpoint recovers once the burst passes."""
+    seq_before = fr.RECORDER.events()[-1]["seq"] if fr.RECORDER.events() else 0
+    outcomes: list[tuple[int, dict, int]] = []
+
+    def client(port):
+        # slow-device injection: every fused dispatch stalls 250ms
+        chaos.activate(
+            {
+                "site": "serving.before_dispatch",
+                "action": "delay",
+                "delay_s": 0.25,
+                "repeat": True,
+            }
+        )
+        url = f"http://127.0.0.1:{port}/"
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            futs = [
+                pool.submit(_post, url, {"value": i}, None, 30) for i in range(16)
+            ]
+            for i, f in enumerate(futs):
+                status, body = f.result()
+                outcomes.append((status, body, i))
+        chaos.deactivate()
+        # the burst passed: the endpoint serves normally again
+        status, body = _post(url, {"value": 100})
+        outcomes.append((status, body, 100))
+
+    _run_rest_pipeline(
+        client,
+        serving=ServingConfig(
+            max_queue=4,
+            default_deadline_ms=20_000.0,
+            batch_max=8,
+            batch_window_ms=1.0,
+        ),
+    )
+
+    burst, recovered = outcomes[:-1], outcomes[-1]
+    ok = [(s, b, i) for s, b, i in burst if s == 200]
+    shed = [(s, b, i) for s, b, i in burst if s != 200]
+    assert ok, burst
+    assert shed, burst
+    for s, b, i in ok:
+        assert b == i * 2  # admitted requests answer correctly
+    for s, b, _ in shed:
+        assert s == 503
+        assert b["reason"] == "queue_full"  # typed, machine-actionable
+    # the bounded ledger never admitted more than max_queue at once
+    assert SERVING_METRICS.shed_total.get("queue_full", 0) == len(shed)
+    assert SERVING_METRICS.queue_depth <= 4
+    assert recovered[0] == 200 and recovered[1] == 200  # post-burst health
+
+    # admission/shed events are ringed for the black-box crash dump
+    kinds = {
+        e["kind"] for e in fr.RECORDER.events() if e["seq"] > seq_before
+    }
+    assert "serving.admit" in kinds
+    assert "serving.shed" in kinds
+    assert "serving.batch" in kinds
+
+
+@pytest.mark.chaos
+def test_deadline_expiry_mid_pipeline_is_typed_503():
+    """A request whose budget runs out while its batch is stalled gets
+    the typed deadline_exceeded 503, not the legacy opaque 504."""
+    outcomes = {}
+
+    def client(port):
+        chaos.activate(
+            {
+                "site": "serving.before_dispatch",
+                "action": "delay",
+                "delay_s": 0.6,
+                "repeat": True,
+            }
+        )
+        outcomes["expired"] = _post(
+            f"http://127.0.0.1:{port}/",
+            {"value": 3},
+            headers={DEADLINE_HEADER: "150"},
+        )
+        chaos.deactivate()
+        outcomes["served"] = _post(
+            f"http://127.0.0.1:{port}/",
+            {"value": 3},
+            headers={DEADLINE_HEADER: "15000"},
+        )
+
+    _run_rest_pipeline(
+        client,
+        serving=ServingConfig(max_queue=8, default_deadline_ms=20_000.0),
+    )
+    status, body = outcomes["expired"]
+    assert status == 503
+    assert body["reason"] == "deadline_exceeded"
+    status, body = outcomes["served"]
+    assert status == 200 and body == 6
+    assert SERVING_METRICS.deadline_expired_total >= 1
+
+
+def test_deadline_rejected_at_admission_when_budget_below_floor():
+    outcomes = {}
+
+    def client(port):
+        outcomes["rejected"] = _post(
+            f"http://127.0.0.1:{port}/",
+            {"value": 1},
+            headers={DEADLINE_HEADER: "5"},
+        )
+
+    _run_rest_pipeline(
+        client,
+        serving=ServingConfig(
+            max_queue=8, default_deadline_ms=20_000.0, min_service_ms=50.0
+        ),
+    )
+    status, body = outcomes["rejected"]
+    assert status == 503
+    assert body["reason"] == "deadline_exceeded"
+
+
+def test_deadline_header_honored_without_serving_config():
+    """Even an unconfigured endpoint propagates the client deadline:
+    expiry answers a typed 503 instead of the 120s-later 504."""
+    outcomes = {}
+
+    @pw.udf
+    def slow_double(v: int) -> int:
+        if v == 9:  # only the probe query is slow; the warm-up stays fast
+            time.sleep(0.8)
+        return v * 2
+
+    def client(port):
+        t0 = time.monotonic()
+        outcomes["expired"] = _post(
+            f"http://127.0.0.1:{port}/",
+            {"value": 9},
+            headers={DEADLINE_HEADER: "200"},
+        )
+        outcomes["elapsed"] = time.monotonic() - t0
+
+    _run_rest_pipeline(
+        client,
+        serving=None,
+        transform=lambda q: q.select(result=slow_double(pw.this.value)),
+    )
+    status, body = outcomes["expired"]
+    assert status == 503
+    assert body["reason"] == "deadline_exceeded"
+    assert outcomes["elapsed"] < 10.0  # nowhere near the 120s backstop
+
+
+def test_degrade_mode_clamps_fanout_instead_of_rejecting():
+    """shed="degrade": requests admitted above the watermark get reduced
+    top-k (and the X-Pathway-Degraded marker) instead of a 503."""
+    cfg = ServingConfig(shed="degrade", max_queue=4, degrade_watermark=0.0)
+    ctl = AdmissionController(cfg, metrics=ServingMetrics())
+    ticket = ctl.admit(Deadline(5000.0))
+    assert ticket.degraded  # watermark 0: degraded from the first request
+
+    outcomes = {}
+
+    def client(port):
+        outcomes["r"] = _post_raw(f"http://127.0.0.1:{port}/", {"value": 4})
+
+    def _post_raw(url, payload):
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return resp.status, json.loads(resp.read().decode()), dict(resp.headers)
+
+    _run_rest_pipeline(client, serving=cfg)
+    status, body, headers = outcomes["r"]
+    assert status == 200 and body == 8
+    assert headers.get("X-Pathway-Degraded") == "1"
+
+
+@pytest.mark.chaos
+def test_flight_recorder_dump_carries_serving_events(tmp_path):
+    """A crash dump from an overloaded process shows the admission
+    plane's story — `pathway blackbox show` renders the serving events."""
+    ctl = AdmissionController(ServingConfig(max_queue=1))
+    ticket = ctl.admit(Deadline(5000.0))
+    with pytest.raises(QueueFull):
+        ctl.admit(Deadline(5000.0))
+    with pytest.raises(DeadlineExceeded):
+        ctl.admit(Deadline(0.0))
+    ctl.release(ticket)
+
+    path = fr.RECORDER.dump("test-overload", directory=str(tmp_path))
+    assert path is not None
+    kinds = [e["kind"] for e in fr.load_dump(path)["events"]]
+    assert "serving.admit" in kinds
+    assert "serving.shed" in kinds
+    assert "serving.deadline_expired" in kinds
+
+    env = os.environ.copy()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", "blackbox", "show", path],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "serving.shed" in proc.stdout
+    assert "serving.deadline_expired" in proc.stdout
+
+
+def test_webserver_ephemeral_fallback_and_bound_ports():
+    """Two webservers asked for the same port must both come up: the
+    second falls back to an ephemeral port, and ``bound_serving_ports``
+    (what pw.run surfaces on ``RunResult.serving_http_ports``) reports
+    the ports that are actually bound, not the ones requested."""
+    from pathway_tpu.io.http._server import PathwayWebserver, bound_serving_ports
+
+    port = _free_port()
+    first = PathwayWebserver(host="127.0.0.1", port=port)
+    first.start()
+    assert first.port == port
+
+    second = PathwayWebserver(host="127.0.0.1", port=port)
+    second.start()
+    assert second._started.is_set()
+    assert second.port != port and second.port > 0
+
+    bound = bound_serving_ports()
+    assert first.port in bound and second.port in bound
+
+    # port=0 resolves to the kernel's pick the same way
+    third = PathwayWebserver(host="127.0.0.1", port=0)
+    third.start()
+    assert third.port > 0
+    assert third.port in bound_serving_ports()
