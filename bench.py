@@ -1003,12 +1003,260 @@ def suite_serving_qps() -> None:
     )
 
 
+CLUSTER_MTTR_PROGRAM = """
+import os, time
+import pathway_tpu as pw
+from pathway_tpu.io._connector import input_table_from_reader
+from pathway_tpu.internals import flight_recorder
+
+N = int(os.environ["CM_N"])
+NPROC = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+WORDS = ["cat", "dog", "bird"]
+
+class S(pw.Schema):
+    word: str
+
+def reader(ctx):
+    start = int(ctx.offsets.get("pos", 0))
+    for i in range(N):
+        if i % NPROC != ctx.process_id:
+            continue
+        if i < start:
+            continue
+        ctx.insert({"word": WORDS[i % 3]}, offsets={"pos": i + 1})
+        ctx.commit()
+        time.sleep(0.02)
+
+t = input_table_from_reader(
+    S, reader, name="cm_src", parallel_readers=True,
+    persistent_id="cm", supports_offsets=True,
+    autocommit_duration_ms=50,
+)
+c = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+pw.io.jsonlines.write(c, os.environ["CM_OUT"] + "." + os.environ.get("PATHWAY_PROCESS_ID", "0"))
+pw.run(
+    monitoring_level="none",
+    persistence_config=pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(os.environ["CM_STORE"]),
+        snapshot_interval_ms=200,
+    ),
+)
+if int(os.environ.get("PATHWAY_PROCESS_ID", "0")) == 0:
+    # the coordinator process survives the partial restart, so its ring
+    # holds the whole story: delivered epochs, the lease expiry, the
+    # partial restart, and the post-restart delivered epochs
+    flight_recorder.dump("bench.end")
+"""
+
+
+def suite_cluster_mttr() -> None:
+    """Cluster fault-domain suite. Two segments:
+
+    - **degraded serving** (in-process): one shard marked down in
+      CLUSTER_HEALTH; shed mode keeps answering every healthy-shard
+      query and sheds the down shard's with a typed 503, degrade mode
+      converts them to degraded tickets instead.
+    - **detection latency + MTTR** (2-process cluster): a chaos
+      partition rule silences worker 1's side of the cluster channel
+      mid-run; the coordinator's lease expires, it runs a partial
+      restart, and the worker rejoins once the partition heals.
+      detection = lease expiry minus the last pre-failure delivered
+      epoch; MTTR = first post-restart delivered epoch minus the lease
+      expiry. Both read from the coordinator's flight-recorder ring.
+    """
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    from pathway_tpu.resilience.cluster import CLUSTER_HEALTH
+    from pathway_tpu.serving import (
+        AdmissionController,
+        ServingConfig,
+        ShardUnavailable,
+    )
+    from pathway_tpu.serving.metrics import ServingMetrics
+
+    # -- segment 1: shed-mode serving keeps answering healthy shards --
+    CLUSTER_HEALTH.mark_down([1], retry_after_s=1.5)
+    try:
+        ctl = AdmissionController(
+            ServingConfig(max_queue=256), metrics=ServingMetrics()
+        )
+        healthy = down_shed = 0
+        for i in range(200):
+            try:
+                ticket = ctl.admit(shard=i % 2)
+                ctl.release(ticket)
+                healthy += 1
+            except ShardUnavailable:
+                down_shed += 1
+        dctl = AdmissionController(
+            ServingConfig(max_queue=256, shed="degrade"),
+            metrics=ServingMetrics(),
+        )
+        degraded = 0
+        for _ in range(100):
+            ticket = dctl.admit(shard=1)
+            degraded += int(ticket.degraded)
+            dctl.release(ticket)
+    finally:
+        CLUSTER_HEALTH.mark_all_up()
+    _emit(
+        "cluster_degraded_serving",
+        healthy,
+        "queries",
+        offered=200,
+        healthy_shard_answered=healthy,
+        down_shard_shed=down_shed,
+        degrade_mode_degraded=degraded,
+        mode="shard 1 down: shed mode answers every healthy-shard query "
+        "and 503s the down shard's; degrade mode serves them degraded",
+    )
+
+    # -- segment 2: partition -> lease expiry -> partial restart --
+    tmp = tempfile.mkdtemp(prefix="pathway-bench-mttr-")
+    try:
+        prog = os.path.join(tmp, "cm.py")
+        with open(prog, "w") as f:
+            f.write(CLUSTER_MTTR_PROGRAM)
+        import socket as _socket
+
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        lease_ms = 1200.0
+        # worker 1 goes silent on its 25th cluster-channel send: replies
+        # AND heartbeats dropped, for longer than one lease — a partition
+        # shorter than the lease is sub-lease message loss, which TCP
+        # excludes and the lease cannot see. generation=0 keeps the rule
+        # from re-arming after the regroup bumps the generation.
+        chaos_spec = json.dumps(
+            {
+                "site": "cluster.send",
+                "action": "partition",
+                "process": 1,
+                "hit": 25,
+                "duration_s": 2.5,
+                "generation": 0,
+            }
+        )
+        procs = []
+        for pid in range(2):
+            env = dict(os.environ)
+            env.pop("PATHWAY_CHAOS", None)
+            env.update(
+                CM_N="240",
+                CM_OUT=os.path.join(tmp, "out.jsonl"),
+                CM_STORE=os.path.join(tmp, "store"),
+                JAX_PLATFORMS="cpu",
+                PATHWAY_THREADS="1",
+                PATHWAY_PROCESSES="2",
+                PATHWAY_PROCESS_ID=str(pid),
+                PATHWAY_FIRST_PORT=str(port),
+                PATHWAY_CLUSTER_TOKEN="bench-mttr",
+                PATHWAY_CLUSTER_LEASE_MS=str(lease_ms),
+                PATHWAY_CLUSTER_RESPAWN="0",
+                # the partition outlives the first re-formation, so a
+                # second regroup is expected; leave headroom
+                PATHWAY_CLUSTER_PARTIAL_RESTARTS="5",
+                PATHWAY_CHAOS=chaos_spec,
+                PATHWAY_FLIGHT_RECORDER_DIR=os.path.join(tmp, "blackbox"),
+                # the ring must hold the whole run: the default 512
+                # events get evicted by post-restart epochs before the
+                # bench.end dump is written
+                PATHWAY_FLIGHT_RECORDER_SIZE="16384",
+                PYTHONPATH=os.path.dirname(os.path.abspath(__file__))
+                + os.pathsep
+                + env.get("PYTHONPATH", ""),
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, prog],
+                    env=env,
+                    cwd=tmp,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+            )
+        errs = []
+        for p in procs:
+            try:
+                _, err = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                _, err = p.communicate()
+            errs.append((p.returncode, (err or "")[-2000:]))
+        if any(rc != 0 for rc, _ in errs):
+            raise RuntimeError(f"cluster run failed: {errs}")
+
+        from pathway_tpu.internals import flight_recorder as fr
+
+        dump_dir = os.path.join(tmp, "blackbox")
+        final = None
+        for path in fr.list_dumps(dump_dir):
+            data = fr.load_dump(path)
+            if data.get("reason") == "bench.end":
+                final = data
+        if final is None:
+            raise RuntimeError(
+                f"no bench.end dump in {sorted(os.listdir(dump_dir))}"
+            )
+        events = final["events"]
+        delivered = [e["time"] for e in events if e["kind"] == "epoch.delivered"]
+        expiries = [
+            e["time"] for e in events if e["kind"] == "cluster.lease_expired"
+        ]
+        restarts = [
+            e["time"] for e in events if e["kind"] == "cluster.partial_restart"
+        ]
+        if not (expiries and restarts):
+            raise RuntimeError(
+                f"no lease expiry / partial restart in the ring: "
+                f"{sorted({e['kind'] for e in events})}"
+            )
+        detect_at, restart_at = expiries[0], restarts[0]
+        before = [t for t in delivered if t < detect_at]
+        after = [t for t in delivered if t > restart_at]
+        if not (before and after):
+            raise RuntimeError(
+                f"delivered epochs do not bracket the failure "
+                f"(before={len(before)}, after={len(after)})"
+            )
+        detection_ms = (detect_at - before[-1]) * 1e3
+        mttr_ms = (after[0] - detect_at) * 1e3
+        _emit(
+            "cluster_detection_latency_ms",
+            detection_ms,
+            "ms",
+            lease_ms=lease_ms,
+            note="lease expiry minus the last delivered epoch before it; "
+            "bounded by the lease plus one epoch",
+        )
+        _emit(
+            "cluster_mttr_ms",
+            mttr_ms,
+            "ms",
+            partial_restarts=len(restarts),
+            delivered_before=len(before),
+            delivered_after=len(after),
+            note="first delivered epoch after the partial restart minus "
+            "the lease expiry: regroup + re-formation + snapshot replay",
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_suite() -> None:
     import traceback
 
     for fn in (
         suite_etl,
         suite_serving_qps,
+        suite_cluster_mttr,
         suite_knn_10k,
         suite_vector_store_ingest,
         suite_adaptive_rag_p50,

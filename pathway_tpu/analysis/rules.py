@@ -27,6 +27,10 @@ PWL008 (warning) serving endpoint without overload protection in a run
                  configured for resilience/throughput (recovery or
                  pipeline_depth>1): no admission control, deadlines or
                  load shedding on the query path.
+PWL009 (warning) multi-worker run without a cluster fault domain:
+                 recovery off (one worker crash kills the whole run) or
+                 heartbeats disabled (cluster_lease_ms=0: a hung or
+                 partitioned worker stalls every epoch forever).
 """
 
 from __future__ import annotations
@@ -69,6 +73,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL006": (Severity.INFO, "unconnected table / engine node"),
     "PWL007": (Severity.WARNING, "recovery enabled with monitoring fully off"),
     "PWL008": (Severity.WARNING, "serving endpoint without overload protection"),
+    "PWL009": (Severity.WARNING, "multi-worker run without a cluster fault domain"),
 }
 
 _MUTABLE_TYPES = (list, dict, set, bytearray)
@@ -752,6 +757,55 @@ def check_serving_overload(view: GraphView) -> list[Diagnostic]:
     ]
 
 
+# --------------------------------------------------------------------------
+# PWL009 — multi-worker run without a cluster fault domain
+
+
+def check_cluster_fault_domain(view: GraphView) -> list[Diagnostic]:
+    """A sharded/multiprocess run (``PATHWAY_PROCESSES``/``THREADS``
+    give world > 1) whose cluster fault domain is hollowed out: with
+    ``recovery=`` off a single worker crash fails the entire run (no
+    supervisor to catch the escalation, no partial restart to contain
+    it); with ``cluster_lease_ms=0`` heartbeats are disabled, so a hung
+    or network-partitioned worker never expires its lease and every
+    surviving worker blocks in the epoch barrier forever. The run
+    configuration is recorded on the parse graph by ``pw.run``
+    (``run_context``) before the analyze-only return."""
+    ctx = getattr(view.graph, "run_context", None)
+    if not ctx:
+        return []
+    world = int(ctx.get("processes") or 1) * int(ctx.get("threads") or 1)
+    if world <= 1:
+        return []
+    out: list[Diagnostic] = []
+    if not ctx.get("recovery"):
+        out.append(
+            _diag(
+                "PWL009",
+                f"multi-worker run (world={world}) without recovery=: one "
+                "worker crash fails the whole run — partial restart "
+                "(respawn just the dead worker, survivors resume from the "
+                "last snapshot barrier) only engages under "
+                "pw.run(recovery=...)",
+                detail={"world": world, "recovery": False},
+            )
+        )
+    lease = ctx.get("cluster_lease_ms")
+    if lease is not None and float(lease) <= 0:
+        out.append(
+            _diag(
+                "PWL009",
+                f"multi-worker run (world={world}) with heartbeats disabled "
+                "(cluster_lease_ms=0): a hung or partitioned worker never "
+                "expires its lease, so the surviving workers stall in the "
+                "epoch barrier forever — set a finite lease "
+                "(pw.run(cluster_lease_ms=...) or PATHWAY_CLUSTER_LEASE_MS)",
+                detail={"world": world, "cluster_lease_ms": float(lease)},
+            )
+        )
+    return out
+
+
 LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dtype_consistency,
     check_unbounded_state,
@@ -761,4 +815,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_unconnected,
     check_recovery_observability,
     check_serving_overload,
+    check_cluster_fault_domain,
 ]

@@ -2405,8 +2405,11 @@ class EngineGraph:
                 t = max(scripted_t, last_time + 1)
             t = max(t, last_time + 1) if t <= last_time else t
             self.current_time = t
+            _epoch_kw = {"t": int(t), "worker": self.worker_id}
+            if self.cluster_generation():
+                _epoch_kw["generation"] = self.cluster_generation()
             flight_recorder.record(
-                "epoch.begin", t=int(t), worker=self.worker_id, batches=len(session_batches)
+                "epoch.begin", batches=len(session_batches), **_epoch_kw
             )
             self._frontier_hooks(t)
             for s in self.static_sources:
@@ -2449,7 +2452,7 @@ class EngineGraph:
                 if session_batches:
                     self._maybe_snapshot_operators(t)
             last_time = t
-            flight_recorder.record("epoch.advance", t=int(t), worker=self.worker_id)
+            flight_recorder.record("epoch.advance", **_epoch_kw)
             if monitoring_callback is not None:
                 monitoring_callback(self)
 
@@ -2497,6 +2500,13 @@ class EngineGraph:
         if self._threads_started:
             for t in self.connector_threads:
                 t.join(timeout=5.0)
+
+    def cluster_generation(self) -> int:
+        """The cluster fault-domain generation this engine runs under
+        (0 outside a multiprocess cluster / before any partial restart).
+        Monitoring stamps it on /status and epoch telemetry so dumps
+        from different generations of the same run are tellable apart."""
+        return int(getattr(self.cluster, "generation", 0) or 0)
 
     def _raise_connector_failure(self) -> None:
         if self.connector_failures:

@@ -757,6 +757,7 @@ class EnginePersistence:
 
     OPS_SOURCE = "__operators__"
     DELIVERED_SOURCE = "__delivered__"
+    CLUSTER_SOURCE = "__cluster__"
 
     def mark_delivered(self, time: int) -> None:
         """Process 0 only: durably record that sinks flushed epoch
@@ -793,6 +794,36 @@ class EnginePersistence:
         finally:
             reader.close()
         return frontier
+
+    def cluster_generation(self) -> int:
+        """Current cluster epoch-generation token (0 when none was ever
+        written). Read from the process-0 namespace so every worker
+        sees the coordinator's bumps; the coordinator stamps it into
+        hellos/welcomes and rejects protocol frames carrying an older
+        one (zombie fencing after a partial restart)."""
+        reader = self._open_reader_base(self.CLUSTER_SOURCE)
+        if reader is None:
+            return 0
+        gen = 0
+        try:
+            for kind, time, _key, _blob in reader:
+                if kind == KIND_ADVANCE:
+                    gen = max(gen, int(time))
+        finally:
+            reader.close()
+        return gen
+
+    def bump_cluster_generation(self) -> int:
+        """Coordinator only: durably advance the generation at the start
+        of a partial restart. A single-record log, like the compacted
+        delivered marker — only the latest token matters."""
+        gen = self.cluster_generation() + 1
+        self._writers.pop(self.CLUSTER_SOURCE, None)
+        self._replace_single_record(
+            self.CLUSTER_SOURCE, (KIND_ADVANCE, gen, 0, b"")
+        )
+        flight_recorder.record("cluster.generation", generation=gen)
+        return gen
 
     def _open_reader_base(self, source_id: str):
         """Open a source log in the PROCESS-0 namespace regardless of

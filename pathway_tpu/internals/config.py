@@ -121,6 +121,45 @@ class PathwayConfig:
         except ValueError:
             return None
 
+    @property
+    def cluster_lease_ms(self) -> float:
+        """Worker lease in milliseconds (PATHWAY_CLUSTER_LEASE_MS,
+        default 30000): both sides of the cluster channel heartbeat at
+        lease/3 and treat a socket silent for a whole lease as a lost
+        peer. 0 disables leases (legacy blocking protocol)."""
+        v = os.environ.get("PATHWAY_CLUSTER_LEASE_MS")
+        if not v:
+            return 30000.0
+        try:
+            return max(0.0, float(v))
+        except ValueError:
+            return 30000.0
+
+    @property
+    def cluster_partial_restarts(self) -> int:
+        """Partial-restart budget per run (PATHWAY_CLUSTER_PARTIAL_RESTARTS,
+        default 3): how many cluster regroups internals/run.py performs
+        before the failure escalates to the full-restart supervisor."""
+        return max(0, _env_int("PATHWAY_CLUSTER_PARTIAL_RESTARTS", 3))
+
+    @property
+    def cluster_respawn(self) -> bool:
+        """Whether the coordinator respawns dead workers itself
+        (PATHWAY_CLUSTER_RESPAWN, default on). Off, it only regroups
+        with the survivors rejoining — for launchers (or tests) that own
+        worker process lifecycles."""
+        v = os.environ.get("PATHWAY_CLUSTER_RESPAWN")
+        if v is None or v == "":
+            return True
+        return v.lower() not in ("0", "false", "off", "no")
+
+    @property
+    def flight_recorder_keep(self) -> int:
+        """Black-box dump retention (PATHWAY_FLIGHT_RECORDER_KEEP):
+        keep only the N newest blackbox-*.json files in the dump
+        directory after each dump. 0 (default) keeps everything."""
+        return max(0, _env_int("PATHWAY_FLIGHT_RECORDER_KEEP", 0))
+
 
 def get_pathway_config() -> PathwayConfig:
     cfg = PathwayConfig()

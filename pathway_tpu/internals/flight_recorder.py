@@ -155,9 +155,35 @@ class FlightRecorder:
                 f.write("\n")
             os.replace(tmp, path)
             self._dumped_paths.append(path)
+            self._prune(directory)
             return path
         except Exception:
             return None
+
+    @staticmethod
+    def _prune(directory: str) -> None:
+        """Retention (PATHWAY_FLIGHT_RECORDER_KEEP=N): after a dump,
+        delete all but the N newest blackbox files in the directory.
+        A chaos-heavy soak can otherwise write one dump per kill and
+        fill the disk. 0 (default) keeps everything."""
+        keep = max(0, _env_int("PATHWAY_FLIGHT_RECORDER_KEEP", 0))
+        if not keep:
+            return
+
+        def _age(path: str) -> tuple[float, str]:
+            # dumps in the same second get -1/-2 suffixes that sort
+            # lexically BEFORE the unsuffixed name; mtime is the real
+            # creation order
+            try:
+                return (os.path.getmtime(path), path)
+            except OSError:
+                return (0.0, path)
+
+        for stale in sorted(list_dumps(directory), key=_age)[:-keep]:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass  # racing processes pruning the same dir is fine
 
 
 RECORDER = FlightRecorder()

@@ -258,11 +258,15 @@ class GraphRunner:
         monitoring_callback=None,
         accept_timeout: float | None = None,
         hello_timeout: float | None = None,
+        lease_ms: float | None = None,
+        fence: dict[int, int] | None = None,
     ) -> None:
         """Process 0 of a PATHWAY_PROCESSES cluster: local shards
         [0, T), sources/sinks/persistence + the worker protocol.
         ``accept_timeout``/``hello_timeout`` bound cluster formation
-        (None = CoordinatorCluster defaults / env)."""
+        (None = CoordinatorCluster defaults / env); ``lease_ms``
+        configures worker-loss detection and ``fence`` maps respawned
+        worker pids to the minimum generation their hello must carry."""
         from ..parallel.multiprocess import CoordinatorCluster
 
         kwargs = {}
@@ -270,14 +274,25 @@ class GraphRunner:
             kwargs["accept_timeout"] = accept_timeout
         if hello_timeout is not None:
             kwargs["hello_timeout"] = hello_timeout
+        if lease_ms is not None:
+            kwargs["lease_ms"] = lease_ms
+        if fence:
+            kwargs["fence"] = fence
         self._cluster = CoordinatorCluster(
             self._cluster_engines(), processes=processes, first_port=first_port, **kwargs
         )
         self._cluster.run(monitoring_callback)
 
-    def run_worker(self, processes: int, first_port: int, process_id: int) -> None:
+    def run_worker(
+        self,
+        processes: int,
+        first_port: int,
+        process_id: int,
+        lease_ms: float | None = None,
+    ) -> None:
         """Process p > 0: serve bulk-synchronous rounds for global
-        shards [p*T, (p+1)*T)."""
+        shards [p*T, (p+1)*T). ``lease_ms`` is the fallback lease when
+        the coordinator's welcome does not carry one."""
         from ..parallel import multiprocess as mp
         from ..parallel.sharded import ShardCluster
 
@@ -287,7 +302,7 @@ class GraphRunner:
             base=process_id * threads,
             world=processes * threads,
         )
-        mp.run_worker(cluster, first_port, process_id)
+        mp.run_worker(cluster, first_port, process_id, lease_ms=lease_ms)
 
     # ---------- lowering ----------
 

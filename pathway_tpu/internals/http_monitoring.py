@@ -184,6 +184,7 @@ class MonitoringHttpServer:
         if workers:
             lines.extend(self._worker_lines(workers))
         lines.extend(self._resilience_lines(wl))
+        lines.extend(self._cluster_lines(wl))
         lines.extend(self._serving_lines(wl))
         return "\n".join(lines) + "\n"
 
@@ -263,6 +264,61 @@ class MonitoringHttpServer:
             lines.append(
                 series("pathway_supervisor_escalations_total", sup["escalations"])
             )
+        return lines
+
+    @staticmethod
+    def _cluster_lines(wl: str = "") -> list[str]:
+        """Cluster fault-domain counters (``pathway_cluster_*``): lease
+        expiries, partial restarts, fenced writes, snapshot barriers and
+        the current cluster generation. Rendered only once the fault
+        domain has seen an event (or a shard is marked down), so
+        single-process ``/metrics`` output stays byte-identical."""
+        from ..resilience import CLUSTER_HEALTH, CLUSTER_METRICS
+
+        if not (CLUSTER_METRICS.active() or CLUSTER_HEALTH.any_down()):
+            return []
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+
+        snap = CLUSTER_METRICS.snapshot()
+        lines = ["# TYPE pathway_cluster_lease_expiries_total counter"]
+        for pid in sorted(snap["lease_expiries"]):
+            lines.append(
+                series(
+                    "pathway_cluster_lease_expiries_total",
+                    snap["lease_expiries"][pid],
+                    f'process="{_escape_label(pid)}"',
+                )
+            )
+        lines.extend(
+            [
+                "# TYPE pathway_cluster_partial_restarts_total counter",
+                series(
+                    "pathway_cluster_partial_restarts_total",
+                    snap["partial_restarts_total"],
+                ),
+                "# TYPE pathway_cluster_fenced_writes_total counter",
+                series(
+                    "pathway_cluster_fenced_writes_total",
+                    snap["fenced_writes_total"],
+                ),
+                "# TYPE pathway_cluster_barriers_total counter",
+                series("pathway_cluster_barriers_total", snap["barriers_total"]),
+                "# TYPE pathway_cluster_generation gauge",
+                series("pathway_cluster_generation", snap["generation"]),
+            ]
+        )
+        down = CLUSTER_HEALTH.down_shards()
+        if down:
+            lines.append("# TYPE pathway_cluster_shard_down gauge")
+            for shard in sorted(down):
+                lines.append(
+                    series(
+                        "pathway_cluster_shard_down", 1, f'shard="{int(shard)}"'
+                    )
+                )
         return lines
 
     @staticmethod
@@ -383,6 +439,12 @@ class MonitoringHttpServer:
         workers = getattr(snap, "workers", {}) or {}
         if workers:
             status["workers"] = {str(wid): workers[wid] for wid in sorted(workers)}
+        from ..resilience import CLUSTER_HEALTH, CLUSTER_METRICS
+
+        if CLUSTER_METRICS.active() or CLUSTER_HEALTH.any_down():
+            cluster = CLUSTER_METRICS.snapshot()
+            cluster["down_shards"] = sorted(CLUSTER_HEALTH.down_shards())
+            status["cluster"] = cluster
         from ..serving import SERVING_METRICS
 
         if SERVING_METRICS.active():

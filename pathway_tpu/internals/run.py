@@ -76,6 +76,8 @@ def run(
     pipeline_depth: int | None = None,
     cluster_accept_timeout: float | None = None,
     cluster_hello_timeout: float | None = None,
+    cluster_lease_ms: float | None = None,
+    cluster_partial_restarts: int | None = None,
     **kwargs: Any,
 ) -> RunResult | None:
     """Execute all registered outputs/subscriptions to completion
@@ -103,6 +105,19 @@ def run(
     10 s; also settable via PATHWAY_CLUSTER_ACCEPT_TIMEOUT /
     PATHWAY_CLUSTER_HELLO_TIMEOUT).
 
+    ``cluster_lease_ms`` (default 30000, also PATHWAY_CLUSTER_LEASE_MS;
+    0 disables): the cluster fault-domain lease. Coordinator and
+    workers heartbeat at lease/3 over the authenticated protocol
+    channel; a peer silent for a whole lease is declared lost. With
+    persistence configured, a lost worker triggers a *partial restart*:
+    the survivors quiesce at the last coordinated snapshot barrier,
+    only the dead process is respawned (fenced against zombies by a
+    durable generation token), and the run continues —
+    ``cluster_partial_restarts`` (default 3, also
+    PATHWAY_CLUSTER_PARTIAL_RESTARTS) bounds how many before the
+    failure escalates to the full-restart supervisor. See README
+    "Cluster fault domains".
+
     ``pipeline_depth``: overlapped host/device epoch pipeline (also
     PATHWAY_PIPELINE_DEPTH). 1 (default) keeps today's strict serial
     epoch loop; ``>= 2`` stages epoch N+1 on the host — connector
@@ -125,12 +140,33 @@ def run(
         )
     except ValueError:
         _depth_ctx = 1
+    try:
+        _procs_ctx = int(os.environ.get("PATHWAY_PROCESSES") or 1)
+    except ValueError:
+        _procs_ctx = 1
+    try:
+        _threads_ctx = int(os.environ.get("PATHWAY_THREADS") or 1)
+    except ValueError:
+        _threads_ctx = 1
+    try:
+        _lease_ctx = (
+            float(cluster_lease_ms)
+            if cluster_lease_ms is not None
+            else float(os.environ.get("PATHWAY_CLUSTER_LEASE_MS") or 30000.0)
+        )
+    except ValueError:
+        _lease_ctx = 30000.0
     G.run_context = {
         "recovery": bool(recovery),
         "monitoring_level": monitoring_level,
         "with_http_server": bool(with_http_server),
         "persistence": persistence_config is not None,
         "pipeline_depth": max(1, _depth_ctx),
+        # cluster shape for PWL009 (fault-domain coverage): analyze-only
+        # runs read these off the graph without importing config
+        "processes": max(1, _procs_ctx),
+        "threads": max(1, _threads_ctx),
+        "cluster_lease_ms": max(0.0, _lease_ctx),
     }
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
@@ -191,6 +227,16 @@ def run(
         cluster_hello_timeout
         if cluster_hello_timeout is not None
         else pwcfg.cluster_hello_timeout
+    )
+    lease_ms = (
+        float(cluster_lease_ms)
+        if cluster_lease_ms is not None
+        else pwcfg.cluster_lease_ms
+    )
+    partial_budget = (
+        max(0, int(cluster_partial_restarts))
+        if cluster_partial_restarts is not None
+        else pwcfg.cluster_partial_restarts
     )
 
     def _build_runner(is_restart: bool) -> GraphRunner:
@@ -264,21 +310,99 @@ def run(
                 monitor.http_port = http_server.port
         run_span = None
 
-        def _attempt(is_restart: bool) -> None:
-            runner = _build_runner(is_restart)
-            if processes > 1:
-                # reference CommunicationConfig::Cluster (config.rs:62-86):
-                # P processes × T threads; coordinator = process 0
-                if pwcfg.process_id == 0:
+        # cluster fault domain: partial restarts replace ONLY the dead
+        # worker process. The regroup loops live OUTSIDE the supervisor,
+        # so a partial restart never charges the full-restart budget
+        # (pathway_supervisor_restarts_total stays 0 for them).
+        children: list[Any] = []
+        fence_gens: dict[int, int] = {}
+
+        def _respawn_worker(wpid: int, generation: int) -> None:
+            """Same interpreter + argv (every process runs the same
+            program), with the dead worker's slot and the bumped
+            generation in the environment — the generation is what lets
+            the coordinator tell the replacement from a zombie."""
+            import subprocess
+
+            env = dict(os.environ)
+            env["PATHWAY_PROCESS_ID"] = str(wpid)
+            env["PATHWAY_CLUSTER_GENERATION"] = str(generation)
+            children.append(subprocess.Popen([sys.executable] + sys.argv, env=env))
+
+        def _coordinator_attempt(runner: GraphRunner) -> None:
+            from ..resilience import ClusterRegroup
+
+            budget = partial_budget
+            while True:
+                try:
                     runner.run_coordinator(
                         processes,
                         pwcfg.first_port,
                         monitoring_callback=monitor.update if monitor else None,
                         accept_timeout=accept_timeout,
                         hello_timeout=hello_timeout,
+                        lease_ms=lease_ms,
+                        fence=fence_gens,
                     )
+                    return
+                except ClusterRegroup as regroup:
+                    path = flight_recorder.dump("cluster.partial_restart", regroup)
+                    if path:
+                        logger.warning(
+                            "cluster partial restart (generation %d, dead=%s): "
+                            "flight recorder dump written to %s",
+                            regroup.generation,
+                            regroup.dead_pids,
+                            path,
+                        )
+                    if budget <= 0:
+                        from ..engine.dataflow import EngineError
+
+                        raise EngineError(
+                            "cluster partial-restart budget exhausted "
+                            f"({partial_budget}): {regroup}"
+                        ) from regroup
+                    budget -= 1
+                    if pwcfg.cluster_respawn:
+                        for wpid in regroup.dead_pids:
+                            fence_gens[wpid] = regroup.generation
+                            _respawn_worker(wpid, regroup.generation)
+                    # survivors' volatile state is stale: rebuild the
+                    # runner like a supervisor restart and re-form the
+                    # cluster; persistence rehydrates from the barrier
+                    runner = _build_runner(True)
+
+        def _worker_attempt(runner: GraphRunner) -> None:
+            from ..resilience import ClusterRegroup
+
+            # a survivor regroups once per coordinator partial restart
+            # (plus its own lease expiries under partitions); the real
+            # budget is enforced on the coordinator
+            budget = partial_budget + 2
+            while True:
+                try:
+                    runner.run_worker(
+                        processes,
+                        pwcfg.first_port,
+                        pwcfg.process_id,
+                        lease_ms=lease_ms,
+                    )
+                    return
+                except ClusterRegroup:
+                    if budget <= 0:
+                        raise
+                    budget -= 1
+                    runner = _build_runner(True)
+
+        def _attempt(is_restart: bool) -> None:
+            runner = _build_runner(is_restart)
+            if processes > 1:
+                # reference CommunicationConfig::Cluster (config.rs:62-86):
+                # P processes × T threads; coordinator = process 0
+                if pwcfg.process_id == 0:
+                    _coordinator_attempt(runner)
                 else:
-                    runner.run_worker(processes, pwcfg.first_port, pwcfg.process_id)
+                    _worker_attempt(runner)
             else:
                 runner.run(monitoring_callback=monitor.update if monitor else None)
 
@@ -314,6 +438,17 @@ def run(
                 logger.error("flight recorder dump written to %s", path)
             raise
         finally:
+            # reap respawned worker processes: on a clean run they saw
+            # END and exit immediately; after a failure they must not
+            # outlive the coordinator
+            for child in children:
+                try:
+                    child.wait(timeout=15.0)
+                except Exception:
+                    try:
+                        child.kill()
+                    except Exception:
+                        pass
             if profiler is not None:
                 set_current_profiler(None)
             if monitor is not None:

@@ -53,6 +53,17 @@ fed-but-unadvanced epoch at or below the delivered marker (replay, no
 re-delivery) and trims epochs above it (re-read, delivered once) — so
 no crash position loses or duplicates an epoch.
 
+Fault domains: with a lease configured (pw.run(cluster_lease_ms=...) /
+PATHWAY_CLUSTER_LEASE_MS, default 30 s, 0 disables), every socket read
+is bounded and both sides heartbeat at lease/3 over the SAME
+authenticated channel (no extra listener), so a dead, hung or
+partitioned process is detected within one lease. Frames are
+seq-stamped (receivers drop duplicates) and generation-stamped: the
+coordinator durably bumps a cluster generation on every partial
+restart, survivors regroup at the last coordinated snapshot barrier,
+ONLY the dead worker is respawned (internals/run.py), and zombies of a
+buried generation are fenced at the hello and on every frame.
+
 Trust boundary: after an authenticated JSON handshake, frames are
 pickled (rows may hold arbitrary python values), so a peer that knows
 the cluster token can execute code — exactly the trust level of the
@@ -74,12 +85,19 @@ import pickle
 import socket
 import struct
 import sys
+import threading
 import time as _wall
 from typing import Any
 
 from ..engine import dataflow as df
 from ..internals import flight_recorder
 from ..resilience import chaos
+from ..resilience.cluster import (
+    CLUSTER_HEALTH,
+    CLUSTER_METRICS,
+    ClusterRegroup,
+    WorkerLost,
+)
 from .sharded import ShardCluster
 
 _HDR = struct.Struct("<I")
@@ -124,6 +142,33 @@ def _recv(sock: socket.socket) -> Any:
     return pickle.loads(_recv_exact(sock, n))
 
 
+def _send_frame(
+    sock: socket.socket,
+    obj: Any,
+    lock: threading.Lock | None = None,
+    *,
+    site: str = "cluster.send",
+    time: int | None = None,
+) -> None:
+    """Pickle send through the chaos channel seam: an active plan may
+    drop or duplicate this frame (or delay/kill via the usual actions)
+    to model a lossy or partitioned cluster network. ``lock``
+    serializes protocol frames against the heartbeat thread sharing the
+    socket, so interleaved sendall calls cannot corrupt the stream."""
+    verdict = chaos.channel(site, time=time)
+    if verdict == "drop":
+        return
+    blob = pickle.dumps(obj, protocol=4)
+    frame = _HDR.pack(len(blob)) + blob
+    if verdict == "duplicate":
+        frame = frame + frame
+    if lock is None:
+        sock.sendall(frame)
+    else:
+        with lock:
+            sock.sendall(frame)
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -158,9 +203,38 @@ def _telemetry_stats(cluster: ShardCluster) -> dict[int, dict]:
     return out
 
 
+def _stored_generation(engines) -> int:
+    """Durable cluster generation (0 when never bumped / no
+    persistence). Read at formation time so a coordinator that crashed
+    mid-regroup still fences the zombies of the generation it buried."""
+    cfg = engines[0].persistence_config
+    if cfg is None:
+        return 0
+    from ..engine.persistence import EnginePersistence
+
+    p = EnginePersistence(cfg)
+    try:
+        return p.cluster_generation()
+    finally:
+        p.close()
+
+
 class CoordinatorCluster(ShardCluster):
     """Process 0's cluster: local shards [0, T) of a P*T world, plus the
-    protocol driving P-1 remote worker processes."""
+    protocol driving P-1 remote worker processes.
+
+    Fault domain: each worker process is failure-isolated. With a lease
+    (``lease_ms``), every socket read is bounded and both sides send
+    heartbeats at lease/3, so a dead, hung or partitioned worker
+    surfaces as :class:`WorkerLost` within one lease instead of a hang.
+    With persistence configured, the coordinator converts that into a
+    *partial restart*: bump the durable cluster generation, tell the
+    survivors to regroup at the last snapshot barrier, and raise
+    :class:`ClusterRegroup` so ``internals/run.py`` respawns only the
+    dead worker. ``fence`` maps respawned pids to the minimum hello
+    generation they must present — a zombie of the buried generation
+    (e.g. a worker that was partitioned, not dead) is refused and told
+    to exit, so its stale writes can never reach the cluster."""
 
     def __init__(
         self,
@@ -169,24 +243,39 @@ class CoordinatorCluster(ShardCluster):
         first_port: int,
         accept_timeout: float = 60.0,
         hello_timeout: float = 10.0,
+        lease_ms: float | None = None,
+        fence: dict[int, int] | None = None,
     ):
         threads = len(engines)
         super().__init__(engines, base=0, world=processes * threads)
         self.threads = threads
         self.processes = processes
+        self.lease_s = (
+            float(lease_ms) / 1000.0
+            if lease_ms is not None and float(lease_ms) > 0
+            else None
+        )
+        self._lease_ms = float(lease_ms) if lease_ms is not None else 0.0
+        self._fence = {int(p): int(g) for p, g in (fence or {}).items()}
+        self.generation = _stored_generation(engines)
+        CLUSTER_METRICS.set_generation(self.generation)
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("127.0.0.1", first_port))
         srv.listen(processes)
         srv.settimeout(accept_timeout)
         self._conns: dict[int, socket.socket] = {}
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._send_seq: dict[int, int] = {}
+        self._expect_seq: dict[int, int] = {}
+        self._stale_hb: dict[int, int] = {}
         self._worker_frontiers: list[int] = []
         sig = _graph_sig(engines[0])
         token = cluster_token()
         try:
             while len(self._conns) < processes - 1:
                 try:
-                    conn, _ = srv.accept()
+                    conn, addr = srv.accept()
                 except socket.timeout:
                     missing = sorted(
                         set(range(1, processes)) - set(self._conns)
@@ -206,33 +295,117 @@ class CoordinatorCluster(ShardCluster):
                 # peer that stalls mid-hello must not eat the whole
                 # accept budget
                 conn.settimeout(hello_timeout)
+                peer = f"{addr[0]}:{addr[1]}"
                 try:
                     hello = _recv_json(conn)
-                except (ConnectionError, ValueError, socket.timeout):
+                except (ConnectionError, ValueError, socket.timeout) as exc:
                     conn.close()
-                    continue
+                    raise df.EngineError(
+                        f"cluster formation failed: peer {peer} did not "
+                        f"complete its hello within {hello_timeout:g}s "
+                        f"({type(exc).__name__})"
+                    ) from None
                 if hello.get("op") != "hello" or not hmac.compare_digest(
                     str(hello.get("token", "")), token
                 ):
                     conn.close()
+                    raise df.EngineError(
+                        f"cluster formation failed: peer {peer} "
+                        f"(pid {hello.get('pid', '?')}) sent a bad hello or "
+                        "cluster token (PATHWAY_CLUSTER_TOKEN must match in "
+                        "every process)"
+                    )
+                wpid = int(hello["pid"])
+                hello_gen = int(hello.get("gen", -1))
+                floor = self._fence.get(wpid)
+                if wpid in self._conns or (
+                    floor is not None and hello_gen < floor
+                ):
+                    # zombie fencing: a worker declared dead and replaced
+                    # must not rejoin under its buried generation — its
+                    # slot belongs to the respawned process now
+                    flight_recorder.record(
+                        "cluster.fenced_write",
+                        pid=wpid,
+                        generation=self.generation,
+                        hello_generation=hello_gen,
+                        phase="formation",
+                    )
+                    CLUSTER_METRICS.record_fenced_write(wpid)
+                    try:
+                        _send_json(
+                            conn,
+                            {
+                                "op": "fatal",
+                                "error": (
+                                    f"fenced: worker {wpid} was superseded "
+                                    f"(cluster generation is {self.generation})"
+                                ),
+                            },
+                        )
+                    except Exception:
+                        pass
+                    conn.close()
                     continue
                 if hello["sig"] != sig:
                     _send_json(conn, {"op": "fatal", "error": "graph mismatch: every process must run the same program"})
-                    raise RuntimeError(
-                        f"worker {hello['pid']} built a different graph "
-                        f"(sig {hello['sig']} != {sig})"
+                    conn.close()
+                    raise df.EngineError(
+                        f"cluster formation failed: worker {wpid} built a "
+                        f"different graph (sig {hello['sig']} != {sig})"
                     )
                 if hello["threads"] != threads:
                     _send_json(conn, {"op": "fatal", "error": "PATHWAY_THREADS mismatch"})
-                    raise RuntimeError("PATHWAY_THREADS differs across processes")
-                _send_json(conn, {"op": "welcome", "token": token})
-                conn.settimeout(None)  # steady-state protocol is blocking
-                self._conns[hello["pid"]] = conn
+                    conn.close()
+                    raise df.EngineError(
+                        f"cluster formation failed: worker {wpid} runs "
+                        f"{hello['threads']} threads, this process runs "
+                        f"{threads} (PATHWAY_THREADS differs across processes)"
+                    )
+                _send_json(
+                    conn,
+                    {
+                        "op": "welcome",
+                        "token": token,
+                        "gen": self.generation,
+                        "lease_ms": self._lease_ms,
+                    },
+                )
+                # lease: every inbound frame (heartbeats included) resets
+                # it; a socket silent for a whole lease means the worker
+                # is dead, hung, or partitioned. None = legacy blocking.
+                conn.settimeout(self.lease_s)
+                self._conns[wpid] = conn
+                self._send_locks[wpid] = threading.Lock()
+                self._send_seq[wpid] = 0
+                self._expect_seq[wpid] = 1
+                self._stale_hb[wpid] = 0
                 self._worker_frontiers.append(
                     int(hello.get("replay_frontier", -1))
                 )
+        except BaseException as exc:
+            # a half-formed cluster must not leak sockets: tell every
+            # already-accepted peer why formation died, then close them
+            # all before surfacing the offender
+            for c in self._conns.values():
+                try:
+                    _send_json(
+                        c,
+                        {"op": "fatal", "error": f"cluster formation failed: {exc}"},
+                    )
+                except Exception:
+                    pass
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            self._conns.clear()
+            raise
         finally:
             srv.close()
+        # full strength again: clear any shards marked down by a
+        # previous generation's partial restart
+        CLUSTER_HEALTH.mark_all_up()
         # relay buffer: worker→worker mail waiting for the next round
         self._relay: dict[int, dict[int, list]] = {}
         self._epoch_frontier: Any = None
@@ -242,24 +415,243 @@ class CoordinatorCluster(ShardCluster):
         # replies, keyed by global shard id; StatsMonitor merges this
         # into its snapshot's `workers` map (engine.cluster == self)
         self.worker_telemetry: dict[int, dict] = {}
+        self._hb_stop = threading.Event()
+        self._hb_thread: threading.Thread | None = None
+        if self.lease_s is not None:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                name="pathway:cluster-heartbeat",
+                daemon=True,
+            )
+            self._hb_thread.start()
 
     # -- protocol helpers --
 
-    def _round_all(self, msg_per_pid: dict[int, dict]) -> dict[int, dict]:
-        for pid, conn in self._conns.items():
-            _send(conn, msg_per_pid[pid])
-        replies = {}
-        for pid, conn in self._conns.items():
-            r = _recv(conn)
+    def _heartbeat_loop(self) -> None:
+        # liveness frames both sides skip on receive, but whose arrival
+        # resets the peer's lease timer — so a slow epoch on a healthy
+        # cluster never reads as a failure. Each heartbeat also carries
+        # the protocol progress ("sent": the last request seq on the
+        # wire), which lets the receiver distinguish "peer is slow" from
+        # "a frame was lost": heartbeats flowing while the awaited frame
+        # never arrives would otherwise wait forever, invisible to the
+        # lease. The seq is read and sent under the send lock so wire
+        # order matches seq order.
+        interval = self.lease_s / 3.0
+        while not self._hb_stop.wait(interval):
+            for wpid, conn in list(self._conns.items()):
+                lock = self._send_locks.get(wpid)
+                if lock is None:
+                    continue
+                try:
+                    with lock:
+                        _send_frame(
+                            conn,
+                            {
+                                "op": "hb",
+                                "gen": self.generation,
+                                "sent": self._send_seq.get(wpid, 0),
+                            },
+                        )
+                except Exception:
+                    return  # the protocol loop reports the broken conn
+
+    def _stop_heartbeats(self) -> None:
+        self._hb_stop.set()
+        t = self._hb_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        self._hb_thread = None
+
+    def _send_worker(self, wpid: int, msg: dict) -> None:
+        """Seq/generation-stamped frame to one worker. The seq lets the
+        receiver drop duplicated frames (chaos ``duplicate`` verdicts,
+        retransmits) without breaking the strict request/reply shape."""
+        self._send_seq[wpid] += 1
+        stamped = dict(msg)
+        stamped["seq"] = self._send_seq[wpid]
+        stamped["gen"] = self.generation
+        try:
+            _send_frame(
+                self._conns[wpid],
+                stamped,
+                self._send_locks[wpid],
+                time=msg.get("t"),
+            )
+        except (ConnectionError, OSError) as exc:
+            raise WorkerLost(wpid, f"send failed ({exc})") from None
+
+    def _recv_worker(self, wpid: int) -> dict:
+        """Next protocol reply from one worker, skipping heartbeats and
+        duplicated frames; lease expiry and dead connections surface as
+        :class:`WorkerLost` for the partial-restart path."""
+        conn = self._conns[wpid]
+        while True:
+            try:
+                r = _recv(conn)
+            except socket.timeout:
+                flight_recorder.record(
+                    "cluster.lease_expired", pid=wpid, lease_s=self.lease_s
+                )
+                CLUSTER_METRICS.record_lease_expired(wpid)
+                raise WorkerLost(
+                    wpid, f"lease expired ({self.lease_s:g}s without a frame)"
+                ) from None
+            except (ConnectionError, OSError) as exc:
+                raise WorkerLost(wpid, f"connection lost ({exc})") from None
+            if not isinstance(r, dict):
+                raise WorkerLost(wpid, "protocol violation (non-dict frame)")
+            if r.get("op") == "hb":
+                # heartbeats prove liveness but also progress: if the
+                # worker reports it already replied to the request we
+                # are waiting for, or repeatedly reports never having
+                # received it, the channel lost a frame — heartbeats
+                # would keep resetting the lease forever otherwise
+                awaiting = self._send_seq.get(wpid, 0)
+                done = r.get("done")
+                if done is not None and int(done) >= awaiting > 0:
+                    flight_recorder.record(
+                        "cluster.frame_lost",
+                        pid=wpid,
+                        direction="reply",
+                        seq=awaiting,
+                    )
+                    raise WorkerLost(
+                        wpid,
+                        f"reply to seq {awaiting} lost in transit "
+                        f"(worker reports done={int(done)})",
+                    )
+                got = r.get("got")
+                if got is not None and int(got) < awaiting:
+                    self._stale_hb[wpid] = self._stale_hb.get(wpid, 0) + 1
+                    # two consecutive stale heartbeats (~2/3 of a lease)
+                    # rule out the instant between the frame arriving
+                    # and the worker recording it
+                    if self._stale_hb[wpid] >= 2:
+                        flight_recorder.record(
+                            "cluster.frame_lost",
+                            pid=wpid,
+                            direction="request",
+                            seq=awaiting,
+                        )
+                        raise WorkerLost(
+                            wpid,
+                            f"request seq {awaiting} lost in transit "
+                            f"(worker reports got={int(got)})",
+                        )
+                else:
+                    self._stale_hb[wpid] = 0
+                continue  # liveness traffic, not a reply
+            rgen = r.get("gen")
+            if rgen is not None and int(rgen) != self.generation:
+                # a frame stamped with a buried generation is a zombie
+                # write — refuse it and retire the sender
+                flight_recorder.record(
+                    "cluster.fenced_write",
+                    pid=wpid,
+                    generation=self.generation,
+                    frame_generation=int(rgen),
+                )
+                CLUSTER_METRICS.record_fenced_write(wpid)
+                raise WorkerLost(
+                    wpid,
+                    f"stale generation {rgen} (cluster is at {self.generation})",
+                )
+            seq = r.get("seq")
+            if seq is not None:
+                if int(seq) < self._expect_seq[wpid]:
+                    continue  # duplicated frame; already processed
+                self._expect_seq[wpid] = int(seq) + 1
             if r.get("op") == "error":
                 raise df.EngineError(
-                    f"worker process {pid} failed:\n{r['traceback']}"
+                    f"worker process {wpid} failed:\n{r['traceback']}"
                 )
-            replies[pid] = r
+            self._stale_hb[wpid] = 0
+            return r
+
+    def _round_all(self, msg_per_pid: dict[int, dict]) -> dict[int, dict]:
+        for wpid in self._conns:
+            self._send_worker(wpid, msg_per_pid[wpid])
+        replies = {}
+        for wpid in list(self._conns):
+            replies[wpid] = self._recv_worker(wpid)
         return replies
 
     def _broadcast(self, msg: dict) -> dict[int, dict]:
         return self._round_all({pid: msg for pid in self._conns})
+
+    # -- fault domain: detection -> partial restart --
+
+    def run(self, monitoring_callback=None) -> None:
+        try:
+            super().run(monitoring_callback)
+        except WorkerLost as exc:
+            self._begin_partial_restart(exc)
+        finally:
+            self._stop_heartbeats()
+
+    def _begin_partial_restart(self, exc: WorkerLost) -> None:
+        """Convert a lost worker into a partial restart: bump the
+        durable generation (fencing the dead worker's zombie writes),
+        quiesce the survivors at the last snapshot barrier, and raise
+        :class:`ClusterRegroup` for ``internals/run.py`` to respawn
+        ONLY the dead process. Without persistence there is no barrier
+        to restart from, so the whole attempt fails instead (charged to
+        the supervisor's full-restart budget when ``recovery=`` is on)."""
+        self._stop_heartbeats()
+        persistence = getattr(self, "_persistence", None)
+        if persistence is None:
+            self._close_conns(f"cluster failed: {exc}")
+            raise df.EngineError(str(exc)) from exc
+        gen = persistence.bump_cluster_generation()
+        self.generation = gen
+        CLUSTER_METRICS.set_generation(gen)
+        CLUSTER_METRICS.record_partial_restart(exc.pid)
+        # the dead worker's shard range is down until the next formation
+        # completes; the serving plane degrades those shards instead of
+        # failing the whole endpoint
+        CLUSTER_HEALTH.mark_down(
+            range(exc.pid * self.threads, (exc.pid + 1) * self.threads),
+            retry_after_s=self.lease_s,
+        )
+        flight_recorder.record(
+            "cluster.partial_restart",
+            pid=exc.pid,
+            generation=gen,
+            reason=exc.reason,
+        )
+        # survivors drop volatile state and rejoin the next formation
+        # under the bumped generation; best-effort — an unreachable
+        # survivor discovers the regroup via its own lease
+        for wpid, conn in self._conns.items():
+            if wpid == exc.pid:
+                continue
+            try:
+                with self._send_locks[wpid]:
+                    _send(conn, {"op": "regroup", "gen": gen})
+            except Exception:
+                pass
+        self._close_conns(None)
+        try:
+            persistence.close()
+        except Exception:
+            pass
+        self._persistence = None
+        raise ClusterRegroup([exc.pid], gen, exc.reason) from exc
+
+    def _close_conns(self, fatal: str | None) -> None:
+        for wpid, conn in self._conns.items():
+            if fatal is not None:
+                try:
+                    with self._send_locks[wpid]:
+                        _send(conn, {"op": "fatal", "error": fatal})
+                except Exception:
+                    pass
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self._conns.clear()
 
     # -- distributed sweep --
 
@@ -402,12 +794,24 @@ class CoordinatorCluster(ShardCluster):
         for pid, r in self._broadcast({"op": "snapshot", "t": int(t)}).items():
             states.update(r["states"])
         blob = pickle.dumps(
-            {"sig": self._cluster_signature(), "time": int(t), "states": states},
+            {
+                "sig": self._cluster_signature(),
+                "time": int(t),
+                "states": states,
+                "generation": self.generation,
+            },
             protocol=4,
         )
         self._persistence.save_operator_snapshot(int(t), blob)
         self._compact_inputs(int(t))
         self._last_opsnap_wall = _wall.monotonic()
+        # the snapshot broadcast IS the coordinated barrier: every
+        # worker contributed state for the same epoch t, so a partial
+        # restart resumes the whole cluster from here
+        flight_recorder.record(
+            "cluster.barrier", t=int(t), generation=self.generation, world=self.world
+        )
+        CLUSTER_METRICS.record_barrier(self.generation)
 
     def _cluster_signature(self):
         # all processes build the identical graph, so the signature of
@@ -423,18 +827,26 @@ class CoordinatorCluster(ShardCluster):
                 self.engines[shard].nodes[nid].restore_state(st)
             else:
                 remote.setdefault(shard // self.threads, {})[(shard, nid)] = st
-        for pid, conn in self._conns.items():
-            _send(conn, {"op": "restore", "states": remote.get(pid, {}), "time": time})
-            r = _recv(conn)
+        for pid in self._conns:
+            self._send_worker(
+                pid, {"op": "restore", "states": remote.get(pid, {}), "time": time}
+            )
+            r = self._recv_worker(pid)
             assert r.get("op") == "ok"
 
     def _flush_needed(self) -> bool:
         return True  # remote processes may hold buffered state
 
     def _finish_remote(self) -> None:
+        # heartbeats first: a racing hb sendall mid-"end" would corrupt
+        # the stream. END deliberately bypasses the chaos channel — a
+        # dropped shutdown frame models nothing the lease doesn't
+        # already cover, and would wedge fault-free teardown.
+        self._stop_heartbeats()
         for pid, conn in self._conns.items():
             try:
-                _send(conn, {"op": "end"})
+                with self._send_locks[pid]:
+                    _send(conn, {"op": "end"})
             except Exception:
                 pass
         for conn in self._conns.values():
@@ -592,9 +1004,21 @@ def _feed_partitioned(
     return fed
 
 
-def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 120) -> None:
+def run_worker(
+    cluster: ShardCluster,
+    first_port: int,
+    pid: int,
+    retries: int = 120,
+    lease_ms: float | None = None,
+) -> None:
     """Worker process main loop (PATHWAY_PROCESS_ID > 0): serve rounds
-    until the coordinator says END."""
+    until the coordinator says END.
+
+    The welcome carries the coordinator's lease and cluster generation;
+    with a lease, the worker heartbeats at lease/3 and bounds every
+    socket read, raising :class:`ClusterRegroup` (rejoin the next
+    formation via ``internals/run.py``) when the coordinator goes
+    silent or orders a regroup."""
     # worker-side persistence FIRST: the hello reports this process's
     # replay frontier, so recovery must happen before connecting
     wp = None
@@ -633,6 +1057,10 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
             "sig": _graph_sig(cluster.engines[0]),
             "token": token,
             "replay_frontier": replay_frontier,
+            # the generation this process believes is current: respawned
+            # workers inherit the bumped one via the environment, zombies
+            # present a buried one and are fenced at the door
+            "gen": int(os.environ.get("PATHWAY_CLUSTER_GENERATION", "-1") or -1),
         },
     )
     welcome = _recv_json(sock)
@@ -642,6 +1070,104 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
     assert welcome.get("op") == "welcome"
     if not hmac.compare_digest(str(welcome.get("token", "")), token):
         raise ConnectionError("coordinator failed token check")
+    gen = int(welcome.get("gen", 0))
+    # carried into the next formation (and any process we fork): the
+    # learned generation is what distinguishes a survivor from a zombie
+    os.environ["PATHWAY_CLUSTER_GENERATION"] = str(gen)
+    w_lease = welcome.get("lease_ms")
+    if w_lease is None:
+        w_lease = lease_ms
+    lease_s = (
+        float(w_lease) / 1000.0 if w_lease is not None and float(w_lease) > 0 else None
+    )
+    # the 5s connect timeout bounded the handshake; steady state is
+    # bounded by the lease (None = legacy blocking protocol)
+    sock.settimeout(lease_s)
+    send_lock = threading.Lock()
+    hb_stop = threading.Event()
+    last_seq = 0  # last request seq received from the coordinator
+    done_seq = 0  # last request seq whose reply has been handed to the wire
+
+    def _reply(obj: dict, *, seq: int | None = None, time: int | None = None) -> None:
+        nonlocal done_seq
+        if seq is not None:
+            obj["seq"] = seq
+        obj["gen"] = gen
+        _send_frame(sock, obj, send_lock, time=time)
+        if seq is not None:
+            done_seq = int(seq)
+
+    def _hb_loop() -> None:
+        # heartbeats carry protocol progress (got/done), so the
+        # coordinator can tell a slow epoch (got the request, still
+        # working) from a lost frame (never got it / already replied)
+        while not hb_stop.wait(lease_s / 3.0):
+            try:
+                _send_frame(
+                    sock,
+                    {"op": "hb", "gen": gen, "got": last_seq, "done": done_seq},
+                    send_lock,
+                )
+            except Exception:
+                return
+
+    if lease_s is not None:
+        threading.Thread(
+            target=_hb_loop, name="pathway:cluster-heartbeat", daemon=True
+        ).start()
+
+    stale_hb = 0
+
+    def _recv_op() -> dict:
+        nonlocal last_seq, stale_hb
+        while True:
+            try:
+                msg = _recv(sock)
+            except socket.timeout:
+                flight_recorder.record(
+                    "cluster.lease_expired", pid=pid, side="worker", lease_s=lease_s
+                )
+                CLUSTER_METRICS.record_lease_expired(pid)
+                raise ClusterRegroup(
+                    [], gen, "coordinator unreachable (lease expired)"
+                ) from None
+            except (ConnectionError, OSError) as exc:
+                raise ClusterRegroup(
+                    [], gen, f"coordinator connection lost ({exc})"
+                ) from None
+            if not isinstance(msg, dict):
+                continue
+            if msg.get("op") == "hb":
+                # the coordinator's heartbeat names the last request seq
+                # it put on the wire; if it is repeatedly ahead of what
+                # we received, that request was lost in transit and the
+                # lease alone would never notice (heartbeats keep it
+                # fresh) — regroup instead of waiting forever
+                sent = msg.get("sent")
+                if sent is not None and int(sent) > last_seq:
+                    stale_hb += 1
+                    if stale_hb >= 2:
+                        flight_recorder.record(
+                            "cluster.frame_lost",
+                            pid=pid,
+                            side="worker",
+                            direction="request",
+                            seq=int(sent),
+                        )
+                        raise ClusterRegroup(
+                            [], gen, f"request seq {sent} lost in transit"
+                        )
+                else:
+                    stale_hb = 0
+                continue
+            seq = msg.get("seq")
+            if seq is not None:
+                if int(seq) <= last_seq:
+                    continue  # duplicated frame; already processed
+                last_seq = int(seq)
+            stale_hb = 0
+            return msg
+
     processes = cluster.world // cluster.n
     mesh = PeerMesh(pid, processes, first_port, token) if processes > 2 else None
     # partitioned sources read their slice HERE: start only the readers
@@ -652,7 +1178,7 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
     pending_advance: dict = {}
     try:
         while True:
-            msg = _recv(sock)
+            msg = _recv_op()
             op = msg["op"]
             if op == "round":
                 t = msg["t"]
@@ -685,7 +1211,15 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                     if mesh is not None:
                         peer_out = {p: b for p, b in out.items() if p != 0}
                         sent_peer |= any(peer_out.values())
-                        inbound = mesh.exchange(peer_out)
+                        try:
+                            inbound = mesh.exchange(peer_out)
+                        except (ConnectionError, OSError) as exc:
+                            # a dead peer breaks the mesh before the
+                            # coordinator's lease notices: regroup, the
+                            # next formation rebuilds the mesh
+                            raise ClusterRegroup(
+                                [], gen, f"peer mesh broken ({exc})"
+                            ) from None
                         if inbound:
                             cluster.post_mail(inbound)
                             got_peer = True
@@ -694,25 +1228,26 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                         dst = p0_mail.setdefault(dest_pid, {})
                         for shard, box in boxes.items():
                             dst.setdefault(shard, []).extend(box)
-                _send(
-                    sock,
+                _reply(
                     {
                         "op": "reply",
                         "mail": p0_mail,
                         "wm": cluster.watermark_map(),
                         "active": had or bool(p0_mail) or sent_peer or got_peer,
                     },
+                    seq=msg.get("seq"),
+                    time=t,
                 )
             elif op == "poll":
                 srcs = _partitioned_sources(cluster)
-                _send(
-                    sock,
+                _reply(
                     {
                         "op": "poll_reply",
                         "pending": any(s.session.pending() for s in srcs),
                         "closed": all(s.session.closed for s in srcs),
                         "stats": _telemetry_stats(cluster),
                     },
+                    seq=msg.get("seq"),
                 )
             elif op == "time_end":
                 cluster._time_end_all(msg["t"])
@@ -723,7 +1258,11 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                         wp.advance(sid, at, offs)
                     pending_advance.clear()
                 chaos.inject("worker.after_advance", time=int(msg["t"]))
-                _send(sock, {"op": "ok", "stats": _telemetry_stats(cluster)})
+                _reply(
+                    {"op": "ok", "stats": _telemetry_stats(cluster)},
+                    seq=msg.get("seq"),
+                    time=int(msg["t"]),
+                )
             elif op == "snapshot":
                 states = {}
                 for i, e in enumerate(cluster.engines):
@@ -746,7 +1285,13 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                         ],
                         msg.get("t", -1),
                     )
-                _send(sock, {"op": "states", "states": states})
+                # contributing state to the cluster snapshot is this
+                # worker's side of the coordinated barrier
+                flight_recorder.record(
+                    "cluster.barrier", t=msg.get("t"), pid=pid, generation=gen
+                )
+                CLUSTER_METRICS.record_barrier(gen)
+                _reply({"op": "states", "states": states}, seq=msg.get("seq"))
             elif op == "restore":
                 for (shard, nid), st in msg["states"].items():
                     cluster.engines[shard - cluster.base].nodes[nid].restore_state(st)
@@ -759,7 +1304,7 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                         s_.replay_batches = [
                             (tt, ups) for tt, ups in s_.replay_batches if tt > t0
                         ]
-                _send(sock, {"op": "ok"})
+                _reply({"op": "ok"}, seq=msg.get("seq"))
             elif op == "end":
                 for e in cluster.engines:
                     for n in e.nodes:
@@ -767,21 +1312,43 @@ def run_worker(cluster: ShardCluster, first_port: int, pid: int, retries: int = 
                 if wp is not None:
                     wp.close()
                 return
+            elif op == "regroup":
+                # the coordinator lost a worker: drop volatile state and
+                # rejoin the next formation under the bumped generation
+                new_gen = int(msg.get("gen", -1))
+                os.environ["PATHWAY_CLUSTER_GENERATION"] = str(new_gen)
+                if wp is not None:
+                    wp.close()
+                raise ClusterRegroup([], new_gen, "coordinator regroup")
             elif op == "fatal":
                 raise RuntimeError(msg["error"])
             else:
                 raise RuntimeError(f"unknown op {op!r}")
+    except ClusterRegroup as exc:
+        # not a crash: no black-box dump, no error frame — the regroup
+        # loop in internals/run.py rebuilds the runner and reconnects
+        flight_recorder.record(
+            "cluster.regroup", pid=pid, generation=exc.generation, reason=exc.reason
+        )
+        if wp is not None:
+            try:
+                wp.close()
+            except Exception:
+                pass
+        raise
     except Exception as exc:
         import traceback
 
         flight_recorder.record("worker.error", pid=pid, error=type(exc).__name__)
         flight_recorder.dump("worker_crash", exc)
         try:
-            _send(sock, {"op": "error", "traceback": traceback.format_exc()})
+            with send_lock:
+                _send(sock, {"op": "error", "traceback": traceback.format_exc()})
         except Exception:
             pass
         raise
     finally:
+        hb_stop.set()
         if mesh is not None:
             mesh.close()
         sock.close()

@@ -455,6 +455,15 @@ class ShardCluster:
         self._persistence.save_operator_snapshot(int(t), blob)
         self._compact_inputs(int(t))
         self._last_opsnap_wall = _wall.monotonic()
+        if self.world > 1:
+            # multi-worker snapshot = the coordinated barrier all shards
+            # agree on; single-worker runs keep their quieter telemetry
+            from ..resilience.cluster import CLUSTER_METRICS
+
+            flight_recorder.record(
+                "cluster.barrier", t=int(t), generation=0, world=self.world
+            )
+            CLUSTER_METRICS.record_barrier()
 
     def _compact_inputs(self, t: int) -> None:
         cfg = self.engines[0].persistence_config
@@ -541,8 +550,11 @@ class ShardCluster:
             if session_batches and scripted_t is not None:
                 t = max(scripted_t, last_time + 1)
             t = max(t, last_time + 1) if t <= last_time else t
+            _epoch_kw = {"t": int(t), "world": self.world}
+            if int(getattr(self, "generation", 0) or 0):
+                _epoch_kw["generation"] = int(self.generation)
             flight_recorder.record(
-                "epoch.begin", t=int(t), world=self.world, batches=len(session_batches)
+                "epoch.begin", batches=len(session_batches), **_epoch_kw
             )
             self._sync_watermarks()
             for e in self.engines:
@@ -582,7 +594,7 @@ class ShardCluster:
                 if session_batches:
                     self._maybe_snapshot_operators(t)
             last_time = t
-            flight_recorder.record("epoch.advance", t=int(t), world=self.world)
+            flight_recorder.record("epoch.advance", **_epoch_kw)
             if monitoring_callback is not None:
                 monitoring_callback(primary)
 

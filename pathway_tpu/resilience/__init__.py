@@ -20,6 +20,14 @@ from __future__ import annotations
 
 from . import chaos
 from .chaos import ChaosInjected, ChaosPlan
+from .cluster import (
+    CLUSTER_HEALTH,
+    CLUSTER_METRICS,
+    ClusterHealth,
+    ClusterMetrics,
+    ClusterRegroup,
+    WorkerLost,
+)
 from .retry import DEFAULT_RETRY_CODES, RETRY_METRICS, RetryMetrics, RetryPolicy
 from .supervisor import (
     SUPERVISOR_METRICS,
@@ -30,6 +38,11 @@ from .supervisor import (
 )
 
 __all__ = [
+    "CLUSTER_HEALTH",
+    "CLUSTER_METRICS",
+    "ClusterHealth",
+    "ClusterMetrics",
+    "ClusterRegroup",
     "DEFAULT_RETRY_CODES",
     "RETRY_METRICS",
     "RetryMetrics",
@@ -39,6 +52,7 @@ __all__ = [
     "RecoveryEscalated",
     "Supervisor",
     "SupervisorMetrics",
+    "WorkerLost",
     "ChaosInjected",
     "ChaosPlan",
     "chaos",

@@ -41,6 +41,21 @@ Rule shape (JSON object, or a list of them, or ``{"rules": [...]}``;
 the recovery contract is written for); ``exit`` is ``os._exit``;
 ``raise`` throws :class:`ChaosInjected`, which the run supervisor
 treats as restartable.
+
+Cluster-channel fault family: the multiprocess protocol seams
+(``cluster.send`` on both sides of the coordinator star) consult
+:func:`channel` instead of :func:`inject` and obey a *verdict* —
+``drop`` discards the frame, ``duplicate`` sends it twice,
+``partition`` arms a sticky drop for ``duration_s`` seconds (default:
+until the process exits), modelling a network partition; ``delay``
+sleeps inline. Verdict rules never fire from :func:`inject` (a dropped
+frame is meaningless at, say, a persistence site) and vice versa the
+kill/raise actions still work at channel sites. Rules may additionally
+key on ``"generation"`` (the PATHWAY_CLUSTER_GENERATION a process was
+spawned with, default 0) so a kill rule fires in the original cluster
+generation only — a partially restarted worker replays the same sites
+without being re-killed, which is what makes partial-restart chaos
+runs deterministic end-to-end.
 """
 
 from __future__ import annotations
@@ -53,7 +68,9 @@ import time as _time
 from typing import Any
 
 _SIGNALS = {"kill": signal.SIGKILL, "term": signal.SIGTERM}
-_ACTIONS = ("kill", "term", "exit", "raise", "delay")
+# channel verdict actions apply only at sites that call channel()
+_CHANNEL_ACTIONS = ("drop", "duplicate", "partition")
+_ACTIONS = ("kill", "term", "exit", "raise", "delay") + _CHANNEL_ACTIONS
 
 
 class ChaosInjected(RuntimeError):
@@ -76,7 +93,17 @@ class ChaosPlan:
                 )
             rule["_hits"] = 0
             rule["_done"] = False
+            rule["_partition_until"] = None
             self.rules.append(rule)
+        # stable material for deterministic_seed(): the user-visible
+        # rule fields only, independent of runtime hit state
+        self.seed_material = json.dumps(
+            [
+                {k: v for k, v in r.items() if not k.startswith("_")}
+                for r in self.rules
+            ],
+            sort_keys=True,
+        ).encode()
 
     @classmethod
     def from_spec(cls, spec: Any) -> "ChaosPlan":
@@ -105,10 +132,16 @@ class ChaosPlan:
             # first time the instrumented site reports reaching it
             if offset is None or int(offset) < int(rule["offset"]):
                 return False
+        if "generation" in rule:
+            gen = int(os.environ.get("PATHWAY_CLUSTER_GENERATION", "0") or 0)
+            if int(rule["generation"]) != gen:
+                return False
         return True
 
     def fire(self, site: str, time: int | None, offset: int | None) -> None:
         for rule in self.rules:
+            if rule["action"] in _CHANNEL_ACTIONS:
+                continue  # verdict rules only apply via channel()
             if rule["_done"] or not self._matches(rule, site, time, offset):
                 continue
             rule["_hits"] += 1
@@ -119,6 +152,52 @@ class ChaosPlan:
             else:
                 rule["_hits"] = 0
             self._act(rule, site, time, offset)
+
+    def channel(
+        self, site: str, time: int | None, offset: int | None
+    ) -> str | None:
+        """Verdict for one protocol frame at a channel site: ``"drop"``,
+        ``"duplicate"``, or None (deliver normally). An armed partition
+        drops every matching frame until it expires; kill/raise/delay
+        rules at channel sites act exactly as they would via inject()."""
+        from ..internals import flight_recorder
+
+        verdict: str | None = None
+        for rule in self.rules:
+            until = rule["_partition_until"]
+            if until is not None:
+                if _time.monotonic() < until and rule["site"] == site:
+                    verdict = "drop"
+                continue
+            if rule["_done"] or not self._matches(rule, site, time, offset):
+                continue
+            rule["_hits"] += 1
+            if rule["_hits"] < int(rule.get("hit", 1)):
+                continue
+            if not rule.get("repeat", False):
+                rule["_done"] = True
+            else:
+                rule["_hits"] = 0
+            action = rule["action"]
+            if action == "partition":
+                duration = float(rule.get("duration_s", 1e9))
+                rule["_partition_until"] = _time.monotonic() + duration
+                flight_recorder.record(
+                    "chaos.hit",
+                    site=site,
+                    action="partition",
+                    t=time,
+                    duration_s=duration,
+                )
+                verdict = "drop"
+            elif action in ("drop", "duplicate"):
+                flight_recorder.record(
+                    "chaos.hit", site=site, action=action, t=time
+                )
+                verdict = action
+            else:
+                self._act(rule, site, time, offset)
+        return verdict
 
     def _act(
         self, rule: dict[str, Any], site: str, time: int | None, offset: int | None
@@ -201,3 +280,35 @@ def inject(site: str, *, time: int | None = None, offset: int | None = None) -> 
     if plan is None:
         return
     plan.fire(site, time, offset)
+
+
+def channel(
+    site: str, *, time: int | None = None, offset: int | None = None
+) -> str | None:
+    """Channel-fault hook for the cluster protocol seams: returns
+    ``"drop"`` / ``"duplicate"`` / None for this frame. No-op (None)
+    without an active plan."""
+    if not _env_loaded:
+        _load_env()
+    plan = _active
+    if plan is None:
+        return None
+    return plan.channel(site, time, offset)
+
+
+def deterministic_seed() -> int | None:
+    """A stable per-process seed derived from the active chaos spec.
+
+    Same plan + same PATHWAY_PROCESS_ID -> same seed, so every jitter
+    source that defaults to it (``RetryPolicy`` without an explicit
+    ``seed=``/``rng=``) replays identically across chaos re-runs.
+    None when no plan is active (normal runs keep real entropy)."""
+    if not _env_loaded:
+        _load_env()
+    plan = _active
+    if plan is None:
+        return None
+    import zlib
+
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0") or 0)
+    return (zlib.crc32(plan.seed_material) ^ (pid * 0x9E3779B1)) & 0xFFFFFFFF

@@ -111,6 +111,14 @@ class RetryPolicy:
         self.backoff_factor = backoff_factor
         self.jitter_ms = jitter_ms
         self.max_retries = max_retries
+        if rng is None and seed is None:
+            # chaos runs must be deterministic end-to-end: when a
+            # PATHWAY_CHAOS plan is active, default jitter draws from a
+            # seed derived from the plan + process id instead of global
+            # entropy, so a replayed chaos run retries identically
+            from . import chaos as _chaos
+
+            seed = _chaos.deterministic_seed()
         self._seed = seed
         if rng is None:
             rng = random.Random(seed) if seed is not None else random  # type: ignore[assignment]

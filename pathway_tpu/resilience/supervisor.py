@@ -11,6 +11,14 @@ budget with backoff; an exhausted budget escalates to a clean
 
 Restart counts are recorded in :data:`SUPERVISOR_METRICS` and rendered
 on ``/metrics`` as ``pathway_supervisor_restarts_total``.
+
+Division of labor with the cluster fault domain: a *partial* restart
+(one dead worker process, :class:`~.cluster.ClusterRegroup`) is handled
+by the regroup loops in ``internals/run.py`` and never charges this
+supervisor's budget — the survivors keep running and
+``pathway_supervisor_restarts_total`` stays 0. The supervisor owns
+*full* restarts: whole-run failures, including a partial-restart budget
+that ran out (escalated as ``EngineError``).
 """
 
 from __future__ import annotations
@@ -131,6 +139,8 @@ class Supervisor:
         self.label = label
 
     def run(self, attempt: Callable[[bool], Any]) -> Any:
+        from .cluster import ClusterRegroup
+
         restart_on = self.recovery.restart_on
         if restart_on is None:
             restart_on = _default_restart_on()
@@ -139,6 +149,19 @@ class Supervisor:
         while True:
             try:
                 return attempt(restarts > 0)
+            except ClusterRegroup:
+                # deliberately NOT restartable here: partial restarts
+                # belong to the regroup loops in internals/run.py; a
+                # regroup reaching the supervisor is a wiring bug, and
+                # silently charging the full-restart budget for it
+                # would mask that
+                logger.error(
+                    "%s: ClusterRegroup leaked to the supervisor (partial "
+                    "restarts are handled by pw.run's regroup loop); "
+                    "failing the run instead of restarting",
+                    self.label,
+                )
+                raise
             except restart_on as exc:
                 from ..internals import flight_recorder
 

@@ -43,6 +43,7 @@ from .admission import (
     QueueFull,
     RateLimited,
     ServingConfig,
+    ShardUnavailable,
     Ticket,
     TokenBucket,
 )
@@ -68,6 +69,7 @@ __all__ = [
     "SERVING_METRICS",
     "ServingConfig",
     "ServingMetrics",
+    "ShardUnavailable",
     "Ticket",
     "TokenBucket",
     "bind_deadline",

@@ -47,6 +47,7 @@ __all__ = [
     "QueueFull",
     "RateLimited",
     "ServingConfig",
+    "ShardUnavailable",
     "Ticket",
     "TokenBucket",
 ]
@@ -83,6 +84,16 @@ class QueueFull(OverloadError):
 class DeadlineExceeded(OverloadError):
     status = 503
     reason = "deadline_exceeded"
+
+
+class ShardUnavailable(OverloadError):
+    """The engine shard a query needs is down (a worker process died
+    and its partial restart has not completed). Queries for healthy
+    shards keep flowing; ``Retry-After`` is roughly the cluster lease —
+    by then the restart either completed or escalated."""
+
+    status = 503
+    reason = "shard_unavailable"
 
 
 @dataclass
@@ -228,11 +239,22 @@ class AdmissionController:
                 heapq.heappop(self._heap)
             return self._heap[0][0] if self._heap else None
 
-    def admit(self, deadline: Deadline | None = None) -> Ticket:
+    def admit(
+        self, deadline: Deadline | None = None, *, shard: int | None = None
+    ) -> Ticket:
         """Admit or shed. Raises :class:`RateLimited` /
-        :class:`QueueFull` / :class:`DeadlineExceeded`."""
+        :class:`QueueFull` / :class:`DeadlineExceeded` /
+        :class:`ShardUnavailable`.
+
+        ``shard`` pins the request to one engine shard; while the
+        cluster fault domain has that shard marked down (worker died,
+        partial restart in flight) the request is shed — or, under
+        ``shed="degrade"``, admitted as a degraded ticket the endpoint
+        answers from the healthy shards only.
+        """
         from ..internals import flight_recorder
         from ..resilience import chaos as _chaos
+        from ..resilience.cluster import CLUSTER_HEALTH
 
         cfg = self.config
         if deadline is None:
@@ -240,6 +262,23 @@ class AdmissionController:
         # burst-arrival chaos site: a delay rule here simulates a
         # thundering herd piling up at the front door
         _chaos.inject("serving.admit")
+
+        shard_degraded = False
+        if shard is not None and CLUSTER_HEALTH.is_down(shard):
+            if cfg.shed == "degrade":
+                shard_degraded = True
+            else:
+                self.metrics.record_shed("shard_unavailable")
+                flight_recorder.record(
+                    "serving.shed",
+                    route=self.route,
+                    reason="shard_unavailable",
+                    shard=int(shard),
+                )
+                raise ShardUnavailable(
+                    f"shard {shard} is down (partial restart in flight)",
+                    retry_after_s=CLUSTER_HEALTH.retry_after_s(),
+                )
 
         t0 = _time.monotonic()
         if self._bucket is not None and not self._bucket.try_acquire():
@@ -281,7 +320,7 @@ class AdmissionController:
                     f"admission queue full ({depth}/{cfg.max_queue})",
                     retry_after_s=deadline.remaining() if remaining_ms < 1e12 else None,
                 )
-            degraded = (
+            degraded = shard_degraded or (
                 cfg.shed == "degrade"
                 and depth >= cfg.degrade_watermark * cfg.max_queue
             )

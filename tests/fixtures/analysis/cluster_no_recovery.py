@@ -1,0 +1,26 @@
+"""Analysis fixture: a 2-process sharded run with the cluster fault
+domain hollowed out — the verifier must flag PWL009 (warning) twice:
+once for ``recovery=`` off (one worker crash kills the whole run, no
+partial restart) and once for heartbeats disabled
+(``cluster_lease_ms=0``: a hung or partitioned worker stalls the epoch
+barrier forever)."""
+
+import os
+
+os.environ["PATHWAY_PROCESSES"] = "2"
+
+import pathway_tpu as pw
+
+t = pw.debug.table_from_markdown(
+    """
+    | word
+  1 | cat
+  2 | dog
+    """
+)
+
+counts = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+
+pw.io.null.write(counts)
+
+pw.run(cluster_lease_ms=0)

@@ -548,3 +548,71 @@ def test_pwl008_negative_no_endpoints(monkeypatch):
     _null_sink()
     _describe_run(monkeypatch, recovery=True, monitoring_level="in_out")
     assert "PWL008" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL009
+
+
+def test_pwl009_multiworker_without_recovery(monkeypatch):
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL009"]
+    assert hits and hits[0].severity is Severity.WARNING
+    assert "recovery" in hits[0].message
+    assert hits[0].detail["world"] == 2
+
+
+def test_pwl009_threads_count_toward_world(monkeypatch):
+    # a single process with 4 engine threads is still a sharded run
+    monkeypatch.setenv("PATHWAY_THREADS", "4")
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL009"]
+    assert hits and hits[0].detail["world"] == 4
+
+
+def test_pwl009_lease_zero_disables_heartbeats(monkeypatch):
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        recovery=True,
+        monitoring_level="in_out",
+        cluster_lease_ms=0,
+    )
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL009"]
+    # recovery= is on, so only the disabled-heartbeats arm fires
+    assert len(hits) == 1
+    assert "heartbeats disabled" in hits[0].message
+    assert hits[0].detail["cluster_lease_ms"] == 0.0
+
+
+def test_pwl009_both_arms_fire_together(monkeypatch):
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out", cluster_lease_ms=0)
+    assert len([d for d in pw.analysis.analyze() if d.rule == "PWL009"]) == 2
+
+
+def test_pwl009_negative_single_worker(monkeypatch):
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out", cluster_lease_ms=0)
+    assert "PWL009" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl009_negative_fault_domain_intact(monkeypatch):
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        recovery=True,
+        monitoring_level="in_out",
+        cluster_lease_ms=2000,
+    )
+    assert "PWL009" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl009_negative_without_run_context():
+    _null_sink()
+    assert "PWL009" not in _rules(pw.analysis.analyze())

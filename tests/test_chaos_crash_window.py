@@ -331,7 +331,13 @@ def _free_port() -> int:
     return port
 
 
-def _spawn_cluster(tmp_path, out: str, chaos_spec: str | None, n: int):
+def _spawn_cluster(
+    tmp_path,
+    out: str,
+    chaos_spec: str | None,
+    n: int,
+    extra_env: dict[str, str] | None = None,
+):
     prog = tmp_path / "mc.py"
     prog.write_text(MP_PROGRAM)
     port = _free_port()
@@ -351,6 +357,7 @@ def _spawn_cluster(tmp_path, out: str, chaos_spec: str | None, n: int):
             PATHWAY_CLUSTER_TOKEN="chaos-test",
             PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
         )
+        env.update(extra_env or {})
         if chaos_spec is not None:
             env["PATHWAY_CHAOS"] = chaos_spec
         procs.append(
@@ -446,3 +453,94 @@ def test_cluster_killed_at_every_window_position(tmp_path, site, process):
     state = _net(out1 + ".0")
     final = _net(out2 + ".0", state, lenient_first_touch=True)
     assert final == {"cat": 40, "dog": 40, "bird": 40}, (site, final)
+
+# ---------------------------------------------------------------------------
+# cluster fault domain: partial restart — only the dead worker respawns
+# ---------------------------------------------------------------------------
+
+# the worker-side positions of the FEED→ADVANCE window; the coordinator
+# sites stay in WINDOW_SITES (killing process 0 kills the fault domain
+# itself — that is the supervisor's job, not a partial restart)
+PARTIAL_RESTART_SITES = [
+    "worker.after_feed_log",
+    "worker.before_advance",
+    "worker.after_advance",
+]
+
+
+@pytest.mark.parametrize("site", PARTIAL_RESTART_SITES)
+def test_partial_restart_respawns_only_dead_worker(tmp_path, site):
+    """SIGKILL worker 1 at a scripted window position with the cluster
+    fault domain armed (lease + respawn): the coordinator must detect
+    the death, respawn ONLY worker 1 (fenced by the bumped generation —
+    the `generation: 0` guard keeps the chaos rule from re-killing the
+    replacement), and finish the run in its ORIGINAL process with exact
+    final counts — no row lost, none double-counted in net state. (The
+    survivor's sink file crosses the regroup boundary mid-file: the
+    epoch in flight when the regroup unwinds the engine may have its
+    sink flush dropped, so the rebuilt engine's first retract per word
+    can reference a count the file never recorded — the same
+    at-least-once window the cross-file matrix above documents.)"""
+    n = 120
+    spec = json.dumps(
+        {
+            "site": site,
+            "process": 1,
+            "generation": 0,
+            "hit": 3,
+            "action": "kill",
+        }
+    )
+    out = str(tmp_path / "out.jsonl")
+    flight_dir = str(tmp_path / "blackbox")
+    procs = _spawn_cluster(
+        tmp_path,
+        out,
+        spec,
+        n,
+        extra_env={
+            "PATHWAY_CLUSTER_LEASE_MS": "1500",
+            "PATHWAY_CLUSTER_RESPAWN": "1",
+            "PATHWAY_FLIGHT_RECORDER_DIR": flight_dir,
+        },
+    )
+    p0, p1 = procs
+    try:
+        _, err0 = p0.communicate(timeout=180)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        p1.wait(timeout=10)
+
+    # the original worker 1 was SIGKILLed by the chaos rule...
+    assert p1.returncode == -signal.SIGKILL, (p1.returncode, err0[-3000:])
+    # ...and the coordinator finished the run in its one original
+    # process: a partial restart, not a supervisor (full) restart
+    assert p0.returncode == 0, err0[-3000:]
+    assert "cluster partial restart" in err0
+
+    # exact final counts by net accounting (retract pops, insert sets):
+    # the regroup may drop the in-flight epoch's flush, so strict
+    # retract/insert pairing cannot hold across the boundary, but the
+    # net state must land exactly on the clean-run counts
+    state: dict = {}
+    with open(out + ".0") as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec["diff"] > 0:
+                state[rec["word"]] = rec["n"]
+            else:
+                state.pop(rec["word"], None)
+    assert state == {"cat": 40, "dog": 40, "bird": 40}
+
+    # the black box kept the evidence: a cluster.partial_restart dump
+    # whose ring names the dead worker, and no supervisor restart
+    from pathway_tpu.internals import flight_recorder as fr
+
+    dumps = [fr.load_dump(p) for p in fr.list_dumps(flight_dir)]
+    restarts = [d for d in dumps if d.get("reason") == "cluster.partial_restart"]
+    assert restarts, [d.get("reason") for d in dumps]
+    kinds = {e["kind"] for d in restarts for e in d["events"]}
+    assert "cluster.partial_restart" in kinds
+    assert "supervisor.restart" not in kinds

@@ -1,0 +1,336 @@
+"""Unit coverage for the cluster fault domain: the shared registries
+(`ClusterMetrics`/`ClusterHealth`), shard-aware admission shedding, the
+cluster-channel chaos fault family, durable generation tokens, the
+deterministic chaos seed, /metrics gating, and flight-recorder dump
+retention. Multi-process integration (lease expiry, partial restart)
+lives in tests/test_chaos_crash_window.py."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import persistence as eng_persist
+from pathway_tpu.internals.flight_recorder import FlightRecorder, list_dumps
+from pathway_tpu.resilience import chaos
+from pathway_tpu.resilience.cluster import (
+    CLUSTER_HEALTH,
+    CLUSTER_METRICS,
+    ClusterHealth,
+    ClusterMetrics,
+    ClusterRegroup,
+    WorkerLost,
+)
+from pathway_tpu.serving import (
+    AdmissionController,
+    ServingConfig,
+    ShardUnavailable,
+)
+from pathway_tpu.serving.metrics import ServingMetrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_registries():
+    yield
+    CLUSTER_METRICS.reset()
+    CLUSTER_HEALTH.mark_all_up()
+    chaos.deactivate()
+
+
+# ---------------------------------------------------------- registries
+
+
+def test_cluster_metrics_counts_and_snapshot():
+    m = ClusterMetrics()
+    assert not m.active()
+    m.record_lease_expired(1)
+    m.record_lease_expired(1)
+    m.record_lease_expired(2)
+    m.record_partial_restart(1)
+    m.record_fenced_write(2)
+    m.record_barrier(generation=3)
+    snap = m.snapshot()
+    assert snap["lease_expiries"] == {"1": 2, "2": 1}
+    assert snap["lease_expiries_total"] == 3
+    assert snap["partial_restarts_total"] == 1
+    assert snap["fenced_writes_total"] == 1
+    assert snap["barriers_total"] == 1
+    assert snap["generation"] == 3
+    assert m.active()
+    m.reset()
+    assert not m.active()
+
+
+def test_cluster_metrics_barrier_without_generation_keeps_token():
+    m = ClusterMetrics()
+    m.record_barrier(generation=2)
+    m.record_barrier()
+    assert m.snapshot()["generation"] == 2
+    assert m.snapshot()["barriers_total"] == 2
+
+
+def test_cluster_health_down_and_recovery():
+    h = ClusterHealth()
+    assert not h.any_down()
+    h.mark_down([2, 3], retry_after_s=4.5)
+    assert h.is_down(2) and h.is_down(3) and not h.is_down(0)
+    assert h.down_shards() == frozenset({2, 3})
+    assert h.retry_after_s() == 4.5
+    h.mark_down([5])  # accumulates until the next full formation
+    assert h.down_shards() == frozenset({2, 3, 5})
+    h.mark_all_up()
+    assert not h.any_down()
+
+
+def test_worker_lost_and_regroup_carry_identity():
+    wl = WorkerLost(3, "lease expired (2s without a frame)")
+    assert wl.pid == 3 and "lease expired" in str(wl)
+    rg = ClusterRegroup([3, 1], 7, "lease expired")
+    assert rg.dead_pids == [1, 3]
+    assert rg.generation == 7
+    assert "generation=7" in str(rg)
+    # a leaked regroup must NOT be absorbed by the supervisor's default
+    # restart_on classes — it is the partial-restart loop's signal
+    from pathway_tpu.resilience.supervisor import _default_restart_on
+
+    assert not isinstance(rg, _default_restart_on())
+
+
+# ------------------------------------------------- shard-aware admission
+
+
+def test_admit_sheds_down_shard_with_typed_503():
+    CLUSTER_HEALTH.mark_down([1], retry_after_s=2.0)
+    ctl = AdmissionController(
+        ServingConfig(max_queue=8), metrics=ServingMetrics()
+    )
+    t = ctl.admit(shard=0)  # healthy shard unaffected
+    ctl.release(t)
+    with pytest.raises(ShardUnavailable) as ei:
+        ctl.admit(shard=1)
+    assert ei.value.status == 503
+    assert ei.value.reason == "shard_unavailable"
+    assert ei.value.retry_after_s == 2.0
+    assert ctl.metrics.snapshot()["shed_total"]["shard_unavailable"] == 1
+
+
+def test_admit_degrade_mode_serves_down_shard_degraded():
+    CLUSTER_HEALTH.mark_down([1])
+    ctl = AdmissionController(
+        ServingConfig(max_queue=8, shed="degrade"), metrics=ServingMetrics()
+    )
+    t = ctl.admit(shard=1)
+    assert t.degraded
+    ctl.release(t)
+    t = ctl.admit(shard=0)
+    assert not t.degraded
+    ctl.release(t)
+
+
+def test_admit_without_shard_ignores_cluster_health():
+    CLUSTER_HEALTH.mark_down([0, 1, 2])
+    ctl = AdmissionController(
+        ServingConfig(max_queue=8), metrics=ServingMetrics()
+    )
+    t = ctl.admit()  # not pinned to a shard: answered normally
+    ctl.release(t)
+
+
+# ------------------------------------------- cluster-channel chaos family
+
+
+def test_chaos_channel_drop_and_duplicate_verdicts():
+    chaos.activate(
+        [
+            {"site": "cluster.send", "action": "drop", "hit": 2},
+            {"site": "cluster.send", "action": "duplicate", "hit": 3},
+        ]
+    )
+    # hit counters advance independently per rule
+    assert chaos.channel("cluster.send") is None  # drop@1, dup@1
+    v2 = chaos.channel("cluster.send")  # drop fires at its 2nd hit
+    assert v2 == "drop"
+    v3 = chaos.channel("cluster.send")  # duplicate fires at its 3rd
+    assert v3 == "duplicate"
+    assert chaos.channel("cluster.send") is None  # both one-shot
+
+
+def test_chaos_channel_partition_is_sticky_until_expiry():
+    chaos.activate(
+        [
+            {
+                "site": "cluster.send",
+                "action": "partition",
+                "duration_s": 0.2,
+            }
+        ]
+    )
+    assert chaos.channel("cluster.send") == "drop"  # arms the partition
+    assert chaos.channel("cluster.send") == "drop"  # sticky
+    assert chaos.channel("other.site") is None  # per-site
+    time.sleep(0.25)
+    assert chaos.channel("cluster.send") is None  # healed
+
+
+def test_chaos_channel_filters_on_process_and_generation(monkeypatch):
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    monkeypatch.setenv("PATHWAY_CLUSTER_GENERATION", "0")
+    chaos.activate(
+        [
+            {
+                "site": "cluster.send",
+                "action": "drop",
+                "process": 1,
+                "generation": 0,
+            }
+        ]
+    )
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    assert chaos.channel("cluster.send") is None  # wrong process
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    monkeypatch.setenv("PATHWAY_CLUSTER_GENERATION", "1")
+    # generation moved on (partial restart happened): rule disarmed
+    assert chaos.channel("cluster.send") is None
+    monkeypatch.setenv("PATHWAY_CLUSTER_GENERATION", "0")
+    assert chaos.channel("cluster.send") == "drop"
+
+
+class _FakeSock:
+    def __init__(self):
+        self.data = b""
+
+    def sendall(self, b):
+        self.data += b
+
+
+def test_send_frame_applies_channel_verdicts():
+    from pathway_tpu.parallel.multiprocess import _HDR, _send_frame
+
+    chaos.activate(
+        [{"site": "cluster.send", "action": "drop", "hit": 1}]
+    )
+    s = _FakeSock()
+    _send_frame(s, {"op": "poll"}, threading.Lock())
+    assert s.data == b""  # dropped: nothing hit the wire
+    chaos.activate(
+        [{"site": "cluster.send", "action": "duplicate", "hit": 1}]
+    )
+    _send_frame(s, {"op": "poll"})
+    (n,) = _HDR.unpack(s.data[: _HDR.size])
+    assert len(s.data) == 2 * (_HDR.size + n)  # frame sent twice
+    assert s.data[: _HDR.size + n] == s.data[_HDR.size + n :]
+
+
+def test_deterministic_seed_stable_per_plan_and_process(monkeypatch):
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    chaos.activate([{"site": "cluster.send", "action": "drop"}])
+    s1 = chaos.deterministic_seed()
+    s2 = chaos.deterministic_seed()
+    assert s1 is not None and s1 == s2
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "2")
+    assert chaos.deterministic_seed() != s1
+    chaos.deactivate()
+    assert chaos.deterministic_seed() is None
+
+
+def test_retry_policy_defaults_jitter_seed_from_chaos_plan(monkeypatch):
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    chaos.activate([{"site": "cluster.send", "action": "drop"}])
+    def schedule():
+        p = pw.RetryPolicy(
+            first_delay_ms=10, backoff_factor=2, jitter_ms=100, max_retries=5
+        )
+        return [p.wait_duration_before_retry() for _ in range(5)]
+
+    assert schedule() == schedule()  # chaos runs replay identically
+    chaos.deactivate()
+
+
+# ----------------------------------------------- durable generation token
+
+
+def test_cluster_generation_bump_is_durable(tmp_path):
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+    cfg = pw.persistence.Config.simple_config(backend)
+    p = eng_persist.EnginePersistence(cfg)
+    assert p.cluster_generation() == 0
+    assert p.bump_cluster_generation() == 1
+    assert p.bump_cluster_generation() == 2
+    p.close()
+    p2 = eng_persist.EnginePersistence(cfg)
+    assert p2.cluster_generation() == 2
+    p2.close()
+
+
+def test_cluster_generation_visible_from_worker_namespace(
+    tmp_path, monkeypatch
+):
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+    cfg = pw.persistence.Config.simple_config(backend)
+    p0 = eng_persist.EnginePersistence(cfg)
+    p0.bump_cluster_generation()
+    p0.close()
+    # a worker process namespaces its own logs under proc-<pid> but must
+    # read the coordinator's generation from the base namespace
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    pw1 = eng_persist.EnginePersistence(cfg)
+    assert pw1.cluster_generation() == 1
+    pw1.close()
+
+
+# ------------------------------------------------- metrics plane gating
+
+
+def test_metrics_cluster_lines_gated_on_activity():
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+
+    assert MonitoringHttpServer._cluster_lines() == []
+    CLUSTER_METRICS.record_barrier(generation=1)
+    CLUSTER_HEALTH.mark_down([3])
+    lines = "\n".join(MonitoringHttpServer._cluster_lines())
+    assert "pathway_cluster_barriers_total 1" in lines
+    assert "pathway_cluster_generation 1" in lines
+    assert 'pathway_cluster_shard_down{shard="3"} 1' in lines
+
+
+def test_metrics_cluster_lines_render_per_process_counters():
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+
+    CLUSTER_METRICS.record_lease_expired(1)
+    CLUSTER_METRICS.record_partial_restart(1)
+    CLUSTER_METRICS.record_fenced_write(2)
+    CLUSTER_METRICS.record_fenced_write(2)
+    lines = "\n".join(MonitoringHttpServer._cluster_lines())
+    # lease expiries keep the per-process split; the rest are totals
+    assert 'pathway_cluster_lease_expiries_total{process="1"} 1' in lines
+    assert "pathway_cluster_partial_restarts_total 1" in lines
+    assert "pathway_cluster_fenced_writes_total 2" in lines
+
+
+# -------------------------------------------------- dump retention (KEEP)
+
+
+def test_flight_recorder_keep_prunes_old_dumps(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER_KEEP", "2")
+    fr = FlightRecorder(size=16, enabled=True)
+    d = str(tmp_path / "bb")
+    paths = []
+    for i in range(5):
+        fr.record("epoch.begin", t=i)
+        paths.append(fr.dump(f"r{i}", directory=d))
+    remaining = list_dumps(d)
+    assert len(remaining) == 2
+    assert remaining == sorted(paths[-2:])
+
+
+def test_flight_recorder_keep_zero_keeps_everything(tmp_path, monkeypatch):
+    monkeypatch.delenv("PATHWAY_FLIGHT_RECORDER_KEEP", raising=False)
+    fr = FlightRecorder(size=16, enabled=True)
+    d = str(tmp_path / "bb")
+    for i in range(4):
+        fr.record("epoch.begin", t=i)
+        fr.dump(f"r{i}", directory=d)
+    assert len(list_dumps(d)) == 4

@@ -146,3 +146,33 @@ def test_pwl008_json_names_route_and_pressure():
     assert diag["detail"]["endpoints"][0]["route"] == "/"
     assert diag["detail"]["recovery"] is True
     assert diag["detail"]["pipeline_depth"] == 2
+
+def test_cluster_without_fault_domain_warns_pwl009():
+    """A 2-process run with recovery= off and cluster_lease_ms=0: two
+    PWL009 warnings (exit 0), nonzero only under --strict-warnings.
+    The fixture sets PATHWAY_PROCESSES itself, so the CLI sees the
+    cluster shape through the recorded run configuration."""
+    fixture = os.path.join(FIXTURES, "cluster_no_recovery.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert proc.stdout.count("PWL009") == 2
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--strict-warnings")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl009_json_carries_world_and_lease():
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "cluster_no_recovery.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    diags = [d for d in payload["diagnostics"] if d["rule"] == "PWL009"]
+    assert len(diags) == 2
+    assert all(d["severity"] == "warning" for d in diags)
+    assert {d["detail"]["world"] for d in diags} == {2}
+    (lease_diag,) = [
+        d for d in diags if "cluster_lease_ms" in d["detail"]
+    ]
+    assert lease_diag["detail"]["cluster_lease_ms"] == 0.0
