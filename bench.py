@@ -147,7 +147,8 @@ def bench_framework_path(words: int = 130, n: int = 32768):
     pipeline); only a checksum returns, so the tunnel's slow host link
     doesn't masquerade as framework overhead.
 
-    Returns (emb/s, padded seq bucket, achieved model TFLOP/s)."""
+    Returns (emb/s, padded seq bucket, achieved model TFLOP/s,
+    kernel pad fraction over the measured run)."""
     from pathway_tpu.models.batching import DEFAULT_SEQ_BUCKETS, bucket
     from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
 
@@ -158,13 +159,17 @@ def bench_framework_path(words: int = 130, n: int = 32768):
     )
     seq = bucket(int(lens.max()), DEFAULT_SEQ_BUCKETS)
     s = np.asarray(emb.encode_device(texts).sum())  # compile + warm
+    from pathway_tpu.internals.profiler import ENCODER_KERNEL_STATS
+
+    ENCODER_KERNEL_STATS.reset()  # attribute the measured run only
     t0 = time.perf_counter()
     out = emb.encode_device(texts)
     s = np.asarray(out.sum())
     dt = time.perf_counter() - t0
     assert out.shape == (n, emb.get_embedding_dimension()) and np.isfinite(s)
     tflops = n * seq * _encoder_flops_per_token(seq) / dt / 1e12
-    return n / dt, seq, round(tflops, 1)
+    pad_fraction = round(ENCODER_KERNEL_STATS.pad_fraction(), 4)
+    return n / dt, seq, round(tflops, 1), pad_fraction
 
 
 def bench_device_scan_bound(seq: int, n: int = 32768) -> float:
@@ -214,7 +219,7 @@ def main() -> None:
     # round); the headline stays the LAST line for the driver
     run_suite()
     raw_eps, n_chips = bench_device_scan()
-    fw_eps, fw_seq, fw_tflops = bench_framework_path()
+    fw_eps, fw_seq, fw_tflops, fw_pad = bench_framework_path()
     bound_eps = bench_device_scan_bound(fw_seq)
     fw_per_chip = fw_eps / n_chips
     peak = bench_chip_peak_probe()
@@ -227,6 +232,7 @@ def main() -> None:
         "embeddings/s",
         seq_bucket=fw_seq,
         achieved_tflops=fw_tflops,
+        pad_fraction=fw_pad,
         per_chip=round(fw_per_chip, 1),
     )
     _emit(
@@ -248,6 +254,7 @@ def main() -> None:
                 "regime), via the C++ batched tokenizer + bucketed "
                 "scanned encoder with tokenize/compute overlap",
                 "achieved_tflops": fw_tflops,
+                "pad_fraction": fw_pad,
                 "seq_bucket": fw_seq,
                 "device_scan_bound_eps": round(bound_eps, 1),
                 "vs_device_scan_bound": round(fw_eps / bound_eps, 3),
@@ -1250,21 +1257,173 @@ def suite_cluster_mttr() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def suite_encoder_mfu() -> None:
+    """Kernel microbench for the fused encoder layer, per seq bucket.
+
+    Two legs share one entry point so `bench.py suite_encoder_mfu` is
+    runnable anywhere:
+
+    - off-TPU (CI / tier-1): the SAME pallas kernel in interpret mode at
+      miniature geometry — asserts the ragged (lens-driven) dispatch is
+      bit-identical to the dense dispatch on the live rows, dead
+      all-padding blocks come back zero, and the kernel matches the
+      per-op XLA module. Green here means a kernel regression can't hide
+      behind "no TPU in CI".
+    - on TPU: per-bucket achieved model TFLOP/s of the real kernel, plus
+      the pad-skip speedup when half the rows are padding (the ragged
+      grid should approach 2x — that is the 150-wordpiece tax refund).
+    """
+    import jax
+
+    if jax.default_backend() == "tpu":
+        _encoder_mfu_measure()
+    else:
+        _encoder_mfu_interpret_check()
+
+
+def _encoder_mfu_interpret_check() -> None:
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.encoder import EncoderConfig, TextEncoder, init_params
+    from pathway_tpu.ops.fused_layer import (
+        _pack_rows,
+        encoder_flops_per_token,
+        encoder_forward,
+    )
+
+    cfg = EncoderConfig(
+        vocab_size=1000,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=2,
+        intermediate_size=64,
+        max_position=64,
+    )
+    module = TextEncoder(cfg)
+    params = init_params(module, cfg)
+    rng = np.random.default_rng(0)
+    for seq in (16, 32):
+        p = _pack_rows(seq)
+        b = p  # one live block exactly, so the ragged run appends a dead one
+        ids = rng.integers(5, 999, (b, seq)).astype(np.int32)
+        lens = rng.integers(max(1, seq // 2), seq + 1, (b,)).astype(np.int32)
+        mask = np.arange(seq)[None, :] < lens[:, None]
+        dense = np.asarray(
+            encoder_forward(
+                params, cfg, jnp.asarray(ids), jnp.asarray(mask),
+                lens=jnp.asarray(lens), interpret=True,
+            )
+        )
+        ids_r = np.concatenate([ids, np.zeros_like(ids)], axis=0)
+        lens_r = np.concatenate([lens, np.zeros_like(lens)])
+        mask_r = np.arange(seq)[None, :] < lens_r[:, None]
+        ragged = np.asarray(
+            encoder_forward(
+                params, cfg, jnp.asarray(ids_r), jnp.asarray(mask_r),
+                lens=jnp.asarray(lens_r), interpret=True,
+            )
+        )
+        if not np.array_equal(ragged[:b], dense):
+            raise AssertionError(
+                f"ragged dispatch != dense dispatch on live rows at seq={seq}"
+            )
+        if not np.all(ragged[b:] == 0.0):
+            raise AssertionError(f"dead all-padding block not zeroed at seq={seq}")
+        ref = np.asarray(module.apply(params, jnp.asarray(ids), jnp.asarray(mask)))
+        err = float(np.abs(ref - dense).max())
+        if err > 3e-2:
+            raise AssertionError(f"kernel vs XLA parity err {err} at seq={seq}")
+        _emit(
+            "encoder_mfu_interpret_parity",
+            err,
+            "max_abs_err",
+            seq=seq,
+            rows_per_block=p,
+            gflops_per_row=round(seq * encoder_flops_per_token(cfg, seq) / 1e9, 6),
+            note="CPU leg: interpret-mode kernel; ragged==dense bitwise, "
+            "dead blocks zeroed, XLA parity within bf16 tolerance",
+        )
+
+
+def _encoder_mfu_measure() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.encoder import EncoderConfig, TextEncoder, init_params
+    from pathway_tpu.ops.fused_layer import encoder_flops_per_token, encoder_forward
+
+    cfg = EncoderConfig.minilm_l6()
+    params = init_params(TextEncoder(cfg), cfg)
+    rng = np.random.default_rng(0)
+    rounds = 10
+    for seq in (32, 128, 160, 256):
+        B = 4096 if seq <= 160 else 2048
+
+        def run(p, ids, mask, lens):
+            return jnp.sum(encoder_forward(p, cfg, ids, mask, lens=lens)[:, 0])
+
+        fn = jax.jit(run)
+        ids = jax.device_put(rng.integers(999, 29000, (B, seq)).astype(np.int32))
+        mask = jax.device_put(np.ones((B, seq), bool))
+        full = jax.device_put(np.full((B,), seq, np.int32))
+        # half the rows are padding: the ragged grid skips their blocks
+        lens_half = np.full((B,), seq, np.int32)
+        lens_half[B // 2:] = 0
+        mask_half = np.arange(seq)[None, :] < lens_half[:, None]
+        mask_half_d = jax.device_put(mask_half)
+        half = jax.device_put(lens_half)
+
+        def timed(m, l) -> float:
+            fn(params, ids, m, l).block_until_ready()  # compile + warm
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(rounds):
+                out = fn(params, ids, m, l)
+            out.block_until_ready()
+            return time.perf_counter() - t0
+
+        dt_dense = timed(mask, full)
+        dt_half = timed(mask_half_d, half)
+        tflops = rounds * B * seq * encoder_flops_per_token(cfg, seq) / dt_dense / 1e12
+        _emit(
+            "encoder_mfu_tflops",
+            tflops,
+            "TFLOP/s",
+            seq=seq,
+            batch=B,
+            mode="dense, per-layer fused kernel",
+        )
+        _emit(
+            "encoder_mfu_pad_skip_speedup",
+            dt_dense / dt_half,
+            "x",
+            seq=seq,
+            note="half the rows all-padding; the ragged grid should "
+            "approach 2x by skipping their blocks",
+        )
+
+
+#: `--suite` registry; any name here is also directly invocable as
+#: `python bench.py <suite_name>`
+SUITES = (
+    suite_etl,
+    suite_serving_qps,
+    suite_cluster_mttr,
+    suite_knn_10k,
+    suite_vector_store_ingest,
+    suite_adaptive_rag_p50,
+    suite_clip,
+    suite_encoder_mfu,
+    suite_streaming_8shard,
+    suite_streaming_tpu_chip,
+    suite_knn_churn,
+)
+
+
 def run_suite() -> None:
     import traceback
 
-    for fn in (
-        suite_etl,
-        suite_serving_qps,
-        suite_cluster_mttr,
-        suite_knn_10k,
-        suite_vector_store_ingest,
-        suite_adaptive_rag_p50,
-        suite_clip,
-        suite_streaming_8shard,
-        suite_streaming_tpu_chip,
-        suite_knn_churn,
-    ):
+    for fn in SUITES:
         try:
             fn()
         except Exception as e:  # one config failing must not hide the rest
@@ -1284,7 +1443,12 @@ def run_suite() -> None:
 if __name__ == "__main__":
     import sys
 
-    if "--suite" in sys.argv:
+    _by_name = {fn.__name__: fn for fn in SUITES}
+    named = [a for a in sys.argv[1:] if a in _by_name]
+    if named:
+        for a in named:
+            _by_name[a]()
+    elif "--suite" in sys.argv:
         run_suite()
     else:
         main()

@@ -99,6 +99,9 @@ class DeviceRing:
         self._lock = threading.Lock()
         self.staged = 0       # total stage() calls
         self.donated = 0      # buffers invalidated by slot reuse
+        self.stage_stall_s = 0.0  # time stage() blocked on unretired slots
+        self.bytes_staged = 0     # host->device bytes pushed through the ring
+        self.high_water = 0       # max generations simultaneously in flight
         with _registry_lock:
             _registry.add(self)
 
@@ -116,9 +119,16 @@ class DeviceRing:
             if not prev_retired:
                 # consumer still reading the old generation: the put
                 # below would donate it out from under them — wait for
-                # the device to drain it first (backpressure, not UB)
+                # the device to drain it first (backpressure, not UB).
+                # Stall time here means the host is outrunning the ring:
+                # raise the depth (PATHWAY_WIRE_RING_DEPTH for encoder
+                # wire uploads) so staging keeps pace with the kernel.
+                import time as _time
+
+                t0 = _time.perf_counter()
                 for a in prev:
                     _block(a)
+                self.stage_stall_s += _time.perf_counter() - t0
             for a in prev:
                 _delete(a)
             self.donated += len(prev)
@@ -128,12 +138,28 @@ class DeviceRing:
                 "ring.donate", ring=self.name, buffers=len(prev), total=self.donated
             )
         handles = [_device_put(a) for a in items]
+        nbytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in items)
         with self._lock:
             self._slots[idx] = handles
             self._retired[idx] = False
             self._in_flight.append(handles)
             self.staged += 1
+            self.bytes_staged += nbytes
+            self.high_water = max(self.high_water, len(self._in_flight))
         return handles
+
+    def stats(self) -> dict:
+        """Staging-depth telemetry for the host-path attribution."""
+        with self._lock:
+            return {
+                "ring": self.name,
+                "depth": self.depth,
+                "staged": self.staged,
+                "donated": self.donated,
+                "bytes_staged": self.bytes_staged,
+                "high_water": self.high_water,
+                "stage_stall_s": self.stage_stall_s,
+            }
 
     def retire(self, handles: list[Any]) -> None:
         """The consuming epoch delivered: the slot holding ``handles``

@@ -112,7 +112,7 @@ class PipelineStats:
             self.device_wait_s += max(0.0, seconds)
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "depth": self.depth,
             "host_prep_s": round(self.host_prep_s, 6),
             "device_wait_s": round(self.device_wait_s, 6),
@@ -122,6 +122,24 @@ class PipelineStats:
             "staged_epochs": self.staged_epochs,
             "executed_epochs": self.executed_epochs,
         }
+        # encoder-kernel MFU attribution rides the pipeline stats into
+        # the chrome trace / monitoring snapshot when the run dispatched
+        # the fused encoder (zero-cost otherwise)
+        from ..internals.profiler import ENCODER_KERNEL_STATS
+
+        if ENCODER_KERNEL_STATS.dispatches:
+            enc = ENCODER_KERNEL_STATS.snapshot()
+            out["encoder_achieved_tflops"] = round(enc["achieved_tflops"], 2)
+            out["encoder_pad_fraction"] = round(enc["pad_fraction"], 4)
+            out["encoder_dispatches"] = enc["dispatches"]
+        # ditto for staging-ring backpressure: stall time here is the
+        # "host can't keep pace" signal PATHWAY_WIRE_RING_DEPTH tunes
+        from .device_ring import active_rings
+
+        stall = sum(r.stage_stall_s for r in active_rings())
+        if stall:
+            out["ring_stage_stall_s"] = round(stall, 6)
+        return out
 
 
 @dataclass

@@ -181,6 +181,35 @@ class MonitoringHttpServer:
                     series("pathway_pipeline_depth", snap.pipeline_depth),
                 ]
             )
+        if getattr(snap, "encoder_dispatches", 0) > 0:
+            # fused-encoder MFU / pad-waste attribution (profiler
+            # ENCODER_KERNEL_STATS): achieved model-TFLOPs over the
+            # recent dispatch window and the padding share of computed
+            # tokens. Rendered only when the fused encoder dispatched,
+            # so non-encoder pipelines' output stays byte-identical.
+            lines.extend(
+                [
+                    "# TYPE pathway_encoder_achieved_tflops gauge",
+                    series(
+                        "pathway_encoder_achieved_tflops",
+                        f"{snap.encoder_achieved_tflops:.3f}",
+                    ),
+                    "# TYPE pathway_encoder_pad_fraction gauge",
+                    series(
+                        "pathway_encoder_pad_fraction",
+                        f"{snap.encoder_pad_fraction:.4f}",
+                    ),
+                    "# TYPE pathway_encoder_dispatches_total counter",
+                    series(
+                        "pathway_encoder_dispatches_total", snap.encoder_dispatches
+                    ),
+                    "# TYPE pathway_encoder_skipped_tokens_total counter",
+                    series(
+                        "pathway_encoder_skipped_tokens_total",
+                        snap.encoder_skipped_tokens,
+                    ),
+                ]
+            )
         if workers:
             lines.extend(self._worker_lines(workers))
         lines.extend(self._resilience_lines(wl))

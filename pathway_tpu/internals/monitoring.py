@@ -133,6 +133,15 @@ class StatsSnapshot:
     host_prep_s: float = 0.0
     device_wait_s: float = 0.0
     overlap_ratio: float = 0.0
+    #: fused-encoder kernel MFU attribution (profiler
+    #: ENCODER_KERNEL_STATS): windowed achieved model-TFLOPs, the
+    #: padding share of computed tokens, and the dispatch count.
+    #: All zero when no fused encoder ran — rendering stays
+    #: byte-identical for non-encoder pipelines.
+    encoder_achieved_tflops: float = 0.0
+    encoder_pad_fraction: float = 0.0
+    encoder_dispatches: int = 0
+    encoder_skipped_tokens: int = 0
     #: cluster telemetry plane: worker_id -> per-worker stats dict
     #: (epoch, rows_in, rows_out, rows_per_s, event_lag_s,
     #: overlap_ratio, restarts, pid). Empty outside sharded /
@@ -227,6 +236,14 @@ class StatsMonitor:
             snap.host_prep_s = pipeline.host_prep_s
             snap.device_wait_s = pipeline.device_wait_s
             snap.overlap_ratio = pipeline.overlap_ratio
+        from .profiler import ENCODER_KERNEL_STATS
+
+        if ENCODER_KERNEL_STATS.dispatches:
+            enc = ENCODER_KERNEL_STATS.snapshot()
+            snap.encoder_achieved_tflops = enc["achieved_tflops"]
+            snap.encoder_pad_fraction = enc["pad_fraction"]
+            snap.encoder_dispatches = enc["dispatches"]
+            snap.encoder_skipped_tokens = enc["skipped_tokens"]
         for node in engine.nodes:
             rows_in, rows_out = node.stats.rows_in, node.stats.rows_out
             key = f"{node.id}:{node.name}"
@@ -367,6 +384,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
     profiled = monitor.profiler is not None
     snap = monitor.snapshot
     pipelined = snap.pipeline_depth > 1
+    # encoder-kernel MFU column only when the fused encoder dispatched
+    encoding = snap.encoder_dispatches > 0
     table = Table(caption=caption, box=box.SIMPLE)
     table.add_column("operator", justify="left")
     table.add_column(r"latency to wall clock \[ms]", justify="right")
@@ -376,7 +395,9 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         table.add_column(r"event lag \[s]", justify="right")
     if pipelined:
         table.add_column("overlap ratio", justify="right")
-    pad = (2 if profiled else 0) + (1 if pipelined else 0)
+    if encoding:
+        table.add_column(r"MFU \[TF] / pad", justify="right")
+    pad = (2 if profiled else 0) + (1 if pipelined else 0) + (1 if encoding else 0)
 
     def row(*cells):
         table.add_row(*(cells + ("",) * pad))
@@ -399,6 +420,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
                 )
             if pipelined:
                 cells = cells + ("",)
+            if encoding:
+                cells = cells + ("",)
             table.add_row(*cells)
     if pipelined:
         cells = (
@@ -409,6 +432,23 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         if profiled:
             cells = cells + (f"{snap.host_prep_s * 1000:.1f}", "")
         cells = cells + (f"{snap.overlap_ratio:.2f}",)
+        if encoding:
+            cells = cells + ("",)
+        table.add_row(*cells)
+    if encoding:
+        cells = (
+            f"encoder kernel ({snap.encoder_dispatches} dispatches)",
+            "",
+            "",
+        )
+        if profiled:
+            cells = cells + ("", "")
+        if pipelined:
+            cells = cells + ("",)
+        cells = cells + (
+            f"{snap.encoder_achieved_tflops:.1f} / "
+            f"{snap.encoder_pad_fraction * 100:.1f}%",
+        )
         table.add_row(*cells)
     row("output", f"{monitor.output_latency_ms(now)}", "")
     return table

@@ -461,6 +461,129 @@ class RunProfiler:
             json.dump(self.chrome_trace(), f)
 
 
+# ---- fused-encoder kernel MFU / pad-waste attribution ----
+
+
+class EncoderKernelStats:
+    """Process-global achieved-TFLOPs and pad-waste accounting for the
+    fused encoder kernel (ops/fused_layer.py).
+
+    Every dispatch on the encode hot path reports its bucket geometry:
+    how many tokens were real, how many the kernel actually computed
+    (live blocks — the ragged grid skips all-padding blocks), and how
+    many the (batch, seq) bucket nominally holds.  From those this
+    derives the two first-class observability signals of the MFU round:
+
+    - ``pad_fraction`` — of the tokens the kernel computed, the share
+      that was padding (the FLOP tax the bucketing layer failed to
+      avoid); skipped dead blocks are *excluded* — they cost nothing.
+    - ``achieved_tflops`` — model FLOPs of computed tokens over wall
+      time, windowed over recent dispatches.  Attribution is
+      dispatch-clock: FLOPs are counted when a dispatch is issued while
+      the device crunches asynchronously, so the rate is meaningful
+      across a stream of dispatches (the steady state of the encode
+      path), not for a single isolated call.
+
+    A module singleton (:data:`ENCODER_KERNEL_STATS`) feeds the
+    StatsSnapshot dashboard column, the ``pathway_encoder_*`` gauges on
+    ``/metrics``, and ``kernel.dispatch`` flight-recorder events.
+    """
+
+    WINDOW_S = 30.0
+
+    def __init__(self) -> None:
+        from collections import deque
+
+        self._lock = threading.Lock()
+        self.dispatches = 0
+        self.real_tokens = 0
+        self.computed_tokens = 0
+        self.padded_tokens = 0
+        self.skipped_tokens = 0
+        self.model_flops = 0.0
+        self._samples: Any = deque(maxlen=512)  # (monotonic t, cum flops)
+
+    def record_dispatch(
+        self,
+        *,
+        seq: int,
+        batch: int,
+        real_tokens: int,
+        computed_tokens: int,
+        flops: float,
+    ) -> None:
+        padded = seq * batch
+        now = time.monotonic()
+        with self._lock:
+            self.dispatches += 1
+            self.real_tokens += int(real_tokens)
+            self.computed_tokens += int(computed_tokens)
+            self.padded_tokens += int(padded)
+            self.skipped_tokens += int(padded - computed_tokens)
+            self.model_flops += float(flops)
+            self._samples.append((now, self.model_flops))
+        from . import flight_recorder
+
+        flight_recorder.record(
+            "kernel.dispatch",
+            seq=int(seq),
+            batch=int(batch),
+            real_tokens=int(real_tokens),
+            computed_tokens=int(computed_tokens),
+            gflops=round(float(flops) / 1e9, 3),
+        )
+
+    def pad_fraction(self) -> float:
+        """Padding share of the tokens the kernel actually computed."""
+        with self._lock:
+            if not self.computed_tokens:
+                return 0.0
+            return 1.0 - self.real_tokens / self.computed_tokens
+
+    def achieved_tflops(self) -> float:
+        """Model-FLOPs throughput over the recent dispatch window."""
+        now = time.monotonic()
+        with self._lock:
+            recent = [s for s in self._samples if now - s[0] <= self.WINDOW_S]
+            if len(recent) < 2:
+                return 0.0
+            (t0, f0), (t1, f1) = recent[0], recent[-1]
+            if t1 - t0 <= 1e-6:
+                return 0.0
+            return (f1 - f0) / (t1 - t0) / 1e12
+
+    def snapshot(self) -> dict:
+        tflops = self.achieved_tflops()
+        with self._lock:
+            return {
+                "dispatches": self.dispatches,
+                "real_tokens": self.real_tokens,
+                "computed_tokens": self.computed_tokens,
+                "padded_tokens": self.padded_tokens,
+                "skipped_tokens": self.skipped_tokens,
+                "model_flops": self.model_flops,
+                "pad_fraction": (
+                    1.0 - self.real_tokens / self.computed_tokens
+                    if self.computed_tokens
+                    else 0.0
+                ),
+                "achieved_tflops": tflops,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.dispatches = 0
+            self.real_tokens = 0
+            self.computed_tokens = 0
+            self.padded_tokens = 0
+            self.skipped_tokens = 0
+            self.model_flops = 0.0
+            self._samples.clear()
+
+
+ENCODER_KERNEL_STATS = EncoderKernelStats()
+
+
 # ---- module-level current profiler (jit hooks in models/ and udfs/) ----
 
 _current: RunProfiler | None = None

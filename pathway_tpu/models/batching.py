@@ -12,10 +12,18 @@ from __future__ import annotations
 
 import numpy as np
 
-# 160/192 between 128 and 256: TokenCountSplitter-regime chunks
-# (~130-190 wordpieces) otherwise pad to 256 and waste ~40% of the
-# encoder FLOPs on pad tokens
-DEFAULT_SEQ_BUCKETS = (16, 32, 64, 128, 160, 192, 256, 512)
+# Intermediate buckets (48/96 below 128; 160/192/224 between 128 and
+# 256; 320/384/448 between 256 and 512) bound the worst-case pad tax of
+# a sorted length-group to the gap to the next bucket — the old coarse
+# set sent TokenCountSplitter-regime chunks (~130-190 wordpieces) in a
+# mixed batch straight to 256 and wasted ~40% of the encoder FLOPs on
+# pad tokens (r05 bench).  Callers sort by length BEFORE grouping
+# (SentenceEncoder._matrix_groups), so each group's max length sits
+# close to its bucket and the extra buckets translate into real
+# pad-fraction wins, not just more compiled programs.  The jit cache
+# stays bounded: one program per (batch bucket, seq bucket) pair that
+# actually occurs.
+DEFAULT_SEQ_BUCKETS = (16, 32, 48, 64, 96, 128, 160, 192, 224, 256, 320, 384, 448, 512)
 DEFAULT_BATCH_BUCKETS = (1, 8, 32, 128, 256, 512, 1024)
 
 
@@ -24,6 +32,28 @@ def bucket(n: int, buckets) -> int:
         if n <= b:
             return b
     return buckets[-1]
+
+
+def pad_fraction(lens, seq_buckets=DEFAULT_SEQ_BUCKETS, group: int | None = None):
+    """Fraction of encoder tokens that would be padding if ``lens`` were
+    sorted by length, split into groups of ``group`` rows (None = one
+    group), and each group padded to its own seq bucket.
+
+    This is the FLOP-waste model the batching layer optimises: the
+    kernel's dead-block skip removes all-padding rows, so the tax that
+    remains is (bucket - len) inside live rows — exactly what this
+    reports."""
+    lens = sorted(int(l) for l in lens)
+    if not lens:
+        return 0.0
+    real = padded = 0
+    step = group or len(lens)
+    for i in range(0, len(lens), step):
+        g = lens[i : i + step]
+        s = bucket(max(g), seq_buckets)
+        real += sum(g)
+        padded += s * len(g)
+    return 1.0 - real / max(padded, 1)
 
 
 def pad_token_batch(

@@ -193,9 +193,22 @@ class SentenceEncoder:
                 mask = jnp.arange(ids_.shape[1])[None, :] < lens_[:, None]
                 ids32 = ids_.astype(jnp.int32)
                 if self._fused_layer_ok(ids_.shape[1]):
-                    from ..ops.fused_layer import encoder_forward
+                    from ..ops.fused_layer import (
+                        encoder_forward,
+                        fused_encoder_interpret,
+                    )
 
-                    return encoder_forward(p, self.cfg, ids32, mask)
+                    # lens feed the ragged kernel grid directly: the
+                    # per-block lengths mask padded keys in-kernel and
+                    # let all-padding blocks be skipped, not computed
+                    return encoder_forward(
+                        p,
+                        self.cfg,
+                        ids32,
+                        mask,
+                        lens=lens_.astype(jnp.int32),
+                        interpret=fused_encoder_interpret(self.cfg),
+                    )
                 return self.module.apply(p, ids32, mask)
 
             from ..internals.profiler import wrap_jit
@@ -204,22 +217,51 @@ class SentenceEncoder:
                 "sentence_encoder.fwd_group", jax.jit(fwd_group)
             )
         # int16 halves the host->device id bytes; only when ids fit.
-        # The wire arrays stage through a donated 2-slot ring: the
-        # device_put is non-blocking (the upload overlaps whatever
-        # compute is still in flight) and slot reuse donates the
-        # previous group's buffers instead of accumulating one upload
-        # per dispatch in HBM.
+        # The wire arrays stage through a donated ring (depth 2 by
+        # default, PATHWAY_WIRE_RING_DEPTH to deepen): the device_put is
+        # non-blocking (the upload overlaps whatever compute is still in
+        # flight) and slot reuse donates the previous group's buffers
+        # instead of accumulating one upload per dispatch in HBM.
         wire = np.int16 if self.cfg.vocab_size < 32768 else np.int32
         if self._wire_ring is None:
+            import os
+
             from ..engine.device_ring import DeviceRing
 
-            self._wire_ring = DeviceRing(depth=2, name="sentence_encoder.wire")
+            depth = max(2, int(os.environ.get("PATHWAY_WIRE_RING_DEPTH", "2")))
+            self._wire_ring = DeviceRing(depth=depth, name="sentence_encoder.wire")
         ids_dev, lens_dev = self._wire_ring.stage(
-            [ids.astype(wire), lens.astype(np.int32)]
+            [ids.astype(wire, copy=False), lens.astype(np.int32, copy=False)]
         )
         out = self._fwd_group(self.params, ids_dev, lens_dev)
         self._wire_ring.retire([ids_dev, lens_dev])
+        self._record_dispatch(ids.shape[0], ids.shape[1], lens)
         return out
+
+    def _record_dispatch(self, batch: int, seq: int, lens: np.ndarray) -> None:
+        """MFU / pad-waste attribution for one group dispatch (feeds the
+        dashboard column, the pathway_encoder_* gauges and the
+        kernel.dispatch flight-recorder events)."""
+        if not self._fused_layer_ok(seq):
+            return
+        from ..internals.profiler import ENCODER_KERNEL_STATS
+        from ..ops.fused_layer import _pack_rows, encoder_flops_per_token
+
+        real = int(lens.sum())
+        n_live = int(np.count_nonzero(lens))
+        # real rows are a prefix (length-sorted groups pad at the tail),
+        # so live blocks = ceil(n_live / p); the ragged kernel skips the
+        # all-padding tail blocks entirely
+        p = _pack_rows(seq)
+        total_rows = batch + (-batch) % p  # kernel pads rows to p-multiples
+        live_rows = min(-(-n_live // p) * p, total_rows)
+        ENCODER_KERNEL_STATS.record_dispatch(
+            seq=seq,
+            batch=total_rows,
+            real_tokens=real,
+            computed_tokens=live_rows * seq,
+            flops=live_rows * seq * encoder_flops_per_token(self.cfg, seq),
+        )
 
     def _encode_matrix(self, ids_mat: np.ndarray, lens: np.ndarray) -> np.ndarray:
         out = np.empty((len(lens), self.dim), np.float32)
@@ -470,13 +512,17 @@ class SentenceEncoder:
         return slot_to_chunk, embs
 
     def _pack_uniform(self, ids_mat: np.ndarray, lens: np.ndarray):
-        """Uniform-shape fast path: length-sorted groups all share one
-        (batch, seq) shape, so EVERY group runs the same compiled
-        program, dispatched async back-to-back (results stay on device;
-        nothing blocks until the caller consumes them). Per-group
-        dispatch instead of one lax.scan keeps the compiled-shape set
-        independent of the number of groups — streaming epochs of
-        arbitrary size must never recompile the ingest chain (a G=3
+        """Length-sorted fast path: rows sort by length once, split into
+        max_batch groups, and EACH group pads to ITS OWN seq bucket —
+        with sorted rows a group's max length sits near its bucket, so
+        the pad tax is the gap to the next bucket instead of the batch's
+        global max (the 150-wordpiece headline runs its groups at 160,
+        not 256).  Groups dispatch async back-to-back through the same
+        compiled-program cache as _matrix_groups (results stay on
+        device; nothing blocks until the caller consumes them).
+        Per-group dispatch instead of one lax.scan keeps the
+        compiled-shape set bounded by the bucket set — streaming epochs
+        of arbitrary size must never recompile the ingest chain (a G=3
         epoch once cost a 17s mid-run XLA compile)."""
         from .batching import DEFAULT_SEQ_BUCKETS, bucket
 
@@ -486,19 +532,20 @@ class SentenceEncoder:
         B = self.max_batch
         if n < 2 * B or n % B:
             return None
-        L = min(bucket(int(lens.max()), DEFAULT_SEQ_BUCKETS), ids_mat.shape[1])
         import jax
         import jax.numpy as jnp
 
         order = np.argsort(lens, kind="stable")
         G = n // B
-        ids = np.take(ids_mat[:, :L], order, axis=0).astype(np.int16)
-        ids = ids.reshape(G, B, L)
         ln = lens[order].reshape(G, B).astype(np.int32)
-
-        embs = jnp.concatenate(
-            [self._run_group(ids[g], ln[g]) for g in range(G)], axis=0
-        )  # (n, dim), device-resident
+        parts = []
+        for g in range(G):
+            grp = order[g * B : (g + 1) * B]
+            # sorted ascending, so the group's last row holds its max
+            Lg = min(bucket(int(ln[g, -1]), DEFAULT_SEQ_BUCKETS), ids_mat.shape[1])
+            ids_g = np.take(ids_mat[:, :Lg], grp, axis=0).astype(np.int16)
+            parts.append(self._run_group(ids_g, ln[g]))
+        embs = jnp.concatenate(parts, axis=0)  # (n, dim), device-resident
         return order, embs
 
     def __call__(self, texts: Sequence[str]) -> np.ndarray:

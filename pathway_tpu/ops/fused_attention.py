@@ -39,16 +39,20 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerPar
 BLOCK_OFF = -1.0e30  # additive bias outside the block diagonal
 KEY_OFF = -1.0e9  # additive bias on padded keys
 
+# At/above this sequence length the packed block is tiled as p
+# independent (seq, seq) diagonal score tiles instead of one
+# rows x rows matmul: the off-diagonal tiles carried BLOCK_OFF and
+# contributed exactly zero probability, so skipping them is
+# numerically identical and deletes (p-1)/p of the score FLOPs and
+# softmax VPU work.  Below it, p small (seq, seq) matmuls would
+# starve the MXU's 128-deep pipeline — the full block stays.
+DIAG_MIN_SEQ = 128
 
-def _kernel(qkv_ref, kbias_ref, out_ref, *, n_heads: int, seq: int, scale: float):
-    rows = out_ref.shape[0]  # p * seq packed tokens
-    d = out_ref.shape[1]
+
+def _heads_softmax_pv(qkv, bias, d: int, n_heads: int, scale: float, out_dtype):
+    """scores -> stable f32 softmax -> probs @ V, per head, over one
+    token block. ``bias`` broadcasts across score rows."""
     hd = d // n_heads
-    qkv = qkv_ref[...]
-    # block-diagonal bias: token q may attend token k iff same sequence
-    qi = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 0) // seq
-    ki = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 1) // seq
-    bias = jnp.where(qi == ki, 0.0, BLOCK_OFF) + kbias_ref[0, 0:1, :]  # (rows, rows)
     parts = []
     for i in range(n_heads):
         qh = qkv[:, i * hd : (i + 1) * hd]
@@ -65,9 +69,32 @@ def _kernel(qkv_ref, kbias_ref, out_ref, *, n_heads: int, seq: int, scale: float
         e = jnp.exp(s - m)
         p = (e / jnp.sum(e, axis=1, keepdims=True)).astype(qkv.dtype)
         parts.append(
-            jnp.dot(p, vh, preferred_element_type=jnp.float32).astype(out_ref.dtype)
+            jnp.dot(p, vh, preferred_element_type=jnp.float32).astype(out_dtype)
         )
-    out_ref[...] = jnp.concatenate(parts, axis=1)
+    return jnp.concatenate(parts, axis=1)
+
+
+def _kernel(qkv_ref, kbias_ref, out_ref, *, n_heads: int, seq: int, scale: float):
+    rows = out_ref.shape[0]  # p * seq packed tokens
+    d = out_ref.shape[1]
+    qkv = qkv_ref[...]
+    if seq >= DIAG_MIN_SEQ:
+        # ragged diagonal tiling: each packed sequence attends inside
+        # its own (seq, seq) tile; cross-sequence tiles never computed
+        blocks = []
+        for j in range(rows // seq):
+            kb = kbias_ref[0, 0:1, j * seq : (j + 1) * seq]
+            sub = qkv[j * seq : (j + 1) * seq, :]
+            blocks.append(
+                _heads_softmax_pv(sub, kb, d, n_heads, scale, out_ref.dtype)
+            )
+        out_ref[...] = jnp.concatenate(blocks, axis=0)
+        return
+    # block-diagonal bias: token q may attend token k iff same sequence
+    qi = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 0) // seq
+    ki = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 1) // seq
+    bias = jnp.where(qi == ki, 0.0, BLOCK_OFF) + kbias_ref[0, 0:1, :]  # (rows, rows)
+    out_ref[...] = _heads_softmax_pv(qkv, bias, d, n_heads, scale, out_ref.dtype)
 
 
 def _xla_reference(qkv, key_mask, n_heads: int):
@@ -190,32 +217,12 @@ def _seg_kernel(qkv_ref, seg_ref, segc_ref, out_ref, *, n_heads: int, scale: flo
     column arrives pre-transposed (segc_ref) — an in-kernel (1, rows)
     -> (rows, 1) transpose is a lane->sublane shuffle Mosaic does
     slowly."""
-    rows = out_ref.shape[0]
     d = out_ref.shape[1]
-    hd = d // n_heads
     qkv = qkv_ref[...]
     seg = seg_ref[0, 0:1, :]  # (1, rows) int32 — key side
     segc = segc_ref[:, 0:1]  # (rows, 1) int32 — query side
     bias = jnp.where(segc == seg, 0.0, BLOCK_OFF)  # attend iff same segment
-    parts = []
-    for i in range(n_heads):
-        qh = qkv[:, i * hd : (i + 1) * hd]
-        kh = qkv[:, d + i * hd : d + (i + 1) * hd]
-        vh = qkv[:, 2 * d + i * hd : 2 * d + (i + 1) * hd]
-        s = (
-            jax.lax.dot_general(
-                qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            * scale
-            + bias
-        )
-        m = jnp.max(s, axis=1, keepdims=True)
-        e = jnp.exp(s - m)
-        p = (e / jnp.sum(e, axis=1, keepdims=True)).astype(qkv.dtype)
-        parts.append(
-            jnp.dot(p, vh, preferred_element_type=jnp.float32).astype(out_ref.dtype)
-        )
-    out_ref[...] = jnp.concatenate(parts, axis=1)
+    out_ref[...] = _heads_softmax_pv(qkv, bias, d, n_heads, scale, out_ref.dtype)
 
 
 def _xla_packed_reference(qkv, segment_ids, n_heads: int):

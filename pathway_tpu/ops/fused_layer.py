@@ -8,17 +8,45 @@ encode, /root/reference/python/pathway/xpacks/llm/embedders.py:270-329).
 This kernel keeps a block of packed sequences resident in VMEM for the
 whole layer:
 
-    x -> qkv proj -> block-diagonal attention -> out proj
-      -> +residual, LayerNorm -> FFN (gelu) -> +residual, LayerNorm
+    x -> qkv proj -> ragged block attention -> out proj
+      -> +residual, LayerNorm -> FFN (gelu, chunked f32 accumulation)
+      -> +residual, LayerNorm
+
+MFU round (ROADMAP item 1) tiling:
+
+* **Ragged lengths instead of a key-bias stream.**  Per-sequence real
+  lengths ride a tiny SMEM block ([bp, p] int32) instead of the old
+  [bp, 8, rows] f32 key-bias tensor; the key-padding bias is rebuilt
+  on the VPU from a (1, seq) iota.  That deletes the largest non-token
+  HBM stream the kernel had and is what lets the grid *skip* padded
+  work instead of computing it.
+* **Dead-block skip.**  A block whose sequences are all padding (the
+  tail of a batch bucket) writes zeros and does no matmul — padded
+  tiles are skipped, not computed.
+* **Diagonal-only attention for seq >= 128.**  The old kernel computed
+  a full rows x rows score matrix per head and masked off-diagonal
+  sequence pairs with BLOCK_OFF — at seq=160 / p=3 that is 3x the
+  useful score FLOPs and 3x the softmax VPU work.  Now each packed
+  sequence gets its own (seq, seq) score tile; off-diagonal tiles are
+  never computed.  Below 128 the packed full-block matmul stays: p
+  tiny (seq, seq) matmuls would starve the MXU's 128-deep pipeline,
+  and attention is a small FLOP fraction there anyway.
+* **Chunked FFN epilogue.**  The 4*d intermediate is processed in
+  lane-aligned chunks with a f32 accumulator that already carries the
+  residual + output bias, bounding peak VMEM so Mosaic keeps the x/out
+  block streams double-buffered across the grid.
 
 Weights ride constant-index BlockSpecs, so Mosaic fetches them into
 VMEM once and re-uses them across the token-block grid; HBM traffic per
-layer is x in + x out + weights once, instead of ~8 activation-sized
-round-trips.  Numerics: matmuls accumulate f32 on the MXU, layernorm
-and softmax run in f32 on the VPU, activations carry bf16 between
-stages — matching the flax module (encoder.py EncoderLayer) to bf16
-tolerance.  Backward recomputes through the flax/XLA path via
-custom_vjp (attention-style: recompute beats storing probs).
+layer is x in + x out + weights once + p ints of lengths per block.
+Numerics: matmuls accumulate f32 on the MXU, layernorm and softmax run
+in f32 on the VPU, activations carry bf16 between stages — matching the
+flax module (encoder.py EncoderLayer) to bf16 tolerance.  Backward
+recomputes through the flax/XLA path via custom_vjp (attention-style:
+recompute beats storing probs).
+
+Masks on this path are prefix-contiguous (every caller derives them
+from per-row lengths); the ragged kernel takes the lengths themselves.
 
 ``encoder_forward`` runs the whole TextEncoder (embeddings + N fused
 layers + pooling) straight off the flax params tree, so checkpoints and
@@ -40,6 +68,15 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerPar
 
 from .fused_attention import BLOCK_OFF, KEY_OFF
 
+# Sequences at/above this length get a private (seq, seq) score tile per
+# packed sub-block (no cross-sequence score FLOPs); shorter sequences
+# keep the single rows x rows matmul whose MXU shapes are far better.
+DIAG_ATTENTION_MIN_SEQ = 128
+
+# FFN intermediate is processed in lane-aligned chunks of this many
+# columns, accumulating in f32 — bounds peak VMEM at large row blocks.
+FFN_CHUNK = 512
+
 
 def _ln(x32, scale_ref, bias_ref, eps):
     mu = jnp.mean(x32, axis=-1, keepdims=True)
@@ -54,9 +91,31 @@ def _gelu_tanh(x32):
     return 0.5 * x32 * (1.0 + jnp.tanh(c * (x32 + 0.044715 * x32**3)))
 
 
+def _head_attention(qkv, bias, d: int, hd: int, n_heads: int, scale: float):
+    """Per-head scores -> stable softmax -> probs @ V over one token
+    block; ``bias`` broadcasts over the score rows."""
+    parts = []
+    for i in range(n_heads):
+        qh = qkv[:, i * hd : (i + 1) * hd]
+        kh = qkv[:, d + i * hd : d + (i + 1) * hd]
+        vh = qkv[:, 2 * d + i * hd : 2 * d + (i + 1) * hd]
+        s = (
+            jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+            + bias
+        )
+        m = jnp.max(s, axis=1, keepdims=True)
+        e = jnp.exp(s - m)
+        p = (e / jnp.sum(e, axis=1, keepdims=True)).astype(qkv.dtype)
+        parts.append(jnp.dot(p, vh, preferred_element_type=jnp.float32))
+    return jnp.concatenate(parts, axis=1)
+
+
 def _layer_kernel(
+    lens_ref,
     x_ref,
-    kbias_ref,
     wqkv_ref,
     bqkv_ref,
     wout_ref,
@@ -77,50 +136,78 @@ def _layer_kernel(
     eps: float,
 ):
     rows, d = out_ref.shape
+    p = rows // seq
     hd = d // n_heads
-    x = x_ref[...]
-    qkv = (
-        jnp.dot(x, wqkv_ref[...], preferred_element_type=jnp.float32)
-        + bqkv_ref[0:1, :]
-    ).astype(x.dtype)
-    # attention: p sequences packed per block; a token attends exactly
-    # its own sequence's unpadded keys
-    qi = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 0) // seq
-    ki = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 1) // seq
-    bias = jnp.where(qi == ki, 0.0, BLOCK_OFF) + kbias_ref[0, 0:1, :]
-    parts = []
-    for i in range(n_heads):
-        qh = qkv[:, i * hd : (i + 1) * hd]
-        kh = qkv[:, d + i * hd : d + (i + 1) * hd]
-        vh = qkv[:, 2 * d + i * hd : 2 * d + (i + 1) * hd]
-        s = (
-            jax.lax.dot_general(
-                qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            * scale
-            + bias
+
+    # max real length across the packed sequences: scalar SMEM reads
+    live = lens_ref[0, 0]
+    for j in range(1, p):
+        live = jnp.maximum(live, lens_ref[0, j])
+
+    @pl.when(live == 0)
+    def _dead_block():
+        # whole block is batch-bucket padding: skipped, not computed.
+        # Pad rows are masked off at pooling/scatter downstream.
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(live > 0)
+    def _live_block():
+        x = x_ref[...]
+        qkv = (
+            jnp.dot(x, wqkv_ref[...], preferred_element_type=jnp.float32)
+            + bqkv_ref[0:1, :]
+        ).astype(x.dtype)
+        kiota = jax.lax.broadcasted_iota(jnp.int32, (1, seq), 1)
+        if seq >= DIAG_ATTENTION_MIN_SEQ:
+            # ragged diagonal tiling: one (seq, seq) score tile per
+            # packed sequence; cross-sequence tiles never computed
+            blocks = []
+            for j in range(p):
+                kb = jnp.where(kiota < lens_ref[0, j], 0.0, KEY_OFF)
+                sub = qkv[j * seq : (j + 1) * seq, :]
+                blocks.append(_head_attention(sub, kb, d, hd, n_heads, scale))
+            ctx = jnp.concatenate(blocks, axis=0).astype(x.dtype)
+        else:
+            # packed short sequences: one rows x rows matmul (good MXU
+            # shapes); block-diagonal bias isolates the sequences and
+            # the per-sequence key bias masks padding
+            qi = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 0) // seq
+            ki = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 1) // seq
+            kb = jnp.concatenate(
+                [
+                    jnp.where(kiota < lens_ref[0, j], 0.0, KEY_OFF)
+                    for j in range(p)
+                ],
+                axis=1,
+            )  # (1, rows)
+            bias = jnp.where(qi == ki, 0.0, BLOCK_OFF) + kb
+            ctx = _head_attention(qkv, bias, d, hd, n_heads, scale).astype(x.dtype)
+        att = (
+            jnp.dot(ctx, wout_ref[...], preferred_element_type=jnp.float32)
+            + bout_ref[0:1, :]
         )
-        m = jnp.max(s, axis=1, keepdims=True)
-        e = jnp.exp(s - m)
-        p = (e / jnp.sum(e, axis=1, keepdims=True)).astype(x.dtype)
-        parts.append(jnp.dot(p, vh, preferred_element_type=jnp.float32))
-    ctx = jnp.concatenate(parts, axis=1).astype(x.dtype)
-    att = (
-        jnp.dot(ctx, wout_ref[...], preferred_element_type=jnp.float32)
-        + bout_ref[0:1, :]
-    )
-    h1 = _ln(x.astype(jnp.float32) + att, ln1s_ref, ln1b_ref, eps)
-    h1b = h1.astype(x.dtype)
-    mid = (
-        jnp.dot(h1b, w1_ref[...], preferred_element_type=jnp.float32)
-        + b1_ref[0:1, :]
-    )
-    midb = _gelu_tanh(mid).astype(x.dtype)
-    m2 = (
-        jnp.dot(midb, w2_ref[...], preferred_element_type=jnp.float32)
-        + b2_ref[0:1, :]
-    )
-    out_ref[...] = _ln(h1 + m2, ln2s_ref, ln2b_ref, eps).astype(out_ref.dtype)
+        h1 = _ln(x.astype(jnp.float32) + att, ln1s_ref, ln1b_ref, eps)
+        h1b = h1.astype(x.dtype)
+        interm = w1_ref.shape[1]
+        chunk = FFN_CHUNK if interm % FFN_CHUNK == 0 else interm
+        # residual + mlp_out bias seed the f32 accumulator; each chunk
+        # adds gelu(x @ W1[:, c]) @ W2[c, :]
+        acc = h1 + b2_ref[0:1, :]
+        for c0 in range(0, interm, chunk):
+            mid = (
+                jnp.dot(
+                    h1b,
+                    w1_ref[:, c0 : c0 + chunk],
+                    preferred_element_type=jnp.float32,
+                )
+                + b1_ref[0:1, c0 : c0 + chunk]
+            )
+            acc = acc + jnp.dot(
+                _gelu_tanh(mid).astype(x.dtype),
+                w2_ref[c0 : c0 + chunk, :],
+                preferred_element_type=jnp.float32,
+            )
+        out_ref[...] = _ln(acc, ln2s_ref, ln2b_ref, eps).astype(out_ref.dtype)
 
 
 def _pack_rows(s: int) -> int:
@@ -138,9 +225,20 @@ def _row2(v):
     return v.reshape(1, -1)
 
 
+def block_lens(lens, s: int):
+    """Per-row real lengths [B] -> per-block [bp, p] int32 (rows padded
+    with zero-length sequences so dead blocks are skippable)."""
+    p = _pack_rows(s)
+    lens = jnp.asarray(lens, jnp.int32)
+    pad = (-lens.shape[0]) % p
+    if pad:
+        lens = jnp.pad(lens, (0, pad))
+    return lens.reshape(-1, p)
+
+
 def fused_layer_tokens(
     tokens,
-    kbias,
+    lens,
     layer_params: dict,
     *,
     n_heads: int,
@@ -149,9 +247,10 @@ def fused_layer_tokens(
     interpret: bool = False,
 ):
     """One encoder layer over pre-packed tokens [bp*rows, d] with the
-    per-block key bias [bp, 8, rows] (see ``pack_tokens``)."""
+    per-block sequence lengths [bp, p] (see ``pack_tokens``)."""
     d = tokens.shape[1]
-    rows = _pack_rows(seq) * seq
+    p = _pack_rows(seq)
+    rows = p * seq
     bp = tokens.shape[0] // rows
     att, ln1 = layer_params["attention"], layer_params["ln_att"]
     w = lambda t: t.astype(tokens.dtype)
@@ -180,32 +279,31 @@ def fused_layer_tokens(
         ),
         grid=(bp,),
         in_specs=[
+            pl.BlockSpec((1, p), lambda i: (i, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((1, 8, rows), lambda i: (i, 0, 0)),
             *[const(a.shape) for a in args],
         ],
         out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct(tokens.shape, tokens.dtype),
-        compiler_params=_CompilerParams(dimension_semantics=("arbitrary",)),
+        compiler_params=_CompilerParams(dimension_semantics=("parallel",)),
         interpret=interpret,
-    )(tokens, kbias, *args)
+    )(lens, tokens, *args)
 
 
-def pack_tokens(x, key_mask):
-    """[B, S, d] -> packed [bp*rows, d] tokens + [bp, 8, rows] key bias
-    (+ the original B for unpacking)."""
+def pack_tokens(x, key_mask, lens=None):
+    """[B, S, d] -> packed [bp*rows, d] tokens + [bp, p] per-sequence
+    lengths (+ the original B for unpacking).  ``key_mask`` must be
+    prefix-contiguous; pass precomputed ``lens`` [B] to skip the
+    mask reduction."""
     b, s, d = x.shape
     p = _pack_rows(s)
-    rows = p * s
     pad = (-b) % p
+    if lens is None:
+        lens = key_mask.astype(jnp.int32).sum(axis=1)
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
-        key_mask = jnp.pad(key_mask, ((0, pad), (0, 0)))
-    bp = x.shape[0] // p
-    tokens = x.reshape(bp * rows, d)
-    kbias = jnp.where(key_mask, 0.0, KEY_OFF).astype(jnp.float32).reshape(bp, rows)
-    kbias = jnp.broadcast_to(kbias[:, None, :], (bp, 8, rows))
-    return tokens, kbias, b
+    tokens = x.reshape(-1, d)
+    return tokens, block_lens(lens, s), b
 
 
 def unpack_tokens(tokens, b: int, s: int):
@@ -213,7 +311,7 @@ def unpack_tokens(tokens, b: int, s: int):
     return tokens.reshape(-1, s, d)[:b]
 
 
-def _forward_impl(params, cfg, ids, mask, interpret: bool):
+def _forward_impl(params, cfg, ids, mask, lens, interpret: bool):
     from flax.core import meta as _meta
 
     p = params["params"] if "params" in params else params
@@ -231,11 +329,11 @@ def _forward_impl(params, cfg, ids, mask, interpret: bool):
         cfg.layer_norm_eps,
     ).astype(dtype)
     b, s, d = x.shape
-    tokens, kbias, b0 = pack_tokens(x, mask)
+    tokens, lens_blk, b0 = pack_tokens(x, mask, lens)
     for i in range(cfg.num_layers):
         tokens = fused_layer_tokens(
             tokens,
-            kbias,
+            lens_blk,
             p[f"layer_{i}"],
             n_heads=cfg.num_heads,
             seq=s,
@@ -257,13 +355,13 @@ def _forward_impl(params, cfg, ids, mask, interpret: bool):
     return pooled
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 4))
-def _encoder_forward(params, cfg, ids, mask, interpret):
-    return _forward_impl(params, cfg, ids, mask, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 5))
+def _encoder_forward(params, cfg, ids, mask, lens, interpret):
+    return _forward_impl(params, cfg, ids, mask, lens, interpret)
 
 
-def _efwd(params, cfg, ids, mask, interpret):
-    return _forward_impl(params, cfg, ids, mask, interpret), (params, ids, mask)
+def _efwd(params, cfg, ids, mask, lens, interpret):
+    return _forward_impl(params, cfg, ids, mask, lens, interpret), (params, ids, mask)
 
 
 def _ebwd(cfg, interpret, res, g):
@@ -272,10 +370,25 @@ def _ebwd(cfg, interpret, res, g):
 
     module = TextEncoder(cfg)
     _, vjp = jax.vjp(lambda pr: module.apply(pr, ids, mask), params)
-    return (vjp(g)[0], None, None)
+    return (vjp(g)[0], None, None, None)
 
 
 _encoder_forward.defvjp(_efwd, _ebwd)
+
+
+def encoder_flops_per_token(cfg, seq: int) -> float:
+    """Dense model forward FLOPs per token at padded length ``seq``
+    (multiply-add = 2): the numerator of every achieved-TFLOPs number
+    this repo reports (bench.py FINAL SUMMARY and the
+    ``pathway_encoder_achieved_tflops`` gauge share it)."""
+    d, interm, layers = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    per_layer = (
+        2 * d * 3 * d  # qkv projection
+        + 2 * 2 * seq * d  # scores + probs@V
+        + 2 * d * d  # output projection
+        + 2 * 2 * d * interm  # FFN in + out
+    )
+    return float(layers * per_layer)
 
 
 def supports_fused_encoder(cfg, seq_len: int) -> bool:
@@ -292,18 +405,30 @@ def supports_fused_encoder(cfg, seq_len: int) -> bool:
 def use_fused_encoder(cfg, seq_len: int) -> bool:
     """Policy gate — THE single dispatch decision for every encode path
     (SentenceEncoder jits, the fused text-query jit, benches): honors
-    ``cfg.layer_impl`` ("xla" disables, "fused" forces) and otherwise
-    picks the kernel on TPU when the geometry fits."""
+    ``cfg.layer_impl`` ("xla" disables, "fused" forces, "interpret"
+    forces the kernel in interpret mode — CPU parity tests) and
+    otherwise picks the kernel on TPU when the geometry fits."""
     impl = getattr(cfg, "layer_impl", "auto")
     if impl == "xla":
         return False
-    if impl == "fused":
+    if impl in ("fused", "interpret"):
         return True
     return jax.default_backend() == "tpu" and supports_fused_encoder(cfg, seq_len)
 
 
-def encoder_forward(params, cfg, ids, mask, *, interpret: bool = False):
+def fused_encoder_interpret(cfg) -> bool:
+    """True when ``cfg.layer_impl`` asks for the kernel in interpret
+    mode (exercises the exact pallas path on the CPU backend)."""
+    return getattr(cfg, "layer_impl", "auto") == "interpret"
+
+
+def encoder_forward(params, cfg, ids, mask, *, lens=None, interpret: bool = False):
     """TextEncoder forward (embeddings -> fused layers -> pooling)
-    running each layer as ONE pallas dispatch.  Differentiable: the
-    backward pass recomputes through the flax module."""
-    return _encoder_forward(params, cfg, ids, mask, interpret)
+    running each layer as ONE pallas dispatch.  ``lens`` [B] int32 (the
+    per-row real lengths) skips the mask reduction and feeds the ragged
+    kernel grid directly; ``mask`` must be prefix-contiguous either
+    way.  Differentiable: the backward pass recomputes through the flax
+    module."""
+    if lens is None:
+        lens = mask.astype(jnp.int32).sum(axis=1)
+    return _encoder_forward(params, cfg, ids, mask, lens, interpret)

@@ -9,6 +9,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from pathway_tpu.models.batching import DEFAULT_SEQ_BUCKETS
 from pathway_tpu.models.encoder import EncoderConfig, TextEncoder, init_params
 from pathway_tpu.ops.fused_layer import (
     encoder_forward,
@@ -32,7 +33,7 @@ def _batch(rng, b, s):
     return jnp.asarray(ids), jnp.asarray(mask)
 
 
-@pytest.mark.parametrize("b,s", [(8, 32), (5, 96), (3, 160)])
+@pytest.mark.parametrize("b,s", [(8, 32), (5, 96), (3, 160), (2, 224), (2, 256)])
 def test_fused_encoder_matches_module(minilm, b, s):
     cfg, module, params = minilm
     ids, mask = _batch(np.random.default_rng(s), b, s)
@@ -42,6 +43,44 @@ def test_fused_encoder_matches_module(minilm, b, s):
     err = np.abs(ref - got).max()
     cos = (ref * got).sum(axis=1).min()
     assert err < 3e-2 and cos > 0.999, (err, cos)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    """Miniature geometry for the full bucket sweep: parity is a
+    property of the kernel's (seq, pack-factor) tiling, not the model
+    size, so every bucket runs at a width that keeps interpret mode
+    cheap."""
+    cfg = EncoderConfig(
+        vocab_size=1000, hidden_size=64, num_layers=2, num_heads=2,
+        intermediate_size=128, max_position=512,
+    )
+    module = TextEncoder(cfg)
+    return cfg, module, init_params(module, cfg)
+
+
+@pytest.mark.parametrize("s", list(DEFAULT_SEQ_BUCKETS))
+def test_every_bucket_parity_with_all_padding_rows(tiny, s):
+    """Every seq bucket, every pack factor: the ragged kernel matches
+    the per-op XLA module on live rows, and an all-padding row riding in
+    the batch (its block may be dead-skipped) comes back exactly zero —
+    the batch spills into a second, partly-dead block on purpose."""
+    from pathway_tpu.ops.fused_layer import _pack_rows
+
+    cfg, module, params = tiny
+    rng = np.random.default_rng(s)
+    b = _pack_rows(s) + 2
+    ids = rng.integers(5, 999, (b, s)).astype(np.int32)
+    lens = rng.integers(1, s + 1, (b,))
+    lens[-1] = 0  # all-padding row in the tail (length-sorted contract)
+    mask = np.arange(s)[None, :] < lens[:, None]
+    ids_j, mask_j = jnp.asarray(ids), jnp.asarray(mask)
+    got = np.asarray(encoder_forward(params, cfg, ids_j, mask_j, interpret=True))
+    ref = np.asarray(module.apply(params, ids_j, mask_j))
+    live = lens > 0
+    err = np.abs(ref[live] - got[live]).max()
+    assert err < 3e-2, (s, err)
+    assert np.all(got[~live] == 0.0), "all-padding row must embed to zero"
 
 
 def test_fused_encoder_cls_pooling(minilm):
@@ -88,8 +127,10 @@ def test_pack_unpack_roundtrip():
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(5, 32, 8)).astype(np.float32))
     mask = jnp.ones((5, 32), bool)
-    tokens, kbias, b0 = pack_tokens(x, mask)
+    tokens, lens, b0 = pack_tokens(x, mask)
     assert tokens.shape[0] % (256 // 32 * 32) == 0
+    # per-block lengths: one row per packed block, one entry per sequence
+    assert lens.shape[1] == 256 // 32 and np.asarray(lens)[0, 0] == 32
     back = unpack_tokens(tokens, b0, 32)
     np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
 
