@@ -31,6 +31,10 @@ PWL009 (warning) multi-worker run without a cluster fault domain:
                  recovery off (one worker crash kills the whole run) or
                  heartbeats disabled (cluster_lease_ms=0: a hung or
                  partitioned worker stalls every epoch forever).
+PWL010 (warning) device-backed index larger than a single device's HBM
+                 budget in a run without a mesh: the first growth past
+                 the budget OOMs mid-stream — shard it with
+                 pw.run(mesh=...) / PATHWAY_MESH.
 """
 
 from __future__ import annotations
@@ -74,6 +78,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL007": (Severity.WARNING, "recovery enabled with monitoring fully off"),
     "PWL008": (Severity.WARNING, "serving endpoint without overload protection"),
     "PWL009": (Severity.WARNING, "multi-worker run without a cluster fault domain"),
+    "PWL010": (Severity.WARNING, "device index exceeds single-device HBM without a mesh"),
 }
 
 _MUTABLE_TYPES = (list, dict, set, bytearray)
@@ -806,6 +811,86 @@ def check_cluster_fault_domain(view: GraphView) -> list[Diagnostic]:
     return out
 
 
+# --------------------------------------------------------------------------
+# PWL010 — device-backed index larger than one device's HBM, no mesh
+
+
+#: Per-device HBM budget for PWL010 in bytes (v5e: 16 GiB). Override
+#: with PATHWAY_HBM_BYTES when targeting other parts.
+_DEFAULT_HBM_BYTES = 16 * 1024**3
+
+
+def _index_hbm_bytes(spec: dict) -> int:
+    """Worst-case resident footprint of one device-backed index:
+    the f32 [capacity, dim] matrix, plus the bool valid-mask and f32
+    bias row (dim-independent per-row overhead). Capacity doubles on
+    growth, so the first allocation past reserved_space is 2x — sizing
+    on reserved_space alone is the steady-state floor the user asked
+    for, which is what the budget should gate."""
+    rows = int(spec.get("reserved_space") or 0)
+    dim = int(spec.get("dimensions") or 0)
+    return rows * dim * 4 + rows * 5
+
+
+def check_index_hbm_budget(view: GraphView) -> list[Diagnostic]:
+    """A device-backed KNN index whose reserved capacity cannot fit in
+    a single device's HBM, in a run with no mesh configured: the upload
+    (or the first capacity doubling) OOMs mid-stream, after sources
+    started. Index specs are recorded on the parse graph at query-build
+    time (``external_indexes``); the mesh by ``pw.run`` (``run_context
+    ["mesh_axes"]``, parsed jax-free) — both visible to the analyze-only
+    path before any device allocation."""
+    import os
+
+    specs = getattr(view.graph, "external_indexes", None) or []
+    device_specs = [s for s in specs if s.get("device_backed")]
+    if not device_specs:
+        return []
+    ctx = getattr(view.graph, "run_context", None) or {}
+    axes = ctx.get("mesh_axes") or None
+    n_shards = int(axes["data"]) if axes else 1
+    try:
+        budget = int(os.environ.get("PATHWAY_HBM_BYTES") or _DEFAULT_HBM_BYTES)
+    except ValueError:
+        budget = _DEFAULT_HBM_BYTES
+    out: list[Diagnostic] = []
+    for spec in device_specs:
+        per_device = _index_hbm_bytes(spec) // max(1, n_shards)
+        if per_device <= budget:
+            continue
+        mesh_note = (
+            f"the configured mesh (data={n_shards}) still leaves"
+            if n_shards > 1
+            else "no mesh is configured, leaving"
+        )
+        need = -(-_index_hbm_bytes(spec) // budget)  # ceil shards to fit
+        out.append(
+            _diag(
+                "PWL010",
+                f"device-backed index ({spec.get('kind', 'index')}, "
+                f"reserved_space={spec.get('reserved_space')}, "
+                f"dim={spec.get('dimensions')}) needs "
+                f"~{_index_hbm_bytes(spec) / 1024**3:.1f} GiB resident; "
+                f"{mesh_note} ~{per_device / 1024**3:.1f} GiB on one "
+                f"device against a {budget / 1024**3:.0f} GiB HBM budget "
+                "— it will OOM on upload or first growth. Shard it: "
+                f"pw.run(mesh={need}) / PATHWAY_MESH={need} splits the "
+                "matrix over the mesh's data axis (one logical index, "
+                "per-shard top-k + cross-chip merge; budget override: "
+                "PATHWAY_HBM_BYTES)",
+                detail={
+                    "index": spec,
+                    "bytes": _index_hbm_bytes(spec),
+                    "per_device_bytes": per_device,
+                    "hbm_budget_bytes": budget,
+                    "mesh_axes": axes,
+                    "suggested_mesh": need,
+                },
+            )
+        )
+    return out
+
+
 LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dtype_consistency,
     check_unbounded_state,
@@ -816,4 +901,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_recovery_observability,
     check_serving_overload,
     check_cluster_fault_domain,
+    check_index_hbm_budget,
 ]

@@ -53,10 +53,12 @@ def quiesce_all() -> None:
         ring.sync()
 
 
-def _device_put(arr):
+def _device_put(arr, sharding=None):
     try:
         import jax
 
+        if sharding is not None:
+            return jax.device_put(arr, sharding)
         return jax.device_put(arr)
     except Exception:
         return arr  # host fallback: the ring still bounds generations
@@ -89,9 +91,16 @@ class DeviceRing:
     conservative default keeps them until wrap + retire).
     """
 
-    def __init__(self, depth: int = 2, name: str = "ring"):
+    def __init__(self, depth: int = 2, name: str = "ring", sharding=None):
         self.depth = max(2, int(depth))
         self.name = name
+        # mesh-aware staging: a jax.sharding.Sharding (e.g. a
+        # NamedSharding over a mesh) applied to every staged put, so
+        # donated slots land on the correct device(s) — replicated
+        # query blocks land on every chip of a sharded index's mesh,
+        # per-shard payloads on their owning chip — instead of
+        # defaulting to device 0 and paying a GSPMD reshard later.
+        self.sharding = sharding
         self._slots: list[list[Any] | None] = [None] * self.depth
         self._retired: list[bool] = [True] * self.depth
         self._next = 0
@@ -105,11 +114,23 @@ class DeviceRing:
         with _registry_lock:
             _registry.add(self)
 
-    def stage(self, arrays: list[Any] | tuple[Any, ...] | Any) -> list[Any]:
+    def stage(
+        self,
+        arrays: list[Any] | tuple[Any, ...] | Any,
+        shardings: list[Any] | None = None,
+    ) -> list[Any]:
         """Non-blocking device_put of ``arrays`` into the next slot;
-        returns device handles valid for one consuming epoch."""
+        returns device handles valid for one consuming epoch.
+        ``shardings`` overrides the ring's default placement per array
+        (None entries fall back to ``self.sharding``)."""
         single = not isinstance(arrays, (list, tuple))
         items = [arrays] if single else list(arrays)
+        if shardings is None:
+            per_item = [self.sharding] * len(items)
+        else:
+            per_item = [
+                s if s is not None else self.sharding for s in shardings
+            ]
         with self._lock:
             idx = self._next
             self._next = (idx + 1) % self.depth
@@ -137,7 +158,7 @@ class DeviceRing:
             flight_recorder.record(
                 "ring.donate", ring=self.name, buffers=len(prev), total=self.donated
             )
-        handles = [_device_put(a) for a in items]
+        handles = [_device_put(a, s) for a, s in zip(items, per_item)]
         nbytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in items)
         with self._lock:
             self._slots[idx] = handles

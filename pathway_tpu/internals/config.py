@@ -77,6 +77,14 @@ class PathwayConfig:
         return max(1, _env_int("PATHWAY_PIPELINE_DEPTH", 1))
 
     @property
+    def mesh_spec(self) -> str | None:
+        """Raw mesh spec string (PATHWAY_MESH, e.g. "8" / "4x2" /
+        "data=4,model=2"); parsed by parallel.mesh.parse_mesh_spec and
+        resolved lazily — device-backed indexes shard over it when no
+        explicit ``pw.run(mesh=...)`` is given."""
+        return os.environ.get("PATHWAY_MESH") or None
+
+    @property
     def flight_recorder(self) -> bool:
         """Black-box flight recorder on/off (PATHWAY_FLIGHT_RECORDER;
         default on — recording is an in-memory ring append)."""

@@ -215,6 +215,7 @@ class MonitoringHttpServer:
         lines.extend(self._resilience_lines(wl))
         lines.extend(self._cluster_lines(wl))
         lines.extend(self._serving_lines(wl))
+        lines.extend(self._index_lines(wl))
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -440,6 +441,68 @@ class MonitoringHttpServer:
             lines.extend(stage_lines)
         return lines
 
+    @staticmethod
+    def _index_lines(wl: str = "") -> list[str]:
+        """Device-backed index plane (``pathway_index_*``): per-shard
+        occupancy from the hash router, the shard-imbalance gauge, and
+        the cross-chip merge-collective latency histogram. Rendered only
+        once an index exists — ``/metrics`` stays byte-identical for
+        pipelines without one."""
+        from ..ops.index_metrics import INDEX_METRICS
+
+        if not INDEX_METRICS.active():
+            return []
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+
+        snap = INDEX_METRICS.snapshot()
+        lines: list[str] = []
+        per_shard: list[str] = []
+        valid: list[str] = []
+        for name in sorted(snap["indexes"]):
+            e = snap["indexes"][name]
+            cap = e["shard_capacity"]
+            for s, docs in enumerate(e["docs_shard"]):
+                lbl = f'index="{_escape_label(name)}",shard="{s}"'
+                per_shard.append(series("pathway_index_docs", docs, lbl))
+                if cap > 0:
+                    valid.append(
+                        series("pathway_index_valid_fraction", f"{docs / cap:.4f}", lbl)
+                    )
+        lines.append("# TYPE pathway_index_docs gauge")
+        lines.extend(per_shard)
+        if valid:
+            lines.append("# TYPE pathway_index_valid_fraction gauge")
+            lines.extend(valid)
+        for metric, key, kind in (
+            ("pathway_index_shards", "shards", "gauge"),
+            ("pathway_index_shard_capacity", "shard_capacity", "gauge"),
+            ("pathway_index_imbalance", "imbalance", "gauge"),
+            ("pathway_index_searches_total", "searches", "counter"),
+            ("pathway_index_queries_total", "queries", "counter"),
+        ):
+            lines.append(f"# TYPE {metric} {kind}")
+            for name in sorted(snap["indexes"]):
+                lines.append(
+                    series(
+                        metric,
+                        snap["indexes"][name][key],
+                        f'index="{_escape_label(name)}"',
+                    )
+                )
+        merge = INDEX_METRICS.merge
+        if merge.count:
+            lines.append("# TYPE pathway_index_merge_seconds histogram")
+            for le, cum in merge.cumulative():
+                lines.append(
+                    series("pathway_index_merge_seconds_bucket", cum, f'le="{le}"')
+                )
+            lines.append(series("pathway_index_merge_seconds_sum", f"{merge.total:.9f}"))
+            lines.append(series("pathway_index_merge_seconds_count", merge.count))
+        return lines
+
     def _status(self) -> str:
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
 
@@ -478,6 +541,10 @@ class MonitoringHttpServer:
 
         if SERVING_METRICS.active():
             status["serving"] = SERVING_METRICS.snapshot()
+        from ..ops.index_metrics import INDEX_METRICS
+
+        if INDEX_METRICS.active():
+            status["index"] = INDEX_METRICS.snapshot()
         return json.dumps(status)
 
     # -- lifecycle --

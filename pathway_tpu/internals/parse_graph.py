@@ -26,6 +26,11 @@ class ParseGraph:
         # llm servers): {"route", "kind", "protected"} records for
         # PWL008 (endpoint without overload protection)
         self.serving_endpoints: list[dict] = []
+        # device-backed index specs registered at query-build time
+        # ({"dimensions", "reserved_space", ...}): PWL010 sizes their
+        # HBM footprint against the per-device budget without building
+        # or allocating anything
+        self.external_indexes: list[dict] = []
         # bumped on every clear(): per-program caches (e.g. the shared
         # utc_now clock table) key on this so a cleared graph never
         # serves tables built for a discarded program
@@ -47,6 +52,7 @@ class ParseGraph:
         self.error_log_tables.clear()
         self.run_context = None
         self.serving_endpoints.clear()
+        self.external_indexes.clear()
         self.generation += 1
 
 

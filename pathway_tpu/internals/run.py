@@ -74,6 +74,7 @@ def run(
     profile: Any = None,
     recovery: Any = None,
     pipeline_depth: int | None = None,
+    mesh: Any = None,
     cluster_accept_timeout: float | None = None,
     cluster_hello_timeout: float | None = None,
     cluster_lease_ms: float | None = None,
@@ -156,6 +157,16 @@ def run(
         )
     except ValueError:
         _lease_ctx = 30000.0
+    # mesh spec parsed jax-free so analyze-only runs (PWL010) see the
+    # mesh shape without touching devices; malformed specs fail later,
+    # loudly, on the real resolve_mesh path
+    from ..parallel.mesh import parse_mesh_spec
+
+    _mesh_spec = mesh if mesh is not None else (os.environ.get("PATHWAY_MESH") or None)
+    try:
+        _mesh_axes = parse_mesh_spec(_mesh_spec)
+    except ValueError:
+        _mesh_axes = None
     G.run_context = {
         "recovery": bool(recovery),
         "monitoring_level": monitoring_level,
@@ -167,6 +178,9 @@ def run(
         "processes": max(1, _procs_ctx),
         "threads": max(1, _threads_ctx),
         "cluster_lease_ms": max(0.0, _lease_ctx),
+        # {"data": n, "model": m} or None; PWL010 (index over HBM
+        # budget) checks device-backed index footprints against this
+        "mesh_axes": _mesh_axes,
     }
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
@@ -294,6 +308,16 @@ def run(
 
     result = RunResult()
     dumps_before = len(flight_recorder.RECORDER._dumped_paths)
+    # activate the run-scoped mesh: device-backed indexes built during
+    # lowering (nearest_neighbors._make_device_index) pick it up via
+    # parallel.mesh.active_mesh() — zero query-API change. Only installed
+    # when the run has one, so an outer use_mesh() scope survives runs
+    # that don't override it.
+    from ..parallel.mesh import resolve_mesh, set_active_mesh
+
+    _run_mesh = resolve_mesh(mesh) if mesh is not None else None
+    if _run_mesh is not None:
+        set_active_mesh(_run_mesh)
     with mon_ctx as monitor:
         http_server = None
         if with_http_server:
@@ -463,6 +487,8 @@ def run(
                 profiler.write_chrome_trace(profile_path)
             if http_server is not None:
                 http_server.stop()
+            if _run_mesh is not None:
+                set_active_mesh(None)
             result.flight_recorder_dumps = list(
                 flight_recorder.RECORDER._dumped_paths[dumps_before:]
             )

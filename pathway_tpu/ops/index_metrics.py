@@ -1,0 +1,149 @@
+"""Index-plane metrics registry (``pathway_index_*`` series).
+
+Mirrors :class:`pathway_tpu.serving.metrics.ServingMetrics`: a
+process-wide, thread-safe registry the monitoring HTTP server renders
+on ``/metrics`` and ``/status``. One entry per live
+:class:`~pathway_tpu.ops.knn.DeviceKnnIndex` (keyed by its ``name``),
+holding the per-shard doc counts the hash router produced, the
+per-shard capacity, and search counters; plus one process-wide
+histogram of the cross-chip merge collective's wall time (phase 2 of a
+sharded search — the part of query latency that rides ICI instead of
+the local MXU scan).
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: Merge-collective latency buckets in seconds. The merge moves
+#: [q, n_shards*k] floats — microseconds on ICI, sub-ms on a CPU
+#: dryrun — so the buckets start far below the serving-stage scale.
+MERGE_BUCKETS: tuple[float, ...] = (
+    0.0001,
+    0.00025,
+    0.0005,
+    0.001,
+    0.0025,
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.1,
+    0.25,
+    1.0,
+)
+
+
+class MergeHistogram:
+    """Fixed-bucket histogram (access serialized by IndexMetrics)."""
+
+    __slots__ = ("counts", "total", "count")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(MERGE_BUCKETS) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, seconds: float) -> None:
+        seconds = max(0.0, float(seconds))
+        for i, le in enumerate(MERGE_BUCKETS):
+            if seconds <= le:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        self.total += seconds
+        self.count += 1
+
+    def cumulative(self) -> list[tuple[str, int]]:
+        """Prometheus-style cumulative (le, count) pairs ending at +Inf."""
+        out = []
+        running = 0
+        for le, c in zip(MERGE_BUCKETS, self.counts):
+            running += c
+            out.append((f"{le:g}", running))
+        running += self.counts[-1]
+        out.append(("+Inf", running))
+        return out
+
+
+class IndexMetrics:
+    """Thread-safe accounting for device-backed indexes: shard layout,
+    occupancy, imbalance, and merge-collective latency."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # name -> {"docs_shard": [int], "shard_capacity": int,
+        #          "searches": int, "queries": int}
+        self.indexes: dict[str, dict] = {}
+        self.merge = MergeHistogram()
+
+    def update_index(
+        self, name: str, docs_shard: list[int], shard_capacity: int
+    ) -> None:
+        with self._lock:
+            entry = self.indexes.setdefault(
+                name, {"searches": 0, "queries": 0}
+            )
+            entry["docs_shard"] = list(docs_shard)
+            entry["shard_capacity"] = int(shard_capacity)
+
+    def record_search(self, name: str, n_queries: int) -> None:
+        with self._lock:
+            entry = self.indexes.setdefault(
+                name, {"docs_shard": [], "shard_capacity": 0, "searches": 0, "queries": 0}
+            )
+            entry["searches"] += 1
+            entry["queries"] += int(n_queries)
+
+    def observe_merge(self, seconds: float) -> None:
+        with self._lock:
+            self.merge.observe(seconds)
+
+    @staticmethod
+    def imbalance(docs_shard: list[int]) -> float:
+        """Shard-imbalance gauge: max/mean doc count (1.0 = perfectly
+        balanced; the hash router keeps this near 1 at scale). 0 when
+        the index is empty."""
+        total = sum(docs_shard)
+        if not docs_shard or total <= 0:
+            return 0.0
+        mean = total / len(docs_shard)
+        return max(docs_shard) / mean
+
+    def active(self) -> bool:
+        """Anything to render? (keeps /metrics byte-identical for runs
+        that never touch a device-backed index)"""
+        with self._lock:
+            return bool(self.indexes)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {}
+            for name, e in self.indexes.items():
+                docs = e.get("docs_shard", [])
+                out[name] = {
+                    "docs": sum(docs),
+                    "docs_shard": list(docs),
+                    "shards": len(docs),
+                    "shard_capacity": e.get("shard_capacity", 0),
+                    "imbalance": round(self.imbalance(docs), 4),
+                    "searches": e["searches"],
+                    "queries": e["queries"],
+                }
+            return {
+                "indexes": out,
+                "merge_seconds": {
+                    "count": self.merge.count,
+                    "sum": round(self.merge.total, 6),
+                },
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.indexes.clear()
+            self.merge = MergeHistogram()
+
+
+#: Process-wide registry surfaced on ``/metrics`` and ``/status``.
+INDEX_METRICS = IndexMetrics()

@@ -12,15 +12,45 @@ O(1) slot invalidation.
 Retraction-aware (add/remove driven by engine diffs, reference
 operators/external_index.rs:24). Capacity grows by doubling; each
 capacity bucket compiles once.
+
+Mesh scale-out: constructed with ``mesh=`` (or picked up from
+``pw.run(mesh=...)`` via the stdlib factories) the index becomes ONE
+logical index sharded over the mesh's ``data`` axis — the ``[capacity,
+dim]`` matrix and valid-mask live as a NamedSharding'd array (one slab
+per chip), add/remove diffs hash-route to the owning shard with the
+engine's key-sharding rule (``engine.value.shard_of``, the same
+``hash(key) % n`` the worker exchange uses), search runs a per-shard
+top-k inside a ``shard_map`` and merges the ``[q, n_shards*k]``
+candidate lists with one cross-chip collective (gather-of-k + final
+top-k — no host bounce). Growth doubles the PER-SHARD capacity so every
+compiled program is keyed on (per-shard capacity, k, metric) and a
+16-chip index never recompiles per global capacity. Single-device
+(``mesh=None``) behavior is bit-identical to the unsharded index.
 """
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Callable
 
 import numpy as np
 
 _NEG = -3.0e38
+
+_NAME_SEQ = itertools.count()
+
+
+def _shard_of_key(key, n_shards: int) -> int:
+    """Owning shard for an index key: the engine's canonical key hash
+    (``shard.rs``-style low bits mod n) so an index sharded over the
+    mesh and a table sharded over workers agree on ownership."""
+    if n_shards <= 1:
+        return 0
+    from ..engine.value import ref_scalar, shard_of
+
+    if isinstance(key, (int, np.integer)) and not isinstance(key, bool):
+        return shard_of(int(key), n_shards)
+    return shard_of(int(ref_scalar(key)), n_shards)
 
 # jax imports deferred so `import pathway_tpu` stays jax-free for pure
 # ETL pipelines; kernels compile lazily on first search
@@ -267,6 +297,187 @@ def _grow_fn() -> Callable:
     return _UPDATE_JIT["grow"]
 
 
+# per-mesh compiled program cache. Mesh is hashable, so one entry per
+# mesh; inside, jit re-keys on LOCAL (per-shard) shapes + static args —
+# growing a sharded index from 8x64k to 8x128k rows compiles the same
+# programs a 1x128k index uses, never one per global capacity.
+_MESH_JIT: dict[Any, dict[str, Callable]] = {}
+
+
+def _mesh_fns(mesh) -> dict[str, Callable]:
+    """Sharded variants of the update/search programs: each body runs
+    per-shard inside a shard_map, so scatters touch only the owning
+    chip's slab and search's doc scan never crosses ICI — only the
+    [q, n_shards*k] candidate merge does."""
+    fns = _MESH_JIT.get(mesh)
+    if fns is not None:
+        return fns
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import DATA_AXIS, shard_map
+    from .pallas_knn import NEG as _PNEG
+
+    ndata = int(mesh.shape[DATA_AXIS])
+
+    def _local_slots(slots, rows):
+        # global slot -> this shard's local row; anything outside the
+        # shard's slab (including the caller's pad sentinel) lands on
+        # `rows` and is dropped by the out-of-bounds scatter mode
+        loc = slots - jax.lax.axis_index(DATA_AXIS) * rows
+        return jnp.where((loc >= 0) & (loc < rows), loc, rows)
+
+    row_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS))
+
+    @partial(jax.jit, static_argnames=("l2",), donate_argnums=(0, 1, 2))
+    def scatter(matrix, valid, bias, slots, vecs, flags, l2):
+        def body(m, v, b, s, vc, fl):
+            loc = _local_slots(s, m.shape[0])
+            m = m.at[loc].set(vc, mode="drop")
+            v = v.at[loc].set(fl, mode="drop")
+            bb = jnp.where(fl, 0.0, _PNEG)
+            if l2:
+                bb = jnp.where(fl, bb - jnp.sum(vc * vc, axis=1), bb)
+            b = b.at[loc].set(bb, mode="drop")
+            return m, v, b
+
+        return shard_map(
+            body,
+            mesh=mesh,
+            in_specs=row_specs + (P(), P(None, None), P()),
+            out_specs=row_specs,
+            check_vma=False,
+        )(matrix, valid, bias, slots, vecs, flags)
+
+    @partial(jax.jit, static_argnames=("l2", "normalize"), donate_argnums=(0, 1, 2))
+    def scatter_dev(matrix, valid, bias, slots, vecs, l2, normalize):
+        def body(m, v, b, s, vc):
+            vc = vc.astype(m.dtype)
+            if normalize:
+                norms = jnp.sqrt(jnp.sum(vc * vc, axis=1, keepdims=True))
+                vc = vc / jnp.maximum(norms, 1e-12)
+            loc = _local_slots(s, m.shape[0])
+            m = m.at[loc].set(vc, mode="drop")
+            v = v.at[loc].set(True, mode="drop")
+            bb = (
+                -jnp.sum(vc * vc, axis=1) if l2 else jnp.zeros(s.shape, b.dtype)
+            )
+            b = b.at[loc].set(bb, mode="drop")
+            return m, v, b
+
+        return shard_map(
+            body,
+            mesh=mesh,
+            in_specs=row_specs + (P(), P(None, None)),
+            out_specs=row_specs,
+            check_vma=False,
+        )(matrix, valid, bias, slots, vecs)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def tomb(valid, bias, slots):
+        def body(v, b, s):
+            loc = _local_slots(s, v.shape[0])
+            v = v.at[loc].set(False, mode="drop")
+            b = b.at[loc].set(_PNEG, mode="drop")
+            return v, b
+
+        return shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            check_vma=False,
+        )(valid, bias, slots)
+
+    @jax.jit
+    def grow(matrix, valid, bias):
+        # per-shard doubling: every chip pads ITS slab in place, so the
+        # global layout stays [shard0 | shard1 | ...] with slot
+        # g -> (g // c)*2c + g % c — mirrored on the host by
+        # DeviceKnnIndex._grow. No host round-trip, no reshuffle.
+        def body(m, v, b):
+            rows, dim = m.shape
+            m2 = jax.lax.dynamic_update_slice(
+                jnp.zeros((2 * rows, dim), m.dtype), m, (0, 0)
+            )
+            v2 = jax.lax.dynamic_update_slice(
+                jnp.zeros((2 * rows,), v.dtype), v, (0,)
+            )
+            b2 = jax.lax.dynamic_update_slice(
+                jnp.full((2 * rows,), _PNEG, b.dtype), b, (0,)
+            )
+            return m2, v2, b2
+
+        return shard_map(
+            body, mesh=mesh, in_specs=row_specs, out_specs=row_specs, check_vma=False
+        )(matrix, valid, bias)
+
+    @partial(jax.jit, static_argnames=("cap", "dim"))
+    def empty(cap, dim):
+        def body():
+            rows = cap // ndata
+            return (
+                jnp.zeros((rows, dim), jnp.float32),
+                jnp.zeros((rows,), bool),
+                jnp.full((rows,), _PNEG, jnp.float32),
+            )
+
+        return shard_map(
+            body, mesh=mesh, in_specs=(), out_specs=row_specs, check_vma=False
+        )()
+
+    @partial(jax.jit, static_argnames=("k_local", "l2"))
+    def local_topk(matrix, valid, queries, k_local, l2):
+        # phase 1 of a sharded search: every chip scans only its own
+        # slab (the MXU hot loop never crosses ICI) and keeps its best
+        # k_local candidates, re-based to global slot ids
+        def body(m, v, q):
+            scores = q @ m.T
+            if l2:
+                scores = 2.0 * scores - jnp.sum(m * m, axis=1)[None, :]
+            scores = jnp.where(v[None, :], scores, _NEG)
+            vals, idx = jax.lax.top_k(scores, k_local)
+            return vals, idx + jax.lax.axis_index(DATA_AXIS) * m.shape[0]
+
+        return shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(None, None)),
+            out_specs=(P(None, DATA_AXIS), P(None, DATA_AXIS)),
+            check_vma=False,
+        )(matrix, valid, queries)
+
+    @partial(jax.jit, static_argnames=("k", "l2"))
+    def merge_topk(vals, idx, queries, k, l2):
+        # phase 2, the cross-chip merge: consuming the P(None, "data")
+        # candidate lists with a replicated top-k makes GSPMD all-gather
+        # the [q, n_shards*k_local] block over ICI — bytes scale with
+        # k, not capacity — then one tiny final top-k ranks them.
+        v, pos = jax.lax.top_k(vals, k)
+        gi = jnp.take_along_axis(idx, pos, axis=1)
+        if l2:
+            # match the unsharded topk_l2 exactly: -|q|^2 applied after
+            # the top-k, unconditionally (NEG - |q|^2 rounds back to NEG
+            # in f32, so sentinel rows keep sorting last)
+            v = v - jnp.sum(queries * queries, axis=1, keepdims=True)
+        return v, gi
+
+    fns = {
+        "scatter": scatter,
+        "scatter_dev": scatter_dev,
+        "tomb": tomb,
+        "grow": grow,
+        "empty": empty,
+        "local_topk": local_topk,
+        "merge_topk": merge_topk,
+    }
+    _MESH_JIT[mesh] = fns
+    return fns
+
+
 class DeviceKnnIndex:
     """Growable device matrix + host-side key/metadata mirror.
 
@@ -283,27 +494,77 @@ class DeviceKnnIndex:
         dtype=np.float32,
         mesh=None,
         auxiliary_space: int = 0,  # reference-parity arg (usearch), unused
+        name: str | None = None,
     ):
         self.dim = dim
         self.metric = metric
         self.dtype = dtype
-        self.capacity = max(64, int(reserved_space))
         self.mesh = mesh
+        self.name = name if name is not None else f"knn{next(_NAME_SEQ)}"
+        self.n_shards = int(mesh.shape["data"]) if mesh is not None else 1
+        want = max(64, int(reserved_space))
+        # per-shard slab size; global capacity stays one logical range
+        # [0, n_shards*shard_capacity) split contiguously per shard, so
+        # a NamedSharding over the data axis puts slab s on device s
+        self.shard_capacity = -(-want // self.n_shards)
+        self.capacity = self.n_shards * self.shard_capacity
         self._host = np.zeros((self.capacity, dim), np.float32)
         self._valid_host = np.zeros((self.capacity,), bool)
         self._keys: list[Any] = [None] * self.capacity
         self._slot_of: dict[Any, int] = {}
         self._meta: dict[Any, Any] = {}
-        self._free: list[int] = list(range(self.capacity - 1, -1, -1))
+        # per-shard free lists (shard 0 == the whole index unsharded);
+        # low slots first, matching the historical single-list order
+        self._free_shard: list[list[int]] = [
+            list(range((s + 1) * self.shard_capacity - 1, s * self.shard_capacity - 1, -1))
+            for s in range(self.n_shards)
+        ]
+        self._docs_shard: list[int] = [0] * self.n_shards
         self._full = True  # device needs a full host upload
         self._host_stale = False  # device rows newer than host mirror
         self._pending: dict[int, np.ndarray | None] = {}  # slot -> vec | tombstone
         self._dev_matrix = None
         self._dev_valid = None
         self._dev_bias = None
+        self._query_ring = None  # mesh-aware staging ring, built lazily
 
     def __len__(self) -> int:
         return len(self._slot_of)
+
+    def _alloc_slot(self, key) -> int:
+        """Pop a free slot on the shard owning ``key`` (the hash
+        router), growing per-shard capacity when that shard is full."""
+        shard = _shard_of_key(key, self.n_shards)
+        if not self._free_shard[shard]:
+            self._grow()
+        self._docs_shard[shard] += 1
+        return self._free_shard[shard].pop()
+
+    def _alloc_slots(self, keys) -> list[int]:
+        """Batch slot allocation: route every key to its shard, grow
+        until each shard can hold its share, THEN pop — growth remaps
+        global slot ids when sharded, so it must happen before any slot
+        id for this batch is materialized."""
+        shards = [_shard_of_key(k, self.n_shards) for k in keys]
+        need = [0] * self.n_shards
+        for s in shards:
+            need[s] += 1
+        while any(
+            len(self._free_shard[s]) < need[s] for s in range(self.n_shards)
+        ):
+            self._grow()
+        out = []
+        for s in shards:
+            self._docs_shard[s] += 1
+            out.append(self._free_shard[s].pop())
+        return out
+
+    def _publish_metrics(self) -> None:
+        from .index_metrics import INDEX_METRICS
+
+        INDEX_METRICS.update_index(
+            self.name, list(self._docs_shard), self.shard_capacity
+        )
 
     # --- updates (engine diff protocol) ---
 
@@ -313,9 +574,7 @@ class DeviceKnnIndex:
             raise ValueError(f"index dim {self.dim}, got vector dim {vec.shape[0]}")
         if key in self._slot_of:
             self.remove(key)
-        if not self._free:
-            self._grow()
-        slot = self._free.pop()
+        slot = self._alloc_slot(key)
         if self.metric == "cos":
             n = np.linalg.norm(vec)
             if n > 0:
@@ -328,6 +587,7 @@ class DeviceKnnIndex:
             self._meta[key] = metadata
         if not self._full:
             self._pending[slot] = vec
+        self._publish_metrics()
 
     def add_batch(self, items: list[tuple]) -> None:
         """Engine bulk-ingest protocol: ``items`` is a list of
@@ -354,9 +614,7 @@ class DeviceKnnIndex:
         for key in keys:
             if key in self._slot_of:
                 self.remove(key)
-        while len(self._free) < n:
-            self._grow()
-        slots = [self._free.pop() for _ in range(n)]
+        slots = self._alloc_slots(keys)
         if self.metric == "cos":
             norms = np.linalg.norm(vecs, axis=1, keepdims=True)
             vecs = vecs / np.maximum(norms, 1e-12)
@@ -371,6 +629,7 @@ class DeviceKnnIndex:
         if not self._full:
             for i, slot in enumerate(slots):
                 self._pending[slot] = vecs[i]
+        self._publish_metrics()
 
     def add_batch_device(self, keys, dev_vectors, metadatas=None) -> None:
         """Bulk insert of embeddings that already live in HBM (a jax
@@ -388,43 +647,67 @@ class DeviceKnnIndex:
         if n == 0:
             return
         if self._full or self._dev_matrix is None:
-            if not self._slot_of and not self._pending and self.mesh is None:
+            if not self._slot_of and not self._pending:
                 # cold start on an EMPTY index (the streaming engine's
                 # first epoch): materialize the resident arrays on
                 # device — zero host transfer — and fall through to the
                 # normal scatter.  Pulling dev_vectors down to host here
-                # costs seconds per epoch on a tunneled link.
-                self._dev_matrix, self._dev_valid, self._dev_bias = _empty_fn()(
-                    cap=self.capacity, dim=self.dim
-                )
+                # costs seconds per epoch on a tunneled link. Sharded
+                # indexes materialize one slab per chip the same way.
+                if self.mesh is not None:
+                    self._dev_matrix, self._dev_valid, self._dev_bias = _mesh_fns(
+                        self.mesh
+                    )["empty"](cap=self.capacity, dim=self.dim)
+                else:
+                    self._dev_matrix, self._dev_valid, self._dev_bias = _empty_fn()(
+                        cap=self.capacity, dim=self.dim
+                    )
                 self._full = False
                 self._pending.clear()
             else:
-                # host rows already exist (or the matrix is mesh-sharded):
-                # one full upload, then scatter the device batch into it
+                # host rows already exist: one full upload, then scatter
+                # the device batch into it
                 self._upload_full()
         for key in keys:
             if key in self._slot_of:
                 self.remove(key)
-        while len(self._free) < n:
-            self._grow()
-        if self._full:  # mesh growth falls back to a host re-upload
+        alloc = self._alloc_slots(keys)
+        if self._full:  # growth fell back to a host re-upload
+            for s, key in zip(alloc, keys):  # hand slots back; arrays re-alloc
+                self._docs_shard[s // self.shard_capacity] -= 1
+                self._free_shard[s // self.shard_capacity].append(s)
             self.add_batch_arrays(keys, np.asarray(dev_vectors)[:n], metadatas)
             return
         self._flush_pending()
         nv = int(dev_vectors.shape[0])
-        n_rows = self._dev_matrix.shape[0]
-        slots = np.full((nv,), n_rows, np.int32)  # pad rows drop
-        slots[:n] = [self._free.pop() for _ in range(n)]
-        self._dev_matrix, self._dev_valid, self._dev_bias = _scatter_dev_fn()(
-            self._dev_matrix,
-            self._dev_valid,
-            self._dev_bias,
-            slots,
-            dev_vectors,
-            l2=self.metric == "l2",
-            normalize=self.metric == "cos",
-        )
+        pad_slot = max(int(self._dev_matrix.shape[0]), self.capacity)
+        slots = np.full((nv,), pad_slot, np.int32)  # pad rows drop
+        slots[:n] = alloc
+        if self.mesh is not None:
+            # replicated slots broadcast over the mesh; each shard keeps
+            # only the rows the hash router assigned to it (everything
+            # else maps out of the local slab and drops)
+            self._dev_matrix, self._dev_valid, self._dev_bias = _mesh_fns(self.mesh)[
+                "scatter_dev"
+            ](
+                self._dev_matrix,
+                self._dev_valid,
+                self._dev_bias,
+                slots,
+                dev_vectors,
+                l2=self.metric == "l2",
+                normalize=self.metric == "cos",
+            )
+        else:
+            self._dev_matrix, self._dev_valid, self._dev_bias = _scatter_dev_fn()(
+                self._dev_matrix,
+                self._dev_valid,
+                self._dev_bias,
+                slots,
+                dev_vectors,
+                l2=self.metric == "l2",
+                normalize=self.metric == "cos",
+            )
         real = slots[:n]
         self._valid_host[real] = True
         self._host_stale = True
@@ -433,6 +716,7 @@ class DeviceKnnIndex:
             self._slot_of[key] = int(slot)
             if metadatas is not None and metadatas[i] is not None:
                 self._meta[key] = metadatas[i]
+        self._publish_metrics()
 
     def remove(self, key) -> None:
         slot = self._slot_of.pop(key, None)
@@ -441,33 +725,104 @@ class DeviceKnnIndex:
         self._valid_host[slot] = False
         self._keys[slot] = None
         self._meta.pop(key, None)
-        self._free.append(slot)
+        shard = slot // self.shard_capacity
+        self._free_shard[shard].append(slot)
+        self._docs_shard[shard] -= 1
         if not self._full:
             self._pending[slot] = None
+        self._publish_metrics()
 
     def _grow(self) -> None:
-        old = self.capacity
-        self.capacity *= 2
-        self._host = np.concatenate(
-            [self._host, np.zeros((old, self.dim), np.float32)]
-        )
-        self._valid_host = np.concatenate([self._valid_host, np.zeros((old,), bool)])
-        self._keys.extend([None] * old)
-        self._free.extend(range(self.capacity - 1, old - 1, -1))
-        if self._dev_matrix is not None and not self._full and self.mesh is None:
-            # double the resident buffers on device; pending slot updates
-            # stay valid (old slots keep their positions)
-            self._dev_matrix, self._dev_valid, self._dev_bias = _grow_fn()(
-                self._dev_matrix, self._dev_valid, self._dev_bias, newcap=self.capacity
+        old_shard = self.shard_capacity
+        self.shard_capacity *= 2
+        self.capacity = self.n_shards * self.shard_capacity
+        if self.n_shards == 1:
+            self._host = np.concatenate(
+                [self._host, np.zeros((old_shard, self.dim), np.float32)]
+            )
+            self._valid_host = np.concatenate(
+                [self._valid_host, np.zeros((old_shard,), bool)]
+            )
+            self._keys.extend([None] * old_shard)
+            self._free_shard[0].extend(
+                range(self.capacity - 1, old_shard - 1, -1)
             )
         else:
-            # sharded matrices re-pad to the mesh on the next full
-            # upload; device-only rows must come down first or they'd
-            # be re-uploaded as zeros from the stale host mirror
+            # per-shard doubling keeps the global layout one contiguous
+            # run of slabs; every live slot remaps
+            # g -> (g // c)*2c + g % c, on host AND (below) on device —
+            # the device grow pads each chip's slab in place, so the two
+            # stay aligned without any host round-trip
+            self._remap_grow(old_shard)
+        if self._dev_matrix is not None and not self._full:
+            if self.mesh is None:
+                # double the resident buffers on device; pending slot
+                # updates stay valid (old slots keep their positions)
+                self._dev_matrix, self._dev_valid, self._dev_bias = _grow_fn()(
+                    self._dev_matrix,
+                    self._dev_valid,
+                    self._dev_bias,
+                    newcap=self.capacity,
+                )
+            else:
+                # sharded per-shard grow: compiled once per LOCAL slab
+                # shape, reused across meshes of any global capacity
+                self._dev_matrix, self._dev_valid, self._dev_bias = _mesh_fns(
+                    self.mesh
+                )["grow"](self._dev_matrix, self._dev_valid, self._dev_bias)
+                from ..internals import flight_recorder
+
+                flight_recorder.record(
+                    "index.rebalance",
+                    index=self.name,
+                    shards=self.n_shards,
+                    shard_capacity=self.shard_capacity,
+                    docs=len(self._slot_of),
+                )
+        elif self.mesh is None and (self._dev_matrix is not None or self._host_stale):
+            # device rows newer than host but the resident arrays are
+            # (or must be) dropped: pull them down before the next full
+            # upload or they'd re-upload as zeros from the stale mirror
             self._refresh_host()
             self._dev_matrix = None
             self._full = True
             self._pending.clear()
+
+    def _remap_grow(self, old_shard: int) -> None:
+        """Host-side mirror of the sharded device grow: widen every
+        shard slab from ``old_shard`` to ``2*old_shard`` rows and remap
+        slot ids accordingly."""
+        S = self.n_shards
+        new_shard = self.shard_capacity
+        host = self._host.reshape(S, old_shard, self.dim)
+        self._host = np.concatenate(
+            [host, np.zeros((S, old_shard, self.dim), np.float32)], axis=1
+        ).reshape(self.capacity, self.dim)
+        valid = self._valid_host.reshape(S, old_shard)
+        self._valid_host = np.concatenate(
+            [valid, np.zeros((S, old_shard), bool)], axis=1
+        ).reshape(self.capacity)
+
+        def remap(g: int) -> int:
+            return (g // old_shard) * new_shard + (g % old_shard)
+
+        keys = [None] * self.capacity
+        for g, key in enumerate(self._keys):
+            if key is not None:
+                keys[remap(g)] = key
+        self._keys = keys
+        self._slot_of = {k: remap(g) for k, g in self._slot_of.items()}
+        self._pending = {remap(g): vec for g, vec in self._pending.items()}
+        self._free_shard = [
+            [remap(g) for g in free] for free in self._free_shard
+        ]
+        for s in range(S):
+            # fresh rows append to each shard's LIFO free list, same as
+            # the single-shard extend: post-growth allocations take the
+            # new low rows first
+            self._free_shard[s].extend(
+                range((s + 1) * new_shard - 1, s * new_shard + old_shard - 1, -1)
+            )
 
     def _refresh_host(self) -> None:
         """Pull device-resident rows into the host mirror, overlaying
@@ -490,11 +845,9 @@ class DeviceKnnIndex:
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            ndata = self.mesh.shape["data"]
-            pad = (-mat.shape[0]) % ndata
-            if pad:
-                mat = np.concatenate([mat, np.zeros((pad, self.dim), np.float32)])
-                val = np.concatenate([val, np.zeros((pad,), bool)])
+            # capacity = n_shards * shard_capacity by construction, and
+            # slabs are contiguous in global slot order, so the even
+            # NamedSharding split puts shard s's slab on device s
             self._dev_matrix = jax.device_put(mat, NamedSharding(self.mesh, P("data", None)))
             self._dev_valid = jax.device_put(val, NamedSharding(self.mesh, P("data")))
         else:
@@ -521,7 +874,7 @@ class DeviceKnnIndex:
     def _flush_pending(self) -> None:
         if not self._pending:
             return
-        n_rows = self._dev_matrix.shape[0]  # may exceed capacity (mesh pad)
+        n_rows = max(int(self._dev_matrix.shape[0]), self.capacity)
         m = len(self._pending)
         mb = _k_bucket(m)
         slots = np.full((mb,), n_rows, np.int32)  # pad rows scatter out of bounds
@@ -531,9 +884,14 @@ class DeviceKnnIndex:
             # [mb, dim] vecs matrix made every churn round upload ~400x
             # more bytes than the update carries
             slots[:m] = list(self._pending.keys())
-            self._dev_valid, self._dev_bias = _scatter_tomb_fn()(
-                self._dev_valid, self._dev_bias, slots
-            )
+            if self.mesh is not None:
+                self._dev_valid, self._dev_bias = _mesh_fns(self.mesh)["tomb"](
+                    self._dev_valid, self._dev_bias, slots
+                )
+            else:
+                self._dev_valid, self._dev_bias = _scatter_tomb_fn()(
+                    self._dev_valid, self._dev_bias, slots
+                )
             self._pending.clear()
             return
         vecs = np.zeros((mb, self.dim), np.float32)
@@ -543,7 +901,10 @@ class DeviceKnnIndex:
             if vec is not None:
                 vecs[i] = vec
                 flags[i] = True
-        self._dev_matrix, self._dev_valid, self._dev_bias = _scatter_fn()(
+        scatter = (
+            _mesh_fns(self.mesh)["scatter"] if self.mesh is not None else _scatter_fn()
+        )
+        self._dev_matrix, self._dev_valid, self._dev_bias = scatter(
             self._dev_matrix,
             self._dev_valid,
             self._dev_bias,
@@ -588,9 +949,88 @@ class DeviceKnnIndex:
                     bias=self._dev_bias,
                     mesh=self.mesh,
                 )
+            if self.mesh is not None:
+                return self._sharded_topk(q[todo], fetch)
             return fn(self._dev_matrix, self._dev_valid, q[todo], fetch)
 
-        return self._assemble(len(q), k, filter_fns, dispatch)
+        out = self._assemble(len(q), k, filter_fns, dispatch)
+        self._record_search(len(q), k)
+        return out
+
+    def _record_search(self, n_queries: int, k: int) -> None:
+        from ..internals import flight_recorder
+        from .index_metrics import INDEX_METRICS
+
+        merge_s = getattr(self, "_last_merge_s", None)
+        INDEX_METRICS.record_search(self.name, n_queries)
+        flight_recorder.record(
+            "index.search",
+            index=self.name,
+            queries=n_queries,
+            k=k,
+            shards=self.n_shards,
+            merge_ms=round(merge_s * 1e3, 4) if merge_s is not None else 0.0,
+        )
+        self._last_merge_s = None
+
+    def _stage_queries(self, queries):
+        """Upload a query block through the index's mesh-aware staging
+        ring: the put lands replicated across every mesh device up
+        front, so the sharded search consumes it without GSPMD
+        inserting a broadcast from device 0 on the hot path."""
+        from ..engine.device_ring import DeviceRing
+        from ..parallel.sharding import replicated
+
+        if self._query_ring is None:
+            self._query_ring = DeviceRing(
+                depth=2,
+                name=f"{self.name}.queries",
+                sharding=replicated(self.mesh),
+            )
+        return self._query_ring.stage(queries)
+
+    def _sharded_topk(self, queries, fetch: int, block: bool = True):
+        """Two-phase sharded search: per-shard top-k inside a shard_map
+        (phase 1, no cross-chip traffic), then the merge collective —
+        all-gather of the [q, n_shards*k_local] candidates + one final
+        top-k (phase 2). Phase 2 is timed into the
+        ``pathway_index_merge_seconds`` histogram when metrics are live;
+        candidate width always reaches ``fetch`` because
+        n_shards*k_local >= min(fetch, capacity)."""
+        import time
+
+        import jax
+
+        from .index_metrics import INDEX_METRICS
+
+        fns = _mesh_fns(self.mesh)
+        rows = int(self._dev_matrix.shape[0]) // self.n_shards
+        k_local = min(fetch, rows)
+        k_final = min(fetch, self.n_shards * k_local)
+        l2 = self.metric == "l2"
+        handles = None
+        if block:
+            handles = self._stage_queries(np.asarray(queries, np.float32))
+            qd = handles[0]
+        else:
+            qd = queries
+        vals, idx = fns["local_topk"](
+            self._dev_matrix, self._dev_valid, qd, k_local=k_local, l2=l2
+        )
+        timing = block and INDEX_METRICS.active()
+        t0 = None
+        if timing:
+            jax.block_until_ready((vals, idx))
+            t0 = time.perf_counter()
+        out_v, out_i = fns["merge_topk"](vals, idx, qd, k=k_final, l2=l2)
+        if block:
+            jax.block_until_ready((out_v, out_i))
+            if t0 is not None:
+                self._last_merge_s = time.perf_counter() - t0
+                INDEX_METRICS.observe_merge(self._last_merge_s)
+            if handles is not None:
+                self._query_ring.retire(handles)
+        return out_v, out_i
 
     def _assemble(self, q_n, k, filter_fns, dispatch):
         """Shared result assembly: run ``dispatch(todo, fetch)`` for the
@@ -669,6 +1109,10 @@ class DeviceKnnIndex:
                 bias=self._dev_bias,
                 mesh=self.mesh,
             )
+        if self.mesh is not None:
+            # block=False keeps the async contract: both phases are
+            # dispatched, nothing materializes on host
+            return self._sharded_topk(q, fetch, block=False)
         return _topk_fn(self.metric)(self._dev_matrix, self._dev_valid, q, fetch)
 
     def search_resolve(self, scores, idx, k: int) -> list[list[tuple[Any, float]]]:

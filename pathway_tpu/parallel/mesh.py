@@ -1,0 +1,153 @@
+"""Run-scoped active mesh: how ``pw.run(mesh=...)`` / ``PATHWAY_MESH``
+reach device-backed indexes without threading a Mesh through every
+stdlib constructor.
+
+``pw.run`` resolves its ``mesh=`` argument (or the ``PATHWAY_MESH``
+env var) to a ``jax.sharding.Mesh`` and installs it here for the
+duration of the run; ``DeviceKnnIndex`` factories built at lowering
+time call :func:`active_mesh` and pick it up with zero query-API
+change. Spec parsing is jax-free so the analyze-only path (and rule
+PWL010) can reason about mesh axes without touching a backend.
+
+Accepted specs::
+
+    8            # data=8, model=1
+    "4x2"        # data=4, model=2
+    "data=4,model=2"
+    {"data": 4, "model": 2}
+    Mesh(...)    # passed through verbatim
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from typing import Any
+
+__all__ = [
+    "parse_mesh_spec",
+    "resolve_mesh",
+    "active_mesh",
+    "set_active_mesh",
+    "use_mesh",
+]
+
+_lock = threading.Lock()
+_active: Any = None  # jax.sharding.Mesh | None — run-scoped override
+# PATHWAY_MESH resolution is cached on the raw env string so repeated
+# active_mesh() calls on the hot add/search path stay dict-lookup cheap.
+_env_cache: dict[str, Any] = {}
+
+
+def parse_mesh_spec(spec: Any) -> dict[str, int] | None:
+    """Normalize a mesh spec to ``{"data": n, "model": m}`` without
+    importing jax. Returns None for empty/absent specs; raises
+    ValueError on malformed ones so a typo'd PATHWAY_MESH fails loudly
+    instead of silently running single-device."""
+    if spec is None:
+        return None
+    shape = getattr(spec, "shape", None)
+    if shape is not None and hasattr(spec, "devices"):  # a jax Mesh
+        axes = dict(shape)
+        return {"data": int(axes.get("data", 1)), "model": int(axes.get("model", 1))}
+    if isinstance(spec, bool):
+        raise ValueError(f"mesh spec must be int/str/dict/Mesh, got {spec!r}")
+    if isinstance(spec, int):
+        if spec <= 0:
+            raise ValueError(f"mesh device count must be positive, got {spec}")
+        return {"data": spec, "model": 1}
+    if isinstance(spec, dict):
+        data = int(spec.get("data", 1))
+        model = int(spec.get("model", 1))
+        if data <= 0 or model <= 0:
+            raise ValueError(f"mesh axes must be positive, got {spec!r}")
+        return {"data": data, "model": model}
+    if isinstance(spec, str):
+        text = spec.strip()
+        if not text:
+            return None
+        if "=" in text:
+            axes = {"data": 1, "model": 1}
+            for part in text.replace(";", ",").split(","):
+                name, _, val = part.partition("=")
+                name = name.strip()
+                if name not in axes:
+                    raise ValueError(
+                        f"unknown mesh axis {name!r} in {spec!r}"
+                        " (expected data= and/or model=)"
+                    )
+                axes[name] = int(val)
+            return parse_mesh_spec(axes)
+        if "x" in text:
+            data_s, _, model_s = text.partition("x")
+            return parse_mesh_spec({"data": int(data_s), "model": int(model_s)})
+        return parse_mesh_spec(int(text))
+    raise ValueError(f"mesh spec must be int/str/dict/Mesh, got {spec!r}")
+
+
+def resolve_mesh(spec: Any):
+    """Build a ``jax.sharding.Mesh`` for ``spec`` (passing a Mesh
+    through untouched). Returns None for empty specs. Raises if the
+    spec asks for more devices than the backend exposes."""
+    if spec is None:
+        return None
+    if hasattr(spec, "devices") and hasattr(spec, "shape"):
+        return spec
+    axes = parse_mesh_spec(spec)
+    if axes is None:
+        return None
+    import jax
+
+    from .sharding import make_mesh
+
+    want = axes["data"] * axes["model"]
+    have = len(jax.devices())
+    if want > have:
+        raise ValueError(
+            f"mesh spec {axes} needs {want} devices but only {have} are"
+            " visible (set XLA_FLAGS=--xla_force_host_platform_device_count"
+            " for CPU dryruns)"
+        )
+    return make_mesh(n_devices=want, model_parallel=axes["model"])
+
+
+def set_active_mesh(mesh: Any) -> None:
+    """Install (or clear, with None) the run-scoped active mesh."""
+    global _active
+    with _lock:
+        _active = mesh
+
+
+def active_mesh():
+    """The mesh device-backed indexes should shard over: the run-scoped
+    mesh when a run with ``mesh=`` is live, else ``PATHWAY_MESH`` from
+    the environment, else None (single-device)."""
+    with _lock:
+        if _active is not None:
+            return _active
+    raw = os.environ.get("PATHWAY_MESH", "").strip()
+    if not raw:
+        return None
+    with _lock:
+        if raw in _env_cache:
+            return _env_cache[raw]
+    mesh = resolve_mesh(raw)
+    with _lock:
+        _env_cache[raw] = mesh
+    return mesh
+
+
+@contextmanager
+def use_mesh(mesh: Any):
+    """Scoped :func:`set_active_mesh` — restores the previous mesh on
+    exit, so nested runs and tests can't leak a mesh into each other."""
+    global _active
+    with _lock:
+        prev = _active
+        _active = mesh
+    try:
+        yield mesh
+    finally:
+        with _lock:
+            _active = prev

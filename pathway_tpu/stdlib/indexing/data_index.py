@@ -39,6 +39,12 @@ class InnerIndex:
         """(data_embed, query_embed) batch callables or None."""
         return None, None
 
+    def _index_spec(self) -> dict | None:
+        """Static description for analysis rules (device-backed tiers
+        override; host indexes return None and stay invisible to the
+        HBM-budget rule)."""
+        return None
+
     # --- shared query building ---
 
     def _build_query(
@@ -67,6 +73,13 @@ class InnerIndex:
             "asof_now": as_of_now,
         }
         op = LogicalOp("external_index", [query_table, data_table], params)
+        spec = self._index_spec()
+        if spec is not None:
+            # visible to analysis (PWL010 HBM-budget check) at graph
+            # build time, before any device allocation happens
+            from ...internals.parse_graph import G
+
+            G.external_indexes.append(spec)
         cols = {n: Column(c.dtype) for n, c in query_table._columns.items()}
         cols[_INDEX_REPLY] = Column(dt.ANY)
         cols[_SCORE] = Column(dt.ANY)

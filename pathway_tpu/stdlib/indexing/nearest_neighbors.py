@@ -88,11 +88,29 @@ class AbstractKnn(InnerIndex):
     reserved_space: int = 1024
     metric: str = "cos"
     embedder: Callable | None = None
+    #: explicit jax Mesh (or spec accepted by parallel.mesh.resolve_mesh);
+    #: None defers to the run-scoped mesh from ``pw.run(mesh=...)`` /
+    #: ``PATHWAY_MESH`` at lowering time
+    mesh: Any = None
 
     # device-index classes (DeviceKnnIndex-backed) opt in to the
     # HBM-resident ingest + fused text-query paths; host-side tiers
     # (LshKnn) must keep the embed-on-host contract
     _device_backed = False
+
+    def _index_spec(self) -> dict | None:
+        """Static description for analysis rules (PWL010): enough to
+        estimate the index's HBM footprint without building it."""
+        if not self._device_backed:
+            return None
+        return {
+            "kind": type(self).__name__,
+            "dimensions": int(self.dimensions),
+            "reserved_space": int(self.reserved_space),
+            "metric": self.metric,
+            "device_backed": True,
+            "mesh": self.mesh is not None,
+        }
 
     def _embed_fns(self):
         if self.embedder is None:
@@ -136,9 +154,18 @@ class AbstractKnn(InnerIndex):
     def _make_device_index(self):
         dim, metric, res = self.dimensions, self.metric, self.reserved_space
         enc = fused_query_encoder(self.embedder) if self.embedder else None
+        mesh_spec = self.mesh
 
         def make():
-            idx = _VectorPayloadIndex(dim=dim, metric=metric, reserved_space=max(64, res))
+            # mesh resolution happens HERE — at lowering time inside
+            # pw.run — so retrievers built before the run still pick up
+            # pw.run(mesh=...) / PATHWAY_MESH with zero query-API change
+            from ...parallel.mesh import active_mesh, resolve_mesh
+
+            mesh = resolve_mesh(mesh_spec) if mesh_spec is not None else active_mesh()
+            idx = _VectorPayloadIndex(
+                dim=dim, metric=metric, reserved_space=max(64, res), mesh=mesh
+            )
             if enc is not None:
                 idx.attach_encoder(enc)
             return idx
@@ -267,6 +294,7 @@ class KnnIndexFactory(InnerIndexFactory):
     reserved_space: int = 1024
     metric: str = "cos"
     embedder: Callable | None = None
+    mesh: Any = None  # explicit Mesh/spec; None -> run-scoped mesh
 
     def _get_embed_dimensions(self) -> int:
         if self.dimensions:
@@ -288,6 +316,7 @@ class BruteForceKnnFactory(KnnIndexFactory):
             reserved_space=self.reserved_space,
             metric=self.metric,
             embedder=self.embedder,
+            mesh=self.mesh,
         )
 
 
@@ -305,6 +334,7 @@ class UsearchKnnFactory(KnnIndexFactory):
             reserved_space=self.reserved_space,
             metric=self.metric,
             embedder=self.embedder,
+            mesh=self.mesh,
         )
 
 

@@ -35,16 +35,21 @@ class KNNIndex:
         distance_type: DistanceTypes = "euclidean",
         metadata: ColumnExpression | None = None,
         reserved_space: int = 1024,
+        mesh=None,
     ):
         self.data = data
         self.distance_type = distance_type
         metric = "l2" if distance_type == "euclidean" else "cos"
+        # mesh=None defers to pw.run(mesh=...) / PATHWAY_MESH at
+        # lowering time, so existing call sites scale out with zero
+        # query-API change
         self.inner = BruteForceKnn(
             data_embedding,
             metadata,
             dimensions=n_dimensions,
             reserved_space=reserved_space,
             metric=metric,
+            mesh=mesh,
         )
 
     def _get(

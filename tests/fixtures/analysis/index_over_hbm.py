@@ -1,0 +1,42 @@
+"""Analysis fixture: a device-backed KNN index whose reserved capacity
+(20M x 384 f32 ~= 28.6 GiB) cannot fit one device's 16 GiB HBM budget,
+in a run with no mesh — the verifier must flag PWL010 (warning): shard
+it with pw.run(mesh=...) / PATHWAY_MESH. Analyze-only never builds the
+index, so the huge reserved_space allocates nothing."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+docs = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  1 | 1.0 | 0.0
+  2 | 0.0 | 1.0
+    """
+)
+docs = docs.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, docs.x, docs.y)
+)
+
+queries = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  9 | 1.0 | 1.0
+    """
+)
+queries = queries.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.x, queries.y)
+)
+
+index = KNNIndex(
+    docs.emb,
+    docs,
+    n_dimensions=384,
+    reserved_space=20_000_000,
+    distance_type="cosine",
+)
+res = index.get_nearest_items(queries.emb, k=3)
+
+pw.io.null.write(res)
+
+pw.run()

@@ -616,3 +616,97 @@ def test_pwl009_negative_fault_domain_intact(monkeypatch):
 def test_pwl009_negative_without_run_context():
     _null_sink()
     assert "PWL009" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL010
+
+
+def _knn_sink(reserved: int, dim: int = 384):
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    docs = _static("""
+        | x
+      1 | 1.0
+      2 | 2.0
+    """)
+    docs = docs.select(emb=pw.apply_with_type(lambda x: (x, x), pw.ANY, docs.x))
+    queries = _static("""
+        | x
+      9 | 1.5
+    """)
+    queries = queries.select(
+        emb=pw.apply_with_type(lambda x: (x, x), pw.ANY, queries.x)
+    )
+    index = KNNIndex(docs.emb, docs, n_dimensions=dim, reserved_space=reserved)
+    pw.io.null.write(index.get_nearest_items(queries.emb, k=2))
+
+
+def test_pwl010_index_over_hbm_without_mesh(monkeypatch):
+    # 20M x 384 f32 ~= 28.6 GiB resident against the 16 GiB default
+    _knn_sink(reserved=20_000_000)
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL010"]
+    assert hits and hits[0].severity is Severity.WARNING
+    assert "mesh" in hits[0].message
+    assert hits[0].detail["suggested_mesh"] == 2
+    assert hits[0].detail["mesh_axes"] is None
+
+
+def test_pwl010_mesh_arg_silences(monkeypatch):
+    _knn_sink(reserved=20_000_000)
+    _describe_run(monkeypatch, monitoring_level="in_out", mesh=2)
+    assert "PWL010" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl010_pathway_mesh_env_silences(monkeypatch):
+    monkeypatch.setenv("PATHWAY_MESH", "4x2")
+    _knn_sink(reserved=20_000_000)
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL010" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl010_undersized_mesh_still_fires(monkeypatch):
+    # ~114 GiB index: a 2-way data mesh still leaves 57 GiB per device
+    _knn_sink(reserved=80_000_000)
+    _describe_run(monkeypatch, monitoring_level="in_out", mesh=2)
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL010"]
+    assert hits and hits[0].detail["mesh_axes"] == {"data": 2, "model": 1}
+    assert hits[0].detail["suggested_mesh"] >= 8
+
+
+def test_pwl010_hbm_budget_env_override(monkeypatch):
+    # a modest index trips a deliberately tiny budget
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(64 * 1024 * 1024))
+    _knn_sink(reserved=200_000)
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL010" in _rules(pw.analysis.analyze())
+
+
+def test_pwl010_negative_small_index(monkeypatch):
+    _knn_sink(reserved=100_000)
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL010" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl010_negative_host_index_invisible(monkeypatch):
+    # LSH tier is host-resident: no spec registered, no HBM rule
+    from pathway_tpu.stdlib.indexing import LshKnnFactory
+
+    docs = _static("""
+        | x
+      1 | 1.0
+    """)
+    docs = docs.select(emb=pw.apply_with_type(lambda x: (x, x), pw.ANY, docs.x))
+    queries = _static("""
+        | x
+      9 | 1.5
+    """)
+    queries = queries.select(
+        emb=pw.apply_with_type(lambda x: (x, x), pw.ANY, queries.x)
+    )
+    idx = LshKnnFactory(dimensions=2, reserved_space=50_000_000).build_index(
+        docs.emb, docs
+    )
+    pw.io.null.write(idx.query_as_of_now(queries.emb))
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL010" not in _rules(pw.analysis.analyze())

@@ -311,6 +311,39 @@ def test_bench_smoke_serving_admission_overhead():
     assert wall_on <= wall_off * 1.05 + 0.05, (wall_on, wall_off)
 
 
+def test_bench_smoke_shard_router_overhead():
+    """The mesh scale-out machinery (hash router in _alloc_slots,
+    per-shard free lists, shard_map dispatch) costs <5% wall on the
+    1-device path versus the plain unsharded index — scale-out must be
+    free for everyone who doesn't use it."""
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+    from pathway_tpu.parallel.mesh import resolve_mesh
+
+    rng = np.random.default_rng(0)
+    dim, n_docs, batch = 64, 2048, 256
+    vecs = rng.normal(size=(n_docs, dim)).astype(np.float32)
+    queries = rng.normal(size=(64, dim)).astype(np.float32)
+
+    def run_once(mesh):
+        idx = DeviceKnnIndex(
+            dim=dim, metric="cos", reserved_space=n_docs, mesh=mesh
+        )
+        t0 = time.perf_counter()
+        for j in range(0, n_docs, batch):
+            keys = list(range(j, j + batch))
+            idx.add_batch_arrays(keys, vecs[j : j + batch])
+            idx.search_batch(queries, 10)
+        return time.perf_counter() - t0
+
+    one_dev = resolve_mesh(1)
+    run_once(None), run_once(one_dev)  # warm both jit caches
+    wall_off = min(run_once(None) for _ in range(3))
+    wall_on = min(run_once(one_dev) for _ in range(3))
+    # min-of-3 plus an absolute epsilon so a loaded CI box cannot fail
+    # a millisecond-scale claim
+    assert wall_on <= wall_off * 1.05 + 0.10, (wall_on, wall_off)
+
+
 CLUSTER_OVERHEAD_PROGRAM = """
 import os, time
 import pathway_tpu as pw

@@ -176,3 +176,29 @@ def test_pwl009_json_carries_world_and_lease():
         d for d in diags if "cluster_lease_ms" in d["detail"]
     ]
     assert lease_diag["detail"]["cluster_lease_ms"] == 0.0
+
+
+def test_index_over_hbm_warns_pwl010():
+    """A device-backed index bigger than one device's HBM with no mesh:
+    a warning (exit 0), nonzero only under --strict-warnings. The CLI
+    sees the index because query building records its spec on the parse
+    graph (external_indexes) — no device allocation happens."""
+    fixture = os.path.join(FIXTURES, "index_over_hbm.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL010" in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--strict-warnings")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl010_json_carries_footprint_and_suggestion():
+    proc = _analyze_cli(os.path.join(FIXTURES, "index_over_hbm.py"), "--json")
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL010"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["index"]["reserved_space"] == 20_000_000
+    assert diag["detail"]["bytes"] > diag["detail"]["hbm_budget_bytes"]
+    assert diag["detail"]["suggested_mesh"] == 2
