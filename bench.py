@@ -512,6 +512,163 @@ def suite_clip() -> None:
     )
 
 
+def suite_collab_ingest() -> None:
+    """Collaborative CPU<->device ingest (pathway_tpu/ingest/): the
+    WindVE-style host worker pool + ordered committer vs the strict
+    inline prep path, for both the text ingest chain (native tokenizer
+    shards -> bucketed encoder) and the CLIP image chain (quantize/
+    YUV-pack workers -> donated ring). Model geometry is scaled so the
+    suite runs green on CPU; on-chip, the same path targets >=100k
+    docs/s text ingest and CLIP within 5x of its device-compute bound.
+    Byte-identity at any worker count is asserted, not assumed."""
+    import jax
+
+    from pathway_tpu.ingest import INGEST_METRICS, configure_stage, shutdown_stage
+    from pathway_tpu.models.clip import CLIPConfig, CLIPEncoder
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.models.sentence_encoder import SentenceEncoder
+
+    import os
+
+    INGEST_METRICS.reset()
+    shutdown_stage()  # strict inline baseline first
+    workers = int(os.environ.get("PATHWAY_INGEST_WORKERS") or 4)
+
+    # -- text leg: tokenize (host) -> bucketed encoder (device) --
+    cfg = EncoderConfig(
+        vocab_size=30522,
+        hidden_size=128,
+        num_layers=2,
+        num_heads=4,
+        intermediate_size=256,
+        max_position=128,
+    )
+    enc = SentenceEncoder(config=cfg, max_seq_len=64, max_batch=512)
+    n = 4096
+    texts = [
+        (
+            f"short doc {i} tag {i % 31}"
+            if i % 4
+            else (
+                f"long document {i}: "
+                + "streaming ingest needs straggler isolation " * 6
+            )
+        )
+        for i in range(n)
+    ]
+    ref = enc.encode(texts)  # compile + inline reference output
+    t0 = time.perf_counter()
+    ref = enc.encode(texts)
+    dt_inline = time.perf_counter() - t0
+    # device bound: same encode with tokenization OUTSIDE the window —
+    # what the chip does once host prep is fully hidden
+    m = enc.tokenizer.batch_encode_matrix(texts, enc.max_seq_len)
+    if m is not None:
+        enc._encode_matrix(*m)
+        t0 = time.perf_counter()
+        enc._encode_matrix(*m)
+        dt_bound = time.perf_counter() - t0
+    else:
+        dt_bound = dt_inline
+    configure_stage(workers)
+    out = enc.encode(texts)  # warm the collaborative path
+    t0 = time.perf_counter()
+    out = enc.encode(texts)
+    dt_collab = time.perf_counter() - t0
+    assert np.array_equal(np.asarray(out), np.asarray(ref)), (
+        "collaborative ingest output diverged from the inline path"
+    )
+    snap = INGEST_METRICS.snapshot()
+    collab_eps = n / dt_collab
+    bound_eps = n / dt_bound
+    _emit(
+        "collab_ingest_eps",
+        collab_eps,
+        "docs/s",
+        inline_eps=round(n / dt_inline, 1),
+        device_scan_bound_eps=round(bound_eps, 1),
+        vs_device_scan_bound=round(collab_eps / bound_eps, 3),
+        host_workers=snap["host_workers"],
+        host_stage_utilization=snap["utilization"],
+        queue_high_water=snap["queue_high_water"],
+        routed_short=snap["routed_short"],
+        routed_long=snap["routed_long"],
+        mode=f"{workers}-worker host stage, ordered committer; output "
+        "byte-identical to inline (asserted)",
+    )
+
+    # -- CLIP leg: quantize/YUV-pack (host) -> vision tower (device) --
+    shutdown_stage()
+    INGEST_METRICS.reset()
+    ccfg = CLIPConfig(
+        image_size=64,
+        patch_size=32,
+        vision_width=128,
+        vision_layers=2,
+        vision_heads=4,
+        text_width=64,
+        text_layers=2,
+        text_heads=2,
+        context_length=32,
+        embed_dim=64,
+    )
+    cenc = CLIPEncoder(ccfg, max_batch=64)
+    rng = np.random.default_rng(0)
+    n_img = 256
+    images = (
+        rng.random((n_img, ccfg.image_size, ccfg.image_size, 3)) * 255
+    ).astype(np.uint8)
+    cref = cenc.encode_image(images)  # compile + inline reference
+    t0 = time.perf_counter()
+    cref = cenc.encode_image(images)
+    dt_img_inline = time.perf_counter() - t0
+    # device-compute bound: vision tower on pre-staged packed rows
+    flat = cenc._pack_yuv420(images[:64])
+    flat_dev = jax.device_put(flat)
+    np.asarray(cenc._vfwd_yuv420(cenc.vparams, flat_dev).sum())
+    t0 = time.perf_counter()
+    for _ in range(n_img // 64):
+        np.asarray(cenc._vfwd_yuv420(cenc.vparams, flat_dev).sum())
+    dt_dev = time.perf_counter() - t0
+    configure_stage(workers)
+    cout = cenc.encode_image(images)  # warm the collaborative path
+    t0 = time.perf_counter()
+    cout = cenc.encode_image(images)
+    dt_img_collab = time.perf_counter() - t0
+    shutdown_stage()
+    assert np.array_equal(np.asarray(cout), np.asarray(cref)), (
+        "collaborative CLIP ingest output diverged from the inline path"
+    )
+    csnap = INGEST_METRICS.snapshot()
+    collab_ips = n_img / dt_img_collab
+    bound_ips = n_img / dt_dev
+    ratio = collab_ips / bound_ips
+    _emit(
+        "clip_ingest_vs_device_bound",
+        ratio,
+        "ratio",
+        collab_images_per_sec=round(collab_ips, 1),
+        inline_images_per_sec=round(n_img / dt_img_inline, 1),
+        device_compute_images_per_sec=round(bound_ips, 1),
+        host_stage_utilization=csnap["utilization"],
+        queue_high_water=csnap["queue_high_water"],
+        note="1.0 = ingest saturates the vision tower on pre-staged "
+        "rows; the on-chip target is >= 0.2 (within 5x of the bound)",
+    )
+    headline = {
+        "metric": "collab_ingest_eps",
+        "value": round(collab_eps, 1),
+        "unit": "docs/s",
+        "vs_device_scan_bound": round(collab_eps / bound_eps, 3),
+        "clip_ingest_vs_device_bound": round(ratio, 3),
+        "host_workers": workers,
+        "mode": "WindVE-style host stage: parallel prep workers, one "
+        "ordered committer, byte-identical output (asserted)",
+    }
+    print(json.dumps(headline), flush=True)
+    print_final_summary(headline)
+
+
 def suite_streaming_8shard() -> None:
     """Config 5: the 8-worker streaming pipeline (source -> embed ->
     KNN -> query) sharded over a virtual 8-device mesh (reference worker
@@ -1520,6 +1677,7 @@ SUITES = (
     suite_vector_store_ingest,
     suite_adaptive_rag_p50,
     suite_clip,
+    suite_collab_ingest,
     suite_encoder_mfu,
     suite_streaming_8shard,
     suite_mesh_scaling,

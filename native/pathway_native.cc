@@ -688,6 +688,19 @@ PN_EXPORT void pn_tok_encode_batch(void* tv, const uint8_t* texts,
   for (auto& th : threads) th.join();
 }
 
+// Shard entry for the collaborative host-ingest stage: encodes rows
+// [row_begin, row_end) of a shared blob into a shared matrix. Callers
+// (Python threads — ctypes releases the GIL around this call) give each
+// worker a disjoint row range, so no synchronization is needed here.
+PN_EXPORT void pn_tok_encode_shard(void* tv, const uint8_t* texts,
+                                   const uint64_t* offsets, uint64_t row_begin,
+                                   uint64_t row_end, int32_t max_len,
+                                   int32_t* out_ids, int32_t* out_lens) {
+  const Tok* t = static_cast<Tok*>(tv);
+  tok_encode_range(t, texts, offsets, row_begin, row_end, max_len, out_ids,
+                   out_lens);
+}
+
 // ---------------------------------------------------------------------------
 // blake2b (RFC 7693), batched keyed 8-byte digests.
 //

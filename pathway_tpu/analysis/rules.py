@@ -35,6 +35,12 @@ PWL010 (warning) device-backed index larger than a single device's HBM
                  budget in a run without a mesh: the first growth past
                  the budget OOMs mid-stream — shard it with
                  pw.run(mesh=...) / PATHWAY_MESH.
+PWL011 (warning) host-bound ingest: a streaming connector feeds a
+                 device-backed model/index with pipeline_depth<=1 and
+                 no collaborative ingest stage — tokenize/pack/resolve
+                 runs serially in line with device dispatch, starving
+                 the chip. pw.run(ingest_workers=N) /
+                 PATHWAY_INGEST_WORKERS or pipeline_depth>=2.
 """
 
 from __future__ import annotations
@@ -79,6 +85,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL008": (Severity.WARNING, "serving endpoint without overload protection"),
     "PWL009": (Severity.WARNING, "multi-worker run without a cluster fault domain"),
     "PWL010": (Severity.WARNING, "device index exceeds single-device HBM without a mesh"),
+    "PWL011": (Severity.WARNING, "host-bound ingest feeding a device model"),
 }
 
 _MUTABLE_TYPES = (list, dict, set, bytearray)
@@ -891,6 +898,59 @@ def check_index_hbm_budget(view: GraphView) -> list[Diagnostic]:
     return out
 
 
+def check_host_bound_ingest(view: GraphView) -> list[Diagnostic]:
+    """A streaming connector feeding a device-backed index/model in a
+    run with the strict serial epoch loop (``pipeline_depth <= 1``) and
+    no collaborative ingest stage configured: every epoch tokenizes,
+    packs and resolves its batch on the host *in line with* the device
+    dispatch, so the chip idles for the whole host-prep span (the r05
+    bench measured CLIP ~50x under its device-compute bound this way).
+    Either knob breaks the serialization — ``pw.run(ingest_workers=N)``
+    / PATHWAY_INGEST_WORKERS runs host prep on a worker pool with an
+    order-preserving committer, ``pipeline_depth >= 2`` overlaps whole
+    epochs."""
+    specs = getattr(view.graph, "external_indexes", None) or []
+    device_specs = [s for s in specs if s.get("device_backed")]
+    if not device_specs:
+        return []
+    ctx = getattr(view.graph, "run_context", None) or {}
+    if not ctx:
+        return []  # no pw.run configuration recorded (unit-built graph)
+    if int(ctx.get("pipeline_depth") or 1) > 1:
+        return []
+    if int(ctx.get("ingest_workers") or 0) > 0:
+        return []
+    out: list[Diagnostic] = []
+    for t in view.tables:
+        op = t._op
+        if op.kind != "external_index":
+            continue
+        if not any(view.is_streaming(src) for src in view.op_inputs(op)):
+            continue
+        out.append(
+            _diag(
+                "PWL011",
+                "streaming connector feeds a device-backed index with "
+                "pipeline_depth<=1 and no ingest stage: host prep "
+                "(tokenize/pack/resolve) runs serially in line with "
+                "device dispatch, starving the chip. Configure the "
+                "collaborative host stage — pw.run(ingest_workers=N) / "
+                "PATHWAY_INGEST_WORKERS=N (PATHWAY_INGEST_AUTOSCALE=1 "
+                "sizes it from queue depth) — or overlap whole epochs "
+                "with pipeline_depth>=2; output is byte-identical "
+                "either way",
+                t,
+                detail={
+                    "pipeline_depth": int(ctx.get("pipeline_depth") or 1),
+                    "ingest_workers": int(ctx.get("ingest_workers") or 0),
+                    "indexes": device_specs,
+                },
+            )
+        )
+        break  # one diagnostic per run configuration, not per index op
+    return out
+
+
 LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dtype_consistency,
     check_unbounded_state,
@@ -902,4 +962,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_serving_overload,
     check_cluster_fault_domain,
     check_index_hbm_budget,
+    check_host_bound_ingest,
 ]

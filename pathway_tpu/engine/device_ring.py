@@ -106,6 +106,11 @@ class DeviceRing:
         self._next = 0
         self._in_flight: list[list[Any]] = []
         self._lock = threading.Lock()
+        # serializes whole stage() calls: with >= 2 producers (the
+        # collaborative ingest stage makes that real), producer B could
+        # otherwise lap the ring back to the slot index producer A
+        # grabbed but has not yet filled, donating A's buffers mid-put
+        self._stage_lock = threading.Lock()
         self.staged = 0       # total stage() calls
         self.donated = 0      # buffers invalidated by slot reuse
         self.stage_stall_s = 0.0  # time stage() blocked on unretired slots
@@ -131,43 +136,44 @@ class DeviceRing:
             per_item = [
                 s if s is not None else self.sharding for s in shardings
             ]
-        with self._lock:
-            idx = self._next
-            self._next = (idx + 1) % self.depth
-            prev = self._slots[idx]
-            prev_retired = self._retired[idx]
-        if prev is not None:
-            if not prev_retired:
-                # consumer still reading the old generation: the put
-                # below would donate it out from under them — wait for
-                # the device to drain it first (backpressure, not UB).
-                # Stall time here means the host is outrunning the ring:
-                # raise the depth (PATHWAY_WIRE_RING_DEPTH for encoder
-                # wire uploads) so staging keeps pace with the kernel.
-                import time as _time
+        with self._stage_lock:
+            with self._lock:
+                idx = self._next
+                self._next = (idx + 1) % self.depth
+                prev = self._slots[idx]
+                prev_retired = self._retired[idx]
+            if prev is not None:
+                if not prev_retired:
+                    # consumer still reading the old generation: the put
+                    # below would donate it out from under them — wait for
+                    # the device to drain it first (backpressure, not UB).
+                    # Stall time here means the host is outrunning the ring:
+                    # raise the depth (PATHWAY_WIRE_RING_DEPTH for encoder
+                    # wire uploads) so staging keeps pace with the kernel.
+                    import time as _time
 
-                t0 = _time.perf_counter()
+                    t0 = _time.perf_counter()
+                    for a in prev:
+                        _block(a)
+                    self.stage_stall_s += _time.perf_counter() - t0
                 for a in prev:
-                    _block(a)
-                self.stage_stall_s += _time.perf_counter() - t0
-            for a in prev:
-                _delete(a)
-            self.donated += len(prev)
-            from ..internals import flight_recorder
+                    _delete(a)
+                self.donated += len(prev)
+                from ..internals import flight_recorder
 
-            flight_recorder.record(
-                "ring.donate", ring=self.name, buffers=len(prev), total=self.donated
-            )
-        handles = [_device_put(a, s) for a, s in zip(items, per_item)]
-        nbytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in items)
-        with self._lock:
-            self._slots[idx] = handles
-            self._retired[idx] = False
-            self._in_flight.append(handles)
-            self.staged += 1
-            self.bytes_staged += nbytes
-            self.high_water = max(self.high_water, len(self._in_flight))
-        return handles
+                flight_recorder.record(
+                    "ring.donate", ring=self.name, buffers=len(prev), total=self.donated
+                )
+            handles = [_device_put(a, s) for a, s in zip(items, per_item)]
+            nbytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in items)
+            with self._lock:
+                self._slots[idx] = handles
+                self._retired[idx] = False
+                self._in_flight.append(handles)
+                self.staged += 1
+                self.bytes_staged += nbytes
+                self.high_water = max(self.high_water, len(self._in_flight))
+            return handles
 
     def stats(self) -> dict:
         """Staging-depth telemetry for the host-path attribution."""

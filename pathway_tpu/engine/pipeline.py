@@ -139,6 +139,16 @@ class PipelineStats:
         stall = sum(r.stage_stall_s for r in active_rings())
         if stall:
             out["ring_stage_stall_s"] = round(stall, 6)
+        # collaborative host-ingest stage telemetry (zero-cost when no
+        # stage was ever configured — active() stays False)
+        from ..ingest.metrics import INGEST_METRICS
+
+        if INGEST_METRICS.active():
+            ing = INGEST_METRICS.snapshot()
+            out["ingest_workers"] = ing["host_workers"]
+            out["ingest_queue_depth"] = ing["queue_depth"]
+            out["ingest_utilization"] = ing["utilization"]
+            out["ingest_retried"] = ing["retried"]
         return out
 
 
@@ -254,8 +264,24 @@ class _Stager(threading.Thread):
 
             ep = StagedEpoch(time=t, scripted=scripted_t is not None)
             with self.commit_lock:
-                for s, b in session_batches:
-                    resolved = s.resolve_batch(b)
+                # Collaborative ingest: hand per-source upsert resolution
+                # to the host worker pool (distinct sources touch
+                # disjoint state). The stager stays the single committer
+                # — results come back in source order and the KIND_FEED
+                # log below is written serially, so durability and
+                # output are byte-identical to the inline loop.
+                from ..ingest import stage as _ingest
+
+                ist = _ingest.get_stage()
+                if ist is not None and session_batches:
+                    resolved_all = list(
+                        ist.map_ordered(
+                            lambda sb: sb[0].resolve_batch(sb[1]), session_batches
+                        )
+                    )
+                else:
+                    resolved_all = [s.resolve_batch(b) for s, b in session_batches]
+                for (s, b), resolved in zip(session_batches, resolved_all):
                     offsets = dict(s.last_offsets or {})
                     ep.resolved.append((s, resolved))
                     ep.offsets[id(s)] = offsets
@@ -276,6 +302,12 @@ class _Stager(threading.Thread):
                         ep.fed = True
             self.stats.staged_epochs += 1
             self.stats.end("prep")
+            if ist is not None:
+                # host_prep/device_wait attribution feeds the stage's
+                # autoscaler: host-bound epochs grow the worker pool
+                ist.observe_attribution(
+                    self.stats.host_prep_s, self.stats.device_wait_s
+                )
             flight_recorder.record(
                 "pipeline.staged", t=int(t), fed=ep.fed, scripted=ep.scripted
             )

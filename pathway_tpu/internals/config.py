@@ -77,6 +77,26 @@ class PathwayConfig:
         return max(1, _env_int("PATHWAY_PIPELINE_DEPTH", 1))
 
     @property
+    def ingest_workers(self) -> int:
+        """Collaborative host-ingest stage size (PATHWAY_INGEST_WORKERS):
+        0 = no stage (default, strict inline prep); N >= 1 runs tokenize
+        /pack/resolve prep on N host workers with a single ordered
+        committer (pathway_tpu/ingest/)."""
+        return max(0, _env_int("PATHWAY_INGEST_WORKERS", 0))
+
+    @property
+    def ingest_autoscale(self) -> bool:
+        """Queue-depth autoscaling for the ingest stage
+        (PATHWAY_INGEST_AUTOSCALE): grow on backlog / host-bound
+        attribution up to PATHWAY_INGEST_MAX_WORKERS, shrink on idle."""
+        return os.environ.get("PATHWAY_INGEST_AUTOSCALE", "0") not in ("0", "", "false")
+
+    @property
+    def ingest_max_workers(self) -> int:
+        """Autoscale ceiling (PATHWAY_INGEST_MAX_WORKERS, default 8)."""
+        return max(1, _env_int("PATHWAY_INGEST_MAX_WORKERS", 8))
+
+    @property
     def mesh_spec(self) -> str | None:
         """Raw mesh spec string (PATHWAY_MESH, e.g. "8" / "4x2" /
         "data=4,model=2"); parsed by parallel.mesh.parse_mesh_spec and

@@ -216,6 +216,7 @@ class MonitoringHttpServer:
         lines.extend(self._cluster_lines(wl))
         lines.extend(self._serving_lines(wl))
         lines.extend(self._index_lines(wl))
+        lines.extend(self._ingest_lines(wl))
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -503,6 +504,40 @@ class MonitoringHttpServer:
             lines.append(series("pathway_index_merge_seconds_count", merge.count))
         return lines
 
+    @staticmethod
+    def _ingest_lines(wl: str = "") -> list[str]:
+        """Collaborative host-ingest plane (``pathway_ingest_*``): queue
+        depth, pool size, stage utilization and the short/long routing
+        split. Rendered only once a stage has run — ``/metrics`` stays
+        byte-identical for pipelines without one."""
+        from ..ingest.metrics import INGEST_METRICS
+
+        if not INGEST_METRICS.active():
+            return []
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+
+        snap = INGEST_METRICS.snapshot()
+        lines: list[str] = []
+        for metric, key, kind in (
+            ("pathway_ingest_queue_depth", "queue_depth", "gauge"),
+            ("pathway_ingest_queue_high_water", "queue_high_water", "gauge"),
+            ("pathway_ingest_host_workers", "host_workers", "gauge"),
+            ("pathway_ingest_host_stage_utilization", "utilization", "gauge"),
+            ("pathway_ingest_enqueued_total", "enqueued", "counter"),
+            ("pathway_ingest_committed_total", "committed", "counter"),
+            ("pathway_ingest_retried_total", "retried", "counter"),
+            ("pathway_ingest_scale_up_total", "scale_up", "counter"),
+            ("pathway_ingest_scale_down_total", "scale_down", "counter"),
+            ("pathway_ingest_routed_short_total", "routed_short", "counter"),
+            ("pathway_ingest_routed_long_total", "routed_long", "counter"),
+        ):
+            lines.append(f"# TYPE {metric} {kind}")
+            lines.append(series(metric, snap[key]))
+        return lines
+
     def _status(self) -> str:
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
 
@@ -545,6 +580,10 @@ class MonitoringHttpServer:
 
         if INDEX_METRICS.active():
             status["index"] = INDEX_METRICS.snapshot()
+        from ..ingest.metrics import INGEST_METRICS
+
+        if INGEST_METRICS.active():
+            status["ingest"] = INGEST_METRICS.snapshot()
         return json.dumps(status)
 
     # -- lifecycle --

@@ -142,6 +142,14 @@ class StatsSnapshot:
     encoder_pad_fraction: float = 0.0
     encoder_dispatches: int = 0
     encoder_skipped_tokens: int = 0
+    #: collaborative host-ingest stage (pathway_tpu/ingest/): pool
+    #: size, live queue depth, stage utilization and the committed-task
+    #: count. All zero when no stage was configured — rendering stays
+    #: byte-identical for inline-prep pipelines.
+    ingest_workers: int = 0
+    ingest_queue_depth: int = 0
+    ingest_utilization: float = 0.0
+    ingest_committed: int = 0
     #: cluster telemetry plane: worker_id -> per-worker stats dict
     #: (epoch, rows_in, rows_out, rows_per_s, event_lag_s,
     #: overlap_ratio, restarts, pid). Empty outside sharded /
@@ -244,6 +252,14 @@ class StatsMonitor:
             snap.encoder_pad_fraction = enc["pad_fraction"]
             snap.encoder_dispatches = enc["dispatches"]
             snap.encoder_skipped_tokens = enc["skipped_tokens"]
+        from ..ingest.metrics import INGEST_METRICS
+
+        if INGEST_METRICS.active():
+            ing = INGEST_METRICS.snapshot()
+            snap.ingest_workers = ing["host_workers"]
+            snap.ingest_queue_depth = ing["queue_depth"]
+            snap.ingest_utilization = ing["utilization"]
+            snap.ingest_committed = ing["committed"]
         for node in engine.nodes:
             rows_in, rows_out = node.stats.rows_in, node.stats.rows_out
             key = f"{node.id}:{node.name}"
@@ -386,6 +402,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
     pipelined = snap.pipeline_depth > 1
     # encoder-kernel MFU column only when the fused encoder dispatched
     encoding = snap.encoder_dispatches > 0
+    # ingest column only when a collaborative host stage is running
+    ingesting = snap.ingest_workers > 0
     table = Table(caption=caption, box=box.SIMPLE)
     table.add_column("operator", justify="left")
     table.add_column(r"latency to wall clock \[ms]", justify="right")
@@ -397,7 +415,14 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         table.add_column("overlap ratio", justify="right")
     if encoding:
         table.add_column(r"MFU \[TF] / pad", justify="right")
-    pad = (2 if profiled else 0) + (1 if pipelined else 0) + (1 if encoding else 0)
+    if ingesting:
+        table.add_column("ingest util / queue", justify="right")
+    pad = (
+        (2 if profiled else 0)
+        + (1 if pipelined else 0)
+        + (1 if encoding else 0)
+        + (1 if ingesting else 0)
+    )
 
     def row(*cells):
         table.add_row(*(cells + ("",) * pad))
@@ -422,6 +447,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
                 cells = cells + ("",)
             if encoding:
                 cells = cells + ("",)
+            if ingesting:
+                cells = cells + ("",)
             table.add_row(*cells)
     if pipelined:
         cells = (
@@ -433,6 +460,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
             cells = cells + (f"{snap.host_prep_s * 1000:.1f}", "")
         cells = cells + (f"{snap.overlap_ratio:.2f}",)
         if encoding:
+            cells = cells + ("",)
+        if ingesting:
             cells = cells + ("",)
         table.add_row(*cells)
     if encoding:
@@ -448,6 +477,24 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         cells = cells + (
             f"{snap.encoder_achieved_tflops:.1f} / "
             f"{snap.encoder_pad_fraction * 100:.1f}%",
+        )
+        if ingesting:
+            cells = cells + ("",)
+        table.add_row(*cells)
+    if ingesting:
+        cells = (
+            f"host ingest ({snap.ingest_workers} workers)",
+            "",
+            f"{snap.ingest_committed}",
+        )
+        if profiled:
+            cells = cells + ("", "")
+        if pipelined:
+            cells = cells + ("",)
+        if encoding:
+            cells = cells + ("",)
+        cells = cells + (
+            f"{snap.ingest_utilization * 100:.0f}% / {snap.ingest_queue_depth}",
         )
         table.add_row(*cells)
     row("output", f"{monitor.output_latency_ms(now)}", "")

@@ -74,6 +74,7 @@ def run(
     profile: Any = None,
     recovery: Any = None,
     pipeline_depth: int | None = None,
+    ingest_workers: int | None = None,
     mesh: Any = None,
     cluster_accept_timeout: float | None = None,
     cluster_hello_timeout: float | None = None,
@@ -128,7 +129,16 @@ def run(
     is identical at any depth (epochs still execute strictly in order);
     the recovered time shows up as ``overlap_ratio`` on the dashboard
     and ``pathway_host_prep_seconds`` / ``pathway_device_wait_seconds``
-    on /metrics. See README "Performance"."""
+    on /metrics. See README "Performance".
+
+    ``ingest_workers`` (also PATHWAY_INGEST_WORKERS; 0/None = off):
+    size of the collaborative host-ingest stage — a bounded worker pool
+    that parallelizes CPU-side prep (native tokenizer shards, image
+    packing, per-source upsert resolution) while a single committer
+    preserves order, so output is byte-identical at any worker count.
+    PATHWAY_INGEST_AUTOSCALE=1 lets the pool grow/shrink from queue
+    backlog and the host_prep/device_wait attribution. See README
+    "Collaborative ingest"."""
     # recorded BEFORE the analyze-only return so `pathway analyze` sees
     # the run configuration too (rules PWL007/PWL008 read it off the
     # graph). The env fallback mirrors pwcfg.pipeline_depth, which is
@@ -141,6 +151,14 @@ def run(
         )
     except ValueError:
         _depth_ctx = 1
+    try:
+        _ingest_ctx = (
+            int(ingest_workers)
+            if ingest_workers is not None
+            else int(os.environ.get("PATHWAY_INGEST_WORKERS") or 0)
+        )
+    except ValueError:
+        _ingest_ctx = 0
     try:
         _procs_ctx = int(os.environ.get("PATHWAY_PROCESSES") or 1)
     except ValueError:
@@ -173,6 +191,9 @@ def run(
         "with_http_server": bool(with_http_server),
         "persistence": persistence_config is not None,
         "pipeline_depth": max(1, _depth_ctx),
+        # collaborative host-ingest stage size (0 = none configured);
+        # PWL011 (host-bound ingest) reads this off the graph
+        "ingest_workers": max(0, _ingest_ctx),
         # cluster shape for PWL009 (fault-domain coverage): analyze-only
         # runs read these off the graph without importing config
         "processes": max(1, _procs_ctx),
@@ -187,6 +208,16 @@ def run(
         # this point — return before sinks are built or readers started
         return None
     _run_analysis(analysis)
+    # (re)configure the collaborative host-ingest stage for this run;
+    # env-only configuration (PATHWAY_INGEST_WORKERS) is honored lazily
+    # by ingest.get_stage(), so only explicit args need action here
+    if ingest_workers is not None:
+        from ..ingest import stage as _ingest_stage
+
+        if _ingest_ctx > 0:
+            _ingest_stage.configure_stage(_ingest_ctx)
+        else:
+            _ingest_stage.shutdown_stage()
     from .config import get_pathway_config, pathway_config
     from .licensing import License, check_worker_count
     from .telemetry import Telemetry

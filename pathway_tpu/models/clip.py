@@ -263,6 +263,27 @@ class CLIPEncoder:
             from ..engine.device_ring import DeviceRing
 
             self._ring = DeviceRing(depth=2, name="clip.image")
+        from ..ingest import stage as ingest_stage
+
+        st = ingest_stage.get_stage()
+        if st is not None and len(spans) > 1:
+            # Collaborative path: the quantize/YUV-pack of every span
+            # runs on the ingest workers while this thread — the single
+            # committer — stages into the donated ring and dispatches
+            # strictly in span order, so results are byte-identical to
+            # the inline loop at any worker count.
+            packed = st.map_ordered(
+                lambda lo: self._pack_image_batch(images[lo : lo + step]), spans
+            )
+            pending = []
+            for i, (n, flat, fwd) in enumerate(packed):
+                self._note(f"stage:{i}")
+                (flat_dev,) = self._ring.stage([flat])
+                self._note(f"dispatch:{i}")
+                emb = fwd(self.vparams, flat_dev)
+                self._ring.retire([flat_dev])
+                pending.append((n, emb))
+            return pending
         pending = []
         self._note("pack:0")
         nxt = self._pack_image_batch(images[spans[0] : spans[0] + step])

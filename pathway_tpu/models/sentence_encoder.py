@@ -126,11 +126,33 @@ class SentenceEncoder:
         if not len(texts):
             return np.zeros((0, self.dim), np.float32)
         texts = ["" if t is None else str(t) for t in texts]
-        m = self.tokenizer.batch_encode_matrix(texts, self.max_seq_len)
-        if m is None:  # no native lib / non-ascii input
+        m = self._tokenize_matrix(texts)
+        if m is None:  # no native lib
             toks = [self.tokenizer.encode(t, self.max_seq_len) for t in texts]
             return self.encode_tokens(toks)
         return self._encode_matrix(*m)
+
+    def _tokenize_matrix(self, texts):
+        """Tokenize through the collaborative host-ingest stage when one
+        is configured (PATHWAY_INGEST_WORKERS / pw.run(ingest_workers=)),
+        else inline. Values are identical either way; the stage only
+        parallelizes the GIL-released native shard calls and records the
+        short/long routing split for the seq buckets."""
+        from ..ingest import stage as ingest_stage
+
+        st = ingest_stage.get_stage()
+        m = self.tokenizer.batch_encode_matrix(texts, self.max_seq_len, stage=st)
+        if m is not None and st is not None:
+            from ..ingest.stage import route_by_length
+            from .batching import DEFAULT_SEQ_BUCKETS, bucket
+
+            # short = fits the seq bucket at half this encoder's window;
+            # the argsorted group packer keeps the two populations in
+            # separate dense buckets, so one long straggler no longer
+            # pads out a batch of short docs
+            threshold = bucket(max(1, self.max_seq_len // 2), DEFAULT_SEQ_BUCKETS)
+            route_by_length(m[1].tolist(), threshold)
+        return m
 
     def _matrix_groups(self, ids_mat: np.ndarray, lens: np.ndarray):
         """Bucketed dispatch straight from the native tokenizer's padded
@@ -297,7 +319,7 @@ class SentenceEncoder:
                 first = self.encode_device(texts[:mid])
                 second = self.encode_device(texts[mid:])
                 return jnp.concatenate([first, second], axis=0)
-        m = self.tokenizer.batch_encode_matrix(texts, self.max_seq_len)
+        m = self._tokenize_matrix(texts)
         return self._dispatch_tokenized(texts, m, pad_to)
 
     def encode_device_many(self, batches, pad_to: int | None = None) -> list:
@@ -311,7 +333,7 @@ class SentenceEncoder:
         batches = [["" if t is None else str(t) for t in b] for b in batches]
         if len(batches) < 2:
             return [self.encode_device(b, pad_to=pad_to) for b in batches]
-        prepared = self.tokenizer.batch_encode_matrix(batches[0], self.max_seq_len)
+        prepared = self._tokenize_matrix(batches[0])
         out = []
         for i, texts in enumerate(batches):
             m = prepared
@@ -319,9 +341,7 @@ class SentenceEncoder:
             if i + 1 < len(batches):
                 # tokenize the NEXT epoch's batch while this one's
                 # dispatch (async on device backends) is still crunching
-                prepared = self.tokenizer.batch_encode_matrix(
-                    batches[i + 1], self.max_seq_len
-                )
+                prepared = self._tokenize_matrix(batches[i + 1])
         return out
 
     def _dispatch_tokenized(self, texts, m, pad_to: int | None = None):

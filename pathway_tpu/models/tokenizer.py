@@ -90,22 +90,79 @@ class WordPieceTokenizer:
         ids, lens = m
         return [ids[i, : lens[i]].tolist() for i in range(len(texts))]
 
-    def batch_encode_matrix(self, texts, max_len: int = 128):
+    def batch_encode_matrix(self, texts, max_len: int = 128, *, stage=None):
         """Native-only zero-copy variant: -> (ids [n, max_len] int32,
         lens [n] int32) or None when the native path can't be used.
         Rows are pad_id-filled past their length — feedable straight
-        into the encoder's bucketed batching without Python lists."""
+        into the encoder's bucketed batching without Python lists.
+
+        Non-ASCII texts no longer abandon the C++ path for the whole
+        batch: only those rows detour through the Python fallback (the
+        C++ scanner is ascii-only, so parity needs Python lowercasing
+        there), and their results are merged into the same matrix.
+
+        ``stage``: an optional :class:`~pathway_tpu.ingest.HostIngestStage`
+        — when given, the ASCII rows are encoded as parallel shard calls
+        on the stage's workers (``pn_tok_encode_shard`` releases the
+        GIL), each shard writing its disjoint row range of the shared
+        output matrix. Same values at any worker count.
+        """
         from .. import native as native_mod  # pathway_tpu.native
 
-        # python lowercases non-ascii letters; the C++ path is
-        # ascii-only, so parity is only guaranteed for ascii input
-        if not (native_mod.is_available() and all(t.isascii() for t in texts)):
+        if not native_mod.is_available():
             return None
+        import numpy as np
+
         if self._native is None:
             self._native = native_mod.NativeTokenizer(
                 self._vocab_file, self.vocab_size, self.lowercase, self.max_chars
             )
-        return self._native.encode_batch(texts, max_len)
+        texts = list(texts)
+        ascii_rows = [i for i, t in enumerate(texts) if t.isascii()]
+        if len(ascii_rows) == len(texts):
+            if stage is not None and len(texts) >= 2:
+                return self._encode_matrix_staged(texts, max_len, stage)
+            return self._native.encode_batch(texts, max_len)
+        n = len(texts)
+        ids = np.full((n, max_len), self.pad_id, np.int32)
+        lens = np.zeros(n, np.int32)
+        if ascii_rows:
+            sub = [texts[i] for i in ascii_rows]
+            if stage is not None and len(sub) >= 2:
+                sub_ids, sub_lens = self._encode_matrix_staged(sub, max_len, stage)
+            else:
+                sub_ids, sub_lens = self._native.encode_batch(sub, max_len)
+            ids[ascii_rows] = sub_ids
+            lens[ascii_rows] = sub_lens
+        for i, t in enumerate(texts):
+            if not t.isascii():
+                row = self.encode(t, max_len=max_len)
+                ids[i, : len(row)] = row
+                lens[i] = len(row)
+        return ids, lens
+
+    def _encode_matrix_staged(self, texts, max_len: int, stage):
+        """ASCII-only collaborative path: shard rows across the ingest
+        stage's workers into one shared output matrix. Each shard call
+        covers a disjoint row range, so the per-row values are exactly
+        what one ``encode_batch`` call would produce."""
+        import numpy as np
+
+        n = len(texts)
+        blob, offsets = self._native.prepare_blob(texts)
+        out_ids = np.empty((n, max_len), np.int32)
+        out_lens = np.empty(n, np.int32)
+        shard = max(64, -(-n // max(1, stage.workers * 2)))
+        spans = [(b, min(b + shard, n)) for b in range(0, n, shard)]
+
+        def _run(span):
+            b, e = span
+            self._native.encode_shard(blob, offsets, b, e, max_len, out_ids, out_lens)
+            return None
+
+        for _ in stage.map_ordered(_run, spans):
+            pass
+        return out_ids, out_lens
 
     def encode_pair(self, a: str, b: str, max_len: int = 256) -> tuple[list[int], list[int]]:
         """(ids, token_type_ids) for cross-encoder input [CLS] a [SEP] b [SEP]."""

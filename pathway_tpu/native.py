@@ -100,6 +100,12 @@ def _load() -> ctypes.CDLL | None:
              ctypes.c_int32, ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)],
             None,
         ),
+        "pn_tok_encode_shard": (
+            [ctypes.c_void_p, u8p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+             ctypes.c_uint64, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+             ctypes.POINTER(ctypes.c_int32)],
+            None,
+        ),
         "pn_version": ([], ctypes.c_char_p),
     }
     try:
@@ -390,3 +396,29 @@ class NativeTokenizer:
             out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         )
         return out_ids, out_lens
+
+    @staticmethod
+    def prepare_blob(texts: list[str]):
+        """-> (concat utf-8 bytes, [n+1] uint64 offsets) for shard calls."""
+        import numpy as np
+
+        blobs = [t.encode("utf-8") for t in texts]
+        offsets = np.zeros(len(blobs) + 1, np.uint64)
+        np.cumsum([len(b) for b in blobs], out=offsets[1:])
+        return b"".join(blobs), offsets
+
+    def encode_shard(self, blob, offsets, row_begin: int, row_end: int,
+                     max_len: int, out_ids, out_lens) -> None:
+        """Encode rows [row_begin, row_end) of a prepared blob into the
+        shared (n, max_len) matrix. ctypes drops the GIL for the call,
+        so ingest workers calling disjoint shards run in parallel."""
+        NATIVE.pn_tok_encode_shard(
+            self._h,
+            _as_u8p(blob),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            row_begin,
+            row_end,
+            max_len,
+            out_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )

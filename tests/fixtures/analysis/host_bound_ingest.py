@@ -1,0 +1,37 @@
+"""Analysis fixture: a streaming connector feeding a device-backed KNN
+index with the strict serial epoch loop (pipeline_depth defaults to 1)
+and no collaborative ingest stage configured — the verifier must flag
+PWL011 (warning): host prep runs in line with device dispatch, starving
+the chip; fix with pw.run(ingest_workers=N) / PATHWAY_INGEST_WORKERS or
+pipeline_depth>=2."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+docs = pw.demo.range_stream(nb_rows=5, input_rate=1000.0)
+docs = docs.select(
+    emb=pw.apply_with_type(lambda v: (float(v), 1.0), pw.ANY, docs.value)
+)
+
+queries = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  9 | 1.0 | 1.0
+    """
+)
+queries = queries.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.x, queries.y)
+)
+
+index = KNNIndex(
+    docs.emb,
+    docs,
+    n_dimensions=2,
+    reserved_space=100,
+    distance_type="cosine",
+)
+res = index.get_nearest_items(queries.emb, k=2)
+
+pw.io.null.write(res)
+
+pw.run()

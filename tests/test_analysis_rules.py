@@ -710,3 +710,66 @@ def test_pwl010_negative_host_index_invisible(monkeypatch):
     pw.io.null.write(idx.query_as_of_now(queries.emb))
     _describe_run(monkeypatch, monitoring_level="in_out")
     assert "PWL010" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL011
+
+
+def _streaming_knn_sink():
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    docs = _stream()
+    docs = docs.select(
+        emb=pw.apply_with_type(lambda v: (float(v), 1.0), pw.ANY, docs.value)
+    )
+    queries = _static("""
+        | x   | y
+      9 | 1.0 | 1.0
+    """)
+    queries = queries.select(
+        emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.x, queries.y)
+    )
+    index = KNNIndex(docs.emb, docs, n_dimensions=2, reserved_space=100)
+    pw.io.null.write(index.get_nearest_items(queries.emb, k=2))
+
+
+def test_pwl011_streaming_device_index_serial_ingest(monkeypatch):
+    _streaming_knn_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL011"]
+    assert len(hits) == 1 and hits[0].severity is Severity.WARNING
+    assert "ingest_workers" in hits[0].message
+    assert hits[0].detail["pipeline_depth"] == 1
+    assert hits[0].detail["ingest_workers"] == 0
+    assert hits[0].detail["indexes"], "device index specs missing from detail"
+
+
+def test_pwl011_ingest_workers_arg_silences(monkeypatch):
+    _streaming_knn_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out", ingest_workers=2)
+    assert "PWL011" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl011_ingest_workers_env_silences(monkeypatch):
+    monkeypatch.setenv("PATHWAY_INGEST_WORKERS", "3")
+    _streaming_knn_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL011" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl011_pipeline_depth_silences(monkeypatch):
+    _streaming_knn_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out", pipeline_depth=2)
+    assert "PWL011" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl011_negative_static_source(monkeypatch):
+    # static docs: one epoch, nothing streams — no serial-ingest hazard
+    _knn_sink(reserved=100_000)
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL011" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl011_negative_without_run_context():
+    _streaming_knn_sink()
+    assert "PWL011" not in _rules(pw.analysis.analyze())

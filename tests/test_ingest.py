@@ -1,0 +1,499 @@
+"""Collaborative CPU<->TPU host-ingest stage (pathway_tpu/ingest/).
+
+The stage's contract is the one ``pipeline_depth`` already established:
+parallelism may reorder *work* but never *commits* — N prep workers
+feed a single ordered committer, so every output is byte-identical to
+the strict inline path at any worker count, under chaos at
+``ingest.worker`` (slow or dying workers), and with persistence
+enabled. These tests pin that contract plus the observability plane:
+``pathway_ingest_*`` metrics, ``ingest.enqueue/dequeue/autoscale``
+flight events, queue-depth autoscaling, and the mixed-ASCII native
+tokenizer split.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.ingest import (
+    INGEST_METRICS,
+    HostIngestStage,
+    configure_stage,
+    get_stage,
+    route_by_length,
+    shutdown_stage,
+)
+from pathway_tpu.internals import flight_recorder as fr
+from pathway_tpu.io._connector import input_table_from_reader
+from pathway_tpu.resilience import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_stage():
+    shutdown_stage()
+    INGEST_METRICS.reset()
+    yield
+    shutdown_stage()
+    INGEST_METRICS.reset()
+    chaos.deactivate()
+
+
+@pytest.fixture
+def recorder(monkeypatch):
+    rec = fr.FlightRecorder(size=512, enabled=True)
+    monkeypatch.setattr(fr, "RECORDER", rec)
+    return rec
+
+
+def _kinds(rec):
+    return [e["kind"] for e in rec.events()]
+
+
+# ---------------------------------------------------------------------------
+# stage core: ordering, chaos, autoscale
+# ---------------------------------------------------------------------------
+
+
+def test_map_ordered_preserves_submission_order():
+    st = HostIngestStage(4)
+    try:
+        out = list(st.map_ordered(lambda x: x * x, range(200)))
+    finally:
+        st.shutdown()
+    assert out == [x * x for x in range(200)]
+    snap = INGEST_METRICS.snapshot()
+    assert snap["committed"] == 200
+    assert snap["host_workers"] == 4
+    assert snap["enqueued"] == snap["dequeued"] == 200
+
+
+def test_result_error_propagates_at_commit():
+    st = HostIngestStage(2)
+
+    def boom(x):
+        if x == 3:
+            raise ValueError("task 3 failed")
+        return x
+
+    try:
+        with pytest.raises(ValueError, match="task 3 failed"):
+            list(st.map_ordered(boom, range(6)))
+    finally:
+        st.shutdown()
+
+
+def test_chaos_slow_worker_degrades_but_stays_ordered(recorder):
+    """A delayed worker (chaos ``ingest.worker`` delay) slows the stage
+    down but results still commit in submission order, losslessly."""
+    chaos.activate(
+        [{"site": "ingest.worker", "action": "delay", "delay_s": 0.02, "repeat": True}]
+    )
+    st = HostIngestStage(3)
+    try:
+        out = list(st.map_ordered(lambda x: x + 100, range(24)))
+    finally:
+        st.shutdown()
+        chaos.deactivate()
+    assert out == [x + 100 for x in range(24)]
+    assert INGEST_METRICS.snapshot()["committed"] == 24
+
+
+def test_chaos_dying_worker_never_drops_or_reorders(recorder):
+    """``ingest.worker`` raise kills workers mid-stream; the committer
+    re-executes their untouched tasks inline — every row survives, in
+    order, and the retry is visible on the metrics."""
+    chaos.activate(
+        [{"site": "ingest.worker", "action": "raise", "repeat": True}]
+    )
+    st = HostIngestStage(2)
+    try:
+        out = list(st.map_ordered(lambda x: x * 2, range(40)))
+    finally:
+        st.shutdown()
+        chaos.deactivate()
+    assert out == [x * 2 for x in range(40)], "dying workers dropped/reordered rows"
+    snap = INGEST_METRICS.snapshot()
+    assert snap["committed"] == 40
+    assert snap["retried"] >= 1, "no chaos-killed task was ever retried"
+
+
+def test_autoscale_grows_on_backlog_and_shrinks_on_idle(recorder):
+    st = HostIngestStage(1, autoscale=True, min_workers=1, max_workers=4, max_queue=64)
+    try:
+        # slow tasks pile the queue up past the per-worker watermark
+        out = list(st.map_ordered(lambda x: (time.sleep(0.005), x)[1], range(48)))
+        assert out == list(range(48))
+        grown = st.workers
+        assert grown > 1, "backlog never grew the pool"
+        # idle observations shrink back toward min_workers
+        for _ in range(40):
+            st.submit(lambda: None).result()
+            time.sleep(0.002)
+        assert st.workers < grown, "idle never shrank the pool"
+    finally:
+        st.shutdown()
+    snap = INGEST_METRICS.snapshot()
+    assert snap["scale_up"] >= 1 and snap["scale_down"] >= 1
+    assert "ingest.autoscale" in _kinds(recorder)
+
+
+def test_attribution_feed_grows_host_bound_pool():
+    st = HostIngestStage(1, autoscale=True, max_workers=4)
+    try:
+        st.observe_attribution(host_prep_s=1.0, device_wait_s=0.01)
+        assert st.workers == 2
+    finally:
+        st.shutdown()
+
+
+def test_route_by_length_splits_and_counts():
+    short, long = route_by_length([3, 50, 4, 120, 7], threshold=32)
+    assert short == [0, 2, 4] and long == [1, 3]
+    snap = INGEST_METRICS.snapshot()
+    assert snap["routed_short"] == 3 and snap["routed_long"] == 2
+
+
+# ---------------------------------------------------------------------------
+# flight events + blackbox render
+# ---------------------------------------------------------------------------
+
+
+def test_flight_events_enqueue_dequeue(recorder):
+    st = HostIngestStage(2)
+    try:
+        list(st.map_ordered(lambda x: x, range(8)))
+    finally:
+        st.shutdown()
+    kinds = _kinds(recorder)
+    assert "ingest.enqueue" in kinds and "ingest.dequeue" in kinds
+
+
+def test_ingest_events_visible_in_blackbox_show(tmp_path, recorder):
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli
+
+    st = HostIngestStage(1, autoscale=True, max_workers=2, max_queue=4)
+    try:
+        list(st.map_ordered(lambda x: (time.sleep(0.005), x)[1], range(24)))
+    finally:
+        st.shutdown()
+    path = recorder.dump("test", directory=str(tmp_path))
+    assert path is not None
+    res = CliRunner().invoke(cli, ["blackbox", "show", path])
+    assert res.exit_code == 0, res.output
+    assert "ingest.enqueue" in res.output
+    assert "ingest.dequeue" in res.output
+
+
+# ---------------------------------------------------------------------------
+# env / pw.run wiring
+# ---------------------------------------------------------------------------
+
+
+def test_get_stage_honors_env(monkeypatch):
+    shutdown_stage()
+    monkeypatch.delenv("PATHWAY_INGEST_WORKERS", raising=False)
+    assert get_stage() is None
+    monkeypatch.setenv("PATHWAY_INGEST_WORKERS", "3")
+    st = get_stage()
+    assert st is not None and st.workers == 3
+    shutdown_stage()
+
+
+def test_configure_stage_zero_disables():
+    assert configure_stage(2) is not None
+    assert configure_stage(0) is None
+    assert get_stage() is None
+
+
+def test_run_records_ingest_workers_in_run_context(monkeypatch):
+    monkeypatch.setenv("PATHWAY_ANALYZE_ONLY", "1")
+    t = pw.debug.table_from_markdown(
+        """
+        | x
+      1 | 1
+        """
+    )
+    pw.io.null.write(t)
+    assert pw.run(ingest_workers=4) is None
+    from pathway_tpu.internals.parse_graph import G
+
+    assert G.run_context["ingest_workers"] == 4
+    pw.clear_graph()
+
+
+# ---------------------------------------------------------------------------
+# tokenizer: mixed-ASCII split + collaborative shards
+# ---------------------------------------------------------------------------
+
+
+def test_tokenizer_mixed_ascii_batch_keeps_native_path():
+    """The old gate abandoned C++ for the whole batch on one non-ASCII
+    text; now only the stragglers detour through Python, and every row
+    still equals the per-text reference encoding."""
+    from pathway_tpu import native
+    from pathway_tpu.models.tokenizer import WordPieceTokenizer
+
+    if not native.is_available():
+        pytest.skip("native library unavailable")
+    tok = WordPieceTokenizer()
+    texts = ["plain ascii text"] * 5 + ["café au lait", "naïve übermut"] + [
+        f"more ascii {i}" for i in range(20)
+    ]
+    m = tok.batch_encode_matrix(texts, 32)
+    assert m is not None, "mixed batch abandoned the native path entirely"
+    ids, lens = m
+    for i, t in enumerate(texts):
+        ref = tok.encode(t, max_len=32)
+        assert lens[i] == len(ref)
+        assert ids[i, : lens[i]].tolist() == ref
+        assert (ids[i, lens[i] :] == tok.pad_id).all()
+
+
+def test_tokenizer_staged_shards_byte_identical():
+    from pathway_tpu import native
+    from pathway_tpu.models.tokenizer import WordPieceTokenizer
+
+    if not native.is_available():
+        pytest.skip("native library unavailable")
+    tok = WordPieceTokenizer()
+    texts = [f"document {i} with {'extra words ' * (i % 5)}content" for i in range(300)]
+    ref_ids, ref_lens = tok.batch_encode_matrix(texts, 48)
+    st = HostIngestStage(4)
+    try:
+        ids, lens = tok.batch_encode_matrix(texts, 48, stage=st)
+    finally:
+        st.shutdown()
+    assert np.array_equal(ids, ref_ids) and np.array_equal(lens, ref_lens)
+
+
+# ---------------------------------------------------------------------------
+# model paths: encoder + CLIP byte-identity at any worker count
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_encoder():
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.models.sentence_encoder import SentenceEncoder
+
+    cfg = EncoderConfig(
+        vocab_size=30000,
+        hidden_size=32,
+        num_layers=1,
+        num_heads=2,
+        intermediate_size=64,
+        max_position=64,
+        pooling="mean",
+    )
+    return SentenceEncoder(
+        config=cfg, checkpoint_dir="/nonexistent", max_seq_len=32, max_batch=16
+    )
+
+
+def test_encoder_stage_byte_identical_any_worker_count(tiny_encoder):
+    texts = [f"doc {i} {'long tail of words ' * (i % 4)}end" for i in range(80)]
+    ref = np.asarray(tiny_encoder.encode(texts))  # inline, no stage
+    for workers in (1, 4):
+        configure_stage(workers)
+        out = np.asarray(tiny_encoder.encode(texts))
+        shutdown_stage()
+        # tobytes: true byte-identity (array_equal trips on NaN rows the
+        # random-init reference weights can produce)
+        assert out.tobytes() == ref.tobytes(), f"{workers}-worker output diverged"
+
+
+def test_encoder_stage_records_routing(tiny_encoder):
+    configure_stage(2)
+    texts = ["short"] * 30 + ["many words beyond the short bucket " * 4] * 10
+    tiny_encoder.encode(texts)
+    shutdown_stage()
+    snap = INGEST_METRICS.snapshot()
+    assert snap["routed_short"] >= 30
+    assert snap["routed_long"] >= 10
+
+
+def test_clip_stage_byte_identical():
+    from pathway_tpu.models.clip import CLIPConfig, CLIPEncoder
+
+    cfg = CLIPConfig(
+        image_size=64,
+        patch_size=32,
+        vision_width=64,
+        vision_layers=1,
+        vision_heads=2,
+        text_width=32,
+        text_layers=1,
+        text_heads=2,
+        context_length=16,
+        embed_dim=32,
+    )
+    enc = CLIPEncoder(cfg, max_batch=16)
+    rng = np.random.default_rng(7)
+    images = (rng.random((48, 64, 64, 3)) * 255).astype(np.uint8)
+    ref = np.asarray(enc.encode_image(images))
+    configure_stage(3)
+    out = np.asarray(enc.encode_image(images))
+    shutdown_stage()
+    assert out.tobytes() == ref.tobytes(), "collaborative CLIP pack diverged"
+    assert INGEST_METRICS.snapshot()["committed"] >= 3  # one pack per span
+
+
+# ---------------------------------------------------------------------------
+# engine path: stager hands resolve to the pool; byte-identical output
+# with persistence + chaos at ingest.worker
+# ---------------------------------------------------------------------------
+
+WORDS = ["cat", "dog", "bird", "cat", "dog", "cat", "emu", "dog"]
+FINAL = {"cat": 3, "dog": 3, "bird": 1, "emu": 1}
+
+
+def _build_wordcount(out: str, store: str | None = None, pause: float = 0.04):
+    class S(pw.Schema):
+        word: str
+
+    def reader(ctx):
+        start = int(ctx.offsets.get("pos", 0))
+        for i, w in enumerate(WORDS):
+            if i < start:
+                continue
+            ctx.insert({"word": w}, offsets={"pos": i + 1})
+            ctx.commit()
+            time.sleep(pause)
+
+    t = input_table_from_reader(
+        S,
+        reader,
+        name="isrc",
+        persistent_id="i" if store is not None else None,
+        supports_offsets=True,
+        autocommit_duration_ms=10,
+    )
+    c = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+    pw.io.jsonlines.write(c, out)
+    if store is None:
+        return None
+    return pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(store)
+    )
+
+
+def _net(text: str) -> dict[str, int]:
+    state: dict[str, int] = {}
+    for line in text.splitlines():
+        rec = json.loads(line)
+        if rec["diff"] > 0:
+            state[rec["word"]] = rec["n"]
+        else:
+            state.pop(rec["word"], None)
+    return state
+
+
+def test_pipeline_ingest_stage_net_identical(tmp_path, monkeypatch):
+    """depth-2 run with the ingest stage resolving batches on workers
+    == strict depth-1 inline run, in net sink state."""
+    ref_out = str(tmp_path / "ref.jsonl")
+    _build_wordcount(ref_out)
+    pw.run(monitoring_level="none")
+    pw.clear_graph()
+    with open(ref_out) as f:
+        ref = f.read()
+    assert _net(ref) == FINAL
+
+    monkeypatch.setenv("PATHWAY_INGEST_WORKERS", "3")
+    shutdown_stage()  # force lazy re-read of the env knob
+    out = str(tmp_path / "staged.jsonl")
+    _build_wordcount(out)
+    pw.run(monitoring_level="none", pipeline_depth=2)
+    pw.clear_graph()
+    shutdown_stage()
+    with open(out) as f:
+        assert _net(f.read()) == FINAL
+    assert INGEST_METRICS.snapshot()["committed"] > 0, (
+        "engine path never used the ingest stage"
+    )
+
+
+def test_pipeline_ingest_chaos_with_persistence_byte_identical(tmp_path, monkeypatch):
+    """The acceptance bar: N-worker output == inline, under chaos at
+    ``ingest.worker`` AND with persistence enabled (KIND_FEED logging
+    stays serial on the committer, so the durable log is unchanged)."""
+    cfg = _build_wordcount(str(tmp_path / "ref.jsonl"), str(tmp_path / "ref_store"))
+    pw.run(monitoring_level="none", persistence_config=cfg)
+    pw.clear_graph()
+    with open(tmp_path / "ref.jsonl") as f:
+        ref = f.read()
+    assert _net(ref) == FINAL
+
+    monkeypatch.setenv("PATHWAY_INGEST_WORKERS", "2")
+    shutdown_stage()
+    out = str(tmp_path / "chaos.jsonl")
+    cfg = _build_wordcount(out, str(tmp_path / "chaos_store"))
+    chaos.activate([{"site": "ingest.worker", "action": "raise", "repeat": True}])
+    try:
+        pw.run(monitoring_level="none", persistence_config=cfg, pipeline_depth=2)
+    finally:
+        chaos.deactivate()
+        pw.clear_graph()
+        shutdown_stage()
+    with open(out) as f:
+        assert _net(f.read()) == _net(ref) == FINAL
+    snap = INGEST_METRICS.snapshot()
+    assert snap["committed"] > 0
+    assert snap["retried"] >= 1, "chaos never killed a worker on the engine path"
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_inactive_renders_nothing():
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+
+    assert not INGEST_METRICS.active()
+    assert MonitoringHttpServer._ingest_lines() == []
+
+
+def test_metrics_active_renders_family():
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+
+    st = HostIngestStage(2)
+    try:
+        list(st.map_ordered(lambda x: x, range(5)))
+    finally:
+        st.shutdown()
+    body = "\n".join(MonitoringHttpServer._ingest_lines())
+    for metric in (
+        "pathway_ingest_queue_depth",
+        "pathway_ingest_host_workers 2",
+        "pathway_ingest_host_stage_utilization",
+        "pathway_ingest_enqueued_total 5",
+        "pathway_ingest_committed_total 5",
+        "pathway_ingest_routed_short_total",
+        "pathway_ingest_routed_long_total",
+    ):
+        assert metric in body, f"{metric} missing from /metrics"
+
+
+def test_snapshot_and_dashboard_ingest_column():
+    from pathway_tpu.internals.monitoring import StatsSnapshot, StatsMonitor, _operators_table
+
+    # inactive: snapshot fields stay zero (byte-identical rendering)
+    snap = StatsSnapshot()
+    assert snap.ingest_workers == 0 and snap.ingest_queue_depth == 0
+    monitor = StatsMonitor()
+    inactive = _operators_table(monitor, time.monotonic(), False)
+    assert not any("ingest" in str(c.header) for c in inactive.columns)
+
+    monitor.snapshot.ingest_workers = 3
+    monitor.snapshot.ingest_utilization = 0.5
+    monitor.snapshot.ingest_committed = 42
+    active = _operators_table(monitor, time.monotonic(), False)
+    assert any("ingest" in str(c.header) for c in active.columns)

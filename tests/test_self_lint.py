@@ -202,3 +202,37 @@ def test_pwl010_json_carries_footprint_and_suggestion():
     assert diag["detail"]["index"]["reserved_space"] == 20_000_000
     assert diag["detail"]["bytes"] > diag["detail"]["hbm_budget_bytes"]
     assert diag["detail"]["suggested_mesh"] == 2
+
+
+def test_host_bound_ingest_warns_pwl011():
+    """Streaming connector -> device KNN with the serial epoch loop and
+    no ingest stage: a warning (exit 0), nonzero only under
+    --strict-warnings."""
+    fixture = os.path.join(FIXTURES, "host_bound_ingest.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL011" in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--strict-warnings")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl011_json_carries_depth_and_workers():
+    proc = _analyze_cli(os.path.join(FIXTURES, "host_bound_ingest.py"), "--json")
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL011"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["pipeline_depth"] == 1
+    assert diag["detail"]["ingest_workers"] == 0
+    assert diag["detail"]["indexes"]
+
+
+def test_pwl011_env_knob_silences_cli(monkeypatch):
+    """The fix the diagnostic suggests (PATHWAY_INGEST_WORKERS) makes
+    the same program lint clean — env flows through _analyze_cli."""
+    monkeypatch.setenv("PATHWAY_INGEST_WORKERS", "2")
+    proc = _analyze_cli(os.path.join(FIXTURES, "host_bound_ingest.py"))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL011" not in proc.stdout
