@@ -86,6 +86,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL009": (Severity.WARNING, "multi-worker run without a cluster fault domain"),
     "PWL010": (Severity.WARNING, "device index exceeds single-device HBM without a mesh"),
     "PWL011": (Severity.WARNING, "host-bound ingest feeding a device model"),
+    "PWL012": (Severity.WARNING, "beyond-HBM index without a cold tier"),
 }
 
 _MUTABLE_TYPES = (list, dict, set, bytearray)
@@ -860,8 +861,14 @@ def check_index_hbm_budget(view: GraphView) -> list[Diagnostic]:
         budget = int(os.environ.get("PATHWAY_HBM_BYTES") or _DEFAULT_HBM_BYTES)
     except ValueError:
         budget = _DEFAULT_HBM_BYTES
+    tiered_run = bool(ctx.get("index_tiers"))
     out: list[Diagnostic] = []
     for spec in device_specs:
+        if spec.get("tiers") or tiered_run:
+            # a cold tier bounds the resident footprint to the hot rows
+            # (ops/tiered_knn caps them at the HBM budget) — nothing to
+            # shard away; PWL012 owns the tier-advice side
+            continue
         per_device = _index_hbm_bytes(spec) // max(1, n_shards)
         if per_device <= budget:
             continue
@@ -892,6 +899,82 @@ def check_index_hbm_budget(view: GraphView) -> list[Diagnostic]:
                     "hbm_budget_bytes": budget,
                     "mesh_axes": axes,
                     "suggested_mesh": need,
+                },
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# PWL012 — beyond-HBM index with no cold tier configured
+
+
+def check_index_tier_budget(view: GraphView) -> list[Diagnostic]:
+    """A device-backed index whose projected footprint exceeds the HBM
+    budget with no cold tier configured. PWL010 suggests sharding
+    (throw chips at it); this rule suggests the other lever — a tiered
+    index (ops/tiered_knn.py): HBM-resident hot clusters over an int8
+    host cold tier, so the same corpus fits the same slice. The detail
+    carries the footprint, a suggested hot/cold split at the budget,
+    and the quantized cold-tier estimate (both reuse PWL010's budget
+    math via the shared PATHWAY_HBM_BYTES knob)."""
+    import os
+
+    from ..ops.tiered_knn import cold_row_bytes, hot_row_bytes
+
+    specs = getattr(view.graph, "external_indexes", None) or []
+    device_specs = [s for s in specs if s.get("device_backed")]
+    if not device_specs:
+        return []
+    ctx = getattr(view.graph, "run_context", None) or {}
+    if ctx.get("index_tiers"):
+        return []  # run-scoped tier config covers every device index
+    axes = ctx.get("mesh_axes") or None
+    n_shards = int(axes["data"]) if axes else 1
+    try:
+        budget = int(os.environ.get("PATHWAY_HBM_BYTES") or _DEFAULT_HBM_BYTES)
+    except ValueError:
+        budget = _DEFAULT_HBM_BYTES
+    out: list[Diagnostic] = []
+    for spec in device_specs:
+        if spec.get("tiers"):
+            continue
+        total = _index_hbm_bytes(spec)
+        per_device = total // max(1, n_shards)
+        if per_device <= budget:
+            continue
+        rows = int(spec.get("reserved_space") or 0)
+        dim = int(spec.get("dimensions") or 0)
+        hot_rows = min(
+            rows, max(1, n_shards) * max(1, budget // max(1, hot_row_bytes(dim)))
+        )
+        cold_rows = rows - hot_rows
+        cold_bytes = cold_rows * cold_row_bytes(dim)
+        out.append(
+            _diag(
+                "PWL012",
+                f"device-backed index ({spec.get('kind', 'index')}, "
+                f"reserved_space={rows}, dim={dim}) projects "
+                f"~{total / 1024**3:.1f} GiB resident against a "
+                f"{budget / 1024**3:.0f} GiB HBM budget and no cold "
+                "tier is configured — demote the cold corpus to host "
+                f"memory: pw.run(index_tiers='hot={hot_rows}') / "
+                f"PATHWAY_INDEX_TIERS=hot={hot_rows} keeps the hottest "
+                f"{hot_rows} rows in HBM and the remaining {cold_rows} "
+                f"rows int8-quantized on host "
+                f"(~{cold_bytes / 1024**3:.1f} GiB RAM; budget "
+                "override: PATHWAY_HBM_BYTES)",
+                detail={
+                    "index": spec,
+                    "bytes": total,
+                    "per_device_bytes": per_device,
+                    "hbm_budget_bytes": budget,
+                    "mesh_axes": axes,
+                    "suggested_tier_split": {
+                        "hot_rows": hot_rows,
+                        "cold_rows": cold_rows,
+                    },
+                    "quantized_cold_bytes": cold_bytes,
                 },
             )
         )
@@ -962,5 +1045,6 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_serving_overload,
     check_cluster_fault_domain,
     check_index_hbm_budget,
+    check_index_tier_budget,
     check_host_bound_ingest,
 ]

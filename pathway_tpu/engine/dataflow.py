@@ -1596,19 +1596,34 @@ class ExternalIndexNode(Node):
         return 0  # pinned: device-side sharding lives in ops/knn, not here
 
     # the index itself holds device arrays — snapshot the host-side row
-    # mirror and rebuild the index from it on restore
+    # mirror and rebuild the index from it on restore. Tiered indexes
+    # (ops/tiered_knn.py) additionally persist their tier layout:
+    # centroid table, per-key cluster assignment, and the hot-resident
+    # set, so recovery restores the EXACT tier assignment.
     def snapshot_state(self):
-        return {
+        state = {
             "data_rows": self.data_rows,
             "answered": self.answered,
             "queries": self.queries,
         }
+        tier_state = getattr(self.index, "tier_state", None)
+        if tier_state is not None:
+            state["index_tiers"] = tier_state()
+        return state
 
     def restore_state(self, state) -> None:
         self.data_rows = state["data_rows"]
         self.answered = state["answered"]
         self.queries = state["queries"]
+        tiers = state.get("index_tiers")
+        restore_tiers = getattr(self.index, "restore_tier_state", None)
+        if tiers is not None and restore_tiers is not None:
+            # install BEFORE the re-add so replayed rows land in their
+            # snapshotted clusters (cold), then promote the hot set
+            restore_tiers(tiers)
         self._index_add([(k, *self.data_fn(k, r)) for k, r in self.data_rows.items()])
+        if tiers is not None and restore_tiers is not None:
+            self.index.finish_tier_restore()
 
     def _index_add(self, adds) -> None:
         """Embed (optionally) and insert (key, payload, metadata) triples."""
@@ -1720,6 +1735,11 @@ class ExternalIndexNode(Node):
                 out.append((key, old, -1))
             self.answered[key] = orow
             out.append((key, orow, 1))
+        # tiered indexes rebalance on the epoch pipeline: promotion /
+        # demotion work rides the epoch boundary, never a query
+        rebalance = getattr(self.index, "maybe_rebalance", None)
+        if rebalance is not None and (index_changed or to_answer):
+            rebalance()
         self.emit(out, time)
 
 

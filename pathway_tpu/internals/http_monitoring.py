@@ -502,6 +502,76 @@ class MonitoringHttpServer:
                 )
             lines.append(series("pathway_index_merge_seconds_sum", f"{merge.total:.9f}"))
             lines.append(series("pathway_index_merge_seconds_count", merge.count))
+        # tiered-index plane: rendered only for indexes with tier
+        # accounting, so flat-index runs stay byte-identical
+        tiered = {
+            name: e["tiers"]
+            for name, e in snap["indexes"].items()
+            if "tiers" in e
+        }
+        if tiered:
+            docs_l: list[str] = []
+            bytes_l: list[str] = []
+            for name in sorted(tiered):
+                e = snap["indexes"][name]
+                t = tiered[name]
+                hot_b = t.get("hot_bytes_shard", [])
+                cold_b = t.get("cold_bytes_shard", [])
+                for s, docs in enumerate(e["docs_shard"]):
+                    lbl = f'index="{_escape_label(name)}",shard="{s}",tier="hot"'
+                    docs_l.append(series("pathway_index_tier_docs", docs, lbl))
+                    if s < len(hot_b):
+                        bytes_l.append(
+                            series("pathway_index_tier_bytes", hot_b[s], lbl)
+                        )
+                for s, docs in enumerate(t["cold_docs_shard"]):
+                    lbl = f'index="{_escape_label(name)}",shard="{s}",tier="cold"'
+                    docs_l.append(series("pathway_index_tier_docs", docs, lbl))
+                    if s < len(cold_b):
+                        bytes_l.append(
+                            series("pathway_index_tier_bytes", cold_b[s], lbl)
+                        )
+            lines.append("# TYPE pathway_index_tier_docs gauge")
+            lines.extend(docs_l)
+            lines.append("# TYPE pathway_index_tier_bytes gauge")
+            lines.extend(bytes_l)
+            for metric, key, kind in (
+                ("pathway_index_tier_promotions_total", "promotions", "counter"),
+                ("pathway_index_tier_demotions_total", "demotions", "counter"),
+                ("pathway_index_tier_hot_hit_ratio", "hot_hit_ratio", "gauge"),
+            ):
+                lines.append(f"# TYPE {metric} {kind}")
+                for name in sorted(tiered):
+                    lines.append(
+                        series(
+                            metric,
+                            tiered[name][key],
+                            f'index="{_escape_label(name)}"',
+                        )
+                    )
+            cold_fetch = INDEX_METRICS.cold_fetch
+            if cold_fetch.count:
+                lines.append("# TYPE pathway_index_tier_cold_fetch_seconds histogram")
+                for le, cum in cold_fetch.cumulative():
+                    lines.append(
+                        series(
+                            "pathway_index_tier_cold_fetch_seconds_bucket",
+                            cum,
+                            f'le="{le}"',
+                        )
+                    )
+                lines.append(
+                    series(
+                        "pathway_index_tier_cold_fetch_seconds_sum",
+                        f"{cold_fetch.total:.9f}",
+                    )
+                )
+                lines.append(
+                    series(
+                        "pathway_index_tier_cold_fetch_seconds_count",
+                        cold_fetch.count,
+                    )
+                )
         return lines
 
     @staticmethod

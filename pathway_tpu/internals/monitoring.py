@@ -150,6 +150,15 @@ class StatsSnapshot:
     ingest_queue_depth: int = 0
     ingest_utilization: float = 0.0
     ingest_committed: int = 0
+    #: tiered device index (ops/tiered_knn.py): total hot/cold resident
+    #: docs, lifetime promotions/demotions, and the hot-hit ratio over
+    #: answered results. All zero when no tiered index ran — rendering
+    #: stays byte-identical for flat-index pipelines.
+    tier_hot_docs: int = 0
+    tier_cold_docs: int = 0
+    tier_promotions: int = 0
+    tier_demotions: int = 0
+    tier_hot_hit_ratio: float = 0.0
     #: cluster telemetry plane: worker_id -> per-worker stats dict
     #: (epoch, rows_in, rows_out, rows_per_s, event_lag_s,
     #: overlap_ratio, restarts, pid). Empty outside sharded /
@@ -260,6 +269,22 @@ class StatsMonitor:
             snap.ingest_queue_depth = ing["queue_depth"]
             snap.ingest_utilization = ing["utilization"]
             snap.ingest_committed = ing["committed"]
+        from ..ops.index_metrics import INDEX_METRICS
+
+        if INDEX_METRICS.tiered_active():
+            idx = INDEX_METRICS.snapshot()
+            ratios = []
+            for e in idx["indexes"].values():
+                t = e.get("tiers")
+                if t is None:
+                    continue
+                snap.tier_hot_docs += t["hot_docs"]
+                snap.tier_cold_docs += t["cold_docs"]
+                snap.tier_promotions += t["promotions"]
+                snap.tier_demotions += t["demotions"]
+                ratios.append(t["hot_hit_ratio"])
+            if ratios:
+                snap.tier_hot_hit_ratio = sum(ratios) / len(ratios)
         for node in engine.nodes:
             rows_in, rows_out = node.stats.rows_in, node.stats.rows_out
             key = f"{node.id}:{node.name}"
@@ -404,6 +429,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
     encoding = snap.encoder_dispatches > 0
     # ingest column only when a collaborative host stage is running
     ingesting = snap.ingest_workers > 0
+    # tier column only when a tiered device index is accounting
+    tiering = (snap.tier_hot_docs + snap.tier_cold_docs) > 0
     table = Table(caption=caption, box=box.SIMPLE)
     table.add_column("operator", justify="left")
     table.add_column(r"latency to wall clock \[ms]", justify="right")
@@ -417,11 +444,14 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         table.add_column(r"MFU \[TF] / pad", justify="right")
     if ingesting:
         table.add_column("ingest util / queue", justify="right")
+    if tiering:
+        table.add_column("tier hot/cold", justify="right")
     pad = (
         (2 if profiled else 0)
         + (1 if pipelined else 0)
         + (1 if encoding else 0)
         + (1 if ingesting else 0)
+        + (1 if tiering else 0)
     )
 
     def row(*cells):
@@ -449,6 +479,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
                 cells = cells + ("",)
             if ingesting:
                 cells = cells + ("",)
+            if tiering:
+                cells = cells + ("",)
             table.add_row(*cells)
     if pipelined:
         cells = (
@@ -462,6 +494,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         if encoding:
             cells = cells + ("",)
         if ingesting:
+            cells = cells + ("",)
+        if tiering:
             cells = cells + ("",)
         table.add_row(*cells)
     if encoding:
@@ -480,6 +514,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         )
         if ingesting:
             cells = cells + ("",)
+        if tiering:
+            cells = cells + ("",)
         table.add_row(*cells)
     if ingesting:
         cells = (
@@ -495,6 +531,27 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
             cells = cells + ("",)
         cells = cells + (
             f"{snap.ingest_utilization * 100:.0f}% / {snap.ingest_queue_depth}",
+        )
+        if tiering:
+            cells = cells + ("",)
+        table.add_row(*cells)
+    if tiering:
+        cells = (
+            f"index tiers ({snap.tier_promotions}p/{snap.tier_demotions}d, "
+            f"hit {snap.tier_hot_hit_ratio * 100:.0f}%)",
+            "",
+            "",
+        )
+        if profiled:
+            cells = cells + ("", "")
+        if pipelined:
+            cells = cells + ("",)
+        if encoding:
+            cells = cells + ("",)
+        if ingesting:
+            cells = cells + ("",)
+        cells = cells + (
+            f"{snap.tier_hot_docs} / {snap.tier_cold_docs}",
         )
         table.add_row(*cells)
     row("output", f"{monitor.output_latency_ms(now)}", "")

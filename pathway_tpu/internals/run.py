@@ -76,6 +76,7 @@ def run(
     pipeline_depth: int | None = None,
     ingest_workers: int | None = None,
     mesh: Any = None,
+    index_tiers: Any = None,
     cluster_accept_timeout: float | None = None,
     cluster_hello_timeout: float | None = None,
     cluster_lease_ms: float | None = None,
@@ -185,6 +186,19 @@ def run(
         _mesh_axes = parse_mesh_spec(_mesh_spec)
     except ValueError:
         _mesh_axes = None
+    # tier spec parsed jax-free for the same reason: PWL010/PWL012 see
+    # whether a cold tier is configured without touching devices
+    from ..ops.tiered_knn import parse_tier_spec
+
+    _tier_spec = (
+        index_tiers
+        if index_tiers is not None
+        else (os.environ.get("PATHWAY_INDEX_TIERS") or None)
+    )
+    try:
+        _tier_cfg = parse_tier_spec(_tier_spec)
+    except ValueError:
+        _tier_cfg = None
     G.run_context = {
         "recovery": bool(recovery),
         "monitoring_level": monitoring_level,
@@ -202,6 +216,9 @@ def run(
         # {"data": n, "model": m} or None; PWL010 (index over HBM
         # budget) checks device-backed index footprints against this
         "mesh_axes": _mesh_axes,
+        # TierConfig knob dict or None; PWL012 (beyond-HBM index with
+        # no cold tier) treats a configured tier as the fix in place
+        "index_tiers": _tier_cfg.as_dict() if _tier_cfg is not None else None,
     }
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
@@ -349,6 +366,12 @@ def run(
     _run_mesh = resolve_mesh(mesh) if mesh is not None else None
     if _run_mesh is not None:
         set_active_mesh(_run_mesh)
+    # activate the run-scoped tier config the same way: tiered indexes
+    # built during lowering pick it up via tiered_knn.active_tiers()
+    from ..ops.tiered_knn import set_active_tiers
+
+    if index_tiers is not None and _tier_cfg is not None:
+        set_active_tiers(_tier_cfg)
     with mon_ctx as monitor:
         http_server = None
         if with_http_server:
@@ -520,6 +543,8 @@ def run(
                 http_server.stop()
             if _run_mesh is not None:
                 set_active_mesh(None)
+            if index_tiers is not None and _tier_cfg is not None:
+                set_active_tiers(None)
             result.flight_recorder_dumps = list(
                 flight_recorder.RECORDER._dumped_paths[dumps_before:]
             )

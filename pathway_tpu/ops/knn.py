@@ -531,15 +531,6 @@ class DeviceKnnIndex:
     def __len__(self) -> int:
         return len(self._slot_of)
 
-    def _alloc_slot(self, key) -> int:
-        """Pop a free slot on the shard owning ``key`` (the hash
-        router), growing per-shard capacity when that shard is full."""
-        shard = _shard_of_key(key, self.n_shards)
-        if not self._free_shard[shard]:
-            self._grow()
-        self._docs_shard[shard] += 1
-        return self._free_shard[shard].pop()
-
     def _alloc_slots(self, keys) -> list[int]:
         """Batch slot allocation: route every key to its shard, grow
         until each shard can hold its share, THEN pop — growth remaps
@@ -566,28 +557,23 @@ class DeviceKnnIndex:
             self.name, list(self._docs_shard), self.shard_capacity
         )
 
+    def _tier_cold_docs(self) -> int:
+        """Docs resident in a host cold tier behind this slab (0 for a
+        flat index; overridden when this index serves as the hot tier of
+        ops/tiered_knn.TieredKnnIndex)."""
+        return 0
+
     # --- updates (engine diff protocol) ---
 
     def add(self, key, vector, metadata=None) -> None:
+        # delegates to the batch path so single adds and bulk ingest
+        # share ONE normalization (scalar-norm vs axis-norm sum orders
+        # differ in the last bit, which would break the tiered index's
+        # fits-hot bit-identity guarantee)
         vec = np.asarray(vector, np.float32).reshape(-1)
         if vec.shape[0] != self.dim:
             raise ValueError(f"index dim {self.dim}, got vector dim {vec.shape[0]}")
-        if key in self._slot_of:
-            self.remove(key)
-        slot = self._alloc_slot(key)
-        if self.metric == "cos":
-            n = np.linalg.norm(vec)
-            if n > 0:
-                vec = vec / n
-        self._host[slot] = vec
-        self._valid_host[slot] = True
-        self._keys[slot] = key
-        self._slot_of[key] = slot
-        if metadata is not None:
-            self._meta[key] = metadata
-        if not self._full:
-            self._pending[slot] = vec
-        self._publish_metrics()
+        self.add_batch_arrays([key], vec[None, :], [metadata])
 
     def add_batch(self, items: list[tuple]) -> None:
         """Engine bulk-ingest protocol: ``items`` is a list of
@@ -772,12 +758,16 @@ class DeviceKnnIndex:
                 )["grow"](self._dev_matrix, self._dev_valid, self._dev_bias)
                 from ..internals import flight_recorder
 
+                # cold-tier docs count toward occupancy: a tiered index
+                # (ops/tiered_knn.py) overrides _tier_cold_docs so a
+                # shard whose corpus is merely demoted never reads as
+                # empty in the flight log
                 flight_recorder.record(
                     "index.rebalance",
                     index=self.name,
                     shards=self.n_shards,
                     shard_capacity=self.shard_capacity,
-                    docs=len(self._slot_of),
+                    docs=len(self._slot_of) + self._tier_cold_docs(),
                 )
         elif self.mesh is None and (self._dev_matrix is not None or self._host_stale):
             # device rows newer than host but the resident arrays are

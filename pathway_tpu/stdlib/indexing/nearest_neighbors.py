@@ -20,6 +20,7 @@ import numpy as np
 
 from ...internals.expression import ColumnExpression, ColumnReference
 from ...ops.knn import DeviceKnnIndex, _k_bucket as _pow2_bucket
+from ...ops.tiered_knn import TieredKnnIndex, parse_tier_spec
 from .data_index import DataIndex, InnerIndex
 from .retrievers import InnerIndexFactory
 
@@ -73,6 +74,26 @@ class _VectorPayloadIndex(DeviceKnnIndex):
         return super().search_batch(q, k, filter_fns)
 
 
+class _TieredPayloadIndex(TieredKnnIndex):
+    """TieredKnnIndex with the same payload coercion + text-query
+    routing as :class:`_VectorPayloadIndex`."""
+
+    def add(self, key, payload, metadata=None):
+        super().add(key, _as_vector(payload), metadata)
+
+    def search_batch(self, payloads, k, filter_fns=None):
+        if not len(payloads):
+            return []
+        if self._encoder is not None:
+            probe = next((p for p in payloads if p is not None), None)
+            if probe is None or isinstance(probe, str):
+                return self.search_texts_batch(
+                    ["" if p is None else p for p in payloads], k, filter_fns
+                )
+        q = np.stack([_as_vector(p) for p in payloads])
+        return super().search_batch(q, k, filter_fns)
+
+
 def fused_query_encoder(embedder) -> Any | None:
     """The SentenceEncoder behind ``embedder`` when its internals
     (module/params/tokenizer) are exposed for the fused query path."""
@@ -92,6 +113,10 @@ class AbstractKnn(InnerIndex):
     #: None defers to the run-scoped mesh from ``pw.run(mesh=...)`` /
     #: ``PATHWAY_MESH`` at lowering time
     mesh: Any = None
+    #: explicit tier spec (TierConfig / dict / str accepted by
+    #: ops.tiered_knn.parse_tier_spec); None defers to the run-scoped
+    #: config from ``pw.run(index_tiers=...)`` / ``PATHWAY_INDEX_TIERS``
+    tiers: Any = None
 
     # device-index classes (DeviceKnnIndex-backed) opt in to the
     # HBM-resident ingest + fused text-query paths; host-side tiers
@@ -110,6 +135,7 @@ class AbstractKnn(InnerIndex):
             "metric": self.metric,
             "device_backed": True,
             "mesh": self.mesh is not None,
+            "tiers": self.tiers is not None,
         }
 
     def _embed_fns(self):
@@ -155,17 +181,34 @@ class AbstractKnn(InnerIndex):
         dim, metric, res = self.dimensions, self.metric, self.reserved_space
         enc = fused_query_encoder(self.embedder) if self.embedder else None
         mesh_spec = self.mesh
+        tier_spec = self.tiers
 
         def make():
-            # mesh resolution happens HERE — at lowering time inside
-            # pw.run — so retrievers built before the run still pick up
-            # pw.run(mesh=...) / PATHWAY_MESH with zero query-API change
+            # mesh + tier resolution happens HERE — at lowering time
+            # inside pw.run — so retrievers built before the run still
+            # pick up pw.run(mesh=..., index_tiers=...) / PATHWAY_MESH /
+            # PATHWAY_INDEX_TIERS with zero query-API change
+            from ...ops.tiered_knn import active_tiers
             from ...parallel.mesh import active_mesh, resolve_mesh
 
             mesh = resolve_mesh(mesh_spec) if mesh_spec is not None else active_mesh()
-            idx = _VectorPayloadIndex(
-                dim=dim, metric=metric, reserved_space=max(64, res), mesh=mesh
+            tiers = (
+                parse_tier_spec(tier_spec)
+                if tier_spec is not None
+                else active_tiers()
             )
+            if tiers is not None:
+                idx: Any = _TieredPayloadIndex(
+                    dim=dim,
+                    metric=metric,
+                    reserved_space=max(64, res),
+                    tiers=tiers,
+                    mesh=mesh,
+                )
+            else:
+                idx = _VectorPayloadIndex(
+                    dim=dim, metric=metric, reserved_space=max(64, res), mesh=mesh
+                )
             if enc is not None:
                 idx.attach_encoder(enc)
             return idx
@@ -295,6 +338,7 @@ class KnnIndexFactory(InnerIndexFactory):
     metric: str = "cos"
     embedder: Callable | None = None
     mesh: Any = None  # explicit Mesh/spec; None -> run-scoped mesh
+    tiers: Any = None  # explicit tier spec; None -> run-scoped tiers
 
     def _get_embed_dimensions(self) -> int:
         if self.dimensions:
@@ -317,6 +361,7 @@ class BruteForceKnnFactory(KnnIndexFactory):
             metric=self.metric,
             embedder=self.embedder,
             mesh=self.mesh,
+            tiers=self.tiers,
         )
 
 
@@ -335,6 +380,7 @@ class UsearchKnnFactory(KnnIndexFactory):
             metric=self.metric,
             embedder=self.embedder,
             mesh=self.mesh,
+            tiers=self.tiers,
         )
 
 

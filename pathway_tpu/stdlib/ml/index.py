@@ -36,13 +36,15 @@ class KNNIndex:
         metadata: ColumnExpression | None = None,
         reserved_space: int = 1024,
         mesh=None,
+        tiers=None,
     ):
         self.data = data
         self.distance_type = distance_type
         metric = "l2" if distance_type == "euclidean" else "cos"
-        # mesh=None defers to pw.run(mesh=...) / PATHWAY_MESH at
-        # lowering time, so existing call sites scale out with zero
-        # query-API change
+        # mesh=None / tiers=None defer to pw.run(mesh=...,
+        # index_tiers=...) / PATHWAY_MESH / PATHWAY_INDEX_TIERS at
+        # lowering time, so existing call sites scale out (or go
+        # two-tier) with zero query-API change
         self.inner = BruteForceKnn(
             data_embedding,
             metadata,
@@ -50,6 +52,7 @@ class KNNIndex:
             reserved_space=reserved_space,
             metric=metric,
             mesh=mesh,
+            tiers=tiers,
         )
 
     def _get(

@@ -1,0 +1,44 @@
+"""Analysis fixture: a device-backed KNN index whose reserved capacity
+(20M x 384 f32 ~= 28.6 GiB) cannot fit the 16 GiB per-device HBM budget
+and no cold tier is configured — the verifier must flag PWL012
+(warning): demote the cold corpus with pw.run(index_tiers=...) /
+PATHWAY_INDEX_TIERS. (PWL010 co-fires with the other lever, sharding —
+the two rules advise complementary fixes for the same footprint.)
+Analyze-only never builds the index, so nothing is allocated."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+docs = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  1 | 1.0 | 0.0
+  2 | 0.0 | 1.0
+    """
+)
+docs = docs.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, docs.x, docs.y)
+)
+
+queries = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  9 | 1.0 | 1.0
+    """
+)
+queries = queries.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.x, queries.y)
+)
+
+index = KNNIndex(
+    docs.emb,
+    docs,
+    n_dimensions=384,
+    reserved_space=20_000_000,
+    distance_type="cosine",
+)
+res = index.get_nearest_items(queries.emb, k=3)
+
+pw.io.null.write(res)
+
+pw.run()

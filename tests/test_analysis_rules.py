@@ -773,3 +773,68 @@ def test_pwl011_negative_static_source(monkeypatch):
 def test_pwl011_negative_without_run_context():
     _streaming_knn_sink()
     assert "PWL011" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL012
+
+
+def test_pwl012_beyond_hbm_without_cold_tier(monkeypatch):
+    _knn_sink(reserved=20_000_000)
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL012"]
+    assert hits and hits[0].severity is Severity.WARNING
+    assert "index_tiers" in hits[0].message
+    d = hits[0].detail
+    assert d["bytes"] > d["hbm_budget_bytes"]
+    split = d["suggested_tier_split"]
+    assert split["hot_rows"] + split["cold_rows"] == 20_000_000
+    assert 0 < split["hot_rows"] < 20_000_000
+    # int8 cold estimate: dim bytes + one f32 scale per row
+    assert d["quantized_cold_bytes"] == split["cold_rows"] * (384 + 4)
+    # the sharding rule co-fires: PWL010 advises the other lever
+    assert "PWL010" in _rules(pw.analysis.analyze())
+
+
+def test_pwl012_index_tiers_arg_silences(monkeypatch):
+    _knn_sink(reserved=20_000_000)
+    _describe_run(monkeypatch, monitoring_level="in_out", index_tiers="hot=40000")
+    assert "PWL012" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl012_env_knob_silences(monkeypatch):
+    monkeypatch.setenv("PATHWAY_INDEX_TIERS", "auto")
+    _knn_sink(reserved=20_000_000)
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL012" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl012_tier_config_silences_pwl010_too(monkeypatch):
+    # a tiered run bounds the resident set to the hot tier: neither the
+    # sharding rule nor the tier rule has anything left to flag
+    _knn_sink(reserved=20_000_000)
+    _describe_run(monkeypatch, monitoring_level="in_out", index_tiers="auto")
+    got = _rules(pw.analysis.analyze())
+    assert "PWL010" not in got and "PWL012" not in got
+
+
+def test_pwl012_fires_with_undersized_mesh(monkeypatch):
+    # ~114 GiB over 2 shards leaves 57 GiB per device: tiering advice
+    # still applies, with the hot split scaled by the mesh
+    _knn_sink(reserved=80_000_000)
+    _describe_run(monkeypatch, monitoring_level="in_out", mesh=2)
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL012"]
+    assert hits and hits[0].detail["mesh_axes"] == {"data": 2, "model": 1}
+    assert hits[0].detail["per_device_bytes"] > hits[0].detail["hbm_budget_bytes"]
+
+
+def test_pwl012_negative_fits_hbm(monkeypatch):
+    _knn_sink(reserved=100_000)
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL012" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl012_hbm_budget_env_override(monkeypatch):
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(64 * 1024 * 1024))
+    _knn_sink(reserved=200_000)
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL012" in _rules(pw.analysis.analyze())

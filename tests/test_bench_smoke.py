@@ -534,3 +534,91 @@ def test_bench_smoke_ingest_miniature_stream_net_identical(tmp_path, monkeypatch
     assert INGEST_METRICS.snapshot()["committed"] > 0, (
         "engine path never routed batches through the ingest stage"
     )
+
+
+def test_bench_smoke_tiered_hot_only_overhead_within_5pct():
+    """suite_tiered_recall miniature, gate 1: with the whole corpus in
+    the hot tier the tiered wrapper must price in at <5% query wall
+    versus the flat index (the tier machinery is bookkeeping-only until
+    something actually demotes), and the answers are bit-identical."""
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+    from pathway_tpu.ops.tiered_knn import TierConfig, TieredKnnIndex
+
+    rng = np.random.default_rng(20)
+    dim, n_docs = 64, 2000
+    vecs = rng.normal(size=(n_docs, dim)).astype(np.float32)
+    keys = list(range(n_docs))
+    q = rng.normal(size=(32, dim)).astype(np.float32)
+
+    flat = DeviceKnnIndex(dim=dim, metric="cos", reserved_space=n_docs)
+    flat.add_batch_arrays(keys, vecs)
+    tier = TieredKnnIndex(
+        dim=dim,
+        metric="cos",
+        reserved_space=n_docs,
+        tiers=TierConfig(hot_rows=n_docs, n_clusters=16, n_probe=8),
+    )
+    tier.add_batch_arrays(keys, vecs)
+    assert tier.cold_docs() == 0
+
+    flat.search_batch(q, 10)  # warm the compile caches outside both windows
+    tier.search_batch(q, 10)
+    ref = flat.search_batch(q, 10)
+    got = tier.search_batch(q, 10)
+    assert [[(k, float(s)) for k, s in r] for r in ref] == [
+        [(k, float(s)) for k, s in r] for r in got
+    ], "hot-only tiered answers diverged from flat"
+
+    def wall(idx):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                idx.search_batch(q, 10)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    wall_flat = wall(flat)
+    wall_tier = wall(tier)
+    assert wall_tier <= wall_flat * 1.05 + 0.10, (wall_tier, wall_flat)
+
+
+def test_bench_smoke_tiered_recall_beyond_hbm():
+    """suite_tiered_recall miniature, gate 2: at 4x over-subscription
+    with the int8 cold tier, recall@10 against flat brute force stays
+    >= 0.95."""
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+    from pathway_tpu.ops.tiered_knn import TierConfig, TieredKnnIndex
+
+    rng = np.random.default_rng(21)
+    dim, n_docs, n_centers = 96, 4000, 128
+    centers = rng.normal(size=(n_centers, dim)).astype(np.float32) * 2.0
+    vecs = (
+        centers[rng.integers(0, n_centers, size=n_docs)]
+        + rng.normal(size=(n_docs, dim))
+    ).astype(np.float32)
+    keys = list(range(n_docs))
+    q = (
+        centers[rng.integers(0, n_centers, size=24)]
+        + rng.normal(size=(24, dim))
+    ).astype(np.float32)
+
+    flat = DeviceKnnIndex(dim=dim, metric="cos", reserved_space=n_docs)
+    flat.add_batch_arrays(keys, vecs)
+    truth = [set(k for k, _ in row) for row in flat.search_batch(q, 10)]
+
+    tier = TieredKnnIndex(
+        dim=dim,
+        metric="cos",
+        reserved_space=n_docs,
+        tiers=TierConfig(
+            hot_rows=n_docs // 4, n_clusters=32, n_probe=12, cold_dtype="int8"
+        ),
+    )
+    tier.add_batch_arrays(keys, vecs)
+    assert tier.cold_docs() > 0, "4x config kept everything hot"
+    got = tier.search_batch(q, 10)
+    recall = np.mean(
+        [len(truth[i] & {k for k, _ in got[i]}) / 10 for i in range(len(q))]
+    )
+    assert recall >= 0.95, f"recall@10 {recall:.3f} at 4x beyond-HBM"

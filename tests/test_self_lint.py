@@ -236,3 +236,42 @@ def test_pwl011_env_knob_silences_cli(monkeypatch):
     proc = _analyze_cli(os.path.join(FIXTURES, "host_bound_ingest.py"))
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "PWL011" not in proc.stdout
+
+
+def test_index_no_cold_tier_warns_pwl012():
+    """A beyond-HBM device index with no cold tier: PWL012 warns (exit
+    0), nonzero only under --strict-warnings."""
+    fixture = os.path.join(FIXTURES, "index_no_cold_tier.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL012" in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--strict-warnings")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl012_json_carries_tier_split():
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "index_no_cold_tier.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL012"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["bytes"] > diag["detail"]["hbm_budget_bytes"]
+    split = diag["detail"]["suggested_tier_split"]
+    assert split["hot_rows"] > 0 and split["cold_rows"] > 0
+    assert split["hot_rows"] + split["cold_rows"] == 20_000_000
+    assert diag["detail"]["quantized_cold_bytes"] < diag["detail"]["bytes"]
+
+
+def test_pwl012_env_knob_silences_cli(monkeypatch):
+    """The fix the diagnostic suggests (PATHWAY_INDEX_TIERS) makes the
+    same program lint clean — and silences PWL010 too, since the hot
+    tier now bounds the resident set."""
+    monkeypatch.setenv("PATHWAY_INDEX_TIERS", "auto")
+    proc = _analyze_cli(os.path.join(FIXTURES, "index_no_cold_tier.py"))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL012" not in proc.stdout
+    assert "PWL010" not in proc.stdout

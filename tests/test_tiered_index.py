@@ -1,0 +1,466 @@
+"""Two-tier index (HBM hot tier over a host cold tier): equivalence,
+recall, snapshot, chaos, and pw.run wiring.
+
+The invariants under test mirror the flat-index guarantees:
+
+- tiering OFF or everything fits hot -> bit-identical to the flat
+  DeviceKnnIndex (same keys, same float scores, same metrics stream);
+- full-recall settings (f32 cold tier, probe >= n_clusters) -> same
+  answer set as flat brute force under arbitrary add/remove/re-add
+  churn and forced demotion, scores equal to float tolerance;
+- int8 cold tier keeps recall@10 above the floor when the whole
+  corpus is forcibly demoted;
+- tier_state()/restore_tier_state() round-trips the exact hot/cold
+  assignment, not a re-clustered approximation;
+- a crash mid-promotion (chaos site ``index.tier.promote``) never
+  loses a vector and never answers a key twice.
+"""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.ops.index_metrics import INDEX_METRICS
+from pathway_tpu.ops.knn import DeviceKnnIndex
+from pathway_tpu.ops.tiered_knn import (
+    ColdStore,
+    TierConfig,
+    TieredKnnIndex,
+    active_tiers,
+    cold_row_bytes,
+    hot_row_bytes,
+    parse_bytes,
+    parse_tier_spec,
+    quantize_int8,
+)
+from pathway_tpu.resilience import chaos
+from pathway_tpu.resilience.chaos import ChaosInjected
+
+
+@pytest.fixture(autouse=True)
+def _reset_index_plane():
+    yield
+    INDEX_METRICS.reset()
+    from pathway_tpu.internals import flight_recorder
+
+    flight_recorder.RECORDER.clear()
+
+
+def _rows(rows):
+    return [[(k, round(float(s), 4)) for k, s in row] for row in rows]
+
+
+def _clustered(rng, n_docs, dim=32, n_centers=64, n_queries=16):
+    """Cluster structure with rank gaps above the int8 noise floor."""
+    centers = rng.normal(size=(n_centers, dim)).astype(np.float32) * 2.0
+    assign = rng.integers(0, n_centers, size=n_docs)
+    vecs = (centers[assign] + rng.normal(size=(n_docs, dim))).astype(np.float32)
+    qs = (
+        centers[rng.integers(0, n_centers, size=n_queries)]
+        + rng.normal(size=(n_queries, dim))
+    ).astype(np.float32)
+    return vecs, qs
+
+
+def _full_recall_cfg(**kw):
+    """Settings where tiering can lose nothing: exact f32 cold vectors
+    and every cluster probed."""
+    kw.setdefault("n_clusters", 8)
+    kw.setdefault("n_probe", 8)
+    kw.setdefault("cold_dtype", "f32")
+    return TierConfig(**kw)
+
+
+# ------------------------------------------------------------- spec parsing
+
+
+def test_parse_tier_spec_forms():
+    assert parse_tier_spec(None) is None
+    assert parse_tier_spec("off") is None
+    assert parse_tier_spec(False) is None
+    for on in (True, "on", "auto"):
+        assert isinstance(parse_tier_spec(on), TierConfig)
+    cfg = parse_tier_spec("hot=4096,clusters=32,probe=8,cold=int8,hbm=4G")
+    assert cfg.hot_rows == 4096
+    assert cfg.n_clusters == 32 and cfg.n_probe == 8
+    assert cfg.cold_dtype == "int8"
+    assert cfg.hbm_bytes == 4 * 1024**3
+    assert parse_tier_spec(4096).hot_rows == 4096
+    assert parse_tier_spec({"hot_rows": 16}).hot_rows == 16
+    got = parse_tier_spec(cfg)
+    assert got == cfg
+    for bad in ("hot=", "nope=3", "hot=-1", 3.5, {"n_probe": 0}):
+        with pytest.raises(ValueError):
+            parse_tier_spec(bad)
+    assert parse_bytes("512M") == 512 * 1024**2
+
+
+def test_footprint_math():
+    # f32 hot row: dim floats + key/valid bookkeeping; int8 cold row:
+    # dim bytes + one f32 scale
+    assert hot_row_bytes(384, "f32") == 384 * 4 + 5
+    assert cold_row_bytes(384, "int8") == 384 + 4
+    assert cold_row_bytes(384, "f32") == 384 * 4
+    cfg = TierConfig(hbm_bytes=hot_row_bytes(384) * 1000)
+    assert cfg.resolve_hot_rows(384) == 1000
+
+
+def test_int8_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    v = rng.normal(size=(64, 48)).astype(np.float32)
+    v /= np.linalg.norm(v, axis=1, keepdims=True)
+    q, scale = quantize_int8(v)
+    assert q.dtype == np.int8
+    back = q.astype(np.float32) * (scale[:, None] / 127.0)
+    assert float(np.abs(back - v).max()) <= float(scale.max()) / 127.0 + 1e-7
+
+
+def test_cold_store_put_fetch_erase_grow():
+    rng = np.random.default_rng(1)
+    store = ColdStore(dim=8, dtype="f32", capacity=4)
+    v = rng.normal(size=(10, 8)).astype(np.float32)
+    slots = store.put(v)  # forces growth past the initial capacity
+    np.testing.assert_allclose(store.fetch(slots), v, atol=1e-6)
+    store.erase(slots[:5])
+    again = store.put(v[:5])
+    assert set(map(int, again)) == set(map(int, slots[:5]))
+
+
+# ------------------------------------------------------- flat equivalence
+
+
+@pytest.mark.parametrize("metric", ["cos", "l2", "ip"])
+def test_fits_hot_bit_identical_to_flat(metric):
+    """When the corpus fits in the hot tier the tiered index IS the
+    flat index: same keys AND bit-equal scores."""
+    rng = np.random.default_rng(3)
+    vecs = rng.normal(size=(60, 16)).astype(np.float32)
+    flat = DeviceKnnIndex(dim=16, metric=metric, reserved_space=64)
+    tier = TieredKnnIndex(
+        dim=16, metric=metric, reserved_space=64, tiers=_full_recall_cfg()
+    )
+    for i in range(60):
+        flat.add(i, vecs[i], {"i": i})
+        tier.add(i, vecs[i], {"i": i})
+    assert tier.cold_docs() == 0
+    q = rng.normal(size=(7, 16)).astype(np.float32)
+    rf = flat.search_batch(q, 5)
+    rt = tier.search_batch(q, 5)
+    assert [[(k, float(s)) for k, s in row] for row in rf] == [
+        [(k, float(s)) for k, s in row] for row in rt
+    ]
+
+
+@pytest.mark.parametrize("metric", ["cos", "l2"])
+def test_churn_equivalence_at_full_recall(metric):
+    """Adds, removes, re-adds, and a forced demotion of every cluster:
+    at full-recall settings the tiered answers match flat brute force
+    (scores to f32 tolerance; key order can differ only on ties)."""
+    rng = np.random.default_rng(4)
+    n = 160
+    vecs, qs = _clustered(rng, n, dim=16, n_centers=12, n_queries=9)
+    flat = DeviceKnnIndex(dim=16, metric=metric, reserved_space=64)
+    tier = TieredKnnIndex(
+        dim=16,
+        metric=metric,
+        reserved_space=64,
+        tiers=_full_recall_cfg(hot_rows=64),
+    )
+    for i in range(n):
+        flat.add(i, vecs[i])
+        tier.add(i, vecs[i])
+    # churn: retract every third key, re-add a rotated payload for some
+    for i in range(0, n, 3):
+        flat.remove(i)
+        tier.remove(i)
+    for i in range(0, n, 6):
+        flat.add(i, np.roll(vecs[i], 1))
+        tier.add(i, np.roll(vecs[i], 1))
+    assert len(flat) == len(tier)
+    tier.force_demote()
+    assert tier.hot_docs() == 0 and tier.cold_docs() == len(flat)
+
+    rf = flat.search_batch(qs, 5)
+    rt = tier.search_batch(qs, 5)
+    for row_f, row_t in zip(rf, rt):
+        sf = np.asarray([s for _, s in row_f])
+        st = np.asarray([s for _, s in row_t])
+        np.testing.assert_allclose(st, sf, rtol=1e-5, atol=1e-5)
+        if not np.isclose(sf[:-1], sf[1:]).any():
+            assert [k for k, _ in row_f] == [k for k, _ in row_t]
+
+
+def test_recall_floor_under_forced_demotion_int8():
+    """Everything demoted to the int8 cold tier: recall@10 against
+    exact flat brute force stays above the 0.95 floor."""
+    rng = np.random.default_rng(5)
+    vecs, qs = _clustered(rng, 4000, dim=96, n_centers=128, n_queries=32)
+    keys = list(range(len(vecs)))
+    flat = DeviceKnnIndex(dim=96, metric="cos", reserved_space=4096)
+    flat.add_batch_arrays(keys, vecs)
+    truth = [set(k for k, _ in row) for row in flat.search_batch(qs, 10)]
+
+    tier = TieredKnnIndex(
+        dim=96,
+        metric="cos",
+        reserved_space=4096,
+        tiers=TierConfig(n_clusters=16, n_probe=12, cold_dtype="int8"),
+    )
+    tier.add_batch_arrays(keys, vecs)
+    tier.force_demote()
+    assert tier.hot_docs() == 0 and tier.cold_docs() == 4000
+    got = tier.search_batch(qs, 10)
+    recall = np.mean(
+        [len(truth[i] & {k for k, _ in got[i]}) / 10 for i in range(len(qs))]
+    )
+    assert recall >= 0.95, f"recall@10 {recall:.3f} under forced demotion"
+
+
+def test_promotion_restores_hot_residency():
+    """After force_demote, queries hitting cold clusters drive the
+    rebalance loop to promote them back while shard room lasts."""
+    rng = np.random.default_rng(6)
+    vecs, qs = _clustered(rng, 120, dim=16, n_centers=6, n_queries=4)
+    tier = TieredKnnIndex(
+        dim=16,
+        metric="cos",
+        reserved_space=128,
+        tiers=_full_recall_cfg(n_clusters=6, n_probe=6, promote_every=4),
+    )
+    tier.add_batch_arrays(list(range(120)), vecs)
+    tier.force_demote()
+    assert tier.cold_docs() == 120
+    for _ in range(12):
+        tier.search_batch(qs, 5)
+    tier.maybe_rebalance(force=True)
+    assert tier.hot_docs() > 0, "no cluster promoted despite hits + room"
+    snap = INDEX_METRICS.snapshot()["indexes"][tier.name]["tiers"]
+    assert snap["promotions"] >= 1 and snap["demotions"] >= 1
+
+
+# ------------------------------------------------------- snapshot/restore
+
+
+def test_snapshot_restore_preserves_tier_assignment():
+    rng = np.random.default_rng(8)
+    vecs, qs = _clustered(rng, 90, dim=16, n_centers=8, n_queries=5)
+    src = TieredKnnIndex(
+        dim=16, metric="cos", reserved_space=48, tiers=_full_recall_cfg(hot_rows=48)
+    )
+    src.add_batch_arrays(list(range(90)), vecs, [{"i": i} for i in range(90)])
+    src.force_demote([0, 1])  # mixed residency, not all-hot / all-cold
+    want_hot = set(src.hot._slot_of)
+    want_cluster = dict(src._cluster_of)
+    ref = src.search_batch(qs, 5)
+
+    state = src.tier_state()
+    dst = TieredKnnIndex(
+        dim=16, metric="cos", reserved_space=48, tiers=_full_recall_cfg(hot_rows=48)
+    )
+    dst.restore_tier_state(state)
+    # replay the engine's restore order: bulk re-add, then tier fixup
+    dst.add_batch_arrays(
+        list(range(90)), vecs, [{"i": i} for i in range(90)]
+    )
+    dst.finish_tier_restore()
+
+    assert dict(dst._cluster_of) == want_cluster
+    assert set(dst.hot._slot_of) == want_hot
+    assert dst.cold_docs() == src.cold_docs()
+    assert _rows(dst.search_batch(qs, 5)) == _rows(ref)
+
+
+# ------------------------------------------------------------------ chaos
+
+
+def test_chaos_mid_promotion_no_loss_no_dups():
+    """Kill the promotion between its two hot-insert chunks: every key
+    stays findable exactly once (the cold listing is only cleared after
+    the hot copy lands, and the merge dedups hot-resident keys)."""
+    rng = np.random.default_rng(9)
+    vecs, qs = _clustered(rng, 100, dim=16, n_centers=4, n_queries=4)
+    tier = TieredKnnIndex(
+        dim=16,
+        metric="cos",
+        reserved_space=128,
+        tiers=_full_recall_cfg(n_clusters=4, n_probe=4),
+    )
+    tier.add_batch_arrays(list(range(100)), vecs)
+    tier.force_demote()
+    for _ in range(8):
+        tier.search_batch(qs, 5)
+    chaos.activate([{"site": "index.tier.promote", "hit": 2, "action": "raise"}])
+    try:
+        with pytest.raises(ChaosInjected):
+            tier.maybe_rebalance(force=True)
+    finally:
+        chaos.deactivate()
+    # torn state is allowed (some keys live in BOTH tiers) but answers
+    # must cover every key exactly once
+    assert 0 < tier.hot_docs() < 100, "chaos window missed the promotion"
+    got = tier.search_batch(
+        np.asarray(vecs, np.float32), 1
+    )  # each doc's own vector must find exactly itself at k=1
+    found = [row[0][0] for row in got if row]
+    assert sorted(found) == list(range(100))
+    seen: set = set()
+    for row in tier.search_batch(qs, 100):
+        keys = [k for k, _ in row]
+        assert len(keys) == len(set(keys)), "duplicate key in one answer"
+        seen.update(keys)
+    assert seen == set(range(100))
+    # the next rebalance completes the torn promotion idempotently
+    tier.maybe_rebalance(force=True)
+    assert tier.hot_docs() + tier.cold_docs() == 100
+
+
+# ------------------------------------------------------- metrics plumbing
+
+
+def test_flat_metrics_stream_untouched():
+    """With no tiered index in the process the metrics text contains no
+    tier series and tiered_active() stays False — flat deployments get
+    byte-identical scrape output."""
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+
+    INDEX_METRICS.reset()
+    rng = np.random.default_rng(10)
+    idx = DeviceKnnIndex(dim=8, metric="cos", reserved_space=32, name="flatonly")
+    for i in range(10):
+        idx.add(i, rng.normal(size=8).astype(np.float32))
+    idx.search_batch(rng.normal(size=(2, 8)).astype(np.float32), 3)
+    assert not INDEX_METRICS.tiered_active()
+    text = "\n".join(MonitoringHttpServer._index_lines())
+    assert "pathway_index_docs" in text
+    assert "pathway_index_tier" not in text
+    assert "tiers" not in INDEX_METRICS.snapshot()["indexes"]["flatonly"]
+
+
+def test_tier_metrics_rendered_and_imbalance_counts_cold():
+    from pathway_tpu.internals import flight_recorder
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+
+    INDEX_METRICS.reset()
+    flight_recorder.RECORDER.clear()
+    rng = np.random.default_rng(11)
+    vecs, qs = _clustered(rng, 80, dim=16, n_centers=4, n_queries=3)
+    tier = TieredKnnIndex(
+        dim=16,
+        metric="cos",
+        reserved_space=96,
+        tiers=_full_recall_cfg(n_clusters=4, n_probe=4),
+        name="tiered",
+    )
+    tier.add_batch_arrays(list(range(80)), vecs)
+    tier.force_demote()
+    tier.search_batch(qs, 5)
+
+    snap = INDEX_METRICS.snapshot()
+    tiers = snap["indexes"]["tiered"]["tiers"]
+    assert tiers["hot_docs"] == 0 and tiers["cold_docs"] == 80
+    assert tiers["demotions"] >= 1
+    assert tiers["cold_bytes"] == 80 * cold_row_bytes(16, "f32")
+    assert 0.0 <= tiers["hot_hit_ratio"] <= 1.0
+    assert snap["cold_fetch_seconds"]["count"] >= 1
+    # a fully demoted single-shard index still reports its docs: the
+    # docs gauge and imbalance count BOTH tiers
+    assert snap["indexes"]["tiered"]["docs"] == 80
+    assert tiers["cold_docs_shard"] == [80]
+
+    text = "\n".join(MonitoringHttpServer._index_lines())
+    for needle in (
+        'pathway_index_tier_docs{index="tiered",shard="0",tier="cold"}',
+        "pathway_index_tier_bytes",
+        "pathway_index_tier_promotions_total",
+        "pathway_index_tier_demotions_total",
+        "pathway_index_tier_hot_hit_ratio",
+        "pathway_index_tier_cold_fetch_seconds_bucket",
+    ):
+        assert needle in text
+
+    kinds = [e["kind"] for e in flight_recorder.RECORDER.events()]
+    assert "index.tier.demote" in kinds
+    reb = [
+        e
+        for e in flight_recorder.RECORDER.events()
+        if e["kind"] == "index.rebalance"
+    ]
+    assert reb and reb[-1]["docs"] == [80], "rebalance event ignored cold docs"
+    assert reb[-1]["docs_cold"] == [80] and reb[-1]["docs_hot"] == [0]
+
+
+# ---------------------------------------------------------- pw.run wiring
+
+
+def _knn_pipeline(docs_v, qs_v, reserved=32):
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(i=int), [(i,) for i in range(len(docs_v))]
+    )
+    docs = docs.select(
+        docs.i,
+        emb=pw.apply_with_type(
+            lambda i: tuple(map(float, docs_v[i])), pw.ANY, docs.i
+        ),
+    )
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(i=int), [(i,) for i in range(len(qs_v))]
+    )
+    queries = queries.select(
+        emb=pw.apply_with_type(
+            lambda i: tuple(map(float, qs_v[i])), pw.ANY, queries.i
+        )
+    )
+    index = KNNIndex(docs.emb, docs, n_dimensions=16, reserved_space=reserved)
+    return index.get_nearest_items(
+        queries.emb, k=3, collapse_rows=True, with_distances=True
+    )
+
+
+def _collect(res, **run_kwargs):
+    rows = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            rows[int(key)] = (tuple(row["i"]), tuple(row["dist"]))
+
+    pw.io.subscribe(res, on_change=on_change)
+    pw.run(**run_kwargs)
+    return rows
+
+
+def test_pw_run_index_tiers_end_to_end():
+    """pw.run(index_tiers=...) serves the same answers as the flat run
+    with zero query-API change, and the run-scoped config never leaks."""
+    rng = np.random.default_rng(12)
+    docs_v = rng.normal(size=(20, 16)).astype(np.float32)
+    qs_v = rng.normal(size=(5, 16)).astype(np.float32)
+
+    out_flat = _collect(_knn_pipeline(docs_v, qs_v))
+    pw.clear_graph()
+    out_tier = _collect(
+        _knn_pipeline(docs_v, qs_v), index_tiers="hot=64,clusters=4,probe=4"
+    )
+    assert active_tiers() is None, "run-scoped tier config leaked"
+    assert out_tier == out_flat
+    assert len(out_tier) == 5
+
+
+def test_pathway_index_tiers_env_and_run_context(monkeypatch):
+    rng = np.random.default_rng(13)
+    docs_v = rng.normal(size=(20, 16)).astype(np.float32)
+    qs_v = rng.normal(size=(4, 16)).astype(np.float32)
+
+    out_flat = _collect(_knn_pipeline(docs_v, qs_v))
+    pw.clear_graph()
+    # a hot tier smaller than the corpus: overflow serves from the f32
+    # cold tier at full probe, answers still identical
+    monkeypatch.setenv("PATHWAY_INDEX_TIERS", "hot=8,clusters=4,probe=4,cold=f32")
+    out_env = _collect(_knn_pipeline(docs_v, qs_v))
+    assert {k: v[0] for k, v in out_env.items()} == {
+        k: v[0] for k, v in out_flat.items()
+    }
+    from pathway_tpu.internals.parse_graph import G
+
+    assert G.run_context.get("index_tiers", {}).get("n_probe") == 4
