@@ -1180,6 +1180,160 @@ def suite_tiered_recall() -> None:
     )
 
 
+def suite_decode_serving() -> None:
+    """Decode-plane serving suite: sustained continuous-batching
+    generation through the paged-KV engine, queries arriving while
+    earlier ones are mid-stream. Two passes measure the rerank split:
+
+    - rerank ON: every query first scores 8 candidates through the
+      on-device cross-encoder (models/reranker.py — the stage that
+      replaced the HTTP xpack hop), then generates max_new_tokens.
+    - rerank OFF: the degrade path — rerank skipped and generation
+      clamped to degrade_max_new_tokens, exactly what admission applies
+      under pressure.
+
+    Headline: tokens/s-per-chip with the p99 query completion latency
+    under the budget (0.0 when the budget is blown, like
+    serving_qps_at_p99_budget)."""
+    import jax
+
+    from pathway_tpu.decode import DecodeConfig, DecodeEngine, DecoderConfig
+    from pathway_tpu.decode.metrics import DECODE_METRICS
+    from pathway_tpu.models.reranker import DeviceReranker
+    from pathway_tpu.models.sentence_encoder import CrossEncoderScorer, EncoderConfig
+
+    n_chips = max(1, jax.device_count())
+    N_QUERIES = 64
+    BUDGET_MS = 5000.0  # per-query completion budget under full load
+
+    mcfg = DecoderConfig(
+        vocab_size=8000,
+        hidden_size=128,
+        num_layers=2,
+        num_heads=4,
+        intermediate_size=256,
+        max_position=256,
+    )
+    dcfg = DecodeConfig(
+        pages=512,
+        page_size=16,
+        lanes=8,
+        max_new_tokens=32,
+        degrade_max_new_tokens=8,
+        max_seq=160,
+        impl="auto",
+    )
+    ecfg = EncoderConfig(
+        vocab_size=30522,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=2,
+        intermediate_size=128,
+        max_position=64,
+        pooling="mean",
+    )
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(1, mcfg.vocab_size, int(n)).tolist()
+        for n in rng.integers(4, 64, N_QUERIES)
+    ]
+    cand_docs = [f"candidate document {i} about topic {i % 7}" for i in range(8)]
+
+    def run_once(rerank: bool) -> dict:
+        DECODE_METRICS.reset()
+        engine = DecodeEngine(mcfg, dcfg)
+        reranker = (
+            DeviceReranker(
+                scorer=CrossEncoderScorer(
+                    config=ecfg,
+                    checkpoint_dir="/nonexistent",
+                    max_seq_len=64,
+                    max_batch=64,
+                )
+            )
+            if rerank
+            else None
+        )
+        tickets: list = []
+        done_at: dict[int, float] = {}
+
+        def poll() -> None:
+            now = time.monotonic()
+            for idx, (_t_sub, tk) in enumerate(tickets):
+                if idx not in done_at and tk.done.is_set():
+                    done_at[idx] = now
+
+        # warmup: compile every prefill bucket + the fused step + the
+        # reranker forward outside the timed window
+        for prompt in prompts[:8]:
+            engine.submit(prompt, degraded=not rerank)
+        engine.drain()
+        if reranker is not None:
+            reranker.order("warmup", cand_docs)
+        DECODE_METRICS.reset()
+        t0 = time.perf_counter()
+        for qi, prompt in enumerate(prompts):
+            if reranker is not None:
+                reranker.order(f"query {qi}", cand_docs)
+            tk = engine.submit(prompt, degraded=not rerank)
+            tickets.append((time.monotonic(), tk))
+            engine.step()  # arrivals interleave with in-flight decoding
+            poll()
+        while engine.busy():
+            engine.step()
+            poll()
+        poll()
+        wall = time.perf_counter() - t0
+        lats = sorted(
+            (done_at[i] - tickets[i][0]) * 1e3 for i in range(len(tickets))
+        )
+        total_tokens = sum(len(tk.tokens) for _, tk in tickets)
+
+        def pct(p: float) -> float:
+            return lats[min(len(lats) - 1, int(p * len(lats)))]
+
+        return {
+            "tokens": total_tokens,
+            "wall_s": wall,
+            "tok_per_s": total_tokens / wall,
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+        }
+
+    on = run_once(rerank=True)
+    off = run_once(rerank=False)
+    _emit(
+        "decode_tokens_per_s_rerank_on",
+        on["tok_per_s"],
+        "tokens/s",
+        p50_ms=round(on["p50_ms"], 1),
+        p99_ms=round(on["p99_ms"], 1),
+        queries=N_QUERIES,
+        lanes=dcfg.lanes,
+        max_new_tokens=dcfg.max_new_tokens,
+    )
+    _emit(
+        "decode_tokens_per_s_rerank_off",
+        off["tok_per_s"],
+        "tokens/s",
+        p50_ms=round(off["p50_ms"], 1),
+        p99_ms=round(off["p99_ms"], 1),
+        note="degrade path: rerank skipped, generation clamped to "
+        f"{dcfg.degrade_max_new_tokens} tokens",
+    )
+    _emit(
+        "tokens_per_s_per_chip_at_p99",
+        on["tok_per_s"] / n_chips if on["p99_ms"] <= BUDGET_MS else 0.0,
+        "tokens/s/chip",
+        p99_ms=round(on["p99_ms"], 1),
+        budget_ms=BUDGET_MS,
+        n_chips=n_chips,
+        rerank_off_per_chip=round(off["tok_per_s"] / n_chips, 3),
+        mode="continuous batching over the paged-KV pool; rerank ON "
+        "pass pays the on-device cross-encoder per query",
+    )
+
+
 def suite_etl() -> None:
     """ETL micro-bench: 1M-row select+filter+groupby through the
     columnar vectorized engine; vs_round1 is against the per-row
@@ -1771,6 +1925,7 @@ SUITES = (
     suite_streaming_tpu_chip,
     suite_knn_churn,
     suite_tiered_recall,
+    suite_decode_serving,
 )
 
 
@@ -1802,6 +1957,10 @@ if __name__ == "__main__":
     if named:
         for a in named:
             _by_name[a]()
+        # suite-only invocations still end with the driver's FINAL
+        # SUMMARY contract: the last record emitted is the headline
+        if _RECORDS:
+            print_final_summary(_RECORDS.pop())
     elif "--suite" in sys.argv:
         run_suite()
     else:

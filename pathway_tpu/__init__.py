@@ -228,6 +228,8 @@ from . import resilience  # retry policy / run supervisor / chaos harness
 from .resilience import Recovery, RecoveryEscalated, RetryPolicy
 from . import serving  # overload-safe query plane (admission/deadlines/batching)
 from .serving import ServingConfig
+from . import decode  # on-chip generation (paged-KV continuous batching)
+from .decode import DecodeConfig
 
 
 def __getattr__(name):
@@ -260,5 +262,5 @@ __all__ = [
     "udfs", "unpack_col", "unsafe_make_pointer", "unwrap", "utils",
     "wrap_py_object", "xpacks", "universes", "LiveTable", "analysis",
     "resilience", "Recovery", "RecoveryEscalated", "RetryPolicy",
-    "RunResult", "serving", "ServingConfig",
+    "RunResult", "serving", "ServingConfig", "decode", "DecodeConfig",
 ]

@@ -41,6 +41,14 @@ PWL011 (warning) host-bound ingest: a streaming connector feeds a
                  runs serially in line with device dispatch, starving
                  the chip. pw.run(ingest_workers=N) /
                  PATHWAY_INGEST_WORKERS or pipeline_depth>=2.
+PWL012 (warning) device-backed index beyond the HBM budget with no cold
+                 tier configured — pw.run(index_tiers=...) /
+                 PATHWAY_INDEX_TIERS demotes the cold corpus to host.
+PWL013 (warning) HTTP LLM stage (LLMReranker / chat UDF) in a pipeline
+                 whose run has a device decode plane configured — the
+                 rerank/generate hop can run on-chip (KNNIndex
+                 rerank= / decode.DecodeService) instead of paying a
+                 network round-trip per pair/message.
 """
 
 from __future__ import annotations
@@ -87,6 +95,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL010": (Severity.WARNING, "device index exceeds single-device HBM without a mesh"),
     "PWL011": (Severity.WARNING, "host-bound ingest feeding a device model"),
     "PWL012": (Severity.WARNING, "beyond-HBM index without a cold tier"),
+    "PWL013": (Severity.WARNING, "HTTP LLM stage with a device decode plane available"),
 }
 
 _MUTABLE_TYPES = (list, dict, set, bytearray)
@@ -1034,6 +1043,42 @@ def check_host_bound_ingest(view: GraphView) -> list[Diagnostic]:
     return out
 
 
+def check_http_llm_with_device_decode(view: GraphView) -> list[Diagnostic]:
+    """An HTTP LLM call site (``LLMReranker`` scoring pairs through a
+    chat endpoint, or a chat UDF generating answers) built into a
+    program whose run configures the device decode plane
+    (``pw.run(decode=...)`` / PATHWAY_DECODE): every pair/message pays
+    a network round-trip the chip could absorb — the on-device
+    cross-encoder (``KNNIndex(rerank=...)`` / ``models.reranker``)
+    replaces the rerank hop and the paged-KV decoder
+    (``decode.DecodeService``) the generate hop, keeping the whole
+    embed→retrieve→rerank→generate path in one dispatch. Device-native
+    stages (``CrossEncoderReranker`` etc.) never record here."""
+    endpoints = getattr(view.graph, "llm_endpoints", None) or []
+    if not endpoints:
+        return []
+    ctx = getattr(view.graph, "run_context", None) or {}
+    if not ctx.get("decode"):
+        return []
+    kinds = sorted({e.get("kind") or "llm" for e in endpoints})
+    return [
+        _diag(
+            "PWL013",
+            f"{len(endpoints)} HTTP LLM stage(s) ({', '.join(kinds)}) in "
+            "a run with the device decode plane configured: each "
+            "pair/message leaves the chip for a network round-trip the "
+            "decode plane makes unnecessary. Rerank on-device with "
+            "KNNIndex(rerank=...) (models/reranker.py) and generate "
+            "with decode.DecodeService — the fused path keeps "
+            "embed->retrieve->rerank->generate in one dispatch",
+            detail={
+                "llm_endpoints": list(endpoints),
+                "decode": ctx.get("decode"),
+            },
+        )
+    ]
+
+
 LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dtype_consistency,
     check_unbounded_state,
@@ -1047,4 +1092,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_index_hbm_budget,
     check_index_tier_budget,
     check_host_bound_ingest,
+    check_http_llm_with_device_decode,
 ]

@@ -1,0 +1,55 @@
+"""On-chip generation plane: paged-KV decode with continuous batching.
+
+Completes the RAG loop on the device — embed (PR 8) → retrieve
+(PR 9/11) → rerank (``models/reranker.py``) → generate (here) — with
+no HTTP hop. Configure with ``pw.run(decode=...)`` or
+``PATHWAY_DECODE``; see ``decode/config.py`` for the spec grammar and
+``decode/engine.py`` for the scheduler.
+
+Engine symbols are lazy: ``decode.config`` / ``decode.metrics`` are
+jax-free so the analysis plane (``pathway analyze``, the self-lint
+CLI) can parse decode specs without importing jax; the engine (which
+pulls the Pallas kernel module) loads on first attribute access.
+"""
+
+from .config import (
+    DecodeConfig,
+    active_decode,
+    parse_decode_spec,
+    set_active_decode,
+    use_decode,
+)
+from .metrics import DECODE_METRICS, DecodeMetrics
+
+_ENGINE_SYMBOLS = (
+    "DecodeEngine",
+    "DecodeService",
+    "DecodeTicket",
+    "DecoderConfig",
+    "decode_greedy",
+    "init_decoder_params",
+)
+
+__all__ = [
+    "DecodeConfig",
+    "DecodeEngine",
+    "DecodeService",
+    "DecodeTicket",
+    "DecoderConfig",
+    "DecodeMetrics",
+    "DECODE_METRICS",
+    "active_decode",
+    "decode_greedy",
+    "init_decoder_params",
+    "parse_decode_spec",
+    "set_active_decode",
+    "use_decode",
+]
+
+
+def __getattr__(name):
+    if name in _ENGINE_SYMBOLS:
+        from . import engine
+
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

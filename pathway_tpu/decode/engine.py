@@ -1,0 +1,687 @@
+"""Continuous-batching generative decoder over the paged-KV pool.
+
+The last hop of the RAG loop — answer generation — runs here instead
+of an HTTP LLM xpack. One :class:`DecodeEngine` owns a
+:class:`~pathway_tpu.ops.paged_attention.PagedKvPool` and a fixed set
+of *lanes* (continuous-batching slots). Scheduling follows the
+Gemma-on-TPU serving methodology (PAPERS.md): prefills admit into free
+lanes as queries arrive, then every engine tick runs ONE fused decode
+step for all live lanes — sequences join and leave the batch
+mid-flight, no query waits for a "generation batch" to fill.
+
+Batching is semantically invisible (an acceptance gate): the decode
+step always runs at the full padded lane width with per-row math that
+never crosses rows, and a lane's padding/garbage context is masked
+with the exact-zero ``KEY_OFF`` trick (see ``ops/paged_attention``),
+so a query's token stream is bitwise the same whether it decodes alone
+or interleaved with seven strangers.
+
+Crash discipline: a decode step is compute-then-commit. The fused jit
+is functional (it returns the updated pool rather than mutating it);
+the ``decode.step`` chaos site fires between compute and commit, so a
+step killed there leaves the engine exactly at the pre-step state —
+re-running it recomputes identical tokens (greedy argmax, f32) and
+rewrites identical KV rows. No partial or duplicated token stream.
+
+Deadlines: queries carry the serving plane's :class:`Deadline`;
+mid-stream expiry preempts the lane — its KV pages return to the pool
+(``decode.kv_evict``) and everyone else's stream is untouched. The
+:class:`DecodeService` front door feeds the engine through the
+existing ``AdaptiveBatcher`` so admission, ``query_share`` yielding
+and shed/degrade apply to decode exactly as to retrieval queries
+(degrade = skip rerank + clamp ``max_new_tokens``).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time as _time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from ..ops.paged_attention import (
+    PagedKvPool,
+    dense_decode_attention,
+    paged_attention_reference,
+    paged_decode_attention,
+    pages_for,
+)
+from .config import DecodeConfig, active_decode
+from .metrics import DECODE_METRICS
+
+__all__ = [
+    "DecoderConfig",
+    "init_decoder_params",
+    "decode_greedy",
+    "DecodeTicket",
+    "DecodeEngine",
+    "DecodeService",
+]
+
+#: prefill length buckets (compile-cache keys, like the encoder's)
+_PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    """Geometry of the small generative decoder (GPT-2-style blocks,
+    learned positions, tied embedding/LM head, f32 everywhere — greedy
+    decode must be bit-reproducible)."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 256
+    num_layers: int = 4
+    num_heads: int = 4
+    intermediate_size: int = 1024
+    max_position: int = 512
+
+    def __post_init__(self):
+        if self.hidden_size % self.num_heads != 0:
+            raise ValueError("decoder: num_heads must divide hidden_size")
+
+
+def init_decoder_params(cfg: DecoderConfig, seed: int = 0) -> dict:
+    """Deterministic random init (a checkpoint loader can replace this
+    wholesale — the engine only reads the dict)."""
+    import jax
+
+    key = jax.random.PRNGKey(seed)
+    d, f = cfg.hidden_size, cfg.intermediate_size
+
+    def normal(key, shape, scale=0.02):
+        return scale * jax.random.normal(key, shape, dtype="float32")
+
+    keys = jax.random.split(key, 2 + 4 * cfg.num_layers)
+    params: dict[str, Any] = {
+        "tok": normal(keys[0], (cfg.vocab_size, d)),
+        "pos": normal(keys[1], (cfg.max_position, d)),
+        "lnf_s": np.ones(d, np.float32),
+        "lnf_b": np.zeros(d, np.float32),
+        "layers": [],
+    }
+    for l in range(cfg.num_layers):
+        k0, k1, k2, k3 = keys[2 + 4 * l : 6 + 4 * l]
+        params["layers"].append(
+            {
+                "ln1_s": np.ones(d, np.float32),
+                "ln1_b": np.zeros(d, np.float32),
+                "wqkv": normal(k0, (d, 3 * d)),
+                "bqkv": np.zeros(3 * d, np.float32),
+                "wo": normal(k1, (d, d)),
+                "bo": np.zeros(d, np.float32),
+                "ln2_s": np.ones(d, np.float32),
+                "ln2_b": np.zeros(d, np.float32),
+                "w1": normal(k2, (d, f)),
+                "b1": np.zeros(f, np.float32),
+                "w2": normal(k3, (f, d)),
+                "b2": np.zeros(d, np.float32),
+            }
+        )
+    return params
+
+
+# -- pure model math (shared by the engine jits and the in-jit RAG
+#    answer stage in ops/fused_rag.py) ---------------------------------------
+
+
+def _ln(x, s, b, eps=1e-5):
+    import jax.numpy as jnp
+
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * (1.0 / jnp.sqrt(var + eps)) * s + b
+
+
+def _prefill_math(params, cfg: DecoderConfig, ids, length):
+    """Causal forward over one padded prompt. ``ids``: [S] int32,
+    ``length``: scalar int32. Returns per-layer K/V rows
+    (``[layers, S, d]``) and the first generated token (greedy argmax
+    at position ``length - 1``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.fused_attention import KEY_OFF
+
+    seq = ids.shape[0]
+    d = cfg.hidden_size
+    hd = d // cfg.num_heads
+    scale = 1.0 / math.sqrt(hd)
+    x = params["tok"][ids] + params["pos"][:seq]
+    qi = jax.lax.broadcasted_iota(jnp.int32, (seq, seq), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (seq, seq), 1)
+    bias = jnp.where((ki <= qi) & (ki < length), 0.0, KEY_OFF)
+    ks, vs = [], []
+    for lp in params["layers"]:
+        h = _ln(x, lp["ln1_s"], lp["ln1_b"])
+        qkv = h @ lp["wqkv"] + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        ks.append(k)
+        vs.append(v)
+        outs = []
+        for hh in range(cfg.num_heads):
+            sl = slice(hh * hd, (hh + 1) * hd)
+            s = (
+                jax.lax.dot_general(
+                    q[:, sl],
+                    k[:, sl],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+                + bias
+            )
+            m = jnp.max(s, axis=1, keepdims=True)
+            e = jnp.exp(s - m)
+            p = e / jnp.sum(e, axis=1, keepdims=True)
+            outs.append(
+                jax.lax.dot_general(
+                    p, v[:, sl], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        x = x + jnp.concatenate(outs, axis=1) @ lp["wo"] + lp["bo"]
+        h2 = _ln(x, lp["ln2_s"], lp["ln2_b"])
+        x = x + jax.nn.gelu(h2 @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+    xf = _ln(x, params["lnf_s"], params["lnf_b"])
+    last = jax.lax.dynamic_slice_in_dim(xf, length - 1, 1, 0)  # [1, d]
+    logits = last @ params["tok"].T
+    first_tok = jnp.argmax(logits[0]).astype(jnp.int32)
+    return jnp.stack(ks), jnp.stack(vs), first_tok
+
+
+def _step_math(params, cfg: DecoderConfig, toks, positions, attend):
+    """One decode step for a padded batch of tokens. ``toks``/
+    ``positions``: [B] int32. ``attend(layer, q, k_new, v_new)`` must
+    commit the new KV row into that layer's cache and return the
+    attention output [B, d] — the engine plugs the paged pool in, the
+    in-jit RAG path a dense cache. Per-row math only: nothing here may
+    mix rows, that is the continuous-batching invisibility invariant.
+    Returns the next greedy tokens [B] int32."""
+    import jax
+    import jax.numpy as jnp
+
+    x = params["tok"][toks] + params["pos"][positions]
+    for l, lp in enumerate(params["layers"]):
+        h = _ln(x, lp["ln1_s"], lp["ln1_b"])
+        qkv = h @ lp["wqkv"] + lp["bqkv"]
+        q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+        x = x + attend(l, q, k_new, v_new) @ lp["wo"] + lp["bo"]
+        h2 = _ln(x, lp["ln2_s"], lp["ln2_b"])
+        x = x + jax.nn.gelu(h2 @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+    xf = _ln(x, params["lnf_s"], params["lnf_b"])
+    logits = xf @ params["tok"].T
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def decode_greedy(params, cfg: DecoderConfig, ids, length, max_new: int):
+    """Greedy generation fully inside one trace (prefill + scan over
+    dense KV) — the generate stage ``ops/fused_rag.py`` splices into
+    its fused jit so embed→retrieve→rerank→generate is one device
+    dispatch. ``ids``: [S] int32 padded prompt, ``length``: scalar,
+    ``max_new``: static. Returns [max_new] int32 tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    seq = ids.shape[0]
+    d = cfg.hidden_size
+    layers = cfg.num_layers
+    ctx = seq + max_new
+    k_rows, v_rows, tok0 = _prefill_math(params, cfg, ids, length)
+    cache_k = jnp.zeros((layers, ctx, d), jnp.float32).at[:, :seq].set(k_rows)
+    cache_v = jnp.zeros((layers, ctx, d), jnp.float32).at[:, :seq].set(v_rows)
+
+    def body(carry, _):
+        ck, cv, tok, cur = carry
+
+        def attend(l, q, k_new, v_new):
+            nonlocal ck, cv
+            ck = jax.lax.dynamic_update_slice(ck, k_new[None], (l, cur, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v_new[None], (l, cur, 0))
+            return dense_decode_attention(
+                q, ck[l][None], cv[l][None], (cur + 1)[None], n_heads=cfg.num_heads
+            )
+
+        nxt = _step_math(params, cfg, tok[None], cur[None], attend)[0]
+        return (ck, cv, nxt, cur + 1), tok
+
+    (_, _, last, _), toks = jax.lax.scan(
+        body, (cache_k, cache_v, tok0, length), None, length=max_new - 1
+    )
+    return jnp.concatenate([toks, last[None]]) if max_new > 1 else tok0[None]
+
+
+# -- engine ------------------------------------------------------------------
+
+
+class DecodeTicket:
+    """One query's handle through the decode plane."""
+
+    __slots__ = (
+        "prompt",
+        "max_new",
+        "deadline",
+        "degraded",
+        "skip_rerank",
+        "tokens",
+        "preempted",
+        "done",
+    )
+
+    def __init__(self, prompt, max_new, deadline, degraded):
+        self.prompt = list(prompt)
+        self.max_new = max_new
+        self.deadline = deadline
+        self.degraded = degraded
+        self.skip_rerank = degraded  # degrade semantics: rerank is skipped
+        self.tokens: list[int] = []
+        self.preempted = False
+        self.done = threading.Event()
+
+    def result(self, timeout: float | None = None) -> list[int]:
+        """Block for the final token stream (may be short if the query
+        was preempted — check ``preempted``)."""
+        self.done.wait(timeout)
+        return list(self.tokens)
+
+
+class _Lane:
+    __slots__ = ("ticket", "pages", "t_admit")
+
+    def __init__(self, ticket, pages):
+        self.ticket = ticket
+        self.pages = pages
+        self.t_admit = _time.monotonic()
+
+
+class DecodeEngine:
+    """Paged-KV continuous-batching decoder (see module docstring)."""
+
+    def __init__(
+        self,
+        model_cfg: DecoderConfig | None = None,
+        config: DecodeConfig | None = None,
+        *,
+        params=None,
+        seed: int = 0,
+    ):
+        import jax
+
+        self.model_cfg = model_cfg or DecoderConfig()
+        self.config = config or active_decode() or DecodeConfig()
+        self.config.check_budget(self.model_cfg.num_layers, self.model_cfg.hidden_size)
+        impl = self.config.impl
+        if impl == "auto":
+            impl = "paged" if jax.default_backend() == "tpu" else "xla"
+        self.impl = impl
+        self.params = (
+            params
+            if params is not None
+            else init_decoder_params(self.model_cfg, seed)
+        )
+        self.pool = PagedKvPool(
+            layers=self.model_cfg.num_layers,
+            dim=self.model_cfg.hidden_size,
+            n_pages=self.config.pages,
+            page_size=self.config.page_size,
+        )
+        self._pages_per_seq = self.config.pages_per_seq()
+        lanes = self.config.lanes
+        self._lanes: list[Optional[_Lane]] = [None] * lanes
+        self._page_tables = np.full(
+            (lanes, self._pages_per_seq), self.pool.sentinel, np.int32
+        )
+        self._lens = np.zeros(lanes, np.int32)
+        self._pending: deque[DecodeTicket] = deque()
+        self._jits: dict[Any, Any] = {}
+        self.steps = 0
+        DECODE_METRICS.set_pool(self.pool.pages_in_use, self.pool.n_pages)
+
+    # -- ticket lifecycle --
+
+    def max_prompt_len(self) -> int:
+        return min(self.config.max_seq, self.model_cfg.max_position)
+
+    def make_ticket(
+        self,
+        prompt_ids,
+        *,
+        max_new_tokens: int | None = None,
+        deadline=None,
+        degraded: bool = False,
+    ) -> DecodeTicket:
+        max_new = max_new_tokens or self.config.max_new_tokens
+        if degraded:
+            max_new = min(max_new, self.config.degrade_max_new_tokens)
+        prompt = [int(t) % self.model_cfg.vocab_size for t in prompt_ids]
+        if not prompt:
+            raise ValueError("decode: empty prompt")
+        if len(prompt) + max_new > self.max_prompt_len():
+            raise ValueError(
+                f"decode: prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds the context limit {self.max_prompt_len()}"
+            )
+        DECODE_METRICS.record_query(degraded=degraded)
+        return DecodeTicket(prompt, max_new, deadline, degraded)
+
+    def enqueue(self, ticket: DecodeTicket) -> None:
+        self._pending.append(ticket)
+
+    def submit(self, prompt_ids, **kw) -> DecodeTicket:
+        ticket = self.make_ticket(prompt_ids, **kw)
+        self.enqueue(ticket)
+        return ticket
+
+    # -- jit factories --
+
+    def _prefill_fn(self, seq: int):
+        import functools
+
+        import jax
+
+        key = ("prefill", seq)
+        if key not in self._jits:
+            fn = functools.partial(_prefill_math, cfg=self.model_cfg)
+            self._jits[key] = jax.jit(lambda p, ids, n: fn(p, ids=ids, length=n))
+        return self._jits[key]
+
+    def _scatter_fn(self, seq: int):
+        import jax
+        import jax.numpy as jnp
+
+        key = ("scatter", seq)
+        if key not in self._jits:
+            page_size = self.config.page_size
+            sentinel = self.pool.sentinel
+
+            def scatter(pool_k, pool_v, k_rows, v_rows, page_ids, length):
+                pos = jnp.arange(seq)
+                pages = jnp.where(
+                    pos < length, page_ids[pos // page_size], sentinel
+                )
+                offs = pos % page_size
+                pool_k = pool_k.at[:, pages, offs].set(
+                    k_rows, mode="drop", unique_indices=True
+                )
+                pool_v = pool_v.at[:, pages, offs].set(
+                    v_rows, mode="drop", unique_indices=True
+                )
+                return pool_k, pool_v
+
+            # no donation: the commit-after-chaos contract needs the
+            # pre-step buffers to stay valid until the host commits
+            self._jits[key] = jax.jit(scatter)
+        return self._jits[key]
+
+    def _step_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        key = ("step", self.impl)
+        if key not in self._jits:
+            cfg = self.model_cfg
+            page_size = self.config.page_size
+            lanes = self.config.lanes
+            impl = self.impl
+
+            def step(params, pool_k, pool_v, page_tables, lens, toks):
+                pages = page_tables[jnp.arange(lanes), lens // page_size]
+                offs = lens % page_size
+
+                def attend(l, q, k_new, v_new):
+                    nonlocal pool_k, pool_v
+                    pool_k = pool_k.at[l, pages, offs].set(
+                        k_new, mode="drop", unique_indices=True
+                    )
+                    pool_v = pool_v.at[l, pages, offs].set(
+                        v_new, mode="drop", unique_indices=True
+                    )
+                    if impl == "xla":
+                        return paged_attention_reference(
+                            q, pool_k[l], pool_v[l], page_tables, lens + 1,
+                            n_heads=cfg.num_heads,
+                        )
+                    return paged_decode_attention(
+                        q, pool_k[l], pool_v[l], page_tables, lens + 1,
+                        n_heads=cfg.num_heads,
+                        interpret=(impl == "interpret"),
+                    )
+
+                nxt = _step_math(params, cfg, toks, lens, attend)
+                return nxt, pool_k, pool_v
+
+            # no donation (see _scatter_fn): a step killed at the
+            # decode.step chaos site must leave the old pool intact
+            self._jits[key] = jax.jit(step)
+        return self._jits[key]
+
+    # -- scheduler --
+
+    def _free_lane_pages(self, lane_idx: int, reason: str) -> None:
+        from ..internals import flight_recorder
+
+        lane = self._lanes[lane_idx]
+        assert lane is not None
+        self.pool.free(lane.pages)
+        flight_recorder.record(
+            "decode.kv_evict",
+            lane=lane_idx,
+            pages=len(lane.pages),
+            reason=reason,
+        )
+        self._lanes[lane_idx] = None
+        self._page_tables[lane_idx, :] = self.pool.sentinel
+        self._lens[lane_idx] = 0
+        DECODE_METRICS.set_pool(self.pool.pages_in_use, self.pool.n_pages)
+
+    def _preempt_expired(self) -> None:
+        from ..internals import flight_recorder
+
+        now = _time.monotonic()
+        for i, lane in enumerate(self._lanes):
+            if lane is None:
+                continue
+            dl = lane.ticket.deadline
+            if dl is not None and dl.expires_at <= now:
+                flight_recorder.record(
+                    "decode.preempt",
+                    lane=i,
+                    emitted=len(lane.ticket.tokens),
+                    prompt_tokens=len(lane.ticket.prompt),
+                )
+                DECODE_METRICS.record_preempt()
+                ticket = lane.ticket
+                self._free_lane_pages(i, "preempt")
+                ticket.preempted = True
+                ticket.done.set()
+
+    def _finish(self, lane_idx: int) -> None:
+        ticket = self._lanes[lane_idx].ticket
+        self._free_lane_pages(lane_idx, "finish")
+        ticket.done.set()
+
+    def _admit(self) -> None:
+        from ..models.batching import bucket
+        from ..internals import flight_recorder
+
+        import jax.numpy as jnp
+
+        for i in range(len(self._lanes)):
+            if not self._pending:
+                return
+            if self._lanes[i] is not None:
+                continue
+            ticket = self._pending[0]
+            plen = len(ticket.prompt)
+            need = pages_for(plen + ticket.max_new, self.config.page_size)
+            pages = self.pool.alloc(need)
+            if pages is None:
+                return  # pool pressure: stay queued, retry next tick
+            self._pending.popleft()
+            w0 = _time.monotonic()
+            seq = bucket(plen, _PREFILL_BUCKETS)
+            seq = min(seq, self.max_prompt_len())
+            ids = np.zeros(seq, np.int32)
+            ids[:plen] = ticket.prompt
+            k_rows, v_rows, tok0 = self._prefill_fn(seq)(
+                self.params, jnp.asarray(ids), jnp.int32(plen)
+            )
+            page_ids = np.full(self._pages_per_seq, self.pool.sentinel, np.int32)
+            page_ids[: len(pages)] = pages
+            self.pool.k, self.pool.v = self._scatter_fn(seq)(
+                self.pool.k,
+                self.pool.v,
+                k_rows,
+                v_rows,
+                jnp.asarray(page_ids[: max(1, (seq + self.config.page_size - 1) // self.config.page_size)]),
+                jnp.int32(plen),
+            )
+            wall = _time.monotonic() - w0
+            # commit: install the lane and emit the prefill token
+            self._lanes[i] = _Lane(ticket, pages)
+            self._page_tables[i, :] = self.pool.sentinel
+            self._page_tables[i, : len(pages)] = pages
+            self._lens[i] = plen
+            ticket.tokens.append(int(tok0))
+            DECODE_METRICS.record_prefill(plen, wall)
+            DECODE_METRICS.set_pool(self.pool.pages_in_use, self.pool.n_pages)
+            flight_recorder.record(
+                "decode.prefill",
+                lane=i,
+                prompt_tokens=plen,
+                pages=len(pages),
+                wall_ms=round(wall * 1000.0, 3),
+            )
+            if len(ticket.tokens) >= ticket.max_new:
+                self._finish(i)
+
+    def step(self) -> int:
+        """One engine tick: preempt expired lanes, admit pending
+        prefills, then run one fused decode step across every live
+        lane. Returns the number of tokens emitted. Compute happens
+        before the ``decode.step`` chaos site, commit after — a step
+        killed at the site leaves no trace."""
+        from ..internals import flight_recorder
+        from ..resilience import chaos
+
+        import jax.numpy as jnp
+
+        self._preempt_expired()
+        self._admit()
+        live = [i for i, ln in enumerate(self._lanes) if ln is not None]
+        DECODE_METRICS.set_active_lanes(len(live))
+        if not live:
+            return 0
+        toks = np.zeros(self.config.lanes, np.int32)
+        for i in live:
+            toks[i] = self._lanes[i].ticket.tokens[-1]
+        w0 = _time.monotonic()
+        nxt, new_k, new_v = self._step_fn()(
+            self.params,
+            self.pool.k,
+            self.pool.v,
+            jnp.asarray(self._page_tables),
+            jnp.asarray(self._lens),
+            jnp.asarray(toks),
+        )
+        nxt = np.asarray(nxt)
+        wall = _time.monotonic() - w0
+        # ---- point of no state: everything above is functional ----
+        # (time = the step counter, so plans can target "the Nth step")
+        chaos.inject("decode.step", time=self.steps)
+        # ---- commit ----
+        self.pool.k, self.pool.v = new_k, new_v
+        emitted = 0
+        for i in live:
+            lane = self._lanes[i]
+            self._lens[i] += 1
+            lane.ticket.tokens.append(int(nxt[i]))
+            emitted += 1
+            if len(lane.ticket.tokens) >= lane.ticket.max_new:
+                self._finish(i)
+        self.steps += 1
+        DECODE_METRICS.record_step(emitted, wall)
+        flight_recorder.record(
+            "decode.step",
+            batch=len(live),
+            tokens=emitted,
+            wall_ms=round(wall * 1000.0, 3),
+        )
+        return emitted
+
+    def busy(self) -> bool:
+        return bool(self._pending) or any(l is not None for l in self._lanes)
+
+    def drain(self, max_steps: int = 1_000_000) -> None:
+        """Run the scheduler until every queued query finished (or was
+        preempted)."""
+        for _ in range(max_steps):
+            if not self.busy():
+                return
+            self.step()
+        raise RuntimeError("decode: drain did not converge")
+
+    def generate(self, prompts, **kw) -> list[list[int]]:
+        """Convenience batch API: submit every prompt, run to drain,
+        return the token streams (continuous batching interleaves them
+        on the way — the streams are identical to one-at-a-time runs)."""
+        tickets = [self.submit(p, **kw) for p in prompts]
+        self.drain()
+        return [t.result() for t in tickets]
+
+
+class DecodeService:
+    """Deadline-aware front door: the serving plane's
+    ``AdaptiveBatcher`` coalesces decode queries, drops the ones whose
+    deadline expired while queued, and yields the ingest stream's
+    ``query_share`` between fused dispatches — decode obeys the same
+    admission economics as retrieval."""
+
+    def __init__(self, engine: DecodeEngine, *, config=None):
+        from ..serving.batching import AdaptiveBatcher
+
+        self.engine = engine
+        self._batcher = AdaptiveBatcher(
+            self._dispatch,
+            config=config,
+            name="decode",
+            on_expired=self._expired,
+        )
+
+    def submit(
+        self,
+        prompt_ids,
+        *,
+        deadline=None,
+        max_new_tokens: int | None = None,
+        degraded: bool = False,
+    ) -> DecodeTicket:
+        ticket = self.engine.make_ticket(
+            prompt_ids,
+            max_new_tokens=max_new_tokens,
+            deadline=deadline,
+            degraded=degraded,
+        )
+        self._batcher.submit(ticket, deadline)
+        return ticket
+
+    def _dispatch(self, items) -> None:
+        for ticket in items:
+            self.engine.enqueue(ticket)
+        self.engine.drain()
+
+    @staticmethod
+    def _expired(ticket) -> None:
+        DECODE_METRICS.record_preempt()
+        ticket.preempted = True
+        ticket.done.set()
+
+    def stop(self) -> None:
+        self._batcher.stop()
+
+    @property
+    def error(self):
+        return self._batcher.error

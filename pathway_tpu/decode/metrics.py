@@ -1,0 +1,134 @@
+"""Decode-plane metrics: the ``pathway_decode_*`` family.
+
+Same contract as ``serving/metrics.py``: a process-wide singleton the
+engine records into, exported by the monitoring HTTP server as
+``pathway_decode_*`` Prometheus series and a ``decode`` block on
+``/status`` — but only once :meth:`DecodeMetrics.active` is true, so a
+deployment that never decodes scrapes byte-identical output with the
+decode plane compiled in.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ..serving.metrics import StageHistogram
+
+__all__ = ["DecodeMetrics", "DECODE_METRICS", "DECODE_STAGES"]
+
+#: step-latency histogram stages
+DECODE_STAGES = ("prefill", "decode_step")
+
+#: EWMA smoothing for the sustained tokens/s gauge
+_ALPHA = 0.3
+
+
+class DecodeMetrics:
+    """Counters/gauges/histograms for the continuous-batching decoder."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.tokens_total = 0
+            self.prefill_total = 0
+            self.steps_total = 0
+            self.preempted_total = 0
+            self.degraded_total = 0
+            self.queries_total = 0
+            self.kv_pages_in_use = 0
+            self.kv_page_pool = 0
+            self.active_lanes = 0
+            self.tokens_per_second = 0.0
+            self.stages = {s: StageHistogram() for s in DECODE_STAGES}
+
+    # -- recording (engine side) --
+
+    def record_query(self, *, degraded: bool = False) -> None:
+        with self._lock:
+            self.queries_total += 1
+            if degraded:
+                self.degraded_total += 1
+
+    def record_prefill(self, tokens: int, seconds: float) -> None:
+        """One prefill of ``tokens`` prompt tokens (emits the first
+        generated token, which is what the rate gauge counts)."""
+        with self._lock:
+            self.prefill_total += 1
+            self.tokens_total += 1
+            self.stages["prefill"].observe(seconds)
+            self._blend_rate(1, seconds)
+
+    def record_step(self, tokens: int, seconds: float) -> None:
+        """One fused decode step that emitted ``tokens`` new tokens
+        across all live lanes."""
+        with self._lock:
+            self.steps_total += 1
+            self.tokens_total += int(tokens)
+            self.stages["decode_step"].observe(seconds)
+            self._blend_rate(int(tokens), seconds)
+
+    def record_preempt(self) -> None:
+        with self._lock:
+            self.preempted_total += 1
+
+    def set_pool(self, in_use: int, total: int) -> None:
+        with self._lock:
+            self.kv_pages_in_use = int(in_use)
+            self.kv_page_pool = int(total)
+
+    def set_active_lanes(self, n: int) -> None:
+        with self._lock:
+            self.active_lanes = int(n)
+
+    def _blend_rate(self, tokens: int, seconds: float) -> None:
+        # caller holds the lock
+        if seconds <= 0.0 or tokens <= 0:
+            return
+        rate = tokens / seconds
+        if self.tokens_per_second == 0.0:
+            self.tokens_per_second = rate
+        else:
+            self.tokens_per_second = (
+                1.0 - _ALPHA
+            ) * self.tokens_per_second + _ALPHA * rate
+
+    # -- export side --
+
+    def active(self) -> bool:
+        """True once the decode plane has done anything — the gate that
+        keeps non-decode deployments' scrape output byte-identical."""
+        with self._lock:
+            return bool(
+                self.queries_total
+                or self.prefill_total
+                or self.steps_total
+                or self.preempted_total
+            )
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "tokens_total": self.tokens_total,
+                "prefill_total": self.prefill_total,
+                "steps_total": self.steps_total,
+                "preempted_total": self.preempted_total,
+                "degraded_total": self.degraded_total,
+                "queries_total": self.queries_total,
+                "kv_pages_in_use": self.kv_pages_in_use,
+                "kv_page_pool": self.kv_page_pool,
+                "active_lanes": self.active_lanes,
+                "tokens_per_second": round(self.tokens_per_second, 3),
+                "stage_latency_s": {
+                    stage: {"count": h.count, "sum": round(h.total, 6)}
+                    for stage, h in self.stages.items()
+                    if h.count
+                },
+            }
+
+
+#: process-wide singleton (one decode plane per process, like serving)
+DECODE_METRICS = DecodeMetrics()

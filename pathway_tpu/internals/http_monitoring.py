@@ -217,6 +217,7 @@ class MonitoringHttpServer:
         lines.extend(self._serving_lines(wl))
         lines.extend(self._index_lines(wl))
         lines.extend(self._ingest_lines(wl))
+        lines.extend(self._decode_lines(wl))
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -608,6 +609,48 @@ class MonitoringHttpServer:
             lines.append(series(metric, snap[key]))
         return lines
 
+    @staticmethod
+    def _decode_lines(wl: str = "") -> list[str]:
+        """Decode plane (``pathway_decode_*``): token throughput, KV
+        page-pool occupancy and prefill/step latency histograms.
+        Rendered only once the decode plane has run — ``/metrics``
+        stays byte-identical for pipelines that never decode."""
+        from ..decode.metrics import DECODE_METRICS
+
+        if not DECODE_METRICS.active():
+            return []
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+
+        snap = DECODE_METRICS.snapshot()
+        lines: list[str] = []
+        for metric, key, kind in (
+            ("pathway_decode_tokens_total", "tokens_total", "counter"),
+            ("pathway_decode_prefills_total", "prefill_total", "counter"),
+            ("pathway_decode_steps_total", "steps_total", "counter"),
+            ("pathway_decode_preempted_total", "preempted_total", "counter"),
+            ("pathway_decode_degraded_total", "degraded_total", "counter"),
+            ("pathway_decode_queries_total", "queries_total", "counter"),
+            ("pathway_decode_kv_pages_in_use", "kv_pages_in_use", "gauge"),
+            ("pathway_decode_kv_page_pool", "kv_page_pool", "gauge"),
+            ("pathway_decode_active_lanes", "active_lanes", "gauge"),
+            ("pathway_decode_tokens_per_second", "tokens_per_second", "gauge"),
+        ):
+            lines.append(f"# TYPE {metric} {kind}")
+            lines.append(series(metric, snap[key]))
+        for stage, hist in DECODE_METRICS.stages.items():
+            if not hist.count:
+                continue
+            metric = f"pathway_decode_{stage}_seconds"
+            lines.append(f"# TYPE {metric} histogram")
+            for le, cum in hist.cumulative():
+                lines.append(series(f"{metric}_bucket", cum, f'le="{le}"'))
+            lines.append(series(f"{metric}_sum", f"{hist.total:.9f}"))
+            lines.append(series(f"{metric}_count", hist.count))
+        return lines
+
     def _status(self) -> str:
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
 
@@ -654,6 +697,10 @@ class MonitoringHttpServer:
 
         if INGEST_METRICS.active():
             status["ingest"] = INGEST_METRICS.snapshot()
+        from ..decode.metrics import DECODE_METRICS
+
+        if DECODE_METRICS.active():
+            status["decode"] = DECODE_METRICS.snapshot()
         return json.dumps(status)
 
     # -- lifecycle --

@@ -159,6 +159,16 @@ class StatsSnapshot:
     tier_promotions: int = 0
     tier_demotions: int = 0
     tier_hot_hit_ratio: float = 0.0
+    #: decode plane (pathway_tpu/decode/): generated-token throughput,
+    #: continuous-batching lane occupancy and KV page-pool usage. All
+    #: zero when no decode engine ran — rendering stays byte-identical
+    #: for retrieval-only pipelines.
+    decode_tokens: int = 0
+    decode_tokens_per_s: float = 0.0
+    decode_active_lanes: int = 0
+    decode_kv_pages_in_use: int = 0
+    decode_kv_page_pool: int = 0
+    decode_preempted: int = 0
     #: cluster telemetry plane: worker_id -> per-worker stats dict
     #: (epoch, rows_in, rows_out, rows_per_s, event_lag_s,
     #: overlap_ratio, restarts, pid). Empty outside sharded /
@@ -285,6 +295,16 @@ class StatsMonitor:
                 ratios.append(t["hot_hit_ratio"])
             if ratios:
                 snap.tier_hot_hit_ratio = sum(ratios) / len(ratios)
+        from ..decode.metrics import DECODE_METRICS
+
+        if DECODE_METRICS.active():
+            dec = DECODE_METRICS.snapshot()
+            snap.decode_tokens = dec["tokens_total"]
+            snap.decode_tokens_per_s = dec["tokens_per_second"]
+            snap.decode_active_lanes = dec["active_lanes"]
+            snap.decode_kv_pages_in_use = dec["kv_pages_in_use"]
+            snap.decode_kv_page_pool = dec["kv_page_pool"]
+            snap.decode_preempted = dec["preempted_total"]
         for node in engine.nodes:
             rows_in, rows_out = node.stats.rows_in, node.stats.rows_out
             key = f"{node.id}:{node.name}"
@@ -431,6 +451,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
     ingesting = snap.ingest_workers > 0
     # tier column only when a tiered device index is accounting
     tiering = (snap.tier_hot_docs + snap.tier_cold_docs) > 0
+    # decode column only when the generation plane emitted tokens
+    decoding = snap.decode_tokens > 0
     table = Table(caption=caption, box=box.SIMPLE)
     table.add_column("operator", justify="left")
     table.add_column(r"latency to wall clock \[ms]", justify="right")
@@ -446,12 +468,15 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         table.add_column("ingest util / queue", justify="right")
     if tiering:
         table.add_column("tier hot/cold", justify="right")
+    if decoding:
+        table.add_column("decode tok/s / lanes", justify="right")
     pad = (
         (2 if profiled else 0)
         + (1 if pipelined else 0)
         + (1 if encoding else 0)
         + (1 if ingesting else 0)
         + (1 if tiering else 0)
+        + (1 if decoding else 0)
     )
 
     def row(*cells):
@@ -481,6 +506,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
                 cells = cells + ("",)
             if tiering:
                 cells = cells + ("",)
+            if decoding:
+                cells = cells + ("",)
             table.add_row(*cells)
     if pipelined:
         cells = (
@@ -496,6 +523,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         if ingesting:
             cells = cells + ("",)
         if tiering:
+            cells = cells + ("",)
+        if decoding:
             cells = cells + ("",)
         table.add_row(*cells)
     if encoding:
@@ -516,6 +545,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
             cells = cells + ("",)
         if tiering:
             cells = cells + ("",)
+        if decoding:
+            cells = cells + ("",)
         table.add_row(*cells)
     if ingesting:
         cells = (
@@ -533,6 +564,8 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
             f"{snap.ingest_utilization * 100:.0f}% / {snap.ingest_queue_depth}",
         )
         if tiering:
+            cells = cells + ("",)
+        if decoding:
             cells = cells + ("",)
         table.add_row(*cells)
     if tiering:
@@ -552,6 +585,30 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
             cells = cells + ("",)
         cells = cells + (
             f"{snap.tier_hot_docs} / {snap.tier_cold_docs}",
+        )
+        if decoding:
+            cells = cells + ("",)
+        table.add_row(*cells)
+    if decoding:
+        cells = (
+            f"decode plane ({snap.decode_tokens} tok, "
+            f"{snap.decode_preempted} preempted)",
+            "",
+            "",
+        )
+        if profiled:
+            cells = cells + ("", "")
+        if pipelined:
+            cells = cells + ("",)
+        if encoding:
+            cells = cells + ("",)
+        if ingesting:
+            cells = cells + ("",)
+        if tiering:
+            cells = cells + ("",)
+        cells = cells + (
+            f"{snap.decode_tokens_per_s:.1f} / {snap.decode_active_lanes} "
+            f"(kv {snap.decode_kv_pages_in_use}/{snap.decode_kv_page_pool})",
         )
         table.add_row(*cells)
     row("output", f"{monitor.output_latency_ms(now)}", "")

@@ -31,6 +31,11 @@ class ParseGraph:
         # HBM footprint against the per-device budget without building
         # or allocating anything
         self.external_indexes: list[dict] = []
+        # HTTP LLM call sites built into this program's expressions
+        # ({"kind": "llm_reranker" | "llm_chat", "model": ...}): PWL013
+        # flags these when a device decode config makes the on-chip
+        # rerank/generate path available
+        self.llm_endpoints: list[dict] = []
         # bumped on every clear(): per-program caches (e.g. the shared
         # utc_now clock table) key on this so a cleared graph never
         # serves tables built for a discarded program
@@ -53,6 +58,7 @@ class ParseGraph:
         self.run_context = None
         self.serving_endpoints.clear()
         self.external_indexes.clear()
+        self.llm_endpoints.clear()
         self.generation += 1
 
 

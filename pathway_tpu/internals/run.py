@@ -77,6 +77,7 @@ def run(
     ingest_workers: int | None = None,
     mesh: Any = None,
     index_tiers: Any = None,
+    decode: Any = None,
     cluster_accept_timeout: float | None = None,
     cluster_hello_timeout: float | None = None,
     cluster_lease_ms: float | None = None,
@@ -199,6 +200,17 @@ def run(
         _tier_cfg = parse_tier_spec(_tier_spec)
     except ValueError:
         _tier_cfg = None
+    # decode spec parsed jax-free too: PWL013 (HTTP LLM stage while a
+    # device decode plane is configured) reads this off the graph
+    from ..decode.config import parse_decode_spec
+
+    _decode_spec = (
+        decode if decode is not None else (os.environ.get("PATHWAY_DECODE") or None)
+    )
+    try:
+        _decode_cfg = parse_decode_spec(_decode_spec)
+    except ValueError:
+        _decode_cfg = None
     G.run_context = {
         "recovery": bool(recovery),
         "monitoring_level": monitoring_level,
@@ -219,6 +231,10 @@ def run(
         # TierConfig knob dict or None; PWL012 (beyond-HBM index with
         # no cold tier) treats a configured tier as the fix in place
         "index_tiers": _tier_cfg.as_dict() if _tier_cfg is not None else None,
+        # DecodeConfig knob dict or None; PWL013 (HTTP LLM stage with a
+        # device decode plane available) treats a configured decode as
+        # the on-chip alternative being ready
+        "decode": _decode_cfg.as_dict() if _decode_cfg is not None else None,
     }
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
@@ -372,6 +388,12 @@ def run(
 
     if index_tiers is not None and _tier_cfg is not None:
         set_active_tiers(_tier_cfg)
+    # and the run-scoped decode config: DecodeEngine / DecodeService
+    # construction during this run picks it up via active_decode()
+    from ..decode.config import set_active_decode
+
+    if decode is not None and _decode_cfg is not None:
+        set_active_decode(_decode_cfg)
     with mon_ctx as monitor:
         http_server = None
         if with_http_server:
@@ -545,6 +567,8 @@ def run(
                 set_active_mesh(None)
             if index_tiers is not None and _tier_cfg is not None:
                 set_active_tiers(None)
+            if decode is not None and _decode_cfg is not None:
+                set_active_decode(None)
             result.flight_recorder_dumps = list(
                 flight_recorder.RECORDER._dumped_paths[dumps_before:]
             )

@@ -1,0 +1,106 @@
+"""On-device cross-encoder reranker — the HTTP xpack hop, replaced.
+
+``xpacks/llm/rerankers.LLMReranker`` scores (query, doc) pairs by
+round-tripping every pair through a chat-completion endpoint; this
+module scores them through the fused encoder stack on the local
+device instead (``CrossEncoderScorer`` → ``CrossEncoderHead``, the
+same model family the fused RAG pipeline jits in-graph). It is the
+rerank stage the decode plane's degrade mode skips, and what analysis
+rule PWL013 points at when a pipeline still pays the HTTP hop while a
+device decode config is active.
+
+Graph-build stays cheap: the scorer (jax params + tokenizer) builds
+lazily on the first scored batch, so declaring ``rerank=`` on a
+``KNNIndex`` costs nothing until a query actually flows.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+__all__ = ["DeviceReranker", "as_reranker"]
+
+
+class DeviceReranker:
+    """Scores query×candidate pairs with an on-device cross-encoder.
+
+    ``scorer`` may be a prebuilt
+    :class:`~pathway_tpu.models.sentence_encoder.CrossEncoderScorer`;
+    otherwise one is built lazily from the remaining kwargs on first
+    use (``config=`` a tiny ``EncoderConfig`` keeps tests fast).
+    """
+
+    def __init__(self, scorer=None, **scorer_kwargs: Any):
+        self._scorer = scorer
+        self._scorer_kwargs = scorer_kwargs
+        self._lock = threading.Lock()
+
+    @property
+    def scorer(self):
+        if self._scorer is None:
+            with self._lock:
+                if self._scorer is None:
+                    from .sentence_encoder import CrossEncoderScorer
+
+                    self._scorer = CrossEncoderScorer(**self._scorer_kwargs)
+        return self._scorer
+
+    def score(self, pairs) -> list[float]:
+        """Relevance score per (query, doc) text pair, higher = better."""
+        if not pairs:
+            return []
+        import numpy as np
+
+        return [float(s) for s in np.asarray(self.scorer.score(list(pairs)))]
+
+    def order(self, query: str, docs) -> tuple[int, ...]:
+        """Permutation of ``docs`` by descending device score (stable:
+        ties keep retrieval order). The index rerank stage applies this
+        one permutation to every result column so rows stay aligned."""
+        docs = list(docs)
+        if len(docs) <= 1:
+            return tuple(range(len(docs)))
+        scores = self.score([(str(query), str(d)) for d in docs])
+        return tuple(
+            sorted(range(len(docs)), key=lambda i: (-scores[i], i))
+        )
+
+    def rerank(
+        self, query: str, docs, k: Optional[int] = None
+    ) -> list[tuple[Any, float]]:
+        """``docs`` reordered by device score, with scores, top-``k``."""
+        docs = list(docs)
+        scores = self.score([(str(query), str(d)) for d in docs])
+        order = sorted(range(len(docs)), key=lambda i: (-scores[i], i))
+        if k is not None:
+            order = order[:k]
+        return [(docs[i], scores[i]) for i in order]
+
+
+def as_reranker(spec: Any) -> DeviceReranker | None:
+    """Coerce the ``rerank=`` knob: ``None``/``False``/``"off"`` →
+    no rerank; ``True``/``"auto"``/``"device"`` → default device
+    reranker; a :class:`DeviceReranker` or ``CrossEncoderScorer``
+    passes through; a dict becomes scorer kwargs."""
+    if spec is None or spec is False:
+        return None
+    if isinstance(spec, DeviceReranker):
+        return spec
+    if spec is True:
+        return DeviceReranker()
+    if isinstance(spec, str):
+        text = spec.strip().lower()
+        if text in ("off", "none", "false", ""):
+            return None
+        if text in ("on", "true", "auto", "device"):
+            return DeviceReranker()
+        return DeviceReranker(model=spec)
+    if isinstance(spec, dict):
+        return DeviceReranker(**spec)
+    # duck-typed scorer (has .score over pairs)
+    if hasattr(spec, "score"):
+        return DeviceReranker(scorer=spec)
+    raise ValueError(
+        f"rerank: cannot coerce {type(spec).__name__} into a device reranker"
+    )

@@ -47,8 +47,19 @@ class FusedRagPipeline:
         metric: str = "cos",
         reserved_space: int = 1024,
         doc_seq_len: int = 128,
+        decoder=None,
     ):
         self.enc = encoder
+        if cross is not None and not hasattr(cross, "module"):
+            # a models.reranker.DeviceReranker (the rerank= knob's
+            # object) carries its CrossEncoderScorer under .scorer
+            scorer = getattr(cross, "scorer", None)
+            if scorer is None or not hasattr(scorer, "module"):
+                raise TypeError(
+                    "cross must be a CrossEncoderScorer or DeviceReranker, "
+                    f"got {type(cross).__name__}"
+                )
+            cross = scorer
         self.cross = cross
         self.doc_seq = doc_seq_len
         self.index = DeviceKnnIndex(
@@ -64,6 +75,41 @@ class FusedRagPipeline:
         self._tok_full = True
         self._tok_pending: dict[int, tuple[np.ndarray, int]] = {}
         self._jit_cache: dict[Any, Any] = {}
+        self._dec_params = None
+        self._dec_cfg = None
+        if decoder is not None:
+            self.set_decoder(decoder)
+
+    def set_decoder(self, decoder, *, seed: int = 0) -> None:
+        """Attach the generate stage. Accepts a ``DecoderConfig`` (params
+        are initialised from ``seed``), a ``(params, config)`` tuple, a
+        ``{"params": ..., "config": ...}`` dict, a ``DecodeEngine``
+        (shares its weights), or ``True`` for the default geometry."""
+        from ..decode.engine import DecoderConfig, init_decoder_params
+
+        if decoder is True:
+            decoder = DecoderConfig()
+        if isinstance(decoder, DecoderConfig):
+            self._dec_cfg = decoder
+            self._dec_params = init_decoder_params(decoder, seed=seed)
+        elif isinstance(decoder, tuple) and len(decoder) == 2:
+            self._dec_params, self._dec_cfg = decoder
+        elif isinstance(decoder, dict):
+            self._dec_cfg = decoder["config"]
+            self._dec_params = decoder.get("params")
+            if self._dec_params is None:
+                self._dec_params = init_decoder_params(self._dec_cfg, seed=seed)
+        elif hasattr(decoder, "params") and hasattr(decoder, "model_cfg"):
+            self._dec_params = decoder.params
+            self._dec_cfg = decoder.model_cfg
+        else:
+            raise TypeError(
+                f"decoder: cannot coerce {type(decoder).__name__} "
+                "(want DecoderConfig, (params, config), dict, or DecodeEngine)"
+            )
+        # answer jits close over the decoder geometry — drop stale ones
+        for key in [k for k in self._jit_cache if isinstance(k, tuple)]:
+            del self._jit_cache[key]
 
     # ---- ingest ----
 
@@ -147,18 +193,23 @@ class FusedRagPipeline:
 
     # ---- query ----
 
-    def _fused_fn(self):
-        if "fused" in self._jit_cache:
-            return self._jit_cache["fused"]
+    def _fused_body(self, use_cross: bool = True):
+        """The pure (un-jitted) encode→retrieve→rerank trace, shared by
+        the query jit and the answer jit's front half. ``use_cross=
+        False`` builds the rerank-free variant (the decode plane's
+        degrade path) even when a cross-encoder is configured."""
+        cache_key = ("fused_body", use_cross)
+        if cache_key in self._jit_cache:
+            return self._jit_cache[cache_key]
         import jax
         import jax.numpy as jnp
-        from functools import partial
 
         enc_mod = self.enc.module
-        cross_mod = self.cross.module if self.cross is not None else None
+        cross_mod = (
+            self.cross.module if self.cross is not None and use_cross else None
+        )
         l2 = self.index.metric == "l2"
 
-        @partial(jax.jit, static_argnames=("kr", "kf"))
         def fused(
             enc_params, cross_params, q_ids, q_lens, matrix, valid, toks, dlens, kr, kf
         ):
@@ -202,13 +253,95 @@ class FusedRagPipeline:
             fslots = jnp.take_along_axis(ridx, fidx, axis=1)
             return fslots, fvals, ridx, rvals
 
-        self._jit_cache["fused"] = fused
+        self._jit_cache[cache_key] = fused
         return fused
 
-    def _dispatch(self, texts: Sequence[str], k: int, k_retrieve: int):
-        """Tokenize/pad and launch the fused kernel; returns the raw
-        device (slots, scores) arrays without blocking."""
-        texts = ["" if t is None else str(t) for t in texts]
+    def _fused_fn(self):
+        if "fused" not in self._jit_cache:
+            import jax
+            from functools import partial
+
+            self._jit_cache["fused"] = partial(
+                jax.jit, static_argnames=("kr", "kf")
+            )(self._fused_body())
+        return self._jit_cache["fused"]
+
+    def _answer_fn(self, max_new: int, use_cross: bool = True):
+        """One jit for the WHOLE on-chip query path: encode query →
+        retrieve → (cross-encoder rerank) → build generation prompt from
+        the top hit's resident tokens → greedy decode. Between those
+        stages nothing touches the host: doc tokens are gathered from
+        the device store and spliced after the query in-trace, and the
+        generate stage is ``decode.engine.decode_greedy`` vmapped over
+        the query batch. Only token ids go up and (slots, scores,
+        generated tokens) come down."""
+        key = ("answer", max_new, use_cross)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from ..decode.engine import decode_greedy
+
+        body = self._fused_body(use_cross)
+        dcfg = self._dec_cfg
+        dec_max_prompt = dcfg.max_position - max_new
+        if dec_max_prompt < 1:
+            raise ValueError(
+                f"answer: max_new={max_new} leaves no prompt room in "
+                f"max_position={dcfg.max_position}"
+            )
+
+        @partial(jax.jit, static_argnames=("kr", "kf"))
+        def answer(
+            enc_params,
+            cross_params,
+            dec_params,
+            q_ids,
+            q_lens,
+            matrix,
+            valid,
+            toks,
+            dlens,
+            kr,
+            kf,
+        ):
+            fslots, fvals, _, _ = body(
+                enc_params, cross_params, q_ids, q_lens, matrix, valid,
+                toks, dlens, kr, kf,
+            )
+            nq, Lq = q_ids.shape
+            Ld = toks.shape[1]
+            top = fslots[:, 0]
+            d_tok = toks[top].astype(jnp.int32)  # [q, Ld]
+            d_len = dlens[top]
+            buf = jnp.zeros((nq, Lq + Ld), jnp.int32)
+            buf = buf.at[:, :Lq].set(q_ids.astype(jnp.int32))
+            splice = lambda row, drow, qlen: jax.lax.dynamic_update_slice(
+                row, drow, (qlen,)
+            )
+            buf = jax.vmap(splice)(buf, d_tok, q_lens)
+            Lp = min(Lq + Ld, dec_max_prompt)
+            prompt = buf[:, :Lp]
+            # queries with no live hit generate from the query alone
+            has_hit = fvals[:, 0] > _NEG / 2
+            plen = jnp.clip(
+                jnp.where(has_hit, q_lens + d_len, q_lens), 1, Lp
+            ).astype(jnp.int32)
+            gen = jax.vmap(
+                lambda ids_row, ln: decode_greedy(
+                    dec_params, dcfg, ids_row, ln, max_new
+                )
+            )(prompt, plen)
+            return fslots, fvals, gen
+
+        self._jit_cache[key] = answer
+        return answer
+
+    def _padded_queries(self, texts: Sequence[str], k_retrieve: int):
+        """Tokenize/pad a query batch and sync device stores; returns
+        (ids [qb, L], lens [qb], kr)."""
         m = self.enc.tokenizer.batch_encode_matrix(texts, self.enc.max_seq_len)
         if m is None:
             raise RuntimeError("fused RAG requires the matrix tokenizer path")
@@ -225,6 +358,13 @@ class FusedRagPipeline:
         lens_p = np.zeros((qb,), np.int32)
         lens_p[:n] = lens
         kr = min(_k_bucket(k_retrieve), self.index.capacity)
+        return ids, lens_p, kr
+
+    def _dispatch(self, texts: Sequence[str], k: int, k_retrieve: int):
+        """Tokenize/pad and launch the fused kernel; returns the raw
+        device (slots, scores) arrays without blocking."""
+        texts = ["" if t is None else str(t) for t in texts]
+        ids, lens_p, kr = self._padded_queries(texts, k_retrieve)
         fslots, fvals, _, _ = self._fused_fn()(
             self.enc.params,
             self.cross.params if self.cross is not None else None,
@@ -274,6 +414,65 @@ class FusedRagPipeline:
         queries pay the host->device link once, not per query. Resolve
         slots to keys with ``resolve`` once the arrays are ready."""
         return self._dispatch([text], k, k_retrieve)
+
+    def answer_batch(
+        self,
+        texts: Sequence[str],
+        k: int = 5,
+        k_retrieve: int = 20,
+        max_new: int = 16,
+        rerank: bool = True,
+    ) -> list[dict[str, Any]]:
+        """The full on-chip query path: per query a dict with ``hits``
+        (as :meth:`query_batch`) and ``tokens`` (``max_new`` greedy
+        tokens from the decoder, conditioned on query + top hit). One
+        device dispatch end to end — no host round-trips between the
+        embed, retrieve, rerank and generate stages. ``rerank=False``
+        is the degrade path: candidates keep retrieval order (the
+        cross-encoder stage is skipped) but generation still runs."""
+        if self._dec_params is None:
+            raise RuntimeError(
+                "fused RAG answer path needs a decoder "
+                "(pass decoder= or call set_decoder)"
+            )
+        texts = ["" if t is None else str(t) for t in texts]
+        if not len(texts):
+            return []
+        ids, lens_p, kr = self._padded_queries(texts, k_retrieve)
+        use_cross = rerank and self.cross is not None
+        fslots, fvals, gen = self._answer_fn(int(max_new), use_cross)(
+            self.enc.params,
+            self.cross.params if use_cross else None,
+            self._dec_params,
+            ids,
+            lens_p,
+            self.index._dev_matrix,
+            self.index._dev_valid,
+            self._tok_dev,
+            self._len_dev,
+            kr=kr,
+            kf=min(k, kr),
+        )
+        fslots = np.asarray(fslots)
+        fvals = np.asarray(fvals)
+        gen = np.asarray(gen)
+        out: list[dict[str, Any]] = []
+        for qi in range(len(texts)):
+            hits: list[tuple[Any, float]] = []
+            for slot, val in zip(fslots[qi], fvals[qi]):
+                if val <= _NEG / 2:
+                    continue
+                key = self.index._keys[slot]
+                if key is None:
+                    continue
+                hits.append((key, float(val)))
+            out.append(
+                {"hits": hits[:k], "tokens": [int(t) for t in gen[qi]]}
+            )
+        return out
+
+    def answer(self, text: str, **kw) -> dict[str, Any]:
+        return self.answer_batch([text], **kw)[0]
 
     def resolve(self, fslots, fvals, k: int = 5) -> list[tuple[Any, float]]:
         fslots = np.asarray(fslots)[0]

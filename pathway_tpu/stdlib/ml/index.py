@@ -37,9 +37,19 @@ class KNNIndex:
         reserved_space: int = 1024,
         mesh=None,
         tiers=None,
+        rerank=None,
+        rerank_column: str = "data",
     ):
         self.data = data
         self.distance_type = distance_type
+        # optional on-device rerank stage (models/reranker.py): scores
+        # retrieved candidates through the local cross-encoder instead
+        # of an HTTP LLM xpack. The scorer builds lazily on the first
+        # query, so declaring it here costs nothing at graph build.
+        from ...models.reranker import as_reranker
+
+        self.reranker = as_reranker(rerank)
+        self.rerank_column = rerank_column
         metric = "l2" if distance_type == "euclidean" else "cos"
         # mesh=None / tiers=None defer to pw.run(mesh=...,
         # index_tiers=...) / PATHWAY_MESH / PATHWAY_INDEX_TIERS at
@@ -63,6 +73,7 @@ class KNNIndex:
         with_distances: bool,
         metadata_filter,
         as_of_now: bool,
+        query_text: ColumnReference | None = None,
     ) -> Table:
         data_cols = list(self.data._columns.keys())
         raw = self.inner._build_query(
@@ -83,6 +94,26 @@ class KNNIndex:
             sel = {n: raw[f"_pw_data_{n}"] for n in data_cols}
             if with_distances:
                 sel["dist"] = apply_with_type(to_dist, dt.ANY, raw[_SCORE])
+            if (
+                self.reranker is not None
+                and query_text is not None
+                and self.rerank_column in data_cols
+            ):
+                # device rerank stage: one permutation per query row
+                # (descending cross-encoder score), applied to every
+                # result column so rows stay aligned
+                reranker = self.reranker
+                order = apply_with_type(
+                    lambda q, docs: reranker.order(q, docs),
+                    dt.ANY,
+                    query_text,
+                    sel[self.rerank_column],
+                )
+                permute = lambda t, o: tuple(t[i] for i in o)
+                sel = {
+                    n: apply_with_type(permute, dt.ANY, expr, order)
+                    for n, expr in sel.items()
+                }
             return raw.select(**sel)
         # flat format: one row per match, query_id column
         tmp = raw.select(query_id=raw.id, match=raw[_INDEX_REPLY])
@@ -105,10 +136,20 @@ class KNNIndex:
         collapse_rows: bool = True,
         with_distances: bool = False,
         metadata_filter: ColumnExpression | None = None,
+        query_text: ColumnReference | None = None,
     ) -> Table:
-        """Incremental: results update as better documents arrive."""
+        """Incremental: results update as better documents arrive.
+        ``query_text`` (the raw query string column) enables the
+        on-device rerank stage when the index was built with
+        ``rerank=``."""
         return self._get(
-            query_embedding, k, collapse_rows, with_distances, metadata_filter, False
+            query_embedding,
+            k,
+            collapse_rows,
+            with_distances,
+            metadata_filter,
+            False,
+            query_text=query_text,
         )
 
     def get_nearest_items_asof_now(
@@ -118,8 +159,15 @@ class KNNIndex:
         collapse_rows: bool = True,
         with_distances: bool = False,
         metadata_filter: ColumnExpression | None = None,
+        query_text: ColumnReference | None = None,
     ) -> Table:
         """Answers reflect the index as of query arrival; never updated."""
         return self._get(
-            query_embedding, k, collapse_rows, with_distances, metadata_filter, True
+            query_embedding,
+            k,
+            collapse_rows,
+            with_distances,
+            metadata_filter,
+            True,
+            query_text=query_text,
         )

@@ -136,6 +136,12 @@ class BaseChat(udfs.UDF):
         return self.kwargs.get("model")
 
     def __call__(self, messages: ColumnExpression, **kwargs) -> ColumnExpression:
+        # PWL013 reads these off the graph: a generation stage that
+        # leaves the device per message, flagged when a configured
+        # decode plane could generate on-chip
+        from ...internals.parse_graph import G
+
+        G.llm_endpoints.append({"kind": "llm_chat", "model": self.model})
         return super().__call__(messages, **kwargs)
 
 

@@ -93,6 +93,17 @@ class LLMReranker(udfs.UDF):
     def __call__(
         self, doc: ColumnExpression, query: ColumnExpression, **kwargs
     ) -> ColumnExpression:
+        # PWL013 reads these off the graph: a rerank stage that pays an
+        # HTTP LLM round-trip per pair, flagged when a device decode
+        # plane could score on-chip instead
+        from ...internals.parse_graph import G
+
+        G.llm_endpoints.append(
+            {
+                "kind": "llm_reranker",
+                "model": getattr(self.llm, "model", None),
+            }
+        )
         return super().__call__(doc, query, **kwargs)
 
 

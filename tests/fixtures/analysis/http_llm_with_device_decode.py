@@ -1,0 +1,41 @@
+"""Analysis fixture: a RAG pipeline that reranks candidates through an
+HTTP chat-completion endpoint (LLMReranker) while the run configures
+the device decode plane (pw.run(decode=...)) — the verifier must flag
+PWL013 (warning): the rerank hop can run on-chip via
+KNNIndex(rerank=...) and generation via decode.DecodeService, keeping
+embed->retrieve->rerank->generate in one device dispatch. Analyze-only
+never executes the UDF, so no HTTP call is ever made."""
+
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm.llms import BaseChat
+from pathway_tpu.xpacks.llm.rerankers import LLMReranker
+
+
+class StubChat(BaseChat):
+    """Deterministic stand-in for an HTTP chat endpoint."""
+
+    def __init__(self):
+        super().__init__()
+        self.kwargs = {"model": "gpt-x"}
+
+    def __wrapped__(self, messages, **kwargs) -> str:
+        return "3"
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return False
+
+
+pairs = pw.debug.table_from_markdown(
+    """
+    | doc          | query
+  1 | relevant-doc | what is relevant
+  2 | other-doc    | what is relevant
+    """
+)
+
+reranker = LLMReranker(StubChat())
+scored = pairs.select(score=reranker(pairs.doc, pairs.query))
+
+pw.io.null.write(scored)
+
+pw.run(decode="pages=128,page=16,max_new=32")

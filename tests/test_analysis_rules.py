@@ -838,3 +838,77 @@ def test_pwl012_hbm_budget_env_override(monkeypatch):
     _knn_sink(reserved=200_000)
     _describe_run(monkeypatch, monitoring_level="in_out")
     assert "PWL012" in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL013
+
+
+def _llm_rerank_sink():
+    """A pipeline whose rerank hop goes through an HTTP chat endpoint
+    (LLMReranker records an llm_endpoints entry at expression build)."""
+    from pathway_tpu.xpacks.llm.llms import BaseChat
+    from pathway_tpu.xpacks.llm.rerankers import LLMReranker
+
+    class StubChat(BaseChat):
+        def __init__(self):
+            super().__init__()
+            self.kwargs = {"model": "gpt-x"}
+
+        def __wrapped__(self, messages, **kwargs) -> str:
+            return "3"
+
+        def _accepts_call_arg(self, arg_name: str) -> bool:
+            return False
+
+    pairs = _static("""
+        | doc | query
+      1 | a   | q
+      2 | b   | q
+    """)
+    reranker = LLMReranker(StubChat())
+    pw.io.null.write(pairs.select(score=reranker(pairs.doc, pairs.query)))
+
+
+def test_pwl013_http_llm_with_decode_plane(monkeypatch):
+    _llm_rerank_sink()
+    _describe_run(monkeypatch, decode="pages=64,page=16")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL013"]
+    assert len(hits) == 1 and hits[0].severity is Severity.WARNING
+    assert "llm_reranker" in hits[0].message
+    assert hits[0].detail["llm_endpoints"][0]["model"] == "gpt-x"
+    assert hits[0].detail["decode"]["pages"] == 64
+
+
+def test_pwl013_env_knob_counts_as_decode(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DECODE", "auto")
+    _llm_rerank_sink()
+    _describe_run(monkeypatch)
+    assert "PWL013" in _rules(pw.analysis.analyze())
+
+
+def test_pwl013_negative_no_decode_plane(monkeypatch):
+    monkeypatch.delenv("PATHWAY_DECODE", raising=False)
+    _llm_rerank_sink()
+    _describe_run(monkeypatch)
+    assert "PWL013" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl013_negative_decode_off_spec(monkeypatch):
+    _llm_rerank_sink()
+    _describe_run(monkeypatch, decode="off")
+    assert "PWL013" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl013_negative_device_reranker_does_not_record(monkeypatch):
+    # the on-chip cross-encoder IS the decode-plane-friendly path: a
+    # pipeline already using it must not be told to migrate
+    from pathway_tpu.xpacks.llm.rerankers import CrossEncoderReranker
+
+    pairs = _static("""
+        | doc | query
+      1 | a   | q
+    """)
+    reranker = CrossEncoderReranker()
+    pw.io.null.write(pairs.select(score=reranker(pairs.doc, pairs.query)))
+    _describe_run(monkeypatch, decode=True)
+    assert "PWL013" not in _rules(pw.analysis.analyze())

@@ -1,0 +1,388 @@
+"""Continuous-batching decode engine (pathway_tpu/decode): spec
+parsing and the run-scoped config, the continuous-batching invisibility
+gate (interleaved streams bitwise-equal to one-at-a-time runs and to
+the in-jit ``decode_greedy`` path), deadline preemption, the
+``decode.step`` chaos site's compute-then-commit atomicity, flight
+events, ``pathway_decode_*`` metrics gating, the ``DecodeService``
+front door, the ``pw.run(decode=)`` knob, and the fused-RAG on-chip
+answer path."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.decode import (
+    DECODE_METRICS,
+    DecodeConfig,
+    DecodeEngine,
+    DecodeService,
+    DecoderConfig,
+    decode_greedy,
+    init_decoder_params,
+    parse_decode_spec,
+    use_decode,
+)
+from pathway_tpu.decode.config import active_decode, degraded
+from pathway_tpu.internals import flight_recorder as fr
+from pathway_tpu.resilience import chaos
+from pathway_tpu.serving.deadline import Deadline
+
+# tiny geometry: everything below must run in seconds on CPU
+MODEL = DecoderConfig(
+    vocab_size=97,
+    hidden_size=16,
+    num_layers=2,
+    num_heads=2,
+    intermediate_size=32,
+    max_position=64,
+)
+CONFIG = DecodeConfig(
+    pages=64,
+    page_size=4,
+    lanes=4,
+    max_new_tokens=6,
+    degrade_max_new_tokens=2,
+    max_seq=48,
+    impl="xla",
+)
+PARAMS = init_decoder_params(MODEL, seed=0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    DECODE_METRICS.reset()
+    yield
+    DECODE_METRICS.reset()
+    chaos.deactivate()
+
+
+def _engine(**over) -> DecodeEngine:
+    cfg = CONFIG if not over else DecodeConfig(**{**CONFIG.as_dict(), **over})
+    return DecodeEngine(MODEL, cfg, params=PARAMS)
+
+
+PROMPTS = [
+    [3, 1, 4, 1, 5],
+    [2, 7, 1, 8, 2, 8, 1, 8],
+    [9, 9],
+    [31, 41, 5, 92, 6, 53, 5, 89, 79, 3],
+]
+
+
+# ----------------------------------------------------------------- config
+
+
+def test_parse_decode_spec_forms():
+    assert parse_decode_spec(None) is None
+    assert parse_decode_spec(False) is None
+    assert parse_decode_spec(0) is None
+    assert parse_decode_spec("off") is None
+    assert parse_decode_spec(True) == DecodeConfig()
+    assert parse_decode_spec("auto") == DecodeConfig()
+    assert parse_decode_spec(128) == DecodeConfig(pages=128)
+    cfg = parse_decode_spec("pages=128, page=8, max_new=32, rerank=off")
+    assert (cfg.pages, cfg.page_size, cfg.max_new_tokens, cfg.rerank) == (
+        128, 8, 32, False,
+    )
+    cfg = parse_decode_spec({"pages": 16, "batch": 2, "impl": "XLA"})
+    assert (cfg.pages, cfg.lanes, cfg.impl) == (16, 2, "xla")
+    already = DecodeConfig(pages=99)
+    assert parse_decode_spec(already) is already
+
+
+def test_parse_decode_spec_rejects_malformed():
+    with pytest.raises(ValueError, match="unknown spec key"):
+        parse_decode_spec("pagez=4")
+    with pytest.raises(ValueError, match="key=value"):
+        parse_decode_spec("pages")
+    with pytest.raises(ValueError, match="impl"):
+        parse_decode_spec("impl=cuda")
+    with pytest.raises(ValueError, match="cannot parse"):
+        parse_decode_spec(3.5)
+    with pytest.raises(ValueError, match="degrade_max_new_tokens"):
+        DecodeConfig(max_new_tokens=4, degrade_max_new_tokens=8)
+
+
+def test_env_and_run_scoped_active_config(monkeypatch):
+    monkeypatch.delenv("PATHWAY_DECODE", raising=False)
+    assert active_decode() is None
+    monkeypatch.setenv("PATHWAY_DECODE", "pages=32,page=8")
+    assert active_decode().pages == 32
+    monkeypatch.setenv("PATHWAY_DECODE", "not a spec !!")
+    assert active_decode() is None  # malformed env counts as off
+    monkeypatch.setenv("PATHWAY_DECODE", "pages=32,page=8")
+    with use_decode("pages=8,page=4,max_seq=16"):
+        assert active_decode().pages == 8  # run-scoped beats env
+    assert active_decode().pages == 32
+
+
+def test_degraded_config_semantics():
+    cfg = degraded(CONFIG)
+    assert cfg.rerank is False
+    assert cfg.max_new_tokens == CONFIG.degrade_max_new_tokens
+
+
+def test_pool_budget_rejected_at_parse_time():
+    huge = DecodeConfig(pages=1 << 22, page_size=64, hbm_bytes=1 << 20)
+    with pytest.raises(ValueError, match="HBM budget"):
+        DecodeEngine(MODEL, huge, params=PARAMS)
+
+
+def test_run_knob_lands_in_run_context(monkeypatch):
+    monkeypatch.setenv("PATHWAY_ANALYZE_ONLY", "1")
+    pw.clear_graph()
+    t = pw.debug.table_from_markdown("""
+        | x
+      1 | 1
+    """)
+    pw.io.null.write(t.select(pw.this.x))
+    assert pw.run(decode="pages=16,page=4,max_seq=16") is None
+    ctx = pw.internals.parse_graph.G.run_context
+    assert ctx["decode"]["pages"] == 16
+    assert ctx["decode"]["page_size"] == 4
+    # the analyze-only run must not leave a run-scoped config installed
+    monkeypatch.delenv("PATHWAY_DECODE", raising=False)
+    assert active_decode() is None
+    pw.clear_graph()
+
+
+# ----------------------------------------------------- batching invisibility
+
+
+def test_continuous_batching_is_semantically_invisible():
+    """The acceptance gate: streams decoded interleaved (shared lanes,
+    shared pool) are bitwise identical to each prompt decoded alone in
+    a fresh engine, and to the single-trace ``decode_greedy`` path."""
+    together = _engine().generate(PROMPTS)
+    alone = [_engine().generate([p])[0] for p in PROMPTS]
+    assert together == alone
+    import jax.numpy as jnp
+
+    for prompt, stream in zip(PROMPTS, together):
+        assert len(stream) == CONFIG.max_new_tokens
+        seq = 8 if len(prompt) <= 8 else 16
+        ids = np.zeros(seq, np.int32)
+        ids[: len(prompt)] = prompt
+        ref = decode_greedy(
+            PARAMS, MODEL, jnp.asarray(ids), jnp.int32(len(prompt)),
+            CONFIG.max_new_tokens,
+        )
+        assert stream == [int(t) for t in np.asarray(ref)]
+
+
+def test_more_prompts_than_lanes_queue_and_finish():
+    prompts = [[(7 * i + j) % 97 for j in range(3 + i % 5)] for i in range(11)]
+    eng = _engine(lanes=2, pages=24)
+    streams = eng.generate(prompts)
+    assert all(len(s) == CONFIG.max_new_tokens for s in streams)
+    assert eng.pool.pages_in_use == 0
+    assert not eng.busy()
+    alone = [_engine().generate([p])[0] for p in prompts]
+    assert streams == alone
+
+
+def test_degraded_clamps_max_new():
+    eng = _engine()
+    ticket = eng.submit(PROMPTS[0], degraded=True)
+    eng.drain()
+    assert len(ticket.result()) == CONFIG.degrade_max_new_tokens
+    assert ticket.skip_rerank
+    assert DECODE_METRICS.snapshot()["degraded_total"] == 1
+
+
+def test_ticket_validation():
+    eng = _engine()
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.make_ticket([])
+    with pytest.raises(ValueError, match="context limit"):
+        eng.make_ticket(list(range(60)))
+
+
+# --------------------------------------------------------------- deadlines
+
+
+def test_mid_stream_deadline_preempts_only_the_expired_lane():
+    eng = _engine()
+    expired = Deadline(1.0, start=time.monotonic() - 10.0)
+    victim = eng.submit(PROMPTS[0], deadline=expired)
+    others = [eng.submit(p, deadline=Deadline.none()) for p in PROMPTS[1:]]
+    before = fr.RECORDER._seq
+    eng.drain()
+    assert victim.preempted
+    assert len(victim.result()) < CONFIG.max_new_tokens
+    # the victim's pages went back to the pool...
+    assert eng.pool.pages_in_use == 0
+    kinds = [e["kind"] for e in fr.RECORDER.events() if e["seq"] > before]
+    assert "decode.preempt" in kinds
+    assert "decode.kv_evict" in kinds
+    assert DECODE_METRICS.snapshot()["preempted_total"] == 1
+    # ...and everyone else's stream is bitwise what it would have been
+    for prompt, t in zip(PROMPTS[1:], others):
+        assert not t.preempted
+        assert t.result() == _engine().generate([prompt])[0]
+
+
+# ------------------------------------------------------------------- chaos
+
+
+def test_chaos_kill_at_decode_step_then_retry_is_identical():
+    """A step killed at the ``decode.step`` site (between compute and
+    commit) must leave the engine at the pre-step state: re-running the
+    drain produces exactly the streams an unchaosed engine produces."""
+    eng = _engine()
+    tickets = [eng.submit(p) for p in PROMPTS]
+    chaos.activate([{"site": "decode.step", "time": 2, "action": "raise"}])
+    with pytest.raises(chaos.ChaosInjected):
+        eng.drain()
+    assert eng.steps == 2  # the killed step committed nothing
+    chaos.deactivate()
+    eng.drain()
+    streams = [t.result() for t in tickets]
+    assert streams == _engine().generate(PROMPTS)
+
+
+# ---------------------------------------------------------- flight events
+
+
+def test_flight_events_cover_the_decode_lifecycle():
+    before = fr.RECORDER._seq
+    _engine().generate(PROMPTS[:2])
+    events = [e for e in fr.RECORDER.events() if e["seq"] > before]
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e["kind"], []).append(e)
+    prefills = by_kind["decode.prefill"]
+    assert len(prefills) == 2
+    assert {e["prompt_tokens"] for e in prefills} == {5, 8}
+    assert all(e["pages"] > 0 and e["wall_ms"] >= 0 for e in prefills)
+    steps = by_kind["decode.step"]
+    assert len(steps) == CONFIG.max_new_tokens - 1
+    assert steps[0]["batch"] == 2 and steps[0]["tokens"] == 2
+    evicts = by_kind["decode.kv_evict"]
+    assert len(evicts) == 2
+    assert all(e["reason"] == "finish" for e in evicts)
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_metrics_gate_and_snapshot():
+    assert not DECODE_METRICS.active()
+    eng = _engine()
+    eng.generate(PROMPTS[:2])
+    assert DECODE_METRICS.active()
+    snap = DECODE_METRICS.snapshot()
+    assert snap["queries_total"] == 2
+    assert snap["prefill_total"] == 2
+    assert snap["steps_total"] == CONFIG.max_new_tokens - 1
+    assert snap["tokens_total"] == 2 * CONFIG.max_new_tokens
+    assert snap["kv_pages_in_use"] == 0
+    assert snap["kv_page_pool"] == CONFIG.pages
+    assert snap["tokens_per_second"] > 0
+    assert set(snap["stage_latency_s"]) == {"prefill", "decode_step"}
+    DECODE_METRICS.reset()
+    assert not DECODE_METRICS.active()
+
+
+def test_status_and_prometheus_surface_decode_block():
+    import json
+
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+    from pathway_tpu.internals.monitoring import StatsMonitor
+
+    srv = MonitoringHttpServer(StatsMonitor(), port=0)
+    quiet = srv._prometheus()
+    assert "pathway_decode_" not in quiet  # inactive plane: no series
+    assert "decode" not in json.loads(srv._status())
+    _engine().generate(PROMPTS[:1])
+    prom = srv._prometheus()
+    for series in (
+        "pathway_decode_tokens_total",
+        "pathway_decode_steps_total",
+        "pathway_decode_kv_page_pool",
+        "pathway_decode_tokens_per_second",
+        "pathway_decode_prefill_seconds_bucket",
+        "pathway_decode_decode_step_seconds_count",
+    ):
+        assert series in prom, series
+    assert json.loads(srv._status())["decode"]["queries_total"] == 1
+
+
+# ----------------------------------------------------------------- service
+
+
+def test_decode_service_front_door():
+    eng = _engine()
+    svc = DecodeService(eng)
+    try:
+        tickets = [svc.submit(p, deadline=Deadline.none()) for p in PROMPTS]
+        streams = [t.result(timeout=60.0) for t in tickets]
+        assert svc.error is None
+    finally:
+        svc.stop()
+    assert streams == _engine().generate(PROMPTS)
+
+
+def test_decode_service_drops_queue_expired_tickets():
+    eng = _engine()
+    svc = DecodeService(eng)
+    try:
+        dead = Deadline(1.0, start=time.monotonic() - 10.0)
+        ticket = svc.submit(PROMPTS[0], deadline=dead)
+        ticket.done.wait(timeout=60.0)
+        assert ticket.preempted
+    finally:
+        svc.stop()
+    assert DECODE_METRICS.snapshot()["preempted_total"] >= 1
+
+
+# ------------------------------------------------------- fused answer path
+
+
+def test_fused_rag_answer_path_on_chip():
+    """embed -> retrieve -> rerank -> generate without leaving the
+    device: the answer tokens must equal running ``decode_greedy`` by
+    hand over the spliced query+doc prompt."""
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.models.sentence_encoder import SentenceEncoder
+    from pathway_tpu.ops.fused_rag import FusedRagPipeline
+
+    ecfg = EncoderConfig(
+        vocab_size=30522,
+        hidden_size=32,
+        num_layers=1,
+        num_heads=2,
+        intermediate_size=64,
+        max_position=64,
+        pooling="mean",
+    )
+    enc = SentenceEncoder(config=ecfg, max_seq_len=64, max_batch=64)
+    pipe = FusedRagPipeline(enc, None, reserved_space=64, doc_seq_len=32)
+    pipe.add_docs(
+        ["tpu", "pelican", "joins"],
+        [
+            "tpus multiply matrices quickly",
+            "pelicans eat fish",
+            "streaming joins need watermarks",
+        ],
+    )
+    pipe.set_decoder(DecoderConfig(
+        vocab_size=2048,
+        hidden_size=16,
+        num_layers=1,
+        num_heads=2,
+        intermediate_size=32,
+        max_position=96,
+    ))
+    out = pipe.answer("what do pelicans eat", k=2, max_new=4)
+    assert len(out["hits"]) == 2
+    assert len(out["tokens"]) == 4
+    assert all(isinstance(t, int) for t in out["tokens"])
+    again = pipe.answer("what do pelicans eat", k=2, max_new=4)
+    assert out["tokens"] == again["tokens"]  # greedy decode is reproducible
+    bare = pipe.answer("what do pelicans eat", k=2, max_new=4, rerank=False)
+    assert len(bare["tokens"]) == 4

@@ -1,0 +1,191 @@
+"""Paged-KV decode attention (ops/paged_attention): CPU bitwise-parity
+suite via Pallas interpret mode — the same contract the fused encoder
+pins with ``fused_encoder_interpret``. For every (page_size, sequence
+bucket) combination the paged kernel must match the jitted
+gather-then-dense reference *bitwise*; the suite also covers dead
+(all-padding) pages, empty sequences, and non-contiguous shuffled page
+tables, plus the ``PagedKvPool`` host allocator contract."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.ops.paged_attention import (
+    PagedKvPool,
+    dense_decode_attention,
+    kv_pool_bytes,
+    paged_attention_reference,
+    paged_decode_attention,
+    pages_for,
+)
+
+N_HEADS = 2
+DIM = 8  # 2 heads x 4 — tiny on purpose: interpret mode is slow
+
+
+def _case(seed, batch, n_pages, page_size, pages_per_seq, lens):
+    """Random pool + page tables. Page tables are shuffled (pages are
+    deliberately NON-contiguous in the pool) and dead entries carry the
+    out-of-range sentinel ``n_pages`` to prove they are ignored."""
+    rng = np.random.default_rng(seed)
+    q = rng.normal(size=(batch, DIM)).astype(np.float32)
+    k_pages = rng.normal(size=(n_pages, page_size, DIM)).astype(np.float32)
+    v_pages = rng.normal(size=(n_pages, page_size, DIM)).astype(np.float32)
+    perm = rng.permutation(n_pages)
+    tables = np.full((batch, pages_per_seq), n_pages, np.int32)
+    used = 0
+    for b, ln in enumerate(lens):
+        need = pages_for(ln, page_size)
+        assert used + need <= n_pages, "test case over-allocates the pool"
+        tables[b, :need] = perm[used : used + need]
+        used += need
+    return (
+        jnp.asarray(q),
+        jnp.asarray(k_pages),
+        jnp.asarray(v_pages),
+        jnp.asarray(tables),
+        jnp.asarray(np.asarray(lens, np.int32)),
+    )
+
+
+def _assert_bitwise(args):
+    ref = jax.jit(
+        lambda *a: paged_attention_reference(*a, n_heads=N_HEADS)
+    )(*args)
+    out = paged_decode_attention(*args, n_heads=N_HEADS, interpret=True)
+    ref, out = np.asarray(ref), np.asarray(out)
+    assert ref.shape == out.shape
+    assert np.array_equal(ref, out), (
+        f"paged kernel diverged from reference: "
+        f"max abs diff {np.abs(ref - out).max()}"
+    )
+    return out
+
+
+# ---------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize("page_size", [4, 8, 16])
+@pytest.mark.parametrize("bucket", [8, 16, 32, 64])
+def test_parity_every_page_size_bucket_combo(page_size, bucket):
+    """The acceptance gate: for every (page_size, seq bucket) combo the
+    interpret-mode kernel equals the jitted reference bitwise — ragged
+    lengths inside the bucket included."""
+    pages_per_seq = pages_for(bucket, page_size)
+    lens = [bucket, max(1, bucket // 2), max(1, bucket - 3)]
+    n_pages = sum(pages_for(ln, page_size) for ln in lens) + 2
+    args = _case(
+        seed=page_size * 1000 + bucket,
+        batch=len(lens),
+        n_pages=n_pages,
+        page_size=page_size,
+        pages_per_seq=pages_per_seq,
+        lens=lens,
+    )
+    _assert_bitwise(args)
+
+
+def test_parity_all_padding_and_empty_rows():
+    """Rows whose context is empty (len=0 — every page dead) must come
+    out exactly zero, and partially-dead rows must be untouched by the
+    garbage in their dead pages."""
+    page_size, pages_per_seq = 8, 4
+    lens = [0, 1, 9, 32]  # empty / sub-page / page+1 / full
+    n_pages = sum(pages_for(ln, page_size) for ln in lens) + 1
+    args = _case(7, len(lens), n_pages, page_size, pages_per_seq, lens)
+    out = _assert_bitwise(args)
+    assert np.array_equal(out[0], np.zeros(DIM, np.float32))
+    assert not np.array_equal(out[3], np.zeros(DIM, np.float32))
+
+
+def test_parity_noncontiguous_tables_match_contiguous_context():
+    """A sequence scattered over shuffled pool slots must score exactly
+    like the same context laid out contiguously (dense reference)."""
+    page_size, ln = 4, 14
+    pages_per_seq = pages_for(16, page_size)
+    rng = np.random.default_rng(21)
+    q = jnp.asarray(rng.normal(size=(1, DIM)).astype(np.float32))
+    ctx = rng.normal(size=(pages_per_seq * page_size, DIM)).astype(np.float32)
+    vtx = rng.normal(size=(pages_per_seq * page_size, DIM)).astype(np.float32)
+    # scatter the contiguous context into a shuffled pool
+    n_pages = pages_per_seq + 3
+    order = rng.permutation(n_pages)[:pages_per_seq]
+    k_pages = np.zeros((n_pages, page_size, DIM), np.float32)
+    v_pages = np.zeros((n_pages, page_size, DIM), np.float32)
+    for logical, slot in enumerate(order):
+        k_pages[slot] = ctx[logical * page_size : (logical + 1) * page_size]
+        v_pages[slot] = vtx[logical * page_size : (logical + 1) * page_size]
+    tables = jnp.asarray(order[None].astype(np.int32))
+    lens = jnp.asarray(np.array([ln], np.int32))
+    paged = paged_decode_attention(
+        q, jnp.asarray(k_pages), jnp.asarray(v_pages), tables, lens,
+        n_heads=N_HEADS, interpret=True,
+    )
+    dense = jax.jit(
+        lambda *a: dense_decode_attention(*a, n_heads=N_HEADS)
+    )(q, jnp.asarray(ctx[None]), jnp.asarray(vtx[None]), lens)
+    assert np.array_equal(np.asarray(paged), np.asarray(dense))
+
+
+def test_dead_table_entries_are_ignored():
+    """Entries past ``pages_for(len)`` may be any value (the sentinel
+    included) without perturbing the output."""
+    page_size, pages_per_seq = 4, 8
+    lens = [10]
+    n_pages = 8
+    args = list(_case(3, 1, n_pages, page_size, pages_per_seq, lens))
+    base = _assert_bitwise(tuple(args))
+    tables = np.asarray(args[3]).copy()
+    tables[0, pages_for(10, page_size):] = 0  # in-range garbage instead
+    args[3] = jnp.asarray(tables)
+    again = _assert_bitwise(tuple(args))
+    assert np.array_equal(base, again)
+
+
+# ------------------------------------------------------------ pool math
+
+
+def test_pages_for_and_pool_bytes():
+    assert pages_for(0, 16) == 0
+    assert pages_for(1, 16) == 1
+    assert pages_for(16, 16) == 1
+    assert pages_for(17, 16) == 2
+    # 2 (K+V) x pages x page_size x layers x dim x dtype_bytes
+    assert kv_pool_bytes(256, 16, 4, 128) == 2 * 256 * 16 * 4 * 128 * 4
+
+
+def test_pool_alloc_free_lifecycle():
+    pool = PagedKvPool(layers=1, dim=8, n_pages=4, page_size=4)
+    assert pool.sentinel == 4
+    assert pool.pages_in_use == 0
+    a = pool.alloc(3)
+    assert a is not None and len(a) == 3 and pool.pages_in_use == 3
+    # never a partial grant: over-ask returns None and takes nothing
+    assert pool.alloc(2) is None
+    assert pool.pages_in_use == 3
+    pool.free(a[:1])
+    assert pool.pages_in_use == 2
+    b = pool.alloc(2)
+    assert b is not None and pool.pages_in_use == 4
+    pool.free(a[1:])
+    pool.free(b)
+    assert pool.pages_in_use == 0
+    assert pool.pool_bytes == 2 * 4 * 4 * 8 * 4
+
+
+def test_pool_rejects_double_free_and_foreign_pages():
+    pool = PagedKvPool(layers=1, dim=8, n_pages=2, page_size=4)
+    pages = pool.alloc(1)
+    pool.free(pages)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(pages)
+    with pytest.raises(ValueError, match="not in the pool"):
+        pool.free([99])
+    with pytest.raises(ValueError, match="negative"):
+        pool.alloc(-1)
+    with pytest.raises(ValueError, match="positive"):
+        PagedKvPool(layers=1, dim=8, n_pages=0, page_size=4)

@@ -275,3 +275,40 @@ def test_pwl012_env_knob_silences_cli(monkeypatch):
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "PWL012" not in proc.stdout
     assert "PWL010" not in proc.stdout
+
+
+def test_http_llm_with_decode_warns_pwl013():
+    """An HTTP LLM rerank hop in a run that configures the device
+    decode plane: PWL013 warns (exit 0), nonzero only under
+    --strict-warnings."""
+    fixture = os.path.join(FIXTURES, "http_llm_with_device_decode.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL013" in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--strict-warnings")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl013_json_carries_endpoints_and_decode_config():
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "http_llm_with_device_decode.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL013"]
+    assert diag["severity"] == "warning"
+    endpoints = diag["detail"]["llm_endpoints"]
+    assert endpoints and endpoints[0]["kind"] == "llm_reranker"
+    assert endpoints[0]["model"] == "gpt-x"
+    assert diag["detail"]["decode"]["pages"] == 128
+
+
+def test_pwl013_silent_without_decode_plane(monkeypatch):
+    """A pipeline that never configures the decode plane is PWL013-clean
+    even with HTTP LLM stages elsewhere in the suite's fixtures — the
+    rule only fires when the on-chip alternative is actually set up."""
+    monkeypatch.delenv("PATHWAY_DECODE", raising=False)
+    proc = _analyze_cli(os.path.join(FIXTURES, "host_bound_ingest.py"))
+    assert "PWL013" not in proc.stdout
