@@ -1387,6 +1387,7 @@ def suite_serving_qps() -> None:
     """
     import threading as _threading
 
+    from pathway_tpu import tracing as _trc
     from pathway_tpu.resilience import chaos as _chaos
     from pathway_tpu.serving import (
         AdaptiveBatcher,
@@ -1403,6 +1404,7 @@ def suite_serving_qps() -> None:
 
     def run_once(shed: bool):
         latencies: list[float] = []
+        journeys: list[tuple] = []  # (arrival, done, TraceContext)
         shed_count = [0]
         lock = _threading.Lock()
         metrics = ServingMetrics()
@@ -1423,6 +1425,8 @@ def suite_serving_qps() -> None:
                 for arrival, ticket in items:
                     latencies.append((done - arrival) * 1e3)
                     if ctl is not None and ticket is not None:
+                        if ticket.trace is not None:
+                            journeys.append((arrival, done, ticket.trace))
                         ctl.release(ticket)
 
         def on_expired(item):
@@ -1458,7 +1462,11 @@ def suite_serving_qps() -> None:
                             with lock:
                                 shed_count[0] += 1
                             continue
-                    batcher.submit((time.monotonic(), ticket), deadline)
+                    batcher.submit(
+                        (time.monotonic(), ticket),
+                        deadline,
+                        trace=ticket.trace if ticket is not None else None,
+                    )
                 time.sleep(PERIOD_S)
             # drain: give in-flight work (bounded when shedding) time out
             drain_until = time.monotonic() + (2.0 if shed else 10.0)
@@ -1468,6 +1476,16 @@ def suite_serving_qps() -> None:
             _chaos.deactivate()
             batcher.stop()
         wall = time.perf_counter() - t0
+        # close each journey's root span off the timed path: the member
+        # queue/dispatch spans are recorded by the batcher thread after
+        # the dispatch callback returns, so the root (whose finish
+        # completes the trace and triggers exemplar retention) must be
+        # recorded last — and recording here keeps tracing bookkeeping
+        # out of the measured window entirely
+        for arrival, done, trace in journeys:
+            _trc.record_span(
+                "request", start_mono=arrival, end_mono=done, root_of=trace
+            )
         offered = BURST * ROUNDS
         lat = sorted(latencies)
 
@@ -1512,6 +1530,84 @@ def suite_serving_qps() -> None:
         note="control: same arrivals with no admission/deadlines — the "
         "unbounded queue's p99 vs the shed-on bounded p99; >1 means the "
         "admission plane is buying bounded latency, not hiding work",
+    )
+
+    # -- request tracing: on/off overhead + tail attribution ------------
+    # Same shed-on workload run again with the per-request tracing plane
+    # enabled: every admitted query gets a journey (admission → queue →
+    # dispatch spans), the slowest ones survive as exemplars, and the
+    # tail-attribution report says where each slow request's wall went.
+    # Two gates ride on this: per-stage attribution must cover ≥95% of
+    # each reported request's wall, and tracing-on p50 must stay within
+    # 5% of tracing-off (min-of-2 per side to shed scheduler noise).
+    import tempfile as _tempfile
+
+    from pathway_tpu import tracing as _trc
+
+    off2 = run_once(shed=True)
+    prev_tracing = _trc.set_tracing_enabled(True)
+    try:
+        _trc.TRACE_STORE.reset()
+        traced_runs = [run_once(shed=True), run_once(shed=True)]
+        report = _trc.slow_report(_trc.TRACE_STORE.exemplar_traces(), top_n=10)
+        print(_trc.render_slow_report(report), flush=True)
+        dump_dir = _tempfile.mkdtemp(prefix="pathway-bench-traces-")
+        dump_path = _trc.TRACE_STORE.dump(dump_dir)
+    finally:
+        _trc.set_tracing_enabled(prev_tracing)
+
+    p50_off = min(on["p50_ms"], off2["p50_ms"])
+    p50_on = min(r["p50_ms"] for r in traced_runs)
+    rows = report["traces"]
+    min_coverage = min((r["coverage"] for r in rows), default=0.0)
+    _emit(
+        "serving_tracing_overhead_p50",
+        (p50_on / p50_off) if p50_off > 0 else float("inf"),
+        "ratio",
+        p50_on_ms=round(p50_on, 2),
+        p50_off_ms=round(p50_off, 2),
+        target="<1.05 (tracing must cost <5% p50)",
+        note="same shed-on workload, tracing off vs on; min-of-2 p50 "
+        "per side",
+    )
+    _emit(
+        "serving_tracing_attribution_coverage",
+        min_coverage,
+        "fraction",
+        traces=len(rows),
+        slowest_trace=rows[0]["trace_id"][:16] if rows else "",
+        slowest_wall_ms=rows[0]["wall_ms"] if rows else 0.0,
+        aggregate_pct=report["aggregate_pct"],
+        target=">=0.95 (stage spans must explain each slow request)",
+        note="min interval-union coverage across the top-10 slowest "
+        "retained exemplar traces",
+    )
+
+    # the post-mortem path must reproduce the same breakdown: dump the
+    # store and ask the CLI for its slow report over the dump files
+    cli_ok = 0.0
+    cli_note = "pathway trace slow over the run's dump"
+    try:
+        from click.testing import CliRunner
+
+        from pathway_tpu.cli import cli as _pathway_cli
+
+        res = CliRunner().invoke(
+            _pathway_cli, ["trace", "slow", "--dir", dump_dir, "--top", "10"]
+        )
+        print(res.output, flush=True)
+        if res.exit_code == 0 and rows and rows[0]["trace_id"][:16] in res.output:
+            cli_ok = 1.0
+        else:
+            cli_note = f"exit={res.exit_code}: {res.output[:160]!r}"
+    except Exception as exc:  # pragma: no cover - bench robustness
+        cli_note = f"{type(exc).__name__}: {exc}"
+    _emit(
+        "serving_tracing_cli_roundtrip",
+        cli_ok,
+        "bool",
+        dump=dump_path or "",
+        note=cli_note,
     )
 
 

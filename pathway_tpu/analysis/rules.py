@@ -49,6 +49,12 @@ PWL013 (warning) HTTP LLM stage (LLMReranker / chat UDF) in a pipeline
                  rerank/generate hop can run on-chip (KNNIndex
                  rerank= / decode.DecodeService) instead of paying a
                  network round-trip per pair/message.
+PWL014 (warning) serving endpoint with a deadline/SLO budget in a run
+                 where tracing and the profiler are both off — a missed
+                 deadline surfaces as a 503 with no record of which
+                 stage spent the budget; pw.run(tracing=True) /
+                 PATHWAY_TRACING (or profile=) makes the tail
+                 attributable.
 """
 
 from __future__ import annotations
@@ -96,6 +102,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL011": (Severity.WARNING, "host-bound ingest feeding a device model"),
     "PWL012": (Severity.WARNING, "beyond-HBM index without a cold tier"),
     "PWL013": (Severity.WARNING, "HTTP LLM stage with a device decode plane available"),
+    "PWL014": (Severity.WARNING, "SLO-budgeted endpoint with tracing and profiler off"),
 }
 
 _MUTABLE_TYPES = (list, dict, set, bytearray)
@@ -1079,6 +1086,54 @@ def check_http_llm_with_device_decode(view: GraphView) -> list[Diagnostic]:
     ]
 
 
+# --------------------------------------------------------------------------
+# PWL014 — SLO budget with no observability to attribute it
+
+
+def check_slo_without_tracing(view: GraphView) -> list[Diagnostic]:
+    """A serving endpoint declares a per-request deadline budget
+    (``ServingConfig(default_deadline_ms=...)``) but the run has
+    neither the request tracing plane (``pw.run(tracing=True)`` /
+    PATHWAY_TRACING) nor the profiler (``profile=`` / PATHWAY_PROFILE)
+    on. The budget WILL be missed eventually — and every miss surfaces
+    as a bare 429/503 with no record of which stage (queue, batch,
+    index, rerank, decode) actually spent it. Either observability
+    plane makes the tail attributable: tracing retains the slowest
+    complete journeys per window (``pathway trace slow``), the profiler
+    writes per-operator timings. Endpoints are recorded on the parse
+    graph by ``rest_connector`` (``serving_endpoints``, carrying
+    ``deadline_ms``); the run's tracing/profiler intent by ``pw.run``
+    (``run_context``)."""
+    endpoints = getattr(view.graph, "serving_endpoints", None) or []
+    budgeted = [e for e in endpoints if e.get("deadline_ms")]
+    if not budgeted:
+        return []
+    ctx = getattr(view.graph, "run_context", None) or {}
+    if not ctx:
+        return []  # no pw.run configuration recorded (unit-built graph)
+    if ctx.get("tracing") or ctx.get("profile"):
+        return []
+    routes = ", ".join(sorted(str(e.get("route", "?")) for e in budgeted))
+    return [
+        _diag(
+            "PWL014",
+            f"serving endpoint(s) {routes} enforce a per-request "
+            "deadline budget but tracing and the profiler are both "
+            "off: a missed deadline sheds as a bare 429/503 with no "
+            "record of which stage spent the budget. Turn on "
+            "pw.run(tracing=True) (or PATHWAY_TRACING=1) to retain "
+            "the slowest request journeys with per-stage attribution "
+            "(`pathway trace slow`), or profile= for per-operator "
+            "timings",
+            detail={
+                "endpoints": budgeted,
+                "tracing": bool(ctx.get("tracing")),
+                "profile": bool(ctx.get("profile")),
+            },
+        )
+    ]
+
+
 LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dtype_consistency,
     check_unbounded_state,
@@ -1093,4 +1148,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_index_tier_budget,
     check_host_bound_ingest,
     check_http_llm_with_device_decode,
+    check_slo_without_tracing,
 ]

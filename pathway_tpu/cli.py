@@ -284,6 +284,159 @@ def blackbox_diff(path_a, path_b):
     click.echo(fr.diff(a, b))
 
 
+@cli.group()
+def trace():
+    """Inspect per-request trace dumps.
+
+    A run with tracing on (``pw.run(tracing=True)`` / PATHWAY_TRACING)
+    records a span per pipeline stage each request touches and retains
+    the slowest complete traces per window; at run end they are written
+    to a timestamped JSON file. These commands list the dumps, render
+    one request's journey as a waterfall (cross-linked with black-box
+    flight-recorder events), and answer "where did the tail go".
+    """
+
+
+def _trace_dumps(directory):
+    from .tracing import store as ts
+
+    directory = directory or ts.default_trace_dir()
+    paths = ts.list_trace_dumps(directory)
+    return directory, paths
+
+
+_TRACE_DIR_HELP = "trace dump directory [default: PATHWAY_TRACE_DIR or <tmp>/pathway-traces]"
+
+
+@trace.command(name="list")
+@click.option("--dir", "directory", default=None, help=_TRACE_DIR_HELP)
+def trace_list(directory):
+    """List trace dumps and the slowest retained trace of each."""
+    from .tracing import store as ts
+
+    directory, paths = _trace_dumps(directory)
+    if not paths:
+        click.echo(f"no trace dumps in {directory}")
+        return
+    for path in paths:
+        try:
+            data = ts.load_trace_dump(path)
+        except Exception as exc:
+            click.echo(f"{path}  <unreadable: {exc}>")
+            continue
+        exemplars = data.get("exemplars", [])
+        head = ""
+        if exemplars:
+            worst = exemplars[0]
+            head = (
+                f" slowest={worst.get('trace_id', '?')[:16]}"
+                f" ({worst.get('wall_ms', 0.0):.1f} ms)"
+            )
+        click.echo(
+            f"{path}  pid={data.get('pid', '?')}"
+            f" worker={data.get('worker', '?')}"
+            f" exemplars={len(exemplars)}"
+            f" open={len(data.get('open', []))}" + head
+        )
+
+
+def _collect_trace(paths, trace_id):
+    """Spans for ``trace_id`` (unique-prefix match allowed) across all
+    dumps — a journey fans out over coordinator + worker processes, so
+    one dump rarely holds the whole picture."""
+    from .tracing import store as ts
+
+    matches: dict[str, list[dict]] = {}
+    for path in paths:
+        try:
+            data = ts.load_trace_dump(path)
+        except Exception:
+            continue
+        buckets = [
+            tr.get("spans", []) for tr in data.get("exemplars", [])
+        ] + [data.get("recent", []), data.get("open", [])]
+        for spans in buckets:
+            for sp in spans:
+                tid = str(sp.get("trace", ""))
+                if tid.startswith(trace_id):
+                    matches.setdefault(tid, []).append(sp)
+    if len(matches) > 1:
+        raise click.ClickException(
+            f"trace id prefix {trace_id!r} is ambiguous: "
+            + ", ".join(sorted(matches))
+        )
+    if not matches:
+        return trace_id, []
+    tid, spans = next(iter(matches.items()))
+    seen: set[str] = set()
+    unique = []
+    for sp in sorted(spans, key=lambda s: float(s.get("start", 0.0))):
+        sid = str(sp.get("span", ""))
+        if sid in seen:
+            continue
+        seen.add(sid)
+        unique.append(sp)
+    return tid, unique
+
+
+@trace.command(name="show")
+@click.option("--dir", "directory", default=None, help=_TRACE_DIR_HELP)
+@click.option(
+    "--no-blackbox",
+    is_flag=True,
+    help="skip scanning flight-recorder dumps for matching events",
+)
+@click.argument("trace_id", required=True)
+def trace_show(directory, no_blackbox, trace_id):
+    """Render one request's journey as a stage waterfall.
+
+    Flight-recorder events carrying the same trace id (sheds, degrades,
+    chaos hits) are interleaved at their timestamps.
+    """
+    from .tracing.attribution import render_waterfall
+
+    directory, paths = _trace_dumps(directory)
+    if not paths:
+        raise click.ClickException(f"no trace dumps in {directory}")
+    tid, spans = _collect_trace(paths, trace_id)
+    if not spans:
+        raise click.ClickException(f"trace {trace_id!r} not found in {directory}")
+    events = []
+    if not no_blackbox:
+        from .internals import flight_recorder as fr
+
+        try:
+            events = fr.events_for_trace(tid)
+        except Exception:
+            events = []
+    click.echo(render_waterfall(tid, spans, blackbox_events=events))
+
+
+@trace.command(name="slow")
+@click.option("--dir", "directory", default=None, help=_TRACE_DIR_HELP)
+@click.option(
+    "--top", "top_n", default=10, show_default=True, help="how many traces"
+)
+def trace_slow(directory, top_n):
+    """Tail-latency report: the slowest retained traces with per-stage
+    attribution, plus the aggregate "where the tail went" line."""
+    from .tracing import store as ts
+    from .tracing.attribution import render_slow_report, slow_report
+
+    directory, paths = _trace_dumps(directory)
+    if not paths:
+        raise click.ClickException(f"no trace dumps in {directory}")
+    exemplars = []
+    for path in paths:
+        try:
+            exemplars.extend(ts.load_trace_dump(path).get("exemplars", []))
+        except Exception:
+            continue
+    if not exemplars:
+        raise click.ClickException(f"no retained exemplars in {directory}")
+    click.echo(render_slow_report(slow_report(exemplars, top_n=top_n)))
+
+
 def main() -> None:
     cli()
 

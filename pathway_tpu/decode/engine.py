@@ -269,9 +269,10 @@ class DecodeTicket:
         "tokens",
         "preempted",
         "done",
+        "trace",
     )
 
-    def __init__(self, prompt, max_new, deadline, degraded):
+    def __init__(self, prompt, max_new, deadline, degraded, trace=None):
         self.prompt = list(prompt)
         self.max_new = max_new
         self.deadline = deadline
@@ -280,6 +281,9 @@ class DecodeTicket:
         self.tokens: list[int] = []
         self.preempted = False
         self.done = threading.Event()
+        # request-journey trace of the submitting request (per-tick
+        # decode_step spans link the live lanes' traces)
+        self.trace = trace
 
     def result(self, timeout: float | None = None) -> list[int]:
         """Block for the final token stream (may be short if the query
@@ -365,7 +369,10 @@ class DecodeEngine:
                 f"exceeds the context limit {self.max_prompt_len()}"
             )
         DECODE_METRICS.record_query(degraded=degraded)
-        return DecodeTicket(prompt, max_new, deadline, degraded)
+        from ..tracing import current_trace, tracing_enabled
+
+        trace = current_trace() if tracing_enabled() else None
+        return DecodeTicket(prompt, max_new, deadline, degraded, trace=trace)
 
     def enqueue(self, ticket: DecodeTicket) -> None:
         self._pending.append(ticket)
@@ -578,6 +585,9 @@ class DecodeEngine:
         toks = np.zeros(self.config.lanes, np.int32)
         for i in live:
             toks[i] = self._lanes[i].ticket.tokens[-1]
+        # captured before the commit loop finishes lanes (a finished
+        # lane's journey still belongs to this tick's step span)
+        lane_tickets = [self._lanes[i].ticket for i in live]
         w0 = _time.monotonic()
         nxt, new_k, new_v = self._step_fn()(
             self.params,
@@ -610,6 +620,25 @@ class DecodeEngine:
             tokens=emitted,
             wall_ms=round(wall * 1000.0, 3),
         )
+        from ..tracing import record_span, tracing_enabled
+
+        if tracing_enabled():
+            lane_traces = tuple(
+                {t.trace.trace_id for t in lane_tickets if t.trace is not None}
+            )
+            if lane_traces:
+                # one fused tick serves N lanes: the step span gets its
+                # own trace and links every member request journey
+                record_span(
+                    "decode_step",
+                    start_mono=w0,
+                    end_mono=w0 + wall,
+                    new_trace=True,
+                    links=lane_traces,
+                    step=self.steps - 1,
+                    batch=len(live),
+                    tokens=emitted,
+                )
         return emitted
 
     def busy(self) -> bool:

@@ -149,6 +149,18 @@ class FlightRecorder:
                     "message": str(error),
                 }
             header["events"] = self.events()
+            # a crash mid-request must not lose the journey: spans
+            # still open in the tracing plane ride along (this runs
+            # in-process before chaos kill signals, so even SIGKILL
+            # leaves the in-flight request attributable)
+            try:
+                from ..tracing import TRACE_STORE
+
+                open_spans = TRACE_STORE.open_spans()
+                if open_spans:
+                    header["open_trace_spans"] = open_spans
+            except Exception:
+                pass
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(header, f, indent=1, default=repr)
@@ -260,7 +272,55 @@ def render(dump_data: dict[str, Any], tail_epochs: int = 3) -> str:
     lines.append(f"events ({len(events)} ringed):")
     for ev in events:
         lines.append("  " + _format_event(ev))
+    open_spans = dump_data.get("open_trace_spans") or []
+    if open_spans:
+        lines.append("")
+        lines.append(f"open request spans at dump ({len(open_spans)} in flight):")
+        for sp in open_spans:
+            lines.append(
+                f"  trace={sp.get('trace', '?')} stage={sp.get('stage', '?')} "
+                f"open for {sp.get('dur_ms', 0.0):.3f} ms [w{sp.get('worker', 0)}]"
+            )
+    traced = sorted(
+        {str(ev["trace"]) for ev in events if ev.get("trace")}
+        | {str(sp["trace"]) for sp in open_spans if sp.get("trace")}
+    )
+    if traced:
+        lines.append("")
+        lines.append(
+            "traces referenced (cross-link with `pathway trace show <id>`):"
+        )
+        for tid in traced:
+            lines.append(f"  {tid}")
     return "\n".join(lines)
+
+
+def events_for_trace(trace_id: str, directory: str | None = None) -> list[dict]:
+    """Flight-recorder events carrying ``trace=<id>`` across all dumps
+    in a directory — ``pathway trace show`` folds these into the
+    waterfall so a shed or chaos hit shows up on the request timeline."""
+    out: list[dict] = []
+    for path in list_dumps(directory):
+        try:
+            data = load_dump(path)
+        except (OSError, ValueError):
+            continue
+        for ev in data.get("events", []):
+            if str(ev.get("trace", "")) == trace_id:
+                out.append(ev)
+        for sp in data.get("open_trace_spans", []) or []:
+            if str(sp.get("trace", "")) == trace_id:
+                out.append(
+                    {
+                        "time": sp.get("start", 0.0),
+                        "kind": "trace.open_span",
+                        "stage": sp.get("stage"),
+                        "dur_ms": sp.get("dur_ms"),
+                        "trace": trace_id,
+                    }
+                )
+    out.sort(key=lambda ev: ev.get("time", 0.0))
+    return out
 
 
 def _format_event(ev: dict[str, Any]) -> str:

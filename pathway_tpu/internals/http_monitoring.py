@@ -218,6 +218,7 @@ class MonitoringHttpServer:
         lines.extend(self._index_lines(wl))
         lines.extend(self._ingest_lines(wl))
         lines.extend(self._decode_lines(wl))
+        lines.extend(self._tracing_lines(wl))
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -651,6 +652,46 @@ class MonitoringHttpServer:
             lines.append(series(f"{metric}_count", hist.count))
         return lines
 
+    @staticmethod
+    def _tracing_lines(wl: str = "") -> list[str]:
+        """Request tracing plane (``pathway_request_stage_seconds``):
+        per-stage latency histograms whose buckets carry OpenMetrics
+        trace-id exemplars (``# {trace_id="..."} value ts``), so a
+        dashboard's slow bucket links straight to
+        ``pathway trace show <id>``. Rendered only once a span has been
+        recorded — a tracing-off run scrapes byte-identical output."""
+        from ..tracing import TRACING_METRICS
+
+        if not TRACING_METRICS.active():
+            return []
+
+        def series(name: str, value, labels: str = "", exemplar: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            line = f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+            return line + exemplar
+
+        metric = "pathway_request_stage_seconds"
+        lines = [f"# TYPE {metric} histogram"]
+        for row in TRACING_METRICS.series():
+            labels = (
+                f'stage="{_escape_label(row["stage"])}",worker="{row["worker"]}"'
+            )
+            for le, cum, ex in row["buckets"]:
+                exemplar = ""
+                if ex is not None:
+                    tid, val, ts = ex
+                    exemplar = (
+                        f' # {{trace_id="{tid}"}} {val:.9f} {ts:.3f}'
+                    )
+                lines.append(
+                    series(
+                        f"{metric}_bucket", cum, f'{labels},le="{le}"', exemplar
+                    )
+                )
+            lines.append(series(f"{metric}_sum", f"{row['sum']:.9f}", labels))
+            lines.append(series(f"{metric}_count", row["count"], labels))
+        return lines
+
     def _status(self) -> str:
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
 
@@ -701,6 +742,13 @@ class MonitoringHttpServer:
 
         if DECODE_METRICS.active():
             status["decode"] = DECODE_METRICS.snapshot()
+        from ..tracing import TRACE_STORE, TRACING_METRICS
+
+        if TRACING_METRICS.active() or TRACE_STORE.active():
+            status["tracing"] = {
+                "stages": TRACING_METRICS.snapshot(),
+                **TRACE_STORE.snapshot(),
+            }
         return json.dumps(status)
 
     # -- lifecycle --

@@ -169,6 +169,13 @@ class StatsSnapshot:
     decode_kv_pages_in_use: int = 0
     decode_kv_page_pool: int = 0
     decode_preempted: int = 0
+    #: request tracing plane (pathway_tpu/tracing/): span/trace counts
+    #: and retained slow-trace exemplars. All zero when tracing never
+    #: ran, keeping rendering byte-identical for untraced pipelines.
+    trace_spans: int = 0
+    trace_traces: int = 0
+    trace_open_spans: int = 0
+    trace_exemplars: int = 0
     #: cluster telemetry plane: worker_id -> per-worker stats dict
     #: (epoch, rows_in, rows_out, rows_per_s, event_lag_s,
     #: overlap_ratio, restarts, pid). Empty outside sharded /
@@ -305,6 +312,14 @@ class StatsMonitor:
             snap.decode_kv_pages_in_use = dec["kv_pages_in_use"]
             snap.decode_kv_page_pool = dec["kv_page_pool"]
             snap.decode_preempted = dec["preempted_total"]
+        from ..tracing import TRACE_STORE
+
+        if TRACE_STORE.active():
+            tr = TRACE_STORE.snapshot()
+            snap.trace_spans = tr["spans_total"]
+            snap.trace_traces = tr["traces_total"]
+            snap.trace_open_spans = tr["open_spans"]
+            snap.trace_exemplars = tr["exemplars_retained"]
         for node in engine.nodes:
             rows_in, rows_out = node.stats.rows_in, node.stats.rows_out
             key = f"{node.id}:{node.name}"

@@ -445,6 +445,44 @@ class RunProfiler:
                     "args": {"name": "jit"},
                 }
             )
+        # retained request-journey exemplars (tracing plane) render as
+        # their own track, laying the run's slowest requests out
+        # against operator/jit time in the same Perfetto view
+        try:
+            from ..tracing import TRACE_STORE
+
+            _exemplars = TRACE_STORE.exemplar_traces()
+        except Exception:
+            _exemplars = []
+        if _exemplars:
+            req_tid = jit_tid + 1
+            meta.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": 0,
+                    "tid": req_tid,
+                    "args": {"name": "requests (slowest traces)"},
+                }
+            )
+            for t in _exemplars:
+                for sp in t.get("spans", ()):
+                    ts_us = (sp["start"] * 1e9 - self._t0_unix_ns) / 1e3
+                    events.append(
+                        {
+                            "name": sp["stage"],
+                            "ph": "X",
+                            "pid": 0,
+                            "tid": req_tid,
+                            "ts": ts_us,
+                            "dur": sp["dur_ms"] * 1e3,
+                            "cat": "request",
+                            "args": {
+                                "trace": sp["trace"],
+                                "span": sp["span"],
+                            },
+                        }
+                    )
         return {
             "traceEvents": meta + events,
             "displayTimeUnit": "ms",

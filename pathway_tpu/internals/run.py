@@ -29,11 +29,13 @@ class RunResult:
     ``serving_http_ports`` lists the ports the run's serving endpoints
     (``rest_connector`` / ``PathwayWebserver``) actually bound —
     explicit ports, ``port=0``, and the ephemeral-port fallback all
-    resolve here."""
+    resolve here. ``trace_dumps`` lists the request-trace exemplar
+    files this run wrote (``tracing=True`` / PATHWAY_TRACING)."""
 
     monitoring_http_port: int | None = None
     flight_recorder_dumps: list[str] = field(default_factory=list)
     serving_http_ports: list[int] = field(default_factory=list)
+    trace_dumps: list[str] = field(default_factory=list)
 
 
 def _run_analysis(mode: str | None) -> None:
@@ -72,6 +74,7 @@ def run(
     terminate_on_error: bool = True,
     analysis: str | None = None,
     profile: Any = None,
+    tracing: Any = None,
     recovery: Any = None,
     pipeline_depth: int | None = None,
     ingest_workers: int | None = None,
@@ -92,6 +95,12 @@ def run(
     Perfetto / chrome://tracing); ``profile=True`` uses
     ``pathway_profile.json``. The PATHWAY_PROFILE env var (set by the
     ``pathway profile`` CLI) supplies the path when the arg is None.
+
+    ``tracing``: ``True`` turns on the per-request tracing plane for
+    this run (spans for admission, batching, index search, decode…;
+    slowest-trace exemplars dumped to PATHWAY_TRACE_DIR at run end and
+    browsable with ``pathway trace``). Defaults to the PATHWAY_TRACING
+    env var; ``tracing=False`` overrides an env-enabled plane.
     ``monitoring_http_port``: explicit /metrics port for
     ``with_http_server`` (0 = ephemeral); default 20000 + process_id.
 
@@ -211,6 +220,14 @@ def run(
         _decode_cfg = parse_decode_spec(_decode_spec)
     except ValueError:
         _decode_cfg = None
+    # explicit tracing= wins over PATHWAY_TRACING (tracing=False turns
+    # an env-enabled plane off for this run)
+    _tracing_on = (
+        bool(tracing)
+        if tracing is not None
+        else str(os.environ.get("PATHWAY_TRACING", "")).strip().lower()
+        in ("1", "true", "yes", "on")
+    )
     G.run_context = {
         "recovery": bool(recovery),
         "monitoring_level": monitoring_level,
@@ -235,6 +252,10 @@ def run(
         # device decode plane available) treats a configured decode as
         # the on-chip alternative being ready
         "decode": _decode_cfg.as_dict() if _decode_cfg is not None else None,
+        # request-journey tracing + profiler intent, resolved jax-free;
+        # PWL014 (SLO budget with no observability) reads both
+        "tracing": _tracing_on,
+        "profile": bool(profile) or bool(os.environ.get("PATHWAY_PROFILE")),
     }
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
@@ -277,6 +298,12 @@ def run(
         from .profiler import RunProfiler, set_current_profiler
 
         profiler = RunProfiler()
+    # request-journey tracing plane: installed for the whole run (the
+    # admission/batching/index/decode span sites read the module flag),
+    # restored on exit so nested test runs do not leak the setting
+    from .. import tracing as _req_tracing
+
+    _prev_tracing = _req_tracing.set_tracing_enabled(_tracing_on)
 
     n_workers = max(1, pwcfg.threads)
     processes = max(1, pwcfg.processes)
@@ -558,6 +585,10 @@ def run(
                 # per-operator child spans nest under the run span and
                 # must land before the flush posts /v1/traces
                 profiler.emit_telemetry(telemetry, parent=run_span)
+            if _tracing_on and telemetry.enabled:
+                # retained request-journey exemplars ride the same OTLP
+                # flush, with their real trace/span ids preserved
+                _req_tracing.emit_telemetry(telemetry)
             telemetry.flush()
             if profiler is not None and profile_path is not None:
                 profiler.write_chrome_trace(profile_path)
@@ -572,6 +603,12 @@ def run(
             result.flight_recorder_dumps = list(
                 flight_recorder.RECORDER._dumped_paths[dumps_before:]
             )
+            if _tracing_on:
+                tp = _req_tracing.TRACE_STORE.dump()
+                if tp:
+                    result.trace_dumps.append(tp)
+                    logger.info("request trace dump written to %s", tp)
+            _req_tracing.set_tracing_enabled(_prev_tracing)
     try:
         from ..io.http._server import bound_serving_ports
 

@@ -35,6 +35,9 @@ class Span:
     end_unix_ns: int = 0
     span_id: str = ""
     parent_span_id: str = ""
+    #: per-span trace override: request-journey spans (the tracing
+    #: plane) keep their own W3C trace id instead of the run's
+    trace_id: str = ""
 
     @property
     def duration_ms(self) -> float:
@@ -103,10 +106,15 @@ class Telemetry:
         end_unix_ns: int,
         parent: "Span | None" = None,
         attrs: dict | None = None,
+        trace_id: str = "",
+        span_id: str = "",
+        parent_span_id: str = "",
     ) -> Span:
         """Record an already-measured span (the profiler replays its
         per-operator timings here after the run); nests under ``parent``
-        via parentSpanId while sharing this run's trace_id."""
+        via parentSpanId while sharing this run's trace_id. The tracing
+        plane passes explicit ``trace_id``/``span_id``/``parent_span_id``
+        so request journeys export under their real W3C ids."""
         s = Span(
             name,
             time.monotonic(),
@@ -114,8 +122,13 @@ class Telemetry:
             attrs=dict(attrs or {}),
             start_unix_ns=start_unix_ns,
             end_unix_ns=end_unix_ns,
-            span_id=secrets.token_hex(8),
-            parent_span_id=parent.span_id if parent is not None else "",
+            span_id=span_id or secrets.token_hex(8),
+            parent_span_id=(
+                parent_span_id
+                if parent_span_id
+                else (parent.span_id if parent is not None else "")
+            ),
+            trace_id=trace_id,
         )
         self.spans.append(s)
         return s
@@ -136,7 +149,7 @@ class Telemetry:
     def otlp_traces_payload(self) -> dict:
         spans = [
             {
-                "traceId": self.trace_id,
+                "traceId": s.trace_id or self.trace_id,
                 "spanId": s.span_id or secrets.token_hex(8),
                 **({"parentSpanId": s.parent_span_id} if s.parent_span_id else {}),
                 "name": s.name,

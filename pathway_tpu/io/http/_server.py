@@ -206,12 +206,25 @@ def rest_connector(
         SERVING_METRICS,
         AdaptiveBatcher,
     )
+    from ...tracing import (
+        TRACE_RESPONSE_HEADER,
+        TRACEPARENT_HEADER,
+        TraceContext,
+        span as trace_span,
+        tracing_enabled,
+    )
 
-    # the analysis rule PWL008 reads this registry off the parse graph:
-    # a serving endpoint with no overload protection on a recovering or
-    # pipelined run is worth a warning before it melts under load
+    # the analysis rules read this registry off the parse graph: PWL008
+    # flags a serving endpoint with no overload protection on a
+    # recovering or pipelined run; PWL014 flags an SLO budget
+    # (deadline_ms) with no tracing or profiler to attribute it
     G.serving_endpoints.append(
-        {"route": route, "kind": "rest_connector", "protected": serving is not None}
+        {
+            "route": route,
+            "kind": "rest_connector",
+            "protected": serving is not None,
+            "deadline_ms": serving.default_deadline_ms if serving is not None else None,
+        }
     )
 
     admission = (
@@ -245,35 +258,54 @@ def rest_connector(
         log_ctx = _LoggingContext(request, qid)
         t_start = asyncio.get_running_loop().time()
 
-        def respond(data, status=200, headers=None):
-            log_ctx.log_response(status)
-            return web.json_response(data, status=status, headers=headers)
+        # request-journey tracing: continue the client's W3C trace if a
+        # traceparent header came in, else start a fresh trace; the root
+        # "request" span covers the whole handler and every response —
+        # including 429/503 sheds and degraded replies — echoes the
+        # trace id in X-Pathway-Trace
+        inbound = None
+        if tracing_enabled():
+            inbound = TraceContext.from_traceparent(
+                request.headers.get(TRACEPARENT_HEADER)
+            )
+        with trace_span(
+            "request", ctx=inbound, new_trace=True, boundary=True, route=route
+        ) as root_sp:
+            trace_id = root_sp.trace_id if root_sp is not None else ""
 
-        # per-request deadline: client header wins, then the serving
-        # config's server default, then unbounded
-        deadline = Deadline.from_header(
-            request.headers.get(DEADLINE_HEADER),
-            serving.default_deadline_ms if serving is not None else None,
-        )
+            def respond(data, status=200, headers=None):
+                if trace_id:
+                    headers = dict(headers or {})
+                    headers[TRACE_RESPONSE_HEADER] = trace_id
+                log_ctx.log_response(status)
+                return web.json_response(data, status=status, headers=headers)
 
-        ticket = None
-        if admission is not None:
-            if batcher.error is not None:
-                return respond(
-                    {"error": f"serving plane failed: {batcher.error!r}"}, status=500
-                )
+            # per-request deadline: client header wins, then the serving
+            # config's server default, then unbounded
+            deadline = Deadline.from_header(
+                request.headers.get(DEADLINE_HEADER),
+                serving.default_deadline_ms if serving is not None else None,
+            )
+
+            ticket = None
+            if admission is not None:
+                if batcher.error is not None:
+                    return respond(
+                        {"error": f"serving plane failed: {batcher.error!r}"},
+                        status=500,
+                    )
+                try:
+                    ticket = admission.admit(deadline)
+                except OverloadError as exc:
+                    return _overload_response(respond, exc)
             try:
-                ticket = admission.admit(deadline)
-            except OverloadError as exc:
-                return _overload_response(respond, exc)
-        try:
-            return await _serve_admitted(request, respond, deadline, ticket, qid)
-        finally:
-            if admission is not None and ticket is not None:
-                admission.release(ticket)
-                SERVING_METRICS.observe_stage(
-                    "total", asyncio.get_running_loop().time() - t_start
-                )
+                return await _serve_admitted(request, respond, deadline, ticket, qid)
+            finally:
+                if admission is not None and ticket is not None:
+                    admission.release(ticket)
+                    SERVING_METRICS.observe_stage(
+                        "total", asyncio.get_running_loop().time() - t_start
+                    )
 
     async def _serve_admitted(request, respond, deadline, ticket, qid):
         if request.method == "GET":
@@ -338,7 +370,12 @@ def rest_connector(
         remaining = deadline.remaining()
         timeout = min(remaining, 120.0)
         try:
-            result = await asyncio.wait_for(fut, timeout=timeout)
+            # the wait for the engine to produce the reply — the part
+            # of the journey the serving queue/dispatch spans don't
+            # cover, so slow pipelines show up in the attribution
+            # instead of as an unexplained gap
+            with trace_span("pipeline"):
+                result = await asyncio.wait_for(fut, timeout=timeout)
         except asyncio.TimeoutError:
             if remaining >= 120.0:
                 return respond({"error": "timeout"}, status=504)

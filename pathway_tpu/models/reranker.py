@@ -52,7 +52,12 @@ class DeviceReranker:
             return []
         import numpy as np
 
-        return [float(s) for s in np.asarray(self.scorer.score(list(pairs)))]
+        from ..tracing import span as _trace_span
+
+        with _trace_span("rerank", pairs=len(pairs)):
+            return [
+                float(s) for s in np.asarray(self.scorer.score(list(pairs)))
+            ]
 
     def order(self, query: str, docs) -> tuple[int, ...]:
         """Permutation of ``docs`` by descending device score (stable:

@@ -943,7 +943,16 @@ class DeviceKnnIndex:
                 return self._sharded_topk(q[todo], fetch)
             return fn(self._dev_matrix, self._dev_valid, q[todo], fetch)
 
-        out = self._assemble(len(q), k, filter_fns, dispatch)
+        from ..tracing import span as _trace_span
+
+        with _trace_span(
+            "index_search",
+            index=self.name,
+            queries=len(q),
+            k=k,
+            shards=self.n_shards,
+        ):
+            out = self._assemble(len(q), k, filter_fns, dispatch)
         self._record_search(len(q), k)
         return out
 
@@ -992,6 +1001,7 @@ class DeviceKnnIndex:
         import jax
 
         from .index_metrics import INDEX_METRICS
+        from ..tracing import current_trace, record_span, tracing_enabled
 
         fns = _mesh_fns(self.mesh)
         rows = int(self._dev_matrix.shape[0]) // self.n_shards
@@ -1004,20 +1014,41 @@ class DeviceKnnIndex:
             qd = handles[0]
         else:
             qd = queries
+        # a bound request trace forces phase timing too: the journey
+        # wants per-shard local top-k and merge as separate spans
+        traced = block and tracing_enabled() and current_trace() is not None
+        l0 = time.monotonic()
         vals, idx = fns["local_topk"](
             self._dev_matrix, self._dev_valid, qd, k_local=k_local, l2=l2
         )
-        timing = block and INDEX_METRICS.active()
-        t0 = None
+        timing = block and (INDEX_METRICS.active() or traced)
+        t0 = m0 = None
         if timing:
             jax.block_until_ready((vals, idx))
             t0 = time.perf_counter()
+            m0 = time.monotonic()
+            if traced:
+                record_span(
+                    "index_local_topk",
+                    start_mono=l0,
+                    end_mono=m0,
+                    shards=self.n_shards,
+                    k_local=k_local,
+                )
         out_v, out_i = fns["merge_topk"](vals, idx, qd, k=k_final, l2=l2)
         if block:
             jax.block_until_ready((out_v, out_i))
             if t0 is not None:
                 self._last_merge_s = time.perf_counter() - t0
                 INDEX_METRICS.observe_merge(self._last_merge_s)
+                if traced:
+                    record_span(
+                        "index_merge",
+                        start_mono=m0,
+                        end_mono=time.monotonic(),
+                        shards=self.n_shards,
+                        k=k_final,
+                    )
             if handles is not None:
                 self._query_ring.retire(handles)
         return out_v, out_i

@@ -697,12 +697,16 @@ class TieredKnnIndex:
         cold gather/rescore through the ring, host merge."""
         import time as _time
 
+        from ..tracing import record_span
+
         nq = len(q)
         # 1. hot path dispatches FIRST and never waits on tiering work
+        h0 = _time.monotonic()
         hot_disp = None
         if len(self.hot._slot_of):
             hot_disp = self.hot.search_dispatch(q, fetch)
         # 2. probe centroids host-side (tiny [q, C] matmul)
+        p0 = _time.monotonic()
         probed = self._probe(q)
         # 3. gather cold candidates of every probed cluster
         need = sorted(
@@ -711,18 +715,46 @@ class TieredKnnIndex:
         cand_keys: list = []
         for c in need:
             cand_keys.extend(self._cold_keys[c])
+        record_span(
+            "tier_cold_probe",
+            start_mono=p0,
+            end_mono=_time.monotonic(),
+            clusters=len(need),
+        )
         cold_scores = None
         cold_fetch_s = 0.0
         if cand_keys:
             t0 = _time.perf_counter()
+            g0 = _time.monotonic()
             cvecs = self._cold.fetch([self._cold_slot[key] for key in cand_keys])
+            g1 = _time.monotonic()
+            record_span(
+                "tier_cold_gather",
+                start_mono=g0,
+                end_mono=g1,
+                candidates=len(cand_keys),
+            )
             cold_scores = self._cold_score(q, cvecs)
+            record_span(
+                "tier_cold_rescore",
+                start_mono=g1,
+                end_mono=_time.monotonic(),
+                candidates=len(cand_keys),
+            )
             cold_fetch_s = _time.perf_counter() - t0
         # 4. resolve hot candidates (blocking half)
         hot_lists = [[] for _ in range(nq)]
         if hot_disp is not None:
             hs, hi = hot_disp
             hot_lists = self.hot.search_resolve(hs, hi, int(np.asarray(hs).shape[1]))
+            # hot-tier span covers dispatch → resolve (the async half
+            # overlaps the probe/gather work above by design)
+            record_span(
+                "tier_hot",
+                start_mono=h0,
+                end_mono=_time.monotonic(),
+                hot_docs=len(self.hot._slot_of),
+            )
         # 5. merge per query: hot wins dedup; filters apply to both tiers
         out = []
         for qi in range(nq):

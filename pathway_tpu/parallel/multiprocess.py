@@ -203,6 +203,17 @@ def _telemetry_stats(cluster: ShardCluster) -> dict[int, dict]:
     return out
 
 
+def _trace_spans() -> list[dict]:
+    """Completed request spans since the last drain, piggybacked on the
+    same authenticated replies as :func:`_telemetry_stats` — workers
+    never open a listener of their own for the tracing plane either."""
+    from ..tracing import TRACE_STORE, tracing_enabled
+
+    if not tracing_enabled():
+        return []
+    return TRACE_STORE.drain_outbox()
+
+
 def _stored_generation(engines) -> int:
     """Durable cluster generation (0 when never bumped / no
     persistence). Read at formation time so a coordinator that crashed
@@ -681,6 +692,14 @@ class CoordinatorCluster(ShardCluster):
         for r in replies.values():
             for wid, stats in (r.get("stats") or {}).items():
                 self.worker_telemetry[int(wid)] = stats
+            spans = r.get("spans")
+            if spans:
+                # remote request spans ride the same replies as stats;
+                # ingest dedups by span id so a chaos-duplicated frame
+                # cannot double-count a stage (same fence as PR 7's seq)
+                from ..tracing import TRACE_STORE
+
+                TRACE_STORE.ingest_remote(spans)
 
     def _speedrun_supported(self) -> bool:
         return False  # worker-process logs are not visible to process 0
@@ -1074,6 +1093,11 @@ def run_worker(
     # carried into the next formation (and any process we fork): the
     # learned generation is what distinguishes a survivor from a zombie
     os.environ["PATHWAY_CLUSTER_GENERATION"] = str(gen)
+    # spans recorded in this process carry its first global shard id and
+    # buffer in an outbox until a poll/time_end reply drains them
+    from ..tracing import set_worker as _trace_set_worker
+
+    _trace_set_worker(int(cluster.base))
     w_lease = welcome.get("lease_ms")
     if w_lease is None:
         w_lease = lease_ms
@@ -1246,6 +1270,7 @@ def run_worker(
                         "pending": any(s.session.pending() for s in srcs),
                         "closed": all(s.session.closed for s in srcs),
                         "stats": _telemetry_stats(cluster),
+                        "spans": _trace_spans(),
                     },
                     seq=msg.get("seq"),
                 )
@@ -1259,7 +1284,11 @@ def run_worker(
                     pending_advance.clear()
                 chaos.inject("worker.after_advance", time=int(msg["t"]))
                 _reply(
-                    {"op": "ok", "stats": _telemetry_stats(cluster)},
+                    {
+                        "op": "ok",
+                        "stats": _telemetry_stats(cluster),
+                        "spans": _trace_spans(),
+                    },
                     seq=msg.get("seq"),
                     time=int(msg["t"]),
                 )

@@ -40,6 +40,19 @@ from typing import Optional
 from .deadline import Deadline
 from .metrics import SERVING_METRICS, ServingMetrics
 
+_tracing_store = None
+
+
+def _tracing_enabled() -> bool:
+    """Cheap gate for the admit hot path (caches the module lookup so
+    the tracing-off cost is one global read + one attribute call)."""
+    global _tracing_store
+    if _tracing_store is None:
+        from ..tracing import store as _ts
+
+        _tracing_store = _ts
+    return _tracing_store.tracing_enabled()
+
 __all__ = [
     "AdmissionController",
     "DeadlineExceeded",
@@ -63,6 +76,11 @@ class OverloadError(RuntimeError):
     def __init__(self, message: str, *, retry_after_s: float | None = None):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+        #: trace id of the rejected request (set at the shed site when
+        #: tracing is on) — the HTTP surface echoes it in the
+        #: ``X-Pathway-Trace`` response header so even a 429/503 is
+        #: attributable with ``pathway trace show``.
+        self.trace_id: str = ""
 
     def to_response(self) -> dict:
         body = {"error": str(self), "reason": self.reason}
@@ -178,7 +196,7 @@ class TokenBucket:
 class Ticket:
     """One admitted request's slot in the ledger."""
 
-    __slots__ = ("deadline", "seq", "degraded", "admitted_at", "route")
+    __slots__ = ("deadline", "seq", "degraded", "admitted_at", "route", "trace")
 
     def __init__(
         self,
@@ -187,12 +205,14 @@ class Ticket:
         *,
         degraded: bool = False,
         route: str = "/",
+        trace=None,  # pathway_tpu.tracing.TraceContext | None
     ):
         self.deadline = deadline
         self.seq = seq
         self.degraded = degraded
         self.admitted_at = _time.monotonic()
         self.route = route
+        self.trace = trace
 
 
 class AdmissionController:
@@ -257,8 +277,19 @@ class AdmissionController:
         from ..resilience.cluster import CLUSTER_HEALTH
 
         cfg = self.config
+        t_enter = _time.monotonic()
         if deadline is None:
             deadline = Deadline(cfg.default_deadline_ms)
+        # request-journey tracing: the inbound traceparent (bound by
+        # the HTTP surface) wins; otherwise the journey starts here —
+        # shed events and typed rejections carry the trace id too
+        trace_ctx = None
+        trace_extra: dict = {}
+        if _tracing_enabled():
+            from .. import tracing as _tracing
+
+            trace_ctx = _tracing.ensure_trace()
+            trace_extra = {"trace": trace_ctx.trace_id}
         # burst-arrival chaos site: a delay rule here simulates a
         # thundering herd piling up at the front door
         _chaos.inject("serving.admit")
@@ -274,10 +305,14 @@ class AdmissionController:
                     route=self.route,
                     reason="shard_unavailable",
                     shard=int(shard),
+                    **trace_extra,
                 )
-                raise ShardUnavailable(
-                    f"shard {shard} is down (partial restart in flight)",
-                    retry_after_s=CLUSTER_HEALTH.retry_after_s(),
+                raise self._traced(
+                    ShardUnavailable(
+                        f"shard {shard} is down (partial restart in flight)",
+                        retry_after_s=CLUSTER_HEALTH.retry_after_s(),
+                    ),
+                    trace_ctx,
                 )
 
         t0 = _time.monotonic()
@@ -285,11 +320,14 @@ class AdmissionController:
             retry_after = self._bucket.retry_after()
             self.metrics.record_shed("rate_limited")
             flight_recorder.record(
-                "serving.shed", route=self.route, reason="rate_limited"
+                "serving.shed", route=self.route, reason="rate_limited", **trace_extra
             )
-            raise RateLimited(
-                f"rate limit ({cfg.rate_limit_qps:g} qps) exceeded",
-                retry_after_s=retry_after,
+            raise self._traced(
+                RateLimited(
+                    f"rate limit ({cfg.rate_limit_qps:g} qps) exceeded",
+                    retry_after_s=retry_after,
+                ),
+                trace_ctx,
             )
 
         remaining_ms = deadline.remaining_ms()
@@ -300,10 +338,14 @@ class AdmissionController:
                 "serving.deadline_expired",
                 route=self.route,
                 remaining_ms=round(min(remaining_ms, 1e12), 3),
+                **trace_extra,
             )
-            raise DeadlineExceeded(
-                "request cannot meet its remaining budget "
-                f"({remaining_ms:.0f} ms left, floor {cfg.min_service_ms:g} ms)"
+            raise self._traced(
+                DeadlineExceeded(
+                    "request cannot meet its remaining budget "
+                    f"({remaining_ms:.0f} ms left, floor {cfg.min_service_ms:g} ms)"
+                ),
+                trace_ctx,
             )
 
         with self._lock:
@@ -315,10 +357,14 @@ class AdmissionController:
                     route=self.route,
                     reason="queue_full",
                     depth=depth,
+                    **trace_extra,
                 )
-                raise QueueFull(
-                    f"admission queue full ({depth}/{cfg.max_queue})",
-                    retry_after_s=deadline.remaining() if remaining_ms < 1e12 else None,
+                raise self._traced(
+                    QueueFull(
+                        f"admission queue full ({depth}/{cfg.max_queue})",
+                        retry_after_s=deadline.remaining() if remaining_ms < 1e12 else None,
+                    ),
+                    trace_ctx,
                 )
             degraded = shard_degraded or (
                 cfg.shed == "degrade"
@@ -329,7 +375,9 @@ class AdmissionController:
             heapq.heappush(self._heap, (deadline.expires_at, seq))
             new_depth = len(self._live)
 
-        ticket = Ticket(deadline, seq, degraded=degraded, route=self.route)
+        ticket = Ticket(
+            deadline, seq, degraded=degraded, route=self.route, trace=trace_ctx
+        )
         self.metrics.record_admit(degraded=degraded)
         self.metrics.set_queue_depth(new_depth)
         self.metrics.observe_stage("admission", _time.monotonic() - t0)
@@ -338,8 +386,26 @@ class AdmissionController:
             route=self.route,
             depth=new_depth,
             degraded=degraded,
+            **trace_extra,
         )
+        if trace_ctx is not None:
+            from ..tracing import record_span
+
+            record_span(
+                "admission",
+                start_mono=t_enter,
+                end_mono=_time.monotonic(),
+                ctx=trace_ctx,
+                depth=new_depth,
+                degraded=degraded,
+            )
         return ticket
+
+    @staticmethod
+    def _traced(exc: OverloadError, trace_ctx) -> OverloadError:
+        if trace_ctx is not None:
+            exc.trace_id = trace_ctx.trace_id
+        return exc
 
     def release(self, ticket: Ticket) -> None:
         with self._lock:
@@ -354,11 +420,18 @@ class AdmissionController:
 
         self.metrics.record_deadline_expired()
         self.metrics.record_shed("deadline_exceeded")
+        trace_extra = (
+            {"trace": ticket.trace.trace_id} if ticket.trace is not None else {}
+        )
         flight_recorder.record(
             "serving.deadline_expired",
             route=self.route,
             waited_ms=round((_time.monotonic() - ticket.admitted_at) * 1000.0, 3),
+            **trace_extra,
         )
-        return DeadlineExceeded(
-            "deadline expired before the pipeline produced a response"
+        return self._traced(
+            DeadlineExceeded(
+                "deadline expired before the pipeline produced a response"
+            ),
+            ticket.trace,
         )

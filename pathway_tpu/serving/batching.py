@@ -35,7 +35,7 @@ import threading
 import time as _time
 from typing import Any, Callable, Optional
 
-from .admission import ServingConfig
+from .admission import ServingConfig, _tracing_enabled
 from .deadline import Deadline
 from .metrics import SERVING_METRICS, ServingMetrics
 
@@ -105,15 +105,21 @@ class AdaptiveBatcher:
 
     # -- producer side --
 
-    def submit(self, item: Any, deadline: Deadline | None = None) -> None:
+    def submit(self, item: Any, deadline: Deadline | None = None, trace=None) -> None:
         """Queue one item for the next fused dispatch (starts the
-        worker on first use)."""
+        worker on first use). ``trace`` (a TraceContext) defaults to
+        the submitter's bound context, so the request journey follows
+        the item onto the batcher thread without caller changes."""
         if deadline is None:
             deadline = Deadline.none()
+        if trace is None and _tracing_enabled():
+            from ..tracing import current_trace
+
+            trace = current_trace()
         with self._lock:
             heapq.heappush(
                 self._heap,
-                (deadline.expires_at, next(self._seq), item, _time.monotonic()),
+                (deadline.expires_at, next(self._seq), item, _time.monotonic(), trace),
             )
         self.start()
         self._wake.set()
@@ -159,31 +165,41 @@ class AdaptiveBatcher:
 
     # -- worker --
 
-    def _take_batch(self) -> tuple[list[Any], list[float]]:
+    def _take_batch(self) -> tuple[list[Any], list[float], list[Any]]:
         """Pop up to current_batch_size() live items in deadline order;
         expired items are dropped (never dispatched)."""
         limit = self.current_batch_size()
         now = _time.monotonic()
         items: list[Any] = []
         enqueued: list[float] = []
-        expired: list[Any] = []
+        traces: list[Any] = []
+        expired: list[tuple[Any, float, Any]] = []
         with self._lock:
             while self._heap and len(items) < limit:
-                expires_at, _seq, item, enq = heapq.heappop(self._heap)
+                expires_at, _seq, item, enq, trace = heapq.heappop(self._heap)
                 if expires_at <= now:
-                    expired.append(item)
+                    expired.append((item, enq, trace))
                 else:
                     items.append(item)
                     enqueued.append(enq)
-        for item in expired:
+                    traces.append(trace)
+        for item, enq, trace in expired:
             self.dropped_expired_total += 1
             self.metrics.record_deadline_expired()
+            if trace is not None:
+                # the journey of a dropped request ends in the queue —
+                # record the wait it paid before expiring
+                from ..tracing import record_span
+
+                record_span(
+                    "queue", start_mono=enq, end_mono=now, ctx=trace, dropped=True
+                )
             if self._on_expired is not None:
                 try:
                     self._on_expired(item)
                 except Exception:
                     pass
-        return items, enqueued
+        return items, enqueued, traces
 
     def _loop(self) -> None:
         from ..internals import flight_recorder
@@ -203,7 +219,7 @@ class AdaptiveBatcher:
                 if window_s > 0.0 and self.pending() < self.current_batch_size():
                     _time.sleep(window_s)
                 while not self._halt:
-                    items, enqueued = self._take_batch()
+                    items, enqueued, traces = self._take_batch()
                     if not items:
                         break
                     now = _time.monotonic()
@@ -213,11 +229,54 @@ class AdaptiveBatcher:
                     # device that stopped keeping up
                     _chaos.inject("serving.before_dispatch")
                     w0 = _time.monotonic()
-                    self._dispatch(items)
-                    # stuck-batch chaos site: the batch is logically in
-                    # flight on the device at this point
-                    _chaos.inject("serving.batch_inflight")
-                    wall = _time.monotonic() - w0
+                    # fan-in tracing: one batch span (its own trace)
+                    # *links* the member request traces, so one fused
+                    # dispatch explains N requests; engine-side spans
+                    # (index search, rerank, decode) nest under the
+                    # batch trace via the bound context
+                    batch_span = None
+                    traced = [t for t in traces if t is not None]
+                    if traced and _tracing_enabled():
+                        from ..tracing import span as _trace_span
+
+                        batch_span = _trace_span(
+                            "batch",
+                            new_trace=True,
+                            links=tuple(t.trace_id for t in traced),
+                            size=len(items),
+                            name=self.name,
+                        )
+                    if batch_span is not None:
+                        with batch_span as bsp:
+                            self._dispatch(items)
+                            # stuck-batch chaos site: the batch is
+                            # logically in flight on the device here
+                            _chaos.inject("serving.batch_inflight")
+                        batch_trace_id = bsp.trace_id if bsp is not None else ""
+                    else:
+                        self._dispatch(items)
+                        _chaos.inject("serving.batch_inflight")
+                        batch_trace_id = ""
+                    w1 = _time.monotonic()
+                    wall = w1 - w0
+                    if traced:
+                        from ..tracing import record_span
+
+                        for enq, trace in zip(enqueued, traces):
+                            if trace is None:
+                                continue
+                            # queue wait ends when the device takes the
+                            # batch (w0, after the slow-device site) so
+                            # per-stage spans tile the request's wall
+                            record_span("queue", start_mono=enq, end_mono=w0, ctx=trace)
+                            record_span(
+                                "dispatch",
+                                start_mono=w0,
+                                end_mono=w1,
+                                ctx=trace,
+                                links=(batch_trace_id,) if batch_trace_id else (),
+                                size=len(items),
+                            )
                     per_item = wall / len(items)
                     if self._ewma_item_s == 0.0:
                         self._ewma_item_s = per_item
@@ -240,6 +299,7 @@ class AdaptiveBatcher:
                         name=self.name,
                         size=len(items),
                         wall_ms=round(wall * 1000.0, 3),
+                        **({"trace": batch_trace_id} if batch_trace_id else {}),
                     )
                     # chip-time partitioning: yield the ingest stream's
                     # share of the slot before the next query dispatch

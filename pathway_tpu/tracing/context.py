@@ -1,0 +1,119 @@
+"""W3C trace context for the request-journey tracing plane.
+
+A :class:`TraceContext` is the (trace_id, span_id) pair that rides a
+request through the serving plane, exactly as the request
+:class:`~pathway_tpu.serving.deadline.Deadline` does: the HTTP handler
+parses the inbound ``traceparent`` header (or the admission controller
+generates a fresh context), binds it to the current execution context
+with :class:`bind_trace`, and every downstream layer picks it up with
+:func:`current_trace` — no explicit threading through call signatures.
+
+The wire format is the W3C Trace Context ``traceparent`` header
+(``00-<32 hex trace id>-<16 hex span id>-<2 hex flags>``); responses
+echo the trace id in the ``X-Pathway-Trace`` header so a client can
+quote it back at ``pathway trace show`` — including shed (429/503) and
+degraded responses, which are exactly the ones worth attributing.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import re
+import secrets
+from typing import Optional
+
+#: Inbound W3C header (lowercase per spec; aiohttp headers are
+#: case-insensitive anyway).
+TRACEPARENT_HEADER = "traceparent"
+
+#: Response header echoing the request's trace id (satellite: overload
+#: and degraded replies carry it so rejected requests are attributable).
+TRACE_RESPONSE_HEADER = "X-Pathway-Trace"
+
+_TRACEPARENT_RE = re.compile(
+    r"^(?P<version>[0-9a-f]{2})-(?P<trace_id>[0-9a-f]{32})"
+    r"-(?P<span_id>[0-9a-f]{16})-(?P<flags>[0-9a-f]{2})$"
+)
+
+
+def gen_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def gen_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+class TraceContext:
+    """One point in a request journey: the trace and the span that is
+    current at this point (new child spans parent under ``span_id``)."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, *, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    @classmethod
+    def new(cls) -> "TraceContext":
+        return cls(gen_trace_id(), gen_span_id())
+
+    @classmethod
+    def from_traceparent(cls, header_value: str | None) -> "TraceContext | None":
+        """Parse a W3C ``traceparent`` header; None for an absent or
+        malformed header (a bad header never rejects the request — the
+        server just starts a fresh trace, mirroring
+        ``Deadline.from_header``). All-zero ids are invalid per spec."""
+        if not header_value:
+            return None
+        m = _TRACEPARENT_RE.match(header_value.strip().lower())
+        if m is None:
+            return None
+        trace_id, span_id = m.group("trace_id"), m.group("span_id")
+        if trace_id == "0" * 32 or span_id == "0" * 16:
+            return None
+        sampled = bool(int(m.group("flags"), 16) & 0x01)
+        return cls(trace_id, span_id, sampled=sampled)
+
+    def to_traceparent(self) -> str:
+        flags = "01" if self.sampled else "00"
+        return f"00-{self.trace_id}-{self.span_id}-{flags}"
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh span id (the caller records the span)."""
+        return TraceContext(self.trace_id, gen_span_id(), sampled=self.sampled)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"TraceContext({self.trace_id[:8]}…/{self.span_id})"
+
+
+#: In-context propagation, mirroring ``serving.deadline._CURRENT``: the
+#: handler binds the request's context here; admission, the batcher,
+#: and the ops layers pick it up without signature changes.
+_CURRENT: contextvars.ContextVar[Optional[TraceContext]] = contextvars.ContextVar(
+    "pathway_trace_context", default=None
+)
+
+
+def current_trace() -> TraceContext | None:
+    """The trace context bound to the current execution context."""
+    return _CURRENT.get()
+
+
+class bind_trace:
+    """``with bind_trace(ctx): ...`` — scope a trace context so
+    :func:`current_trace` (and every span recorded below) sees it."""
+
+    def __init__(self, ctx: TraceContext | None):
+        self._ctx = ctx
+        self._token = None
+
+    def __enter__(self) -> TraceContext | None:
+        self._token = _CURRENT.set(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc) -> None:
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+            self._token = None

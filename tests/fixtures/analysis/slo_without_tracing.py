@@ -1,0 +1,24 @@
+"""Analysis fixture: a REST endpoint with admission control and a
+per-request deadline budget (``default_deadline_ms``), but a run where
+tracing and the profiler are both off — a missed deadline sheds as a
+bare 429/503 with no record of which stage spent the budget. The
+verifier must flag PWL014 (warning). ``serving=`` is set so PWL008
+stays quiet, and monitoring is on so PWL007 stays quiet too."""
+
+import pathway_tpu as pw
+
+
+class QuerySchema(pw.Schema):
+    value: int
+
+
+queries, response_writer = pw.io.http.rest_connector(
+    host="127.0.0.1",
+    port=0,
+    schema=QuerySchema,
+    delete_completed_queries=False,
+    serving=pw.ServingConfig(max_queue=32, default_deadline_ms=250.0),
+)
+response_writer(queries.select(result=pw.this.value * 2))
+
+pw.run(monitoring_level="in_out")

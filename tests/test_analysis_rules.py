@@ -912,3 +912,53 @@ def test_pwl013_negative_device_reranker_does_not_record(monkeypatch):
     pw.io.null.write(pairs.select(score=reranker(pairs.doc, pairs.query)))
     _describe_run(monkeypatch, decode=True)
     assert "PWL013" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL014
+
+
+def test_pwl014_slo_budget_without_observability(monkeypatch):
+    monkeypatch.delenv("PATHWAY_TRACING", raising=False)
+    monkeypatch.delenv("PATHWAY_PROFILE", raising=False)
+    _rest_endpoint(serving=pw.ServingConfig(default_deadline_ms=250.0))
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL014"]
+    assert len(hits) == 1 and hits[0].severity is Severity.WARNING
+    assert "deadline" in hits[0].message
+    assert hits[0].detail["endpoints"][0]["deadline_ms"] == 250.0
+    assert hits[0].detail["tracing"] is False
+
+
+def test_pwl014_tracing_arg_silences(monkeypatch):
+    _rest_endpoint(serving=pw.ServingConfig(default_deadline_ms=250.0))
+    _describe_run(monkeypatch, monitoring_level="in_out", tracing=True)
+    assert "PWL014" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl014_tracing_env_silences(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TRACING", "1")
+    _rest_endpoint(serving=pw.ServingConfig(default_deadline_ms=250.0))
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL014" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl014_profiler_silences(monkeypatch):
+    monkeypatch.delenv("PATHWAY_TRACING", raising=False)
+    _rest_endpoint(serving=pw.ServingConfig(default_deadline_ms=250.0))
+    _describe_run(monkeypatch, monitoring_level="in_out", profile="prof.json")
+    assert "PWL014" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl014_negative_no_deadline_budget(monkeypatch):
+    monkeypatch.delenv("PATHWAY_TRACING", raising=False)
+    monkeypatch.delenv("PATHWAY_PROFILE", raising=False)
+    # an endpoint without a deadline budget has no SLO to attribute
+    _rest_endpoint(serving=pw.ServingConfig(default_deadline_ms=None))
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL014" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl014_negative_without_run_context():
+    _rest_endpoint(serving=pw.ServingConfig(default_deadline_ms=250.0))
+    # unit-built graph, pw.run never described: rule stays quiet
+    assert "PWL014" not in _rules(pw.analysis.analyze())

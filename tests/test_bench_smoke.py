@@ -720,3 +720,140 @@ def test_bench_smoke_decode_admission_overhead(tiny_decoder):
     wall_on = min(one_wall(True) for _ in range(3))
     # min-of-3 plus an absolute epsilon (see the serving admission gate)
     assert wall_on <= wall_off * 1.05 + 0.05, (wall_on, wall_off)
+
+
+# ---------------------------------------------------------------------------
+# request tracing plane (pathway_tpu/tracing/)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _tracing_reset():
+    from pathway_tpu.tracing import (
+        TRACE_STORE,
+        TRACING_METRICS,
+        set_tracing_enabled,
+    )
+
+    prev = set_tracing_enabled(False)
+    TRACE_STORE.reset()
+    TRACING_METRICS.reset()
+    yield
+    set_tracing_enabled(prev)
+    TRACE_STORE.reset()
+    TRACING_METRICS.reset()
+
+
+def test_bench_smoke_tracing_off_scrape_byte_identical(_tracing_reset):
+    """A run that never records a span scrapes byte-identical /metrics
+    and /status output — the tracing plane must be invisible until it
+    is used (same discipline as every other plane registry)."""
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+    from pathway_tpu.internals.monitoring import StatsMonitor
+    from pathway_tpu.tracing import TRACING_METRICS, set_tracing_enabled
+
+    monitor = StatsMonitor()
+    server = MonitoringHttpServer(monitor, port=0)
+
+    def scrape():
+        # the wall-clock latency gauges tick between any two scrapes;
+        # everything else must match byte-for-byte
+        return "\n".join(
+            line
+            for line in server._prometheus().splitlines()
+            if not line.startswith(
+                ("pathway_input_latency_ms", "pathway_output_latency_ms")
+            )
+        )
+
+    baseline_metrics = scrape()
+    baseline_status = server._status()
+    assert "pathway_request_stage_seconds" not in baseline_metrics
+    assert "tracing" not in baseline_status
+
+    # flipping the flag alone (tracing=True but zero traffic) must not
+    # change a single byte either
+    set_tracing_enabled(True)
+    assert scrape() == baseline_metrics
+    assert server._status() == baseline_status
+
+    # one observed span and the histogram appears, with its trace-id
+    # exemplar on the bucket line
+    TRACING_METRICS.observe("admission", 0.002, "ab" * 16)
+    body = server._prometheus()
+    assert 'pathway_request_stage_seconds_bucket{stage="admission"' in body
+    assert f'# {{trace_id="{"ab" * 16}"}}' in body
+
+
+def test_bench_smoke_tracing_admission_overhead(_tracing_reset):
+    """Tracing on costs <5% on the admitted request path versus
+    tracing off — always-on journeys must be affordable at p50, not
+    just at the tail they explain."""
+    from pathway_tpu.serving import AdmissionController, Deadline, ServingConfig
+    from pathway_tpu.serving.metrics import ServingMetrics
+    from pathway_tpu.tracing import TRACE_STORE, set_tracing_enabled, span
+
+    N = 200
+
+    def service():
+        time.sleep(0.0005)
+
+    def run_requests():
+        ctl = AdmissionController(
+            ServingConfig(max_queue=64, default_deadline_ms=5000.0),
+            metrics=ServingMetrics(),
+        )
+        t0 = time.perf_counter()
+        for _ in range(N):
+            with span("request", new_trace=True):
+                ticket = ctl.admit(Deadline(5000.0))
+                service()
+                ctl.release(ticket)
+        return time.perf_counter() - t0
+
+    set_tracing_enabled(False)
+    wall_off = min(run_requests() for _ in range(3))
+    set_tracing_enabled(True)
+    wall_on = min(run_requests() for _ in range(3))
+    assert TRACE_STORE.active()  # the traced side actually recorded
+    assert wall_on <= wall_off * 1.05 + 0.05, (wall_on, wall_off)
+
+
+def test_bench_smoke_tracing_attribution_sums_to_wall(_tracing_reset):
+    """Miniature attribution case: a journey with measured stage waits
+    — the per-stage spans must account for >=95% of the request's
+    measured wall time, and the retained exemplar must reproduce the
+    same breakdown (`pathway trace slow` reads exactly these)."""
+    from pathway_tpu.tracing import (
+        TRACE_STORE,
+        attribute,
+        set_tracing_enabled,
+        slow_report,
+        span,
+    )
+
+    set_tracing_enabled(True)
+    t0 = time.perf_counter()
+    with span("request", new_trace=True) as root:
+        with span("queue"):
+            time.sleep(0.02)
+        with span("dispatch"):
+            with span("index_search"):
+                time.sleep(0.03)
+        with span("rerank"):
+            time.sleep(0.01)
+    wall_measured = time.perf_counter() - t0
+
+    att = attribute(TRACE_STORE.get_trace(root.trace_id), root.trace_id)
+    assert att["coverage"] >= 0.95, att
+    # span accounting agrees with the stopwatch to within 5%
+    assert att["wall_ms"] == pytest.approx(wall_measured * 1000.0, rel=0.05)
+    stage_ms = sum(d["ms"] for d in att["stages"].values())
+    assert stage_ms >= 0.95 * att["wall_ms"]
+    assert att["stages"]["dispatch"]["ms"] >= 25.0
+
+    # the retained exemplar reproduces the same breakdown
+    report = slow_report(TRACE_STORE.exemplar_traces())
+    (top,) = [t for t in report["traces"] if t["trace_id"] == root.trace_id]
+    assert top["coverage"] >= 0.95
+    assert top["stages"].keys() == att["stages"].keys()

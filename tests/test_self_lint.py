@@ -312,3 +312,44 @@ def test_pwl013_silent_without_decode_plane(monkeypatch):
     monkeypatch.delenv("PATHWAY_DECODE", raising=False)
     proc = _analyze_cli(os.path.join(FIXTURES, "host_bound_ingest.py"))
     assert "PWL013" not in proc.stdout
+
+
+def test_slo_without_tracing_warns_pwl014(monkeypatch):
+    """A deadline-budgeted serving endpoint in a run with tracing and
+    the profiler both off: PWL014 warns (exit 0), nonzero only under
+    --strict-warnings."""
+    monkeypatch.delenv("PATHWAY_TRACING", raising=False)
+    monkeypatch.delenv("PATHWAY_PROFILE", raising=False)
+    fixture = os.path.join(FIXTURES, "slo_without_tracing.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL014" in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--strict-warnings")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl014_json_carries_budget_and_intent(monkeypatch):
+    monkeypatch.delenv("PATHWAY_TRACING", raising=False)
+    monkeypatch.delenv("PATHWAY_PROFILE", raising=False)
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "slo_without_tracing.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL014"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["endpoints"][0]["deadline_ms"] == 250.0
+    assert diag["detail"]["tracing"] is False
+    assert diag["detail"]["profile"] is False
+
+
+def test_pwl014_tracing_env_silences_cli(monkeypatch):
+    """The fix the diagnostic suggests (PATHWAY_TRACING=1) makes the
+    same program lint clean."""
+    monkeypatch.setenv("PATHWAY_TRACING", "1")
+    fixture = os.path.join(FIXTURES, "slo_without_tracing.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL014" not in proc.stdout

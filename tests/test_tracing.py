@@ -1,0 +1,799 @@
+"""Per-request tracing plane: end-to-end journeys with tail-latency
+attribution.
+
+Covers pathway_tpu.tracing (W3C traceparent context, the bounded span
+store with p99 exemplar retention, per-stage histograms with trace-id
+exemplars, the attribution aggregator), the serving-plane span sites
+(admission, adaptive batcher, REST surface echoing X-Pathway-Trace on
+success and shed alike), the cluster piggyback dedup against
+chaos-duplicated frames, open-span flush into flight-recorder dumps,
+and the ``pathway trace`` CLI over dump files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+from click.testing import CliRunner
+
+import pathway_tpu as pw
+from pathway_tpu import tracing
+from pathway_tpu.cli import cli
+from pathway_tpu.internals import flight_recorder as fr
+from pathway_tpu.serving import (
+    AdaptiveBatcher,
+    AdmissionController,
+    Deadline,
+    OverloadError,
+    SERVING_METRICS,
+    ServingConfig,
+)
+from pathway_tpu.serving.metrics import ServingMetrics
+from pathway_tpu.tracing import (
+    TRACE_RESPONSE_HEADER,
+    TRACE_STORE,
+    TRACEPARENT_HEADER,
+    TRACING_METRICS,
+    TraceContext,
+    attribute,
+    bind_trace,
+    current_trace,
+    record_span,
+    set_tracing_enabled,
+    slow_report,
+    span,
+)
+from pathway_tpu.tracing.attribution import render_slow_report, render_waterfall
+from pathway_tpu.tracing.store import TraceStore
+
+
+@pytest.fixture(autouse=True)
+def _tracing_sandbox():
+    prev = set_tracing_enabled(False)
+    TRACE_STORE.reset()
+    TRACING_METRICS.reset()
+    SERVING_METRICS.reset()
+    yield
+    set_tracing_enabled(prev)
+    TRACE_STORE.reset()
+    TRACING_METRICS.reset()
+    SERVING_METRICS.reset()
+
+
+def _enable():
+    set_tracing_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# W3C trace context
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    ctx = TraceContext.new()
+    parsed = TraceContext.from_traceparent(ctx.to_traceparent())
+    assert parsed is not None
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.span_id == ctx.span_id
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        "00-short-abc-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "zz-" + "a" * 32 + "-" + "b" * 16 + "-01",  # bad version
+    ],
+)
+def test_bad_traceparent_yields_fresh_trace(header):
+    # a malformed header never rejects the request — the surface just
+    # starts a fresh journey
+    assert TraceContext.from_traceparent(header) is None
+
+
+def test_child_keeps_trace_changes_span():
+    ctx = TraceContext.new()
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+
+
+def test_bind_trace_scoping():
+    assert current_trace() is None
+    ctx = TraceContext.new()
+    with bind_trace(ctx):
+        assert current_trace() is ctx
+        inner = TraceContext.new()
+        with bind_trace(inner):
+            assert current_trace() is inner
+        assert current_trace() is ctx
+    assert current_trace() is None
+
+
+# ---------------------------------------------------------------------------
+# span recording + store
+# ---------------------------------------------------------------------------
+
+
+def test_span_noop_when_disabled():
+    with span("stage", new_trace=True) as sp:
+        assert sp is None
+    assert not TRACE_STORE.active()
+    assert not TRACING_METRICS.active()
+
+
+def test_span_noop_without_context_unless_new_trace():
+    _enable()
+    with span("orphan") as sp:
+        assert sp is None
+    with span("root", new_trace=True) as sp:
+        assert sp is not None
+
+
+def test_nested_spans_parent_correctly():
+    _enable()
+    with span("request", new_trace=True) as root:
+        with span("admission") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            with span("index_search") as grand:
+                assert grand.parent_id == child.span_id
+    spans = TRACE_STORE.get_trace(root.trace_id)
+    assert {s["stage"] for s in spans} == {"request", "admission", "index_search"}
+    roots = [s for s in spans if not s["parent"]]
+    assert len(roots) == 1 and roots[0]["stage"] == "request"
+
+
+def test_span_records_error_attr():
+    _enable()
+    with pytest.raises(ValueError):
+        with span("request", new_trace=True) as root:
+            raise ValueError("boom")
+    spans = TRACE_STORE.get_trace(root.trace_id)
+    assert spans[0]["attrs"]["error"] == "ValueError"
+
+
+def test_record_span_monotonic_window():
+    _enable()
+    t0 = time.monotonic()
+    record_span("queue", start_mono=t0 - 0.05, end_mono=t0, new_trace=True, n=3)
+    recent = TRACE_STORE.recent_spans()
+    assert recent and recent[-1]["stage"] == "queue"
+    assert recent[-1]["dur_ms"] == pytest.approx(50.0, rel=0.2)
+    assert recent[-1]["attrs"]["n"] == 3
+
+
+def test_boundary_span_completes_remote_parented_trace():
+    # an inbound traceparent makes the server's request span a child of
+    # the CLIENT's span — never a local root — so the HTTP surface
+    # marks it boundary=True: finishing it still completes the journey
+    # for exemplar retention
+    _enable()
+    remote = TraceContext("ef" * 16, "12" * 8)
+    with span("request", ctx=remote, boundary=True):
+        with span("admission"):
+            pass
+    retained = TRACE_STORE.exemplar_traces()
+    assert [t["trace_id"] for t in retained] == [remote.trace_id]
+    stages = {s["stage"] for s in retained[0]["spans"]}
+    assert stages == {"request", "admission"}
+    # without the boundary mark the same shape is never retained
+    with span("request", ctx=TraceContext("ab" * 16, "34" * 8)):
+        pass
+    assert len(TRACE_STORE.exemplar_traces()) == 1
+
+
+def test_record_span_root_of_completes_trace():
+    # embedded callers (the bench driver) admit/submit under a trace
+    # context, then close the journey root after the async dispatch —
+    # root_of records the root with the context's own span id, so the
+    # already-recorded children parent to it and the trace is retained
+    _enable()
+    ctx = TraceContext.new()
+    t0 = time.monotonic()
+    record_span("queue", start_mono=t0 - 0.09, end_mono=t0 - 0.02, ctx=ctx)
+    record_span("dispatch", start_mono=t0 - 0.02, end_mono=t0, ctx=ctx)
+    root = record_span("request", start_mono=t0 - 0.1, end_mono=t0, root_of=ctx)
+    assert root is not None
+    assert root.span_id == ctx.span_id and root.parent_id == ""
+    retained = TRACE_STORE.exemplar_traces()
+    assert [t["trace_id"] for t in retained] == [ctx.trace_id]
+    att = attribute(retained[0]["spans"], ctx.trace_id)
+    assert att["wall_ms"] == pytest.approx(100.0, rel=0.1)
+    assert set(att["stages"]) == {"queue", "dispatch"}
+    assert att["coverage"] >= 0.85
+
+
+def test_exemplar_retention_survives_ring_eviction():
+    # a tiny ring: p50 traffic evicts everything, yet the slowest
+    # complete traces survive in the retention window
+    store = TraceStore(ring_size=64, exemplar_slots=3)
+    slow_ids = []
+    for i in range(200):
+        tid = f"{i:032x}"
+        dur = 5.0 if i % 50 == 7 else 0.001  # 4 slow traces
+        sp = tracing.Span(tid, f"{i:016x}", "", "request")
+        sp.duration_s = dur
+        sp.start_mono = time.monotonic()
+        store.finish(sp)
+        if dur == 5.0:
+            slow_ids.append(tid)
+    retained = store.exemplar_traces()
+    retained_ids = {t["trace_id"] for t in retained}
+    # only 3 slots: the 3 slowest survive, all of them slow ones
+    assert len(retained) == 3
+    assert retained_ids <= set(slow_ids)
+    # the ring itself has long since dropped the early slow trace
+    ring_ids = {s["trace"] for s in store.recent_spans(limit=10_000)}
+    assert slow_ids[0] not in ring_ids
+    assert slow_ids[0] in retained_ids or len(slow_ids) > 3
+
+
+def test_remote_ingest_dedups_duplicated_frames():
+    # chaos can duplicate cluster protocol frames; a replayed piggyback
+    # must not double-count spans (same discipline as seq-numbered
+    # frames in the transport)
+    worker = TraceStore()
+    worker.configure_worker(3)
+    _enable()
+    tid = "ab" * 16
+    sp = tracing.Span(tid, "cd" * 8, "", "index_merge", worker=3)
+    sp.duration_s = 0.01
+    worker.finish(sp)
+    frame = worker.drain_outbox()
+    assert frame and frame[0]["worker"] == 3
+
+    coord = TraceStore()
+    assert coord.ingest_remote(frame) == 1
+    assert coord.ingest_remote(list(frame)) == 0  # duplicated frame
+    assert coord.remote_dupes_total == 1
+    spans = coord.get_trace(tid)
+    assert len(spans) == 1 and spans[0]["stage"] == "index_merge"
+
+
+def test_dump_roundtrip_and_cli(tmp_path):
+    _enable()
+    with span("request", new_trace=True, route="/") as root:
+        with span("admission"):
+            time.sleep(0.002)
+        with span("dispatch"):
+            time.sleep(0.002)
+    d = str(tmp_path)
+    path = TRACE_STORE.dump(d)
+    assert path and os.path.basename(path).startswith("trace-")
+    data = tracing.load_trace_dump(path)
+    assert data["exemplars"][0]["trace_id"] == root.trace_id
+
+    runner = CliRunner()
+    res = runner.invoke(cli, ["trace", "list", "--dir", d])
+    assert res.exit_code == 0, res.output
+    assert root.trace_id[:16] in res.output
+
+    res = runner.invoke(cli, ["trace", "show", "--dir", d, root.trace_id[:12]])
+    assert res.exit_code == 0, res.output
+    assert "admission" in res.output and "dispatch" in res.output
+    assert "coverage" in res.output
+
+    res = runner.invoke(cli, ["trace", "slow", "--dir", d])
+    assert res.exit_code == 0, res.output
+    assert "where the tail went" in res.output
+    assert root.trace_id[:16] in res.output
+
+
+def test_trace_cli_missing_trace_errors(tmp_path):
+    runner = CliRunner()
+    res = runner.invoke(cli, ["trace", "show", "--dir", str(tmp_path), "deadbeef"])
+    assert res.exit_code != 0
+
+
+# ---------------------------------------------------------------------------
+# per-stage histograms with trace-id exemplars
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_exemplars_and_scrape_lines():
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+
+    assert MonitoringHttpServer._tracing_lines() == []  # inactive: no lines
+    TRACING_METRICS.observe("admission", 0.004, "ff" * 16, worker=2)
+    lines = MonitoringHttpServer._tracing_lines()
+    text = "\n".join(lines)
+    assert "# TYPE pathway_request_stage_seconds histogram" in text
+    assert 'stage="admission"' in text and 'worker="2"' in text
+    assert f'# {{trace_id="{"ff" * 16}"}}' in text
+    # bucket counts are cumulative and end at +Inf
+    assert 'le="+Inf"' in text
+    assert "pathway_request_stage_seconds_count" in text
+
+
+def test_finished_root_span_feeds_stage_histogram():
+    _enable()
+    with span("request", new_trace=True) as root:
+        pass
+    assert TRACING_METRICS.active()
+    snap = TRACING_METRICS.snapshot()
+    assert snap["request[w0]"]["count"] == 1
+    assert root is not None
+
+
+# ---------------------------------------------------------------------------
+# attribution
+# ---------------------------------------------------------------------------
+
+
+def _mk_span(tid, sid, parent, stage, start, dur_ms, worker=0):
+    return {
+        "trace": tid,
+        "span": sid,
+        "parent": parent,
+        "stage": stage,
+        "worker": worker,
+        "start": start,
+        "dur_ms": dur_ms,
+        "attrs": {},
+        "links": [],
+    }
+
+
+def test_attribution_stages_tile_the_wall():
+    tid = "11" * 16
+    spans = [
+        _mk_span(tid, "a" * 16, "", "request", 100.0, 100.0),
+        _mk_span(tid, "b" * 16, "a" * 16, "queue", 100.0, 40.0),
+        _mk_span(tid, "c" * 16, "a" * 16, "dispatch", 100.04, 60.0),
+    ]
+    att = attribute(spans, tid)
+    assert att["wall_ms"] == pytest.approx(100.0)
+    assert att["stages"]["queue"]["pct"] == pytest.approx(40.0, abs=0.5)
+    assert att["stages"]["dispatch"]["pct"] == pytest.approx(60.0, abs=0.5)
+    assert att["coverage"] >= 0.95
+
+
+def test_attribution_coverage_reports_gaps():
+    tid = "22" * 16
+    spans = [
+        _mk_span(tid, "a" * 16, "", "request", 0.0, 100.0),
+        _mk_span(tid, "b" * 16, "a" * 16, "queue", 0.0, 10.0),
+    ]
+    att = attribute(spans, tid)
+    assert att["coverage"] == pytest.approx(0.10, abs=0.02)
+
+
+def test_slow_report_orders_and_aggregates():
+    exemplars = []
+    for i, wall in enumerate([10.0, 50.0, 30.0]):
+        tid = f"{i:032x}"
+        exemplars.append(
+            {
+                "trace_id": tid,
+                "wall_ms": wall,
+                "spans": [
+                    _mk_span(tid, "a" * 16, "", "request", 0.0, wall),
+                    _mk_span(tid, "b" * 16, "a" * 16, "queue", 0.0, wall / 2),
+                    _mk_span(
+                        tid, "c" * 16, "a" * 16, "dispatch", wall / 2000.0, wall / 2
+                    ),
+                ],
+            }
+        )
+    report = slow_report(exemplars, top_n=2)
+    walls = [t["wall_ms"] for t in report["traces"]]
+    assert walls == sorted(walls, reverse=True) and len(walls) == 2
+    assert report["traces"][0]["wall_ms"] == pytest.approx(50.0)
+    agg = report["aggregate_pct"]
+    assert agg["queue"] == pytest.approx(50.0, abs=1.0)
+    text = render_slow_report(report)
+    assert "where the tail went" in text
+
+
+def test_waterfall_interleaves_blackbox_events():
+    tid = "33" * 16
+    spans = [
+        _mk_span(tid, "a" * 16, "", "request", 1000.0, 20.0),
+        _mk_span(tid, "b" * 16, "a" * 16, "queue", 1000.0, 10.0),
+    ]
+    events = [{"kind": "serving.shed", "time": 1000.005, "reason": "queue_full"}]
+    text = render_waterfall(tid, spans, blackbox_events=events)
+    assert "request" in text and "queue" in text
+    assert "serving.shed" in text and "queue_full" in text
+
+
+# ---------------------------------------------------------------------------
+# serving plane integration
+# ---------------------------------------------------------------------------
+
+
+def test_admission_records_span_and_traced_rejection():
+    _enable()
+    ctl = AdmissionController(ServingConfig(max_queue=1), metrics=ServingMetrics())
+    with span("request", new_trace=True) as root:
+        ticket = ctl.admit(Deadline(60_000.0))
+        assert ticket.trace is not None
+        assert ticket.trace.trace_id == root.trace_id
+        with pytest.raises(OverloadError) as exc_info:
+            ctl.admit(Deadline(60_000.0))  # queue full
+        assert exc_info.value.trace_id == root.trace_id
+        ctl.release(ticket)
+    spans = TRACE_STORE.get_trace(root.trace_id)
+    assert "admission" in {s["stage"] for s in spans}
+
+
+def test_admission_shed_flight_event_carries_trace():
+    _enable()
+    before = fr.RECORDER._seq
+    ctl = AdmissionController(ServingConfig(max_queue=1), metrics=ServingMetrics())
+    with span("request", new_trace=True) as root:
+        t = ctl.admit(Deadline(60_000.0))
+        with pytest.raises(OverloadError):
+            ctl.admit(Deadline(60_000.0))
+        ctl.release(t)
+    sheds = [
+        e
+        for e in fr.RECORDER.events()
+        if e["seq"] > before and e["kind"] == "serving.shed"
+    ]
+    assert sheds and sheds[-1]["trace"] == root.trace_id
+
+
+def test_batcher_links_member_traces_into_batch_span():
+    _enable()
+    dispatched = []
+    b = AdaptiveBatcher(
+        dispatched.append, config=ServingConfig(), metrics=ServingMetrics()
+    )
+    b._halt = True  # the auto-started worker exits; we drive _loop ourselves
+    with span("request", new_trace=True) as r1:
+        b.submit("x", Deadline(60_000.0))
+    with span("request", new_trace=True) as r2:
+        b.submit("y", Deadline(60_000.0))
+    b._halt = False
+    t = threading.Thread(target=b._loop, daemon=True)
+    b._wake.set()
+    t.start()
+    deadline = time.time() + 5
+    while not dispatched and time.time() < deadline:
+        time.sleep(0.01)
+    b._halt = True
+    b._wake.set()
+    t.join(timeout=5)
+    assert dispatched == [["x", "y"]]
+    for root in (r1, r2):
+        spans = TRACE_STORE.get_trace(root.trace_id)
+        stages = {s["stage"] for s in spans}
+        assert "queue" in stages and "dispatch" in stages
+        (dispatch,) = [s for s in spans if s["stage"] == "dispatch"]
+        assert dispatch["links"], "dispatch span links the batch trace"
+    # the batch span itself is a root of its own trace, linking members
+    batch_tid = [
+        s for s in TRACE_STORE.recent_spans() if s["stage"] == "batch"
+    ][-1]
+    assert set(batch_tid["links"]) == {r1.trace_id, r2.trace_id}
+    assert batch_tid["attrs"]["size"] == 2
+
+
+def test_batcher_expired_member_records_dropped_queue_span():
+    _enable()
+    b = AdaptiveBatcher(
+        lambda items: None, config=ServingConfig(), metrics=ServingMetrics()
+    )
+    b._halt = True
+    with span("request", new_trace=True) as root:
+        b.submit("dead", Deadline(0.0))
+    items, _, _ = b._take_batch()
+    assert items == []
+    spans = TRACE_STORE.get_trace(root.trace_id)
+    queue = [s for s in spans if s["stage"] == "queue"]
+    assert queue and queue[0]["attrs"]["dropped"] is True
+
+
+def test_tracing_off_serving_paths_record_nothing():
+    ctl = AdmissionController(ServingConfig(), metrics=ServingMetrics())
+    t = ctl.admit(Deadline(60_000.0))
+    assert t.trace is None
+    ctl.release(t)
+    b = AdaptiveBatcher(
+        lambda items: None, config=ServingConfig(), metrics=ServingMetrics()
+    )
+    b._halt = True
+    b.submit("x", Deadline(60_000.0))
+    b._take_batch()
+    assert not TRACE_STORE.active()
+    assert not TRACING_METRICS.active()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder cross-links
+# ---------------------------------------------------------------------------
+
+
+def test_open_spans_flush_into_flight_dump(tmp_path, monkeypatch):
+    # a request in flight when the process dies mid-journey: its open
+    # spans ride the crash dump, so the blackbox names the trace
+    _enable()
+    cm = span("request", new_trace=True, route="/query")
+    root = cm.__enter__()
+    try:
+        rec = fr.FlightRecorder(size=32, enabled=True)
+        rec.record("serving.admit", route="/query", trace=root.trace_id)
+        path = rec.dump("crash", RuntimeError("killed"), directory=str(tmp_path))
+    finally:
+        cm.__exit__(None, None, None)
+    data = fr.load_dump(path)
+    open_spans = data["open_trace_spans"]
+    assert open_spans and open_spans[0]["trace"] == root.trace_id
+    assert open_spans[0]["stage"] == "request"
+    text = fr.render(data)
+    assert "open request spans at dump" in text
+    assert root.trace_id in text
+    assert "pathway trace show" in text
+
+    # events_for_trace merges the dump's events + open spans
+    events = fr.events_for_trace(root.trace_id, directory=str(tmp_path))
+    kinds = {e["kind"] for e in events}
+    assert "serving.admit" in kinds and "trace.open_span" in kinds
+
+
+def test_blackbox_show_cli_cross_links_traces(tmp_path):
+    _enable()
+    with span("request", new_trace=True) as root:
+        rec = fr.FlightRecorder(size=32, enabled=True)
+        rec.record("serving.shed", reason="queue_full", trace=root.trace_id)
+        path = rec.dump("test", directory=str(tmp_path))
+    runner = CliRunner()
+    res = runner.invoke(cli, ["blackbox", "show", path])
+    assert res.exit_code == 0, res.output
+    assert root.trace_id in res.output
+    assert "pathway trace show" in res.output
+
+
+def test_untraced_dump_has_no_trace_sections(tmp_path):
+    rec = fr.FlightRecorder(size=8, enabled=True)
+    rec.record("epoch.begin", t=0)
+    path = rec.dump("test", directory=str(tmp_path))
+    data = fr.load_dump(path)
+    assert "open_trace_spans" not in data
+    assert "traces referenced" not in fr.render(data)
+
+
+# ---------------------------------------------------------------------------
+# REST surface: X-Pathway-Trace echo (success, shed, degraded)
+# ---------------------------------------------------------------------------
+
+
+def _post_with_headers(url, payload, headers=None, timeout=15):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode()), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        body = exc.read().decode()
+        try:
+            decoded = json.loads(body)
+        except json.JSONDecodeError:
+            decoded = {"raw": body}
+        return exc.code, decoded, dict(exc.headers)
+
+
+def _run_rest(client, serving=None):
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    class _Schema(pw.Schema):
+        value: int
+
+    queries, response_writer = pw.io.http.rest_connector(
+        host="127.0.0.1",
+        port=port,
+        schema=_Schema,
+        delete_completed_queries=False,
+        serving=serving,
+    )
+    response_writer(queries.select(result=pw.this.value * 2))
+
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    runner = GraphRunner()
+    for table, sink in list(pw.parse_graph.outputs):
+        build = sink.get("build")
+        if build is not None:
+            build(runner, table)
+    for spec in list(pw.parse_graph.subscriptions):
+        runner.subscribe(
+            spec["table"],
+            on_change=spec.get("on_change"),
+            on_time_end=spec.get("on_time_end"),
+            on_end=spec.get("on_end"),
+        )
+    errors = []
+
+    def _client():
+        try:
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    status, _, _ = _post_with_headers(
+                        f"http://127.0.0.1:{port}/", {"value": 0}, timeout=2
+                    )
+                    if status == 200:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            client(port)
+        except BaseException as exc:  # pragma: no cover - surfaced below
+            errors.append(exc)
+        finally:
+            runner.engine.stop()
+
+    t = threading.Thread(target=_client, daemon=True)
+    t.start()
+    runner.run()
+    t.join(timeout=60)
+    pw.clear_graph()
+    assert not errors, errors
+
+
+def test_rest_echoes_trace_header_and_honors_traceparent():
+    _enable()
+    inbound = TraceContext.new()
+    seen = {}
+
+    def client(port):
+        url = f"http://127.0.0.1:{port}/"
+        seen["fresh"] = _post_with_headers(url, {"value": 3})
+        seen["w3c"] = _post_with_headers(
+            url, {"value": 4}, headers={TRACEPARENT_HEADER: inbound.to_traceparent()}
+        )
+
+    _run_rest(client, serving=ServingConfig(max_queue=16))
+
+    status, body, headers = seen["fresh"]
+    assert (status, body) == (200, 6)
+    fresh_id = headers.get(TRACE_RESPONSE_HEADER)
+    assert fresh_id and len(fresh_id) == 32
+
+    status, body, headers = seen["w3c"]
+    assert (status, body) == (200, 8)
+    # the client's W3C trace id is continued, not replaced
+    assert headers.get(TRACE_RESPONSE_HEADER) == inbound.trace_id
+
+    spans = TRACE_STORE.get_trace(fresh_id)
+    stages = {s["stage"] for s in spans}
+    assert {"request", "admission", "queue", "dispatch"} <= stages
+
+
+def test_rest_shed_reply_carries_trace_header():
+    _enable()
+    seen = {}
+
+    def client(port):
+        url = f"http://127.0.0.1:{port}/"
+        seen["ok"] = _post_with_headers(url, {"value": 1})
+        # rate bucket: burst of 1 is consumed by the warm-up probe +
+        # this request; the next one sheds 429 deterministically
+        seen["shed"] = _post_with_headers(url, {"value": 2})
+
+    _run_rest(
+        client,
+        serving=ServingConfig(rate_limit_qps=0.001, rate_limit_burst=1),
+    )
+    shed_status, shed_body, shed_headers = seen["shed"]
+    assert shed_status == 429, (shed_status, shed_body)
+    assert "rate limit" in str(shed_body.get("error", ""))
+    tid = shed_headers.get(TRACE_RESPONSE_HEADER)
+    assert tid and len(tid) == 32
+
+
+def test_rest_tracing_off_no_trace_header():
+    seen = {}
+
+    def client(port):
+        seen["r"] = _post_with_headers(
+            f"http://127.0.0.1:{port}/", {"value": 5}
+        )
+
+    _run_rest(client, serving=ServingConfig(max_queue=16))
+    status, body, headers = seen["r"]
+    assert (status, body) == (200, 10)
+    assert TRACE_RESPONSE_HEADER not in headers
+    assert not TRACE_STORE.active()
+
+
+# ---------------------------------------------------------------------------
+# run() integration
+# ---------------------------------------------------------------------------
+
+
+def test_run_installs_and_restores_tracing_flag(tmp_path):
+    t = pw.debug.table_from_markdown(
+        """
+        | x
+      1 | 1
+    """
+    )
+    pw.io.null.write(t.select(pw.this.x))
+    assert not tracing.tracing_enabled()
+    pw.run(monitoring_level="none", tracing=True)
+    # restored after the run (the flag only lives for the run's scope)
+    assert not tracing.tracing_enabled()
+
+
+def test_run_writes_trace_dump_when_spans_recorded(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_TRACE_DIR", str(tmp_path))
+
+    t = pw.debug.table_from_markdown(
+        """
+        | x
+      1 | 1
+    """
+    )
+
+    @pw.udf
+    def traced(x: int) -> int:
+        with span("request", new_trace=True):
+            with span("index_search"):
+                pass
+        return x + 1
+
+    pw.io.null.write(t.select(y=traced(pw.this.x)))
+    result = pw.run(monitoring_level="none", tracing=True)
+    assert result.trace_dumps, "run with recorded spans writes a dump"
+    data = tracing.load_trace_dump(result.trace_dumps[0])
+    assert data["exemplars"]
+
+
+def test_run_context_records_tracing_intent(monkeypatch):
+    monkeypatch.setenv("PATHWAY_ANALYZE_ONLY", "1")
+    t = pw.debug.table_from_markdown(
+        """
+        | x
+      1 | 1
+    """
+    )
+    pw.io.null.write(t.select(pw.this.x))
+    assert pw.run(tracing=True) is None
+    assert pw.parse_graph.run_context["tracing"] is True
+    assert pw.parse_graph.run_context["profile"] is False
+
+
+def test_coordinator_capture_dedups_duplicated_reply_frames():
+    # the coordinator-side merge: a chaos-duplicated protocol reply
+    # (same piggybacked spans twice) must not double-count them
+    from pathway_tpu.parallel.multiprocess import CoordinatorCluster
+
+    _enable()
+    worker = TraceStore()
+    worker.configure_worker(1)
+    sp = tracing.Span("ee" * 16, "ff" * 8, "", "dispatch", worker=1)
+    sp.duration_s = 0.002
+    worker.finish(sp)
+    frame = worker.drain_outbox()
+
+    class _Stub:
+        worker_telemetry = {}
+
+    reply = {1: {"stats": {1: {"epoch": 3}}, "spans": frame}}
+    CoordinatorCluster._capture_telemetry(_Stub(), reply)
+    CoordinatorCluster._capture_telemetry(_Stub(), reply)  # duplicated frame
+    assert TRACE_STORE.remote_spans_total == 1
+    assert TRACE_STORE.remote_dupes_total == 1
+    assert len(TRACE_STORE.get_trace("ee" * 16)) == 1
