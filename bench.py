@@ -2004,6 +2004,137 @@ def _encoder_mfu_measure() -> None:
         )
 
 
+def suite_hbm_ledger() -> None:
+    """Resource-ledger accounting suite: churn the index and decode
+    planes, then audit the ledger's books two ways.
+
+    - hbm_accounted_fraction: ledger total vs the device's own live
+      array bytes (``jax.live_arrays``). Gate >= 0.9 — the ledger must
+      explain at least 90% of what the device is actually holding; on
+      CPU the per-account rows are additionally checked exactly against
+      the backing arrays' nbytes.
+    - time_to_oom_forecast_error: a constant-rate synthetic ramp
+      replayed through the watchdog's growth EWMA; relative error of
+      the forecast vs the analytic headroom/rate answer.
+    """
+    import gc
+
+    import jax
+
+    from pathway_tpu.decode import DecodeConfig, DecodeEngine, DecoderConfig
+    from pathway_tpu.internals.ledger import (
+        DEFAULT_RULES,
+        LEDGER,
+        HealthWatchdog,
+        pytree_nbytes,
+    )
+    from pathway_tpu.ops.tiered_knn import TierConfig, TieredKnnIndex
+
+    LEDGER.reset()
+    # arrays allocated before this suite (other suites/tests in the same
+    # process) are not the ledger's to explain — audit only our growth
+    pre_existing = {id(a) for a in jax.live_arrays()}
+    rng = np.random.default_rng(11)
+
+    # index plane: a tiered index whose hot slab holds half the corpus
+    dim = 96
+    n_docs = 8_000
+    vecs = rng.normal(size=(n_docs, dim)).astype(np.float32)
+    idx = TieredKnnIndex(
+        dim=dim,
+        metric="cos",
+        reserved_space=n_docs,
+        tiers=TierConfig(
+            hot_rows=n_docs // 2, n_clusters=32, n_probe=8, cold_dtype="int8"
+        ),
+    )
+    idx.add_batch_arrays(list(range(n_docs)), vecs)
+    q = rng.normal(size=(8, dim)).astype(np.float32)
+    idx.search_batch(q, 10)  # sync: uploads the hot slab, books index.hot
+
+    # decode plane: a small engine — books decode.kv (pool) + weights
+    mcfg = DecoderConfig(
+        vocab_size=4000,
+        hidden_size=128,
+        num_layers=2,
+        num_heads=4,
+        intermediate_size=256,
+        max_position=128,
+    )
+    dcfg = DecodeConfig(
+        pages=128,
+        page_size=16,
+        lanes=4,
+        max_new_tokens=8,
+        degrade_max_new_tokens=4,
+        max_seq=96,
+        impl="auto",
+    )
+    engine = DecodeEngine(mcfg, dcfg)
+    for n in (8, 12, 16, 24):
+        engine.submit(rng.integers(1, mcfg.vocab_size, int(n)).tolist())
+    engine.drain()
+
+    gc.collect()  # drop step temporaries before auditing live arrays
+    snap = LEDGER.snapshot()
+    accounts = snap["accounts"]
+    for name in ("index.hot", "decode.kv", "weights"):
+        assert name in accounts, f"ledger missing account {name!r}"
+    live_bytes = sum(
+        int(a.nbytes) for a in jax.live_arrays() if id(a) not in pre_existing
+    )
+    fraction = snap["total_bytes"] / live_bytes if live_bytes else 0.0
+
+    exact_cpu = jax.default_backend() == "cpu"
+    if exact_cpu:
+        hot = idx.hot
+        want_hot = sum(
+            int(a.nbytes) for a in (hot._dev_matrix, hot._dev_valid, hot._dev_bias)
+        )
+        assert accounts["index.hot"]["bytes"] == want_hot
+        assert accounts["decode.kv"]["bytes"] == int(engine.pool.pool_bytes)
+        assert accounts["weights"]["bytes"] == pytree_nbytes(engine.params)
+
+    # forecast accuracy: 1 MiB/s ramp against a 16 GiB budget for 20
+    # one-second samples; analytic answer is headroom / rate
+    budget = 16 * 2**30
+    rate = float(2**20)
+    wd = HealthWatchdog(rules=DEFAULT_RULES, budget_bytes=budget)
+    n_samples = 20
+    forecast = None
+    for i in range(n_samples):
+        v = wd.evaluate_once({"t": float(i), "hbm_bytes": int(rate * i)})
+        for r in v["rules"]:
+            if r["name"] == "hbm_headroom":
+                forecast = r["value"]
+    analytic = (budget - rate * (n_samples - 1)) / rate
+    assert forecast is not None, "watchdog produced no time-to-OOM forecast"
+    forecast_err = abs(forecast - analytic) / analytic
+    _emit(
+        "time_to_oom_forecast_error",
+        forecast_err,
+        "relative",
+        gate=0.1,
+        forecast_s=round(float(forecast), 1),
+        analytic_s=round(analytic, 1),
+        samples=n_samples,
+        mode="constant 1 MiB/s ramp through the growth EWMA (alpha 0.25)",
+    )
+    _emit(
+        "hbm_accounted_fraction",
+        fraction,
+        "fraction",
+        gate=0.9,
+        ledger_bytes=snap["total_bytes"],
+        device_live_bytes=live_bytes,
+        accounts={k: v["bytes"] for k, v in accounts.items()},
+        exact_cpu_check=exact_cpu,
+        mode="tiered index hot slab + paged-KV pool + decoder weights "
+        "audited against jax.live_arrays",
+    )
+    LEDGER.reset()
+
+
 #: `--suite` registry; any name here is also directly invocable as
 #: `python bench.py <suite_name>`
 SUITES = (
@@ -2022,6 +2153,7 @@ SUITES = (
     suite_knn_churn,
     suite_tiered_recall,
     suite_decode_serving,
+    suite_hbm_ledger,
 )
 
 

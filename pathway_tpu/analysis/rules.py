@@ -55,6 +55,13 @@ PWL014 (warning) serving endpoint with a deadline/SLO budget in a run
                  stage spent the budget; pw.run(tracing=True) /
                  PATHWAY_TRACING (or profile=) makes the tail
                  attributable.
+PWL015 (warning) combined HBM oversubscription: the index plane and the
+                 decode KV page pool each fit the per-device budget
+                 alone, but their *sum* (plus rings/weights) exceeds
+                 PATHWAY_HBM_BYTES — the run OOMs only once both planes
+                 are resident. Shrink one plane, shard the index, or
+                 raise the budget; the live ledger (pathway doctor)
+                 tracks the same accounts at runtime.
 """
 
 from __future__ import annotations
@@ -103,6 +110,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL012": (Severity.WARNING, "beyond-HBM index without a cold tier"),
     "PWL013": (Severity.WARNING, "HTTP LLM stage with a device decode plane available"),
     "PWL014": (Severity.WARNING, "SLO-budgeted endpoint with tracing and profiler off"),
+    "PWL015": (Severity.WARNING, "combined planes oversubscribe the HBM budget"),
 }
 
 _MUTABLE_TYPES = (list, dict, set, bytearray)
@@ -839,21 +847,28 @@ def check_cluster_fault_domain(view: GraphView) -> list[Diagnostic]:
 # PWL010 — device-backed index larger than one device's HBM, no mesh
 
 
-#: Per-device HBM budget for PWL010 in bytes (v5e: 16 GiB). Override
-#: with PATHWAY_HBM_BYTES when targeting other parts.
-_DEFAULT_HBM_BYTES = 16 * 1024**3
-
-
 def _index_hbm_bytes(spec: dict) -> int:
     """Worst-case resident footprint of one device-backed index:
     the f32 [capacity, dim] matrix, plus the bool valid-mask and f32
     bias row (dim-independent per-row overhead). Capacity doubles on
     growth, so the first allocation past reserved_space is 2x — sizing
     on reserved_space alone is the steady-state floor the user asked
-    for, which is what the budget should gate."""
+    for, which is what the budget should gate. The arithmetic lives in
+    the shared footprint model (``internals/ledger``)."""
+    from ..internals.ledger import index_hbm_bytes
+
     rows = int(spec.get("reserved_space") or 0)
     dim = int(spec.get("dimensions") or 0)
-    return rows * dim * 4 + rows * 5
+    return index_hbm_bytes(rows, dim)
+
+
+def _hbm_budget() -> int:
+    """PATHWAY_HBM_BYTES (or the 16 GiB v5e default) via the shared
+    footprint model — the same knob the decode budget check and the
+    live watchdog read."""
+    from ..internals.ledger import default_hbm_bytes
+
+    return default_hbm_bytes()
 
 
 def check_index_hbm_budget(view: GraphView) -> list[Diagnostic]:
@@ -864,8 +879,6 @@ def check_index_hbm_budget(view: GraphView) -> list[Diagnostic]:
     time (``external_indexes``); the mesh by ``pw.run`` (``run_context
     ["mesh_axes"]``, parsed jax-free) — both visible to the analyze-only
     path before any device allocation."""
-    import os
-
     specs = getattr(view.graph, "external_indexes", None) or []
     device_specs = [s for s in specs if s.get("device_backed")]
     if not device_specs:
@@ -873,10 +886,7 @@ def check_index_hbm_budget(view: GraphView) -> list[Diagnostic]:
     ctx = getattr(view.graph, "run_context", None) or {}
     axes = ctx.get("mesh_axes") or None
     n_shards = int(axes["data"]) if axes else 1
-    try:
-        budget = int(os.environ.get("PATHWAY_HBM_BYTES") or _DEFAULT_HBM_BYTES)
-    except ValueError:
-        budget = _DEFAULT_HBM_BYTES
+    budget = _hbm_budget()
     tiered_run = bool(ctx.get("index_tiers"))
     out: list[Diagnostic] = []
     for spec in device_specs:
@@ -934,9 +944,7 @@ def check_index_tier_budget(view: GraphView) -> list[Diagnostic]:
     carries the footprint, a suggested hot/cold split at the budget,
     and the quantized cold-tier estimate (both reuse PWL010's budget
     math via the shared PATHWAY_HBM_BYTES knob)."""
-    import os
-
-    from ..ops.tiered_knn import cold_row_bytes, hot_row_bytes
+    from ..internals.ledger import cold_row_bytes, hot_row_bytes
 
     specs = getattr(view.graph, "external_indexes", None) or []
     device_specs = [s for s in specs if s.get("device_backed")]
@@ -947,10 +955,7 @@ def check_index_tier_budget(view: GraphView) -> list[Diagnostic]:
         return []  # run-scoped tier config covers every device index
     axes = ctx.get("mesh_axes") or None
     n_shards = int(axes["data"]) if axes else 1
-    try:
-        budget = int(os.environ.get("PATHWAY_HBM_BYTES") or _DEFAULT_HBM_BYTES)
-    except ValueError:
-        budget = _DEFAULT_HBM_BYTES
+    budget = _hbm_budget()
     out: list[Diagnostic] = []
     for spec in device_specs:
         if spec.get("tiers"):
@@ -1134,6 +1139,79 @@ def check_slo_without_tracing(view: GraphView) -> list[Diagnostic]:
     ]
 
 
+# --------------------------------------------------------------------------
+# PWL015 — combined planes oversubscribe the HBM budget
+
+
+def check_combined_hbm_oversubscription(view: GraphView) -> list[Diagnostic]:
+    """Each HBM plane passes its own budget check — the index fits
+    (PWL010 silent), the KV page pool fits (decode's parse-time check
+    passes) — but their *sum* does not: the run OOMs only once both
+    planes are resident, typically mid-stream when the index growth
+    lands on top of an allocated pool. Uses the shared footprint model
+    (``internals/ledger.footprint``): per-device index bytes after mesh
+    sharding plus the KV pool at the nominal decoder geometry (the live
+    ledger accounts for the real geometry at runtime). Tiered indexes
+    are excluded — their resident set is hot-tier-bounded and PWL012
+    owns that advice."""
+    ctx = getattr(view.graph, "run_context", None) or {}
+    if not ctx:
+        return []  # no pw.run configuration recorded (unit-built graph)
+    decode_cfg = ctx.get("decode") or None
+    specs = getattr(view.graph, "external_indexes", None) or []
+    device_specs = [
+        s for s in specs if s.get("device_backed") and not s.get("tiers")
+    ]
+    if not decode_cfg or not device_specs or ctx.get("index_tiers"):
+        return []
+    from ..internals.ledger import (
+        NOMINAL_DECODER_HIDDEN,
+        NOMINAL_DECODER_LAYERS,
+        footprint,
+        kv_pool_bytes,
+    )
+
+    budget = _hbm_budget()
+    axes = ctx.get("mesh_axes") or None
+    n_shards = int(axes["data"]) if axes else 1
+    index_bytes = sum(
+        _index_hbm_bytes(s) // max(1, n_shards) for s in device_specs
+    )
+    kv_bytes = kv_pool_bytes(
+        int(decode_cfg.get("pages") or 0),
+        int(decode_cfg.get("page_size") or 0),
+        NOMINAL_DECODER_LAYERS,
+        NOMINAL_DECODER_HIDDEN,
+    )
+    fp = footprint(index_bytes=index_bytes, kv_bytes=kv_bytes)
+    # single-plane overflow is PWL010/012's (or decode check_budget's)
+    # job — this rule owns exactly the each-passes-alone window
+    if index_bytes > budget or kv_bytes > budget or fp["total"] <= budget:
+        return []
+    return [
+        _diag(
+            "PWL015",
+            f"combined HBM planes oversubscribe the budget: the index "
+            f"plane (~{index_bytes / 1024**2:.0f} MiB/device) and the "
+            f"decode KV page pool (~{kv_bytes / 1024**2:.0f} MiB at the "
+            "nominal decoder geometry) each fit the "
+            f"{budget / 1024**2:.0f} MiB budget alone, but together "
+            f"need ~{fp['total'] / 1024**2:.0f} MiB — the run OOMs only "
+            "once both planes are resident. Shrink the pool "
+            "(decode='pages=...'), shard the index (pw.run(mesh=...)), "
+            "tier it (index_tiers=), or raise PATHWAY_HBM_BYTES; "
+            "`pathway doctor` tracks the same accounts live",
+            detail={
+                "footprint": fp,
+                "hbm_budget_bytes": budget,
+                "indexes": device_specs,
+                "decode": decode_cfg,
+                "mesh_axes": axes,
+            },
+        )
+    ]
+
+
 LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dtype_consistency,
     check_unbounded_state,
@@ -1149,4 +1227,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_host_bound_ingest,
     check_http_llm_with_device_decode,
     check_slo_without_tracing,
+    check_combined_hbm_oversubscription,
 ]

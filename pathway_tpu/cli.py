@@ -204,6 +204,90 @@ def profile(output, program):
     sys.exit(rc)
 
 
+_DOCTOR_EXIT = {"green": 0, "yellow": 1, "red": 2}
+
+
+@cli.command(
+    context_settings={"allow_extra_args": True, "ignore_unknown_options": True}
+)
+@click.option(
+    "--json", "as_json", is_flag=True, help="emit the machine-readable verdict JSON"
+)
+@click.option(
+    "--watchdog",
+    "spec",
+    default=None,
+    help="watchdog spec override, e.g. 'interval=0.2,breach_for=1'",
+)
+@click.argument("program", nargs=-1, required=True)
+def doctor(as_json, spec, program):
+    """Run PROGRAM with the health watchdog on and render its verdict.
+
+    The child runs with PATHWAY_WATCHDOG set (kept if already set,
+    unless --watchdog overrides) and writes the machine-readable
+    verdict to a temp file via PATHWAY_HEALTH_OUT; doctor renders it
+    green/yellow/red per plane with evidence lines. Exit codes:
+    0 green, 1 yellow, 2 red, 3 the program failed or left no verdict.
+    """
+    import json as _json
+    import tempfile
+
+    argv = list(program)
+    if argv[0].endswith(".py"):
+        argv = [sys.executable] + argv
+    env = os.environ.copy()
+    if spec is not None:
+        env["PATHWAY_WATCHDOG"] = spec
+    elif not env.get("PATHWAY_WATCHDOG"):
+        env["PATHWAY_WATCHDOG"] = "on"
+    fd, out_path = tempfile.mkstemp(prefix="pathway-doctor-", suffix=".json")
+    os.close(fd)
+    env["PATHWAY_HEALTH_OUT"] = out_path
+    # make pathway_tpu importable from dev checkouts (same reason as
+    # `pathway profile`): the child's sys.path roots at the program's
+    # directory, not ours
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    pkg_parent = os.path.dirname(pkg_root)
+    env["PYTHONPATH"] = (
+        pkg_parent + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH")
+        else pkg_parent
+    )
+    try:
+        rc = subprocess.call(argv, env=env)
+        verdict = None
+        try:
+            with open(out_path, encoding="utf-8") as fh:
+                raw = fh.read()
+            if raw.strip():
+                verdict = _json.loads(raw)
+        except (OSError, ValueError):
+            verdict = None
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+    if rc != 0 or verdict is None:
+        msg = (
+            f"program exited with status {rc}"
+            if rc != 0
+            else "program left no health verdict (did it call pw.run()?)"
+        )
+        if as_json:
+            click.echo(_json.dumps({"status": "unknown", "error": msg}))
+        else:
+            click.echo(f"doctor: {msg}", err=True)
+        sys.exit(3)
+    if as_json:
+        click.echo(_json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        from .internals.ledger import render_verdict
+
+        click.echo(render_verdict(verdict))
+    sys.exit(_DOCTOR_EXIT.get(verdict.get("status"), 3))
+
+
 @cli.group()
 def blackbox():
     """Inspect black-box flight-recorder dumps.

@@ -28,7 +28,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, replace
 from typing import Any
 
-from ..ops.tiered_knn import default_hbm_bytes, parse_bytes
+from ..internals.ledger import default_hbm_bytes, parse_bytes
 
 __all__ = [
     "DecodeConfig",
@@ -106,8 +106,13 @@ class DecodeConfig:
 
     def pool_bytes(self, layers: int, hidden: int, dtype_bytes: int = 4) -> int:
         """K+V pool footprint for a given decoder geometry — the number
-        the README sizing math and PWL010/012 budget share."""
-        return 2 * self.pages * self.page_size * layers * hidden * dtype_bytes
+        the README sizing math and PWL010/012 budget share (one formula,
+        in ``internals/ledger``)."""
+        from ..internals.ledger import kv_pool_bytes
+
+        return kv_pool_bytes(
+            self.pages, self.page_size, layers, hidden, dtype_bytes
+        )
 
     def check_budget(self, layers: int, hidden: int, dtype_bytes: int = 4) -> None:
         budget = self.hbm_bytes if self.hbm_bytes is not None else default_hbm_bytes()

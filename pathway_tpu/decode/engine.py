@@ -343,6 +343,24 @@ class DecodeEngine:
         self._jits: dict[Any, Any] = {}
         self.steps = 0
         DECODE_METRICS.set_pool(self.pool.pages_in_use, self.pool.n_pages)
+        self._ledger_update()
+        from ..internals.ledger import LEDGER, pytree_nbytes
+
+        LEDGER.update("weights", "decoder", pytree_nbytes(self.params))
+
+    def _ledger_update(self) -> None:
+        """Report the KV page pool to the HBM ledger — exact bytes from
+        the live pool arrays; ``used`` is the allocated-page fraction,
+        so the ledger's fragmentation gauge reads idle pool capacity."""
+        from ..internals.ledger import LEDGER
+
+        nbytes = int(self.pool.pool_bytes)
+        used = (
+            int(nbytes * self.pool.pages_in_use / self.pool.n_pages)
+            if self.pool.n_pages
+            else 0
+        )
+        LEDGER.update("decode.kv", "pool", nbytes, used_bytes=used)
 
     # -- ticket lifecycle --
 
@@ -483,6 +501,7 @@ class DecodeEngine:
         self._page_tables[lane_idx, :] = self.pool.sentinel
         self._lens[lane_idx] = 0
         DECODE_METRICS.set_pool(self.pool.pages_in_use, self.pool.n_pages)
+        self._ledger_update()
 
     def _preempt_expired(self) -> None:
         from ..internals import flight_recorder
@@ -555,6 +574,7 @@ class DecodeEngine:
             ticket.tokens.append(int(tok0))
             DECODE_METRICS.record_prefill(plen, wall)
             DECODE_METRICS.set_pool(self.pool.pages_in_use, self.pool.n_pages)
+            self._ledger_update()
             flight_recorder.record(
                 "decode.prefill",
                 lane=i,

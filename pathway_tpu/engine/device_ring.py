@@ -28,11 +28,14 @@ is safe to use from pure-host test paths.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import weakref
 from typing import Any
 
 __all__ = ["DeviceRing", "quiesce_all", "active_rings"]
+
+_ring_seq = itertools.count()
 
 # every live ring, so the snapshot path can quiesce staged transfers it
 # has no direct handle to (model-layer rings inside encoders)
@@ -116,6 +119,13 @@ class DeviceRing:
         self.stage_stall_s = 0.0  # time stage() blocked on unretired slots
         self.bytes_staged = 0     # host->device bytes pushed through the ring
         self.high_water = 0       # max generations simultaneously in flight
+        self._slot_bytes: list[int] = [0] * self.depth
+        # ledger rows are per-instance (names repeat across streams);
+        # the finalizer clears the row when the ring is collected
+        self._ledger_owner = f"{name}@{next(_ring_seq)}"
+        from ..internals.ledger import LEDGER
+
+        weakref.finalize(self, LEDGER.drop, "ring", self._ledger_owner)
         with _registry_lock:
             _registry.add(self)
 
@@ -173,6 +183,16 @@ class DeviceRing:
                 self.staged += 1
                 self.bytes_staged += nbytes
                 self.high_water = max(self.high_water, len(self._in_flight))
+                self._slot_bytes[idx] = nbytes
+                live = sum(self._slot_bytes)
+                in_use = sum(
+                    b
+                    for b, retired in zip(self._slot_bytes, self._retired)
+                    if not retired
+                )
+            from ..internals.ledger import LEDGER
+
+            LEDGER.update("ring", self._ledger_owner, live, used_bytes=in_use)
             return handles
 
     def stats(self) -> dict:

@@ -219,6 +219,7 @@ class MonitoringHttpServer:
         lines.extend(self._ingest_lines(wl))
         lines.extend(self._decode_lines(wl))
         lines.extend(self._tracing_lines(wl))
+        lines.extend(self._ledger_lines(wl))
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -249,6 +250,15 @@ class MonitoringHttpServer:
         if overlap_lines:
             lines.append("# TYPE pathway_worker_overlap_ratio gauge")
             lines.extend(overlap_lines)
+        hbm_lines = [
+            f'pathway_worker_hbm_bytes{{worker="{wid}"}} '
+            f'{workers[wid]["hbm_bytes"]}'
+            for wid in sorted(workers)
+            if workers[wid].get("hbm_bytes") is not None
+        ]
+        if hbm_lines:
+            lines.append("# TYPE pathway_worker_hbm_bytes gauge")
+            lines.extend(hbm_lines)
         lines.append("# TYPE pathway_worker_restarts_total counter")
         for wid in sorted(workers):
             lines.append(
@@ -692,6 +702,49 @@ class MonitoringHttpServer:
             lines.append(series(f"{metric}_count", row["count"], labels))
         return lines
 
+    @staticmethod
+    def _ledger_lines(wl: str = "") -> list[str]:
+        """HBM ledger plane (``pathway_hbm_*``): per-account live bytes,
+        used bytes, high-water and fragmentation, plus the process
+        totals. Rendered only once a subsystem reported an allocation —
+        runs that never touch the ledger scrape byte-identical."""
+        from .ledger import LEDGER
+
+        if not LEDGER.active():
+            return []
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+
+        snap = LEDGER.snapshot()
+        lines: list[str] = []
+        for metric, key, kind in (
+            ("pathway_hbm_bytes", "bytes", "gauge"),
+            ("pathway_hbm_used_bytes", "used_bytes", "gauge"),
+            ("pathway_hbm_high_water_bytes", "high_water_bytes", "gauge"),
+            ("pathway_hbm_fragmentation", "fragmentation", "gauge"),
+            ("pathway_hbm_owners", "owners", "gauge"),
+        ):
+            lines.append(f"# TYPE {metric} {kind}")
+            for account in sorted(snap["accounts"]):
+                lines.append(
+                    series(
+                        metric,
+                        snap["accounts"][account][key],
+                        f'account="{_escape_label(account)}"',
+                    )
+                )
+        lines.append("# TYPE pathway_hbm_total_bytes gauge")
+        lines.append(series("pathway_hbm_total_bytes", snap["total_bytes"]))
+        lines.append("# TYPE pathway_hbm_total_high_water_bytes gauge")
+        lines.append(
+            series("pathway_hbm_total_high_water_bytes", snap["high_water_bytes"])
+        )
+        lines.append("# TYPE pathway_hbm_budget_bytes gauge")
+        lines.append(series("pathway_hbm_budget_bytes", snap["budget_bytes"]))
+        return lines
+
     def _status(self) -> str:
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
 
@@ -749,6 +802,10 @@ class MonitoringHttpServer:
                 "stages": TRACING_METRICS.snapshot(),
                 **TRACE_STORE.snapshot(),
             }
+        from .ledger import LEDGER
+
+        if LEDGER.active():
+            status["hbm"] = LEDGER.snapshot()
         return json.dumps(status)
 
     # -- lifecycle --

@@ -176,6 +176,13 @@ class StatsSnapshot:
     trace_traces: int = 0
     trace_open_spans: int = 0
     trace_exemplars: int = 0
+    #: HBM ledger plane (internals/ledger.py): live per-account device
+    #: bytes and the process total/high-water. All zero/empty when no
+    #: subsystem reported an allocation — rendering stays byte-identical
+    #: for non-ledger runs.
+    hbm_total_bytes: int = 0
+    hbm_high_water_bytes: int = 0
+    hbm_accounts: dict = field(default_factory=dict)  # account -> bytes
     #: cluster telemetry plane: worker_id -> per-worker stats dict
     #: (epoch, rows_in, rows_out, rows_per_s, event_lag_s,
     #: overlap_ratio, restarts, pid). Empty outside sharded /
@@ -216,6 +223,10 @@ def sample_worker(engine) -> dict:
     pipeline = getattr(engine, "pipeline_stats", None)
     if pipeline is not None:
         out["overlap_ratio"] = pipeline.overlap_ratio
+    from .ledger import LEDGER
+
+    if LEDGER.active():
+        out["hbm_bytes"] = LEDGER.total_bytes()
     return out
 
 
@@ -320,6 +331,15 @@ class StatsMonitor:
             snap.trace_traces = tr["traces_total"]
             snap.trace_open_spans = tr["open_spans"]
             snap.trace_exemplars = tr["exemplars_retained"]
+        from .ledger import LEDGER
+
+        if LEDGER.active():
+            led = LEDGER.snapshot()
+            snap.hbm_total_bytes = led["total_bytes"]
+            snap.hbm_high_water_bytes = led["high_water_bytes"]
+            snap.hbm_accounts = {
+                account: e["bytes"] for account, e in led["accounts"].items()
+            }
         for node in engine.nodes:
             rows_in, rows_out = node.stats.rows_in, node.stats.rows_out
             key = f"{node.id}:{node.name}"
@@ -455,10 +475,19 @@ def _operators_table(monitor: StatsMonitor, now: float, with_operators: bool):
         "Latency is measured as the difference between the time the "
         "operator processed the data and the time pathway acquired it."
     )
+    snap = monitor.snapshot
+    # HBM ledger plane rides the caption, not a column: the operators
+    # table already carries one column per active plane and a wide table
+    # gets center-cropped by the layout pane, losing headers
+    if snap.hbm_total_bytes > 0 or snap.hbm_accounts:
+        caption += (
+            f" HBM ledger: {snap.hbm_total_bytes / 2**20:.1f} MiB live"
+            f" (hw {snap.hbm_high_water_bytes / 2**20:.1f}) across"
+            f" {len(snap.hbm_accounts)} accounts."
+        )
     # profiler-backed columns only appear when a profiler is attached;
     # the overlap column only when the epoch pipeline is on (depth >= 2)
     profiled = monitor.profiler is not None
-    snap = monitor.snapshot
     pipelined = snap.pipeline_depth > 1
     # encoder-kernel MFU column only when the fused encoder dispatched
     encoding = snap.encoder_dispatches > 0
@@ -643,11 +672,17 @@ def _workers_table(monitor: StatsMonitor, now: float):
     table.add_column(r"event lag \[s]", justify="right")
     table.add_column("overlap", justify="right")
     table.add_column("restarts", justify="right")
+    # per-worker HBM only when some shard piggybacked a ledger total
+    any_hbm = any(
+        w.get("hbm_bytes") is not None for w in monitor.snapshot.workers.values()
+    )
+    if any_hbm:
+        table.add_column(r"HBM \[MiB]", justify="right")
     for wid in sorted(monitor.snapshot.workers):
         w = monitor.snapshot.workers[wid]
         lag = w.get("event_lag_s")
         overlap = w.get("overlap_ratio")
-        table.add_row(
+        cells = (
             str(wid),
             str(w.get("epoch", "")),
             f"{w.get('rows_per_s', 0.0):.1f}",
@@ -655,6 +690,10 @@ def _workers_table(monitor: StatsMonitor, now: float):
             "" if overlap is None else f"{overlap:.2f}",
             str(w.get("restarts", 0)),
         )
+        if any_hbm:
+            hbm = w.get("hbm_bytes")
+            cells = cells + ("" if hbm is None else f"{hbm / 2**20:.1f}",)
+        table.add_row(*cells)
     return table
 
 

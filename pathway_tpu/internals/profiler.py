@@ -668,6 +668,15 @@ def wrap_jit(name: str, fn):
                 n_rows = int(shape[0])
                 break
         prof.record_jit(name, "compile" if compiled else "execute", dur, n_rows)
+        if compiled:
+            # compile-cache ledger account: entries x nominal size (XLA
+            # exposes no portable executable-size API); only profiled
+            # runs reach here, keeping the unprofiled path zero-cost
+            from .ledger import LEDGER, NOMINAL_EXECUTABLE_BYTES
+
+            LEDGER.update(
+                "compile_cache", name, cache_size() * NOMINAL_EXECUTABLE_BYTES
+            )
         return out
 
     profiled.__wrapped__ = fn

@@ -30,12 +30,17 @@ class RunResult:
     (``rest_connector`` / ``PathwayWebserver``) actually bound —
     explicit ports, ``port=0``, and the ephemeral-port fallback all
     resolve here. ``trace_dumps`` lists the request-trace exemplar
-    files this run wrote (``tracing=True`` / PATHWAY_TRACING)."""
+    files this run wrote (``tracing=True`` / PATHWAY_TRACING).
+    ``health`` is the final :class:`HealthWatchdog` verdict (the
+    machine-readable green/yellow/red document ``pathway doctor``
+    renders) when the run had ``watchdog=`` / PATHWAY_WATCHDOG on;
+    None otherwise."""
 
     monitoring_http_port: int | None = None
     flight_recorder_dumps: list[str] = field(default_factory=list)
     serving_http_ports: list[int] = field(default_factory=list)
     trace_dumps: list[str] = field(default_factory=list)
+    health: dict | None = None
 
 
 def _run_analysis(mode: str | None) -> None:
@@ -75,6 +80,7 @@ def run(
     analysis: str | None = None,
     profile: Any = None,
     tracing: Any = None,
+    watchdog: Any = None,
     recovery: Any = None,
     pipeline_depth: int | None = None,
     ingest_workers: int | None = None,
@@ -101,6 +107,18 @@ def run(
     slowest-trace exemplars dumped to PATHWAY_TRACE_DIR at run end and
     browsable with ``pathway trace``). Defaults to the PATHWAY_TRACING
     env var; ``tracing=False`` overrides an env-enabled plane.
+
+    ``watchdog``: ``True`` starts the live :class:`HealthWatchdog`
+    for this run — a background thread evaluating declarative rules
+    (HBM time-to-OOM forecast, serving p99 burn rate, shed rate, tier
+    hot-hit ratio) against the ledger/metrics streams, emitting
+    ``health.breach`` flight events and a one-shot flight-recorder
+    dump at critical. A string spec tunes it
+    (``"interval=0.5,breach_for=3,oom_warn_s=900"``). Defaults to the
+    PATHWAY_WATCHDOG env var; ``watchdog=False`` overrides. The final
+    verdict lands in :attr:`RunResult.health` (and, when
+    PATHWAY_HEALTH_OUT names a path, as JSON on disk for ``pathway
+    doctor``).
     ``monitoring_http_port``: explicit /metrics port for
     ``with_http_server`` (0 = ephemeral); default 20000 + process_id.
 
@@ -228,6 +246,16 @@ def run(
         else str(os.environ.get("PATHWAY_TRACING", "")).strip().lower()
         in ("1", "true", "yes", "on")
     )
+    # explicit watchdog= wins over PATHWAY_WATCHDOG (watchdog=False
+    # turns an env-enabled watchdog off for this run); a malformed
+    # spec raises here, before any sink is built
+    from .ledger import parse_watchdog_spec
+
+    _watchdog_cfg = parse_watchdog_spec(
+        watchdog
+        if watchdog is not None
+        else (os.environ.get("PATHWAY_WATCHDOG") or None)
+    )
     G.run_context = {
         "recovery": bool(recovery),
         "monitoring_level": monitoring_level,
@@ -256,6 +284,8 @@ def run(
         # PWL014 (SLO budget with no observability) reads both
         "tracing": _tracing_on,
         "profile": bool(profile) or bool(os.environ.get("PATHWAY_PROFILE")),
+        # live health watchdog intent, resolved jax-free like tracing
+        "watchdog": _watchdog_cfg is not None,
     }
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
@@ -304,6 +334,18 @@ def run(
     from .. import tracing as _req_tracing
 
     _prev_tracing = _req_tracing.set_tracing_enabled(_tracing_on)
+    # live health watchdog: a background thread evaluating declarative
+    # rules against the ledger/serving/index metric streams for the
+    # duration of the run; the final verdict lands in RunResult.health
+    _watchdog = None
+    if _watchdog_cfg is not None:
+        from .ledger import HealthWatchdog
+
+        _watchdog = HealthWatchdog(
+            rules=_watchdog_cfg["rules"],
+            interval_s=_watchdog_cfg["interval_s"],
+        )
+        _watchdog.start()
 
     n_workers = max(1, pwcfg.threads)
     processes = max(1, pwcfg.processes)
@@ -600,6 +642,24 @@ def run(
                 set_active_tiers(None)
             if decode is not None and _decode_cfg is not None:
                 set_active_decode(None)
+            if _watchdog is not None:
+                _watchdog.stop()
+                # one final evaluation so even runs shorter than the
+                # watchdog interval leave a verdict (and a critical
+                # breach observed only at the end still dumps)
+                _watchdog.evaluate_once()
+                result.health = _watchdog.verdict()
+                health_out = os.environ.get("PATHWAY_HEALTH_OUT")
+                if health_out:
+                    import json
+
+                    try:
+                        with open(health_out, "w", encoding="utf-8") as fh:
+                            json.dump(result.health, fh, indent=2, sort_keys=True)
+                    except OSError:
+                        logger.warning(
+                            "could not write health verdict to %s", health_out
+                        )
             result.flight_recorder_dumps = list(
                 flight_recorder.RECORDER._dumped_paths[dumps_before:]
             )

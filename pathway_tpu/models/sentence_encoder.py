@@ -82,6 +82,11 @@ class SentenceEncoder:
         # donated double-buffer ring for the wire id/length uploads of
         # the shared group forward (lazy; engine/device_ring.py)
         self._wire_ring = None
+        from ..internals.ledger import LEDGER, pytree_nbytes
+
+        LEDGER.update(
+            "weights", f"encoder:{model}", pytree_nbytes(self.params)
+        )
 
     @property
     def dim(self) -> int:
@@ -601,6 +606,11 @@ class CrossEncoderScorer:
         from ..internals.profiler import wrap_jit
 
         self._fwd = wrap_jit("cross_encoder.fwd", jax.jit(self.module.apply))
+        from ..internals.ledger import LEDGER, pytree_nbytes
+
+        LEDGER.update(
+            "weights", f"reranker:{model}", pytree_nbytes(self.params)
+        )
 
     def score(self, pairs: Sequence[tuple[str, str]]) -> np.ndarray:
         if not len(pairs):

@@ -556,6 +556,28 @@ class DeviceKnnIndex:
         INDEX_METRICS.update_index(
             self.name, list(self._docs_shard), self.shard_capacity
         )
+        self._ledger_update()
+
+    def _ledger_update(self) -> None:
+        """Report this index's live device allocation to the HBM ledger
+        — exact, from the device arrays' ``nbytes``, not an estimate.
+        ``used`` is the occupied-slot fraction of the slab, so the
+        ledger's fragmentation gauge reads reserved-but-empty capacity."""
+        from ..internals.ledger import LEDGER
+
+        alloc = sum(
+            int(getattr(a, "nbytes", 0) or 0)
+            for a in (self._dev_matrix, self._dev_valid, self._dev_bias)
+        )
+        if alloc:
+            used = (
+                int(alloc * len(self._slot_of) / self.capacity)
+                if self.capacity
+                else alloc
+            )
+            LEDGER.update("index.hot", self.name, alloc, used_bytes=used)
+        else:
+            LEDGER.drop("index.hot", self.name)
 
     def _tier_cold_docs(self) -> int:
         """Docs resident in a host cold tier behind this slab (0 for a
@@ -848,6 +870,7 @@ class DeviceKnnIndex:
         self._dev_bias = _pallas_bias(self.metric, self._dev_matrix, self._dev_valid)
         self._full = False
         self._pending.clear()
+        self._ledger_update()
 
     def _sync(self) -> None:
         if self._full or self._dev_matrix is None:

@@ -64,8 +64,11 @@ def pages_for(length: int, page_size: int) -> int:
 def kv_pool_bytes(
     n_pages: int, page_size: int, layers: int, dim: int, dtype_bytes: int = 4
 ) -> int:
-    """HBM footprint of a K+V page pool (the PWL010/012 budget unit)."""
-    return 2 * n_pages * page_size * layers * dim * dtype_bytes
+    """HBM footprint of a K+V page pool (the PWL010/012 budget unit).
+    Delegates to the shared footprint model in ``internals/ledger``."""
+    from ..internals.ledger import kv_pool_bytes as _kv_pool_bytes
+
+    return _kv_pool_bytes(n_pages, page_size, layers, dim, dtype_bytes)
 
 
 def _attend(q, k, v, length, n_heads: int, scale: float):

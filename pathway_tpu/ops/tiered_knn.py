@@ -46,54 +46,17 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..internals.ledger import (  # noqa: F401  (re-exported; the shared
+    _DEFAULT_HBM_BYTES,  # footprint model lives in internals/ledger.py)
+    cold_row_bytes,
+    default_hbm_bytes,
+    hot_row_bytes,
+    parse_bytes,
+)
 from .knn import _NEG, _k_bucket, _shard_of_key
-
-_DEFAULT_HBM_BYTES = 16 * 1024 ** 3  # one v5e device, matches PWL010
 
 _COLD_DTYPES = ("int8", "f32")
 _HOT_DTYPES = ("f32", "int8")
-
-
-def default_hbm_bytes() -> int:
-    """Per-device HBM budget: PATHWAY_HBM_BYTES override or 16 GiB —
-    the same knob PWL010/PWL012 budget math reads."""
-    raw = os.environ.get("PATHWAY_HBM_BYTES", "")
-    if raw:
-        try:
-            return parse_bytes(raw)
-        except ValueError:
-            pass
-    return _DEFAULT_HBM_BYTES
-
-
-def parse_bytes(raw: str | int) -> int:
-    """``"4G"`` / ``"512M"`` / ``"64K"`` / plain int -> bytes."""
-    if isinstance(raw, int):
-        return raw
-    s = str(raw).strip()
-    mult = 1
-    if s and s[-1] in "kKmMgG":
-        mult = {"k": 1024, "m": 1024 ** 2, "g": 1024 ** 3}[s[-1].lower()]
-        s = s[:-1]
-    try:
-        return int(float(s) * mult)
-    except ValueError:
-        raise ValueError(f"index tiers: bad byte size {raw!r}") from None
-
-
-def hot_row_bytes(dim: int, hot_dtype: str = "f32") -> int:
-    """HBM bytes per hot row: matches PWL010's rows*dim*4 + rows*5
-    slab math for f32; int8 rows carry a 4-byte scale instead."""
-    if hot_dtype == "int8":
-        return dim + 4 + 5
-    return dim * 4 + 5
-
-
-def cold_row_bytes(dim: int, cold_dtype: str = "int8") -> int:
-    """Host bytes per cold row (vector payload + per-vector scale)."""
-    if cold_dtype == "int8":
-        return dim + 4
-    return dim * 4
 
 
 @dataclass(frozen=True)
@@ -494,6 +457,10 @@ class TieredKnnIndex:
             hot_bytes_shard=[int(d) * hrb for d in self.hot._docs_shard],
             cold_bytes_shard=[int(d) * crb for d in self._cold_docs_shard],
         )
+        # The hot tier is a DeviceKnnIndex whose publish hook this method
+        # replaces — keep its HBM ledger account (bytes + used fraction)
+        # current here instead.
+        self.hot._ledger_update()
 
     # -- cluster assignment ------------------------------------------------
 

@@ -1,0 +1,43 @@
+"""Analysis fixture: a device-backed KNN index (20k x 384 f32 ~= 29.4
+MiB) and a decode KV page pool (256 pages x 16 ~= 32 MiB at nominal
+decoder geometry) that each fit the HBM budget alone but jointly
+oversubscribe it — with PATHWAY_HBM_BYTES=48M the verifier must flag
+PWL015 (warning) while PWL010/PWL012 stay silent. Analyze-only never
+builds either plane, so nothing allocates."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+docs = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  1 | 1.0 | 0.0
+  2 | 0.0 | 1.0
+    """
+)
+docs = docs.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, docs.x, docs.y)
+)
+
+queries = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  9 | 1.0 | 1.0
+    """
+)
+queries = queries.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.x, queries.y)
+)
+
+index = KNNIndex(
+    docs.emb,
+    docs,
+    n_dimensions=384,
+    reserved_space=20_000,
+    distance_type="cosine",
+)
+res = index.get_nearest_items(queries.emb, k=3)
+
+pw.io.null.write(res)
+
+pw.run(decode="pages=256,page=16")

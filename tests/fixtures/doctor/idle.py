@@ -1,0 +1,17 @@
+"""Doctor fixture: a tiny static pipeline that allocates nothing on
+device and breaches nothing — ``pathway doctor`` must come back green
+(exit 0) with at least one watchdog sample taken."""
+
+import pathway_tpu as pw
+
+rows = pw.debug.table_from_markdown(
+    """
+    | x
+  1 | 1.0
+  2 | 2.0
+    """
+)
+out = rows.select(y=rows.x + 1.0)
+pw.io.null.write(out)
+
+pw.run()

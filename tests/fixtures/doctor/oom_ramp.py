@@ -1,0 +1,44 @@
+"""Doctor fixture: a run whose HBM ledger ramps steadily toward a tiny
+PATHWAY_HBM_BYTES budget. The health watchdog's ingest-rate EWMA
+forecasts time-to-OOM well under the critical threshold, so ``pathway
+doctor`` must come back red with a flight-recorder dump. Each row of
+the pipeline commits ~4 MiB of "hot index" growth and sleeps long
+enough for the watchdog thread to sample the ramp."""
+
+import os
+import time
+
+os.environ.setdefault("PATHWAY_HBM_BYTES", str(64 * 1024 * 1024))
+
+import pathway_tpu as pw
+from pathway_tpu.internals.ledger import LEDGER
+
+_ramp = {"bytes": 0}
+
+
+def _grow(x: float) -> float:
+    _ramp["bytes"] += 4 * 1024 * 1024
+    LEDGER.update("index.hot", "ramp", _ramp["bytes"])
+    time.sleep(0.1)
+    return x
+
+
+rows = pw.debug.table_from_markdown(
+    """
+     | x
+   1 | 1.0
+   2 | 2.0
+   3 | 3.0
+   4 | 4.0
+   5 | 5.0
+   6 | 6.0
+   7 | 7.0
+   8 | 8.0
+   9 | 9.0
+  10 | 10.0
+    """
+)
+out = rows.select(y=pw.apply_with_type(_grow, float, rows.x))
+pw.io.null.write(out)
+
+pw.run()

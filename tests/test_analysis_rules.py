@@ -962,3 +962,79 @@ def test_pwl014_negative_without_run_context():
     _rest_endpoint(serving=pw.ServingConfig(default_deadline_ms=250.0))
     # unit-built graph, pw.run never described: rule stays quiet
     assert "PWL014" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL015
+
+
+def _combined_budget(monkeypatch):
+    """48 MiB budget: a 20k x 384 f32 index (~29.4 MiB) and the default
+    256x16 KV pool (~32 MiB at nominal decoder geometry) each fit alone
+    but jointly oversubscribe."""
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(48 * 1024 * 1024))
+
+
+def test_pwl015_combined_planes_oversubscribe(monkeypatch):
+    _combined_budget(monkeypatch)
+    _knn_sink(reserved=20_000)
+    _describe_run(monkeypatch, monitoring_level="in_out", decode="pages=256,page=16")
+    diags = pw.analysis.analyze()
+    hits = [d for d in diags if d.rule == "PWL015"]
+    assert len(hits) == 1 and hits[0].severity is Severity.WARNING
+    fp = hits[0].detail["footprint"]
+    budget = hits[0].detail["hbm_budget_bytes"]
+    assert fp["index"] <= budget and fp["decode_kv"] <= budget
+    assert fp["total"] > budget
+    # the single-plane rules stay quiet in this window
+    got = _rules(diags)
+    assert "PWL010" not in got and "PWL012" not in got
+
+
+def test_pwl015_negative_fits_together(monkeypatch):
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(256 * 1024 * 1024))
+    _knn_sink(reserved=20_000)
+    _describe_run(monkeypatch, monitoring_level="in_out", decode="pages=256,page=16")
+    assert "PWL015" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl015_negative_without_decode_plane(monkeypatch):
+    _combined_budget(monkeypatch)
+    _knn_sink(reserved=20_000)
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL015" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl015_negative_index_alone_over_budget(monkeypatch):
+    # the index alone blows the budget: PWL010/PWL012 own that finding
+    _combined_budget(monkeypatch)
+    _knn_sink(reserved=200_000)
+    _describe_run(monkeypatch, monitoring_level="in_out", decode="pages=256,page=16")
+    diags = pw.analysis.analyze()
+    assert "PWL015" not in _rules(diags)
+    assert "PWL010" in _rules(diags)
+
+
+def test_pwl015_mesh_sharding_silences(monkeypatch):
+    # a 2-way data mesh halves the per-device index share: fits together
+    _combined_budget(monkeypatch)
+    _knn_sink(reserved=20_000)
+    _describe_run(
+        monkeypatch, monitoring_level="in_out", mesh=4, decode="pages=256,page=16"
+    )
+    assert "PWL015" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl015_index_tiers_silence(monkeypatch):
+    # a configured cold tier bounds the resident hot set: PWL012's
+    # territory, not PWL015's
+    _combined_budget(monkeypatch)
+    monkeypatch.setenv("PATHWAY_INDEX_TIERS", "auto")
+    _knn_sink(reserved=20_000)
+    _describe_run(monkeypatch, monitoring_level="in_out", decode="pages=256,page=16")
+    assert "PWL015" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl015_negative_without_run_context(monkeypatch):
+    _combined_budget(monkeypatch)
+    _knn_sink(reserved=20_000)
+    assert "PWL015" not in _rules(pw.analysis.analyze())

@@ -1,0 +1,565 @@
+"""Device-resource ledger + health watchdog (internals/ledger.py).
+
+Covers the shared HBM footprint model (one formula for PWL010/012/015,
+decode's budget check, and tier-spec parsing), the live DeviceLedger
+accounting (activity gating, high water, fragmentation, the
+PATHWAY_LEDGER=0 kill switch), the HealthWatchdog hysteresis state
+machine over synthetic metric streams (headroom-forecast crossing, p99
+burn, no flapping, one-shot critical dump incl. a chaos kill during the
+dump), the watchdog spec parser, and the pw.run(watchdog=) /
+PATHWAY_WATCHDOG / PATHWAY_HEALTH_OUT integration."""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import flight_recorder
+from pathway_tpu.internals.ledger import (
+    DEFAULT_RULES,
+    LEDGER,
+    DeviceLedger,
+    HealthWatchdog,
+    WatchRule,
+    cold_row_bytes,
+    default_hbm_bytes,
+    footprint,
+    hot_row_bytes,
+    index_hbm_bytes,
+    kv_pool_bytes,
+    parse_bytes,
+    parse_watchdog_spec,
+    pytree_nbytes,
+    render_verdict,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    LEDGER.reset()
+    yield
+    LEDGER.reset()
+
+
+# ---------------------------------------------------------------------------
+# footprint model (the deduplicated budget math)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_bytes_suffixes():
+    assert parse_bytes("4G") == 4 * 1024**3
+    assert parse_bytes("512M") == 512 * 1024**2
+    assert parse_bytes("64k") == 64 * 1024
+    assert parse_bytes(123) == 123
+    assert parse_bytes("1.5g") == int(1.5 * 1024**3)
+    with pytest.raises(ValueError):
+        parse_bytes("lots")
+
+
+def test_default_hbm_bytes_env_override(monkeypatch):
+    monkeypatch.delenv("PATHWAY_HBM_BYTES", raising=False)
+    assert default_hbm_bytes() == 16 * 1024**3
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", "64M")
+    assert default_hbm_bytes() == 64 * 1024**2
+
+
+def test_row_and_pool_formulas():
+    assert hot_row_bytes(384) == 384 * 4 + 5
+    assert hot_row_bytes(384, "int8") == 384 + 4 + 5
+    assert cold_row_bytes(384) == 384 + 4
+    assert cold_row_bytes(384, "f32") == 384 * 4
+    assert index_hbm_bytes(1000, 384) == 1000 * (384 * 4 + 5)
+    # K+V: 2 * pages * page_size * layers * hidden * dtype
+    assert kv_pool_bytes(256, 16, 4, 256) == 2 * 256 * 16 * 4 * 256 * 4
+
+
+def test_footprint_sums_planes():
+    fp = footprint(index_bytes=10, kv_bytes=20, ring_bytes=3, weight_bytes=7)
+    assert fp == {
+        "index": 10,
+        "decode_kv": 20,
+        "rings": 3,
+        "weights": 7,
+        "total": 40,
+    }
+
+
+def test_tiered_knn_reexports_are_the_ledger_functions():
+    """Satellite 1: the tier-spec parser consumes the ledger's footprint
+    model — same objects, not copies."""
+    from pathway_tpu.ops import tiered_knn
+
+    assert tiered_knn.hot_row_bytes is hot_row_bytes
+    assert tiered_knn.cold_row_bytes is cold_row_bytes
+    assert tiered_knn.parse_bytes is parse_bytes
+    assert tiered_knn.default_hbm_bytes is default_hbm_bytes
+
+
+def test_decode_config_shares_pool_formula():
+    from pathway_tpu.decode.config import DecodeConfig
+
+    cfg = DecodeConfig(pages=128, page_size=16)
+    assert cfg.pool_bytes(4, 256) == kv_pool_bytes(128, 16, 4, 256)
+
+
+def test_paged_attention_shares_pool_formula():
+    from pathway_tpu.ops.paged_attention import kv_pool_bytes as pa_pool
+
+    assert pa_pool(64, 16, 2, 128) == kv_pool_bytes(64, 16, 2, 128)
+
+
+def test_analysis_rules_share_index_formula():
+    from pathway_tpu.analysis.rules import _index_hbm_bytes
+
+    spec = {"reserved_space": 1000, "dimensions": 384}
+    assert _index_hbm_bytes(spec) == index_hbm_bytes(1000, 384)
+
+
+def test_pytree_nbytes_walks_nested_params():
+    params = {
+        "layer": {"w": np.zeros((4, 4), np.float32), "b": np.zeros(4, np.float32)},
+        "head": [np.zeros(8, np.int8)],
+    }
+    assert pytree_nbytes(params) == 64 + 16 + 8
+
+
+# ---------------------------------------------------------------------------
+# DeviceLedger
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_inactive_until_first_update():
+    led = DeviceLedger()
+    assert not led.active()
+    led.drop("index.hot", "ghost")  # drops never activate
+    assert not led.active()
+    led.update("index.hot", "a", 100)
+    assert led.active()
+
+
+def test_ledger_aggregates_owners_and_fragmentation():
+    led = DeviceLedger()
+    led.update("index.hot", "a", 1000, used_bytes=500)
+    led.update("index.hot", "b", 1000, used_bytes=750)
+    led.update("weights", "encoder:m", 4096)
+    accounts = led.accounts()
+    assert accounts["index.hot"]["bytes"] == 2000
+    assert accounts["index.hot"]["used_bytes"] == 1250
+    assert accounts["index.hot"]["owners"] == 2
+    assert accounts["index.hot"]["fragmentation"] == pytest.approx(0.375)
+    # no used_bytes reported -> reads as fully used
+    assert accounts["weights"]["fragmentation"] == 0.0
+    assert led.total_bytes() == 2000 + 4096
+
+
+def test_ledger_high_water_survives_frees():
+    led = DeviceLedger()
+    led.update("decode.kv", "pool", 4096, used_bytes=4096)
+    led.update("decode.kv", "pool", 1024, used_bytes=512)
+    accounts = led.accounts()
+    assert accounts["decode.kv"]["bytes"] == 1024
+    assert accounts["decode.kv"]["high_water_bytes"] == 4096
+    led.update("decode.kv", "pool", 0)  # freed entirely
+    accounts = led.accounts()
+    assert accounts["decode.kv"]["bytes"] == 0
+    assert accounts["decode.kv"]["high_water_bytes"] == 4096
+    snap = led.snapshot()
+    assert snap["total_bytes"] == 0
+    assert snap["high_water_bytes"] == 4096
+
+
+def test_ledger_drop_owner_clears_across_accounts():
+    led = DeviceLedger()
+    led.update("ring", "r@1", 10)
+    led.update("weights", "r@1", 20)
+    led.update("weights", "other", 30)
+    led.drop_owner("r@1")
+    assert led.total_bytes() == 30
+
+
+def test_ledger_kill_switch(monkeypatch):
+    monkeypatch.setenv("PATHWAY_LEDGER", "0")
+    led = DeviceLedger()
+    led.update("index.hot", "a", 1000)
+    assert not led.active()
+    assert led.total_bytes() == 0
+
+
+def test_ledger_snapshot_reads_budget(monkeypatch):
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(48 * 1024 * 1024))
+    led = DeviceLedger()
+    led.update("index.hot", "a", 1)
+    assert led.snapshot()["budget_bytes"] == 48 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# live hooks (exact accounting on the CPU backend)
+# ---------------------------------------------------------------------------
+
+
+def test_device_ring_stage_registers_exact_bytes():
+    from pathway_tpu.engine.device_ring import DeviceRing
+
+    ring = DeviceRing(depth=2, name="ledger-test")
+    arr = np.zeros((16, 8), dtype=np.float32)
+    handles = ring.stage([arr])
+    accounts = LEDGER.accounts()
+    assert accounts["ring"]["bytes"] == arr.nbytes
+    # staged-but-unretired slots count as in use: no fragmentation yet
+    assert accounts["ring"]["fragmentation"] == 0.0
+    ring.retire(handles)
+    owner = ring._ledger_owner
+    del ring, handles
+    gc.collect()
+    # the finalizer drops the row; high water still renders
+    accounts = LEDGER.accounts()
+    assert accounts.get("ring", {"bytes": 0})["bytes"] == 0
+    assert ("ring", owner) not in LEDGER._rows
+
+
+def test_knn_index_run_registers_hot_account():
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    docs = pw.debug.table_from_markdown(
+        """
+        | x
+      1 | 1.0
+      2 | 2.0
+        """
+    )
+    docs = docs.select(emb=pw.apply_with_type(lambda x: (x, x), pw.ANY, docs.x))
+    queries = pw.debug.table_from_markdown(
+        """
+        | x
+      9 | 1.5
+        """
+    )
+    queries = queries.select(
+        emb=pw.apply_with_type(lambda x: (x, x), pw.ANY, queries.x)
+    )
+    index = KNNIndex(docs.emb, docs, n_dimensions=2, reserved_space=64)
+    pw.io.null.write(index.get_nearest_items(queries.emb, k=2))
+    pw.run(monitoring_level="none")
+    accounts = LEDGER.accounts()
+    assert "index.hot" in accounts, accounts
+    assert accounts["index.hot"]["bytes"] > 0
+    assert 0.0 <= accounts["index.hot"]["fragmentation"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# /metrics + /status gating
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_lines_absent_when_inactive():
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+
+    assert MonitoringHttpServer._ledger_lines() == []
+
+
+def test_ledger_lines_render_per_account_gauges():
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+
+    LEDGER.update("index.hot", "idx", 2048, used_bytes=1024)
+    LEDGER.update("decode.kv", "pool", 512)
+    text = "\n".join(MonitoringHttpServer._ledger_lines())
+    assert 'pathway_hbm_bytes{account="index.hot"} 2048' in text
+    assert 'pathway_hbm_bytes{account="decode.kv"} 512' in text
+    assert 'pathway_hbm_fragmentation{account="index.hot"} 0.5' in text
+    assert "pathway_hbm_total_bytes 2560" in text
+    assert "pathway_hbm_budget_bytes" in text
+
+
+# ---------------------------------------------------------------------------
+# HealthWatchdog: synthetic metric streams
+# ---------------------------------------------------------------------------
+
+
+def _oom_rules(breach_for=2, clear_for=2):
+    return tuple(
+        WatchRule(
+            r.name, r.plane, r.metric, warn=r.warn, critical=r.critical,
+            higher_is_bad=r.higher_is_bad, breach_for=breach_for,
+            clear_for=clear_for, unit=r.unit,
+        )
+        for r in DEFAULT_RULES
+    )
+
+
+def test_watchdog_headroom_forecast_crossing(monkeypatch, tmp_path):
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER_DIR", str(tmp_path))
+    wd = HealthWatchdog(rules=_oom_rules(), budget_bytes=1000)
+    # steady ramp of 100 B/s against a 1000 B budget: the EWMA forecast
+    # drops far below the 60 s critical threshold immediately
+    verdict = wd.evaluate_once({"t": 0.0, "hbm_bytes": 0})
+    assert verdict["status"] == "green"  # no rate yet -> no forecast
+    wd.evaluate_once({"t": 1.0, "hbm_bytes": 100})
+    verdict = wd.evaluate_once({"t": 2.0, "hbm_bytes": 200})
+    assert verdict["status"] == "red"
+    assert verdict["planes"]["hbm"]["status"] == "red"
+    (oom,) = [r for r in verdict["rules"] if r["name"] == "hbm_headroom"]
+    assert oom["level"] == "critical"
+    assert oom["value"] is not None and oom["value"] < 60.0
+    assert verdict["breaches"] == 1
+    assert verdict["dump_path"] and os.path.exists(verdict["dump_path"])
+    data = flight_recorder.load_dump(verdict["dump_path"])
+    assert data["reason"].startswith("health.critical:hbm_headroom")
+
+
+def test_watchdog_over_budget_forecasts_zero():
+    wd = HealthWatchdog(rules=_oom_rules(breach_for=1), budget_bytes=100)
+    verdict = wd.evaluate_once({"t": 0.0, "hbm_bytes": 150})
+    (oom,) = [r for r in verdict["rules"] if r["name"] == "hbm_headroom"]
+    assert oom["value"] == 0.0
+    assert oom["level"] == "critical"
+
+
+def test_watchdog_flat_usage_stays_green():
+    wd = HealthWatchdog(rules=_oom_rules(breach_for=1), budget_bytes=1000)
+    for t in range(5):
+        verdict = wd.evaluate_once({"t": float(t), "hbm_bytes": 500})
+    assert verdict["status"] == "green"
+    (oom,) = [r for r in verdict["rules"] if r["name"] == "hbm_headroom"]
+    assert "no signal" in oom["evidence"]
+
+
+def test_watchdog_p99_burn_rate():
+    wd = HealthWatchdog(rules=_oom_rules())
+    # p99 at 120% of the deadline budget: critical after breach_for=2
+    wd.evaluate_once({"p99_s": 6.0, "deadline_s": 5.0})
+    verdict = wd.evaluate_once({"p99_s": 6.0, "deadline_s": 5.0})
+    assert verdict["planes"]["serving"]["status"] == "red"
+    (burn,) = [r for r in verdict["rules"] if r["name"] == "p99_burn"]
+    assert burn["value"] == pytest.approx(1.2)
+
+
+def test_watchdog_p99_warn_is_yellow():
+    wd = HealthWatchdog(rules=_oom_rules())
+    for _ in range(2):
+        verdict = wd.evaluate_once({"p99_burn": 0.9})
+    assert verdict["status"] == "yellow"
+    assert verdict["planes"]["serving"]["status"] == "yellow"
+
+
+def test_watchdog_hysteresis_no_flapping():
+    """A metric oscillating across the warn line every sample never
+    accumulates the breach_for streak — the level must not flap."""
+    wd = HealthWatchdog(rules=_oom_rules(breach_for=2, clear_for=2))
+    for i in range(10):
+        verdict = wd.evaluate_once({"shed_rate": 0.1 if i % 2 else 0.0})
+    assert verdict["status"] == "green"
+    assert verdict["breaches"] == 0
+
+
+def test_watchdog_hysteresis_recovery_needs_clear_for():
+    wd = HealthWatchdog(rules=_oom_rules(breach_for=2, clear_for=2))
+    for _ in range(2):
+        wd.evaluate_once({"shed_rate": 0.1})
+    assert wd.verdict()["status"] == "yellow"
+    # one good sample is not enough to clear...
+    wd.evaluate_once({"shed_rate": 0.0})
+    assert wd.verdict()["status"] == "yellow"
+    # ...two consecutive are
+    wd.evaluate_once({"shed_rate": 0.0})
+    assert wd.verdict()["status"] == "green"
+    # recovery is not a breach
+    assert wd.verdict()["breaches"] == 1
+
+
+def test_watchdog_critical_dump_is_one_shot(monkeypatch, tmp_path):
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER_DIR", str(tmp_path))
+    wd = HealthWatchdog(rules=_oom_rules(breach_for=1))
+    wd.evaluate_once({"shed_rate": 0.5})  # critical #1 -> dump
+    first = wd.verdict()["dump_path"]
+    assert first
+    wd.evaluate_once({"shed_rate": 0.5, "p99_burn": 2.0})  # critical #2
+    verdict = wd.verdict()
+    assert verdict["breaches"] == 2
+    assert verdict["dump_path"] == first  # never re-dumped
+
+
+def test_watchdog_chaos_kill_during_dump(monkeypatch):
+    """A dump that dies mid-write is recorded as dump_error, never
+    raises into the evaluation loop, and is never retried."""
+
+    def _boom(reason, error=None):
+        raise OSError("chaos kill during dump")
+
+    monkeypatch.setattr(flight_recorder, "dump", _boom)
+    wd = HealthWatchdog(rules=_oom_rules(breach_for=1))
+    verdict = wd.evaluate_once({"shed_rate": 0.5})
+    assert verdict["status"] == "red"
+    assert verdict["dump_path"] is None
+    assert "chaos kill during dump" in verdict["dump_error"]
+    # a later critical does not retry the dump, even once dumps work
+    monkeypatch.setattr(flight_recorder, "dump", lambda *a, **k: "/nope")
+    wd.evaluate_once({"shed_rate": 0.5, "p99_burn": 2.0})
+    assert wd.verdict()["dump_path"] is None
+
+
+def test_watchdog_breach_emits_flight_event():
+    recorded = []
+    original = flight_recorder.record
+    try:
+        flight_recorder.record = lambda kind, **f: recorded.append((kind, f))
+        wd = HealthWatchdog(rules=_oom_rules(breach_for=1))
+        wd.evaluate_once({"shed_rate": 0.1})
+    finally:
+        flight_recorder.record = original
+    (event,) = [e for e in recorded if e[0] == "health.breach"]
+    assert event[1]["rule"] == "shed_rate"
+    assert event[1]["plane"] == "serving"
+    assert event[1]["level"] == "warn"
+
+
+def test_watchdog_thread_start_stop():
+    samples = iter(range(1000))
+
+    def sampler():
+        t = float(next(samples))
+        return {"t": t, "hbm_bytes": int(100 * t)}
+
+    wd = HealthWatchdog(
+        rules=_oom_rules(breach_for=1), interval_s=0.01,
+        sampler=sampler, budget_bytes=10_000_000,
+    )
+    wd.start()
+    try:
+        import time as _time
+
+        deadline = _time.monotonic() + 5.0
+        while wd.verdict()["samples"] < 3 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+    finally:
+        wd.stop()
+    assert wd.verdict()["samples"] >= 3
+    assert wd._thread is None
+
+
+def test_watchdog_verdict_includes_ledger_snapshot():
+    LEDGER.update("index.hot", "a", 4096)
+    wd = HealthWatchdog(rules=_oom_rules())
+    verdict = wd.evaluate_once({})
+    assert verdict["hbm"]["accounts"]["index.hot"]["bytes"] == 4096
+
+
+def test_render_verdict_lists_planes_and_evidence():
+    wd = HealthWatchdog(rules=_oom_rules(breach_for=1))
+    wd.evaluate_once({"shed_rate": 0.5})
+    text = render_verdict(wd.verdict())
+    assert text.startswith("overall: RED")
+    assert "serving" in text and "shed_rate" in text
+    assert "hbm" in text and "index" in text
+
+
+# ---------------------------------------------------------------------------
+# watchdog spec parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_watchdog_spec_onoff_forms():
+    assert parse_watchdog_spec(None) is None
+    assert parse_watchdog_spec(False) is None
+    assert parse_watchdog_spec("off") is None
+    assert parse_watchdog_spec("0") is None
+    for spec in (True, "on", "1", "auto"):
+        cfg = parse_watchdog_spec(spec)
+        assert cfg == {"interval_s": 1.0, "rules": DEFAULT_RULES}
+
+
+def test_parse_watchdog_spec_overrides():
+    cfg = parse_watchdog_spec("interval=0.2,breach_for=3,oom_critical_s=30")
+    assert cfg["interval_s"] == pytest.approx(0.2)
+    by_name = {r.name: r for r in cfg["rules"]}
+    assert by_name["hbm_headroom"].critical == 30.0
+    assert by_name["hbm_headroom"].warn == 600.0  # untouched default
+    assert all(r.breach_for == 3 for r in cfg["rules"])
+
+
+def test_parse_watchdog_spec_dict_form():
+    cfg = parse_watchdog_spec({"interval_s": 0.5, "shed_warn": 0.01})
+    assert cfg["interval_s"] == 0.5
+    by_name = {r.name: r for r in cfg["rules"]}
+    assert by_name["shed_rate"].warn == 0.01
+
+
+def test_parse_watchdog_spec_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="unknown spec key"):
+        parse_watchdog_spec("intervall=1")
+    with pytest.raises(ValueError, match="key=value"):
+        parse_watchdog_spec("interval")
+    with pytest.raises(ValueError, match="cannot parse"):
+        parse_watchdog_spec(3.14)
+
+
+# ---------------------------------------------------------------------------
+# pw.run(watchdog=) integration
+# ---------------------------------------------------------------------------
+
+
+def _tiny_sink():
+    t = pw.debug.table_from_markdown(
+        """
+        | x
+      1 | 1
+        """
+    )
+    pw.io.null.write(t.select(pw.this.x))
+
+
+def test_run_watchdog_kwarg_yields_health():
+    _tiny_sink()
+    result = pw.run(monitoring_level="none", watchdog=True)
+    assert result.health is not None
+    assert result.health["status"] in ("green", "yellow", "red")
+    assert result.health["samples"] >= 1
+
+
+def test_run_without_watchdog_leaves_health_none(monkeypatch):
+    monkeypatch.delenv("PATHWAY_WATCHDOG", raising=False)
+    _tiny_sink()
+    result = pw.run(monitoring_level="none")
+    assert result.health is None
+
+
+def test_run_watchdog_false_overrides_env(monkeypatch):
+    monkeypatch.setenv("PATHWAY_WATCHDOG", "1")
+    _tiny_sink()
+    result = pw.run(monitoring_level="none", watchdog=False)
+    assert result.health is None
+
+
+def test_run_watchdog_env_spec(monkeypatch):
+    monkeypatch.setenv("PATHWAY_WATCHDOG", "interval=0.05")
+    _tiny_sink()
+    result = pw.run(monitoring_level="none")
+    assert result.health is not None
+
+
+def test_run_writes_health_out(monkeypatch, tmp_path):
+    out = tmp_path / "verdict.json"
+    monkeypatch.setenv("PATHWAY_HEALTH_OUT", str(out))
+    _tiny_sink()
+    result = pw.run(monitoring_level="none", watchdog=True)
+    with open(out, encoding="utf-8") as fh:
+        payload = json.load(fh)
+    assert payload["status"] == result.health["status"]
+    assert "planes" in payload
+
+
+def test_run_context_records_watchdog_intent(monkeypatch):
+    monkeypatch.setenv("PATHWAY_ANALYZE_ONLY", "1")
+    _tiny_sink()
+    assert pw.run(watchdog=True) is None
+    assert pw.parse_graph.run_context["watchdog"] is True
+
+
+def test_run_rejects_malformed_watchdog_spec():
+    _tiny_sink()
+    with pytest.raises(ValueError, match="watchdog"):
+        pw.run(monitoring_level="none", watchdog="bogus_key=1")

@@ -353,3 +353,128 @@ def test_pwl014_tracing_env_silences_cli(monkeypatch):
     proc = _analyze_cli(fixture)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "PWL014" not in proc.stdout
+
+
+def test_combined_over_hbm_warns_pwl015(monkeypatch):
+    """An index plane and a decode KV pool that each fit the HBM budget
+    alone but jointly oversubscribe it: PWL015 warns (exit 0), nonzero
+    only under --strict-warnings — and neither single-plane rule
+    (PWL010/PWL012) fires."""
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(48 * 1024 * 1024))
+    fixture = os.path.join(FIXTURES, "combined_over_hbm.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL015" in proc.stdout
+    assert "PWL010" not in proc.stdout
+    assert "PWL012" not in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--strict-warnings")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl015_json_carries_footprint(monkeypatch):
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(48 * 1024 * 1024))
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "combined_over_hbm.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL015"]
+    assert diag["severity"] == "warning"
+    fp = diag["detail"]["footprint"]
+    budget = diag["detail"]["hbm_budget_bytes"]
+    assert budget == 48 * 1024 * 1024
+    # the rule's defining window: each plane fits alone, not together
+    assert fp["index"] <= budget
+    assert fp["decode_kv"] <= budget
+    assert fp["total"] > budget
+    assert fp["total"] == fp["index"] + fp["decode_kv"]
+    assert diag["detail"]["decode"]["pages"] == 256
+
+
+def test_pwl015_silent_when_budget_fits_both(monkeypatch):
+    """With enough HBM for both planes the same program lints clean."""
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(256 * 1024 * 1024))
+    proc = _analyze_cli(os.path.join(FIXTURES, "combined_over_hbm.py"))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL015" not in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# pathway doctor (internals/ledger.py HealthWatchdog + cli.py doctor)
+# ---------------------------------------------------------------------------
+
+DOCTOR_FIXTURES = os.path.join(REPO, "tests", "fixtures", "doctor")
+
+
+def _doctor_cli(program: str, *flags: str) -> subprocess.CompletedProcess:
+    env = os.environ.copy()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", "doctor", *flags, program],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+
+
+@pytest.mark.parametrize(
+    "demo", demo_programs(), ids=[os.path.basename(p) for p in demo_programs()]
+)
+def test_demo_pipelines_doctor_green(demo):
+    """Every shipped demo must come back green from the health
+    watchdog — the doctor counterpart of the lint gate above."""
+    proc = _doctor_cli(demo)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "overall: GREEN" in proc.stdout
+
+
+def test_doctor_green_on_idle_pipeline():
+    proc = _doctor_cli(os.path.join(DOCTOR_FIXTURES, "idle.py"))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "overall: GREEN" in proc.stdout
+
+
+def test_doctor_red_on_oom_ramp_with_dump():
+    """The watchdog forecasts OOM under a synthetic ingest ramp: doctor
+    exits 2 (red) and points at the one-shot flight-recorder dump."""
+    proc = _doctor_cli(
+        os.path.join(DOCTOR_FIXTURES, "oom_ramp.py"),
+        "--watchdog",
+        "interval=0.05,breach_for=1,oom_critical_s=3600",
+    )
+    assert proc.returncode == 2, (proc.stdout, proc.stderr)
+    assert "overall: RED" in proc.stdout
+    assert "time_to_oom_s" in proc.stdout
+    assert "flight recorder dump:" in proc.stdout
+
+
+def test_doctor_json_contract():
+    """--json emits the machine-readable verdict: status, per-plane
+    statuses with evidence, per-rule entries, and the ledger snapshot
+    when accounts were live."""
+    proc = _doctor_cli(
+        os.path.join(DOCTOR_FIXTURES, "oom_ramp.py"),
+        "--json",
+        "--watchdog",
+        "interval=0.05,breach_for=1,oom_critical_s=3600",
+    )
+    assert proc.returncode == 2, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    assert payload["status"] == "red"
+    assert payload["planes"]["hbm"]["status"] == "red"
+    assert payload["planes"]["hbm"]["evidence"]
+    (oom_rule,) = [r for r in payload["rules"] if r["name"] == "hbm_headroom"]
+    assert oom_rule["level"] == "critical"
+    assert payload["breaches"] >= 1
+    assert payload["dump_path"]
+    assert payload["hbm"]["accounts"]["index.hot"]["bytes"] > 0
+
+
+def test_doctor_broken_program_exits_3():
+    proc = _doctor_cli(os.path.join(DOCTOR_FIXTURES, "does_not_exist.py"))
+    assert proc.returncode == 3
