@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import json
 
+import os
+
 import time
 
 import numpy as np
@@ -2135,6 +2137,170 @@ def suite_hbm_ledger() -> None:
     LEDGER.reset()
 
 
+def suite_tenant_isolation() -> None:
+    """Multi-tenant noisy-neighbor suite: one tenant floods at 10x its
+    QPS quota while the quiet tenants keep querying the SAME shared
+    packed slab through the same admission controller and fair-share
+    batcher. Three properties audited:
+
+    - the flooder is held to its quota (admitted attempts stay near
+      qps*elapsed + burst; the rest shed as typed 429
+      ``tenant_rate_limited``);
+    - the quiet tenants' p99 under contention holds within 1.2x of
+      their solo baseline (``tenant_isolation_p99_ratio``, gate 1.2);
+    - a tenant's masked top-k over the shared slab is bit-identical to
+      a private index holding only its rows.
+
+    PATHWAY_BENCH_TENANT_QUIET (default 99) sizes the quiet population,
+    PATHWAY_BENCH_TENANT_QUERIES (default 3) the per-tenant query count
+    — the bench_smoke CI gate runs a miniature 3-tenant version.
+    """
+    import threading
+
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+    from pathway_tpu.serving import (
+        AdaptiveBatcher,
+        AdmissionController,
+        OverloadError,
+        ServingConfig,
+    )
+    from pathway_tpu.tenancy import TENANCY_METRICS, TenantPackedIndex, use_tenancy
+
+    n_quiet = max(2, int(os.environ.get("PATHWAY_BENCH_TENANT_QUIET", "99") or 99))
+    n_q = max(1, int(os.environ.get("PATHWAY_BENCH_TENANT_QUERIES", "3") or 3))
+    dim, per_docs, k = 64, 32, 5
+    flood_qps, flood_burst = 50.0, 8
+    rng = np.random.default_rng(17)
+
+    idx = TenantPackedIndex(dim=dim, metric="cos", reserved_space=1024)
+    quiet = [f"t{i:03d}" for i in range(n_quiet)]
+    flood = "flood"
+    vecs = {}
+    for t in quiet + [flood]:
+        v = rng.normal(size=(per_docs, dim)).astype(np.float32)
+        vecs[t] = v
+        idx.add_tenant_batch(t, [f"{t}-{j}" for j in range(per_docs)], v)
+
+    # bit-identity: the tenant mask must make the shared slab answer
+    # exactly like a private index holding only this tenant's rows
+    probe = quiet[0]
+    solo_idx = DeviceKnnIndex(dim=dim, metric="cos", reserved_space=1024)
+    solo_idx.add_batch_arrays(
+        [f"{probe}-{j}" for j in range(per_docs)], vecs[probe]
+    )
+    qprobe = rng.normal(size=(4, dim)).astype(np.float32)
+    assert idx.search_tenant_batch(probe, qprobe, k) == solo_idx.search_batch(
+        qprobe, k
+    ), "tenant-masked top-k diverged from a private index"
+
+    queries = {
+        t: rng.normal(size=(n_q, dim)).astype(np.float32) for t in quiet
+    }
+    flood_q = rng.normal(size=(1, dim)).astype(np.float32)
+    tenancy_spec = {
+        "quotas": {flood: {"qps": flood_qps, "burst": flood_burst}},
+        "default": {"weight": 1.0},
+    }
+
+    def run_phase(with_flooder: bool):
+        lat: list[float] = []
+        lat_lock = threading.Lock()
+        done = threading.Event()
+        want = n_quiet * n_q
+        count = [0]
+
+        def dispatch(items):
+            for tenant, q, t0 in items:
+                idx.search_tenant_batch(tenant, q[None], k)
+                if tenant != flood:
+                    with lat_lock:
+                        lat.append(time.perf_counter() - t0)
+                        count[0] += 1
+                        if count[0] >= want:
+                            done.set()
+
+        cfg = ServingConfig(max_queue=4096, default_deadline_ms=None)
+        ac = AdmissionController(cfg, route="/bench/tenant")
+        batcher = AdaptiveBatcher(dispatch, config=cfg, name="bench:tenant")
+        shed = [0]
+        admitted = [0]
+        halt = threading.Event()
+
+        def flooder():
+            while not halt.is_set():
+                try:
+                    ticket = ac.admit(tenant=flood)
+                except OverloadError:
+                    shed[0] += 1
+                else:
+                    admitted[0] += 1
+                    batcher.submit(
+                        (flood, flood_q[0], time.perf_counter()), tenant=flood
+                    )
+                    ac.release(ticket)
+                # 10x the quota's refill rate: one attempt per
+                # 1/(10*qps) seconds
+                halt.wait(1.0 / (10.0 * flood_qps))
+
+        t_start = time.perf_counter()
+        fl = None
+        if with_flooder:
+            fl = threading.Thread(target=flooder, daemon=True)
+            fl.start()
+        # quiet tenants interleave round-robin, paced only by admission
+        for j in range(n_q):
+            for t in quiet:
+                ticket = ac.admit(tenant=t)
+                batcher.submit(
+                    (t, queries[t][j], time.perf_counter()), tenant=t
+                )
+                ac.release(ticket)
+        done.wait(timeout=60.0)
+        elapsed = time.perf_counter() - t_start
+        halt.set()
+        if fl is not None:
+            fl.join(timeout=2.0)
+        batcher.stop()
+        assert count[0] >= want, (
+            f"quiet queries incomplete: {count[0]}/{want}"
+        )
+        p99 = float(np.percentile(np.asarray(lat) * 1e3, 99))
+        return p99, elapsed, admitted[0], shed[0]
+
+    with use_tenancy(tenancy_spec):
+        TENANCY_METRICS.reset()
+        # warm the batch-1 masked-search compile so the solo baseline
+        # measures steady state, not the first dispatch
+        idx.search_tenant_batch(probe, qprobe[:1], k)
+        solo_p99, _, _, _ = run_phase(with_flooder=False)
+        cont_p99, elapsed, admitted, shed = run_phase(with_flooder=True)
+
+    # the quota must actually have held the flooder: its admitted count
+    # stays near bucket capacity for the window, and sheds happened
+    quota_ceiling = flood_qps * elapsed + flood_burst
+    assert admitted <= quota_ceiling * 1.5 + 5, (
+        f"flooder over quota: {admitted} admits vs ceiling {quota_ceiling:.0f}"
+    )
+    ratio = cont_p99 / solo_p99 if solo_p99 > 0 else 0.0
+    _emit(
+        "tenant_isolation_p99_ratio",
+        ratio,
+        "ratio",
+        gate=1.2,
+        solo_p99_ms=round(solo_p99, 3),
+        contended_p99_ms=round(cont_p99, 3),
+        quiet_tenants=n_quiet,
+        queries_per_tenant=n_q,
+        flooder_admitted=admitted,
+        flooder_shed=shed,
+        flooder_quota_qps=flood_qps,
+        bit_identical_packed_results=True,
+        mode="1 tenant floods at 10x its QPS quota against "
+        f"{n_quiet} quiet tenants on one shared packed slab; quiet p99 "
+        "under contention vs solo baseline (gate 1.2)",
+    )
+
+
 #: `--suite` registry; any name here is also directly invocable as
 #: `python bench.py <suite_name>`
 SUITES = (
@@ -2154,6 +2320,7 @@ SUITES = (
     suite_tiered_recall,
     suite_decode_serving,
     suite_hbm_ledger,
+    suite_tenant_isolation,
 )
 
 

@@ -230,6 +230,8 @@ from . import serving  # overload-safe query plane (admission/deadlines/batching
 from .serving import ServingConfig
 from . import decode  # on-chip generation (paged-KV continuous batching)
 from .decode import DecodeConfig
+from . import tenancy  # multi-tenant serving plane (packed slabs/quotas)
+from .tenancy import TenancyConfig, TenantQuotas
 
 
 def __getattr__(name):
@@ -263,4 +265,5 @@ __all__ = [
     "wrap_py_object", "xpacks", "universes", "LiveTable", "analysis",
     "resilience", "Recovery", "RecoveryEscalated", "RetryPolicy",
     "RunResult", "serving", "ServingConfig", "decode", "DecodeConfig",
+    "tenancy", "TenancyConfig", "TenantQuotas",
 ]

@@ -62,6 +62,15 @@ PWL015 (warning) combined HBM oversubscription: the index plane and the
                  are resident. Shrink one plane, shard the index, or
                  raise the budget; the live ledger (pathway doctor)
                  tracks the same accounts at runtime.
+PWL016 (warning) tenancy without quotas: the multi-tenant plane is
+                 configured (pw.run(tenancy=) / PATHWAY_TENANCY) but no
+                 per-tenant quotas and no default quota exist — every
+                 tenant is unthrottled, so one flooding tenant takes
+                 whatever chip time and HBM it wants and the isolation
+                 the plane exists for never engages. Also fires when
+                 the named quotas' HBM budgets sum past
+                 PATHWAY_HBM_BYTES (the admission booking would let
+                 tenants collectively OOM the slab).
 """
 
 from __future__ import annotations
@@ -111,6 +120,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL013": (Severity.WARNING, "HTTP LLM stage with a device decode plane available"),
     "PWL014": (Severity.WARNING, "SLO-budgeted endpoint with tracing and profiler off"),
     "PWL015": (Severity.WARNING, "combined planes oversubscribe the HBM budget"),
+    "PWL016": (Severity.WARNING, "tenancy configured without per-tenant quotas"),
 }
 
 _MUTABLE_TYPES = (list, dict, set, bytearray)
@@ -1212,6 +1222,71 @@ def check_combined_hbm_oversubscription(view: GraphView) -> list[Diagnostic]:
     ]
 
 
+# --------------------------------------------------------------------------
+# PWL016 — tenancy configured without per-tenant quotas
+
+
+def check_tenancy_without_quotas(view: GraphView) -> list[Diagnostic]:
+    """The multi-tenant serving plane is on (``pw.run(tenancy=...)`` /
+    PATHWAY_TENANCY, recorded on ``run_context`` jax-free) but nothing
+    bounds any tenant: no named quotas and no default quota. The plane
+    then routes and labels per tenant but never throttles — one
+    flooding tenant still takes whatever chip time and HBM it wants,
+    which is exactly the failure mode tenancy exists to prevent. The
+    second arm: the named quotas' ``hbm_bytes`` budgets *sum* past the
+    PATHWAY_HBM_BYTES budget, so admission would happily book tenant
+    segments the device cannot actually hold (the per-tenant check in
+    the packed slab passes tenant-by-tenant)."""
+    ctx = getattr(view.graph, "run_context", None) or {}
+    if not ctx:
+        return []  # no pw.run configuration recorded (unit-built graph)
+    tcfg = ctx.get("tenancy") or None
+    if not tcfg:
+        return []
+    quotas = tcfg.get("quotas") or {}
+    default = tcfg.get("default") or None
+    if not quotas and not default:
+        return [
+            _diag(
+                "PWL016",
+                "the multi-tenant serving plane is configured but no "
+                "per-tenant quotas and no default quota exist: tenants "
+                "are routed and labeled but never throttled, so one "
+                "flooding tenant still monopolizes chip time and HBM. "
+                "Name quotas (tenancy={'quotas': {'acme': {'qps': 100, "
+                "'hbm': '64M'}}}) or set a default "
+                "(tenancy='qps=50,inflight=8' applies to every tenant)",
+                detail={"tenancy": tcfg},
+            )
+        ]
+    budget = _hbm_budget()
+    booked = {
+        t: int(q["hbm_bytes"])
+        for t, q in quotas.items()
+        if isinstance(q, dict) and q.get("hbm_bytes")
+    }
+    total = sum(booked.values())
+    if booked and total > budget:
+        return [
+            _diag(
+                "PWL016",
+                f"the per-tenant HBM quotas of {len(booked)} tenant(s) "
+                f"sum to ~{total / 1024**2:.0f} MiB against a "
+                f"{budget / 1024**2:.0f} MiB budget (PATHWAY_HBM_BYTES): "
+                "each tenant passes its own admission check, but "
+                "collectively they can book segments the device cannot "
+                "hold — the slab OOMs once enough tenants grow into "
+                "their quotas. Shrink the quotas or raise the budget",
+                detail={
+                    "tenant_hbm_bytes": booked,
+                    "total_bytes": total,
+                    "hbm_budget_bytes": budget,
+                },
+            )
+        ]
+    return []
+
+
 LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dtype_consistency,
     check_unbounded_state,
@@ -1228,4 +1303,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_http_llm_with_device_decode,
     check_slo_without_tracing,
     check_combined_hbm_oversubscription,
+    check_tenancy_without_quotas,
 ]

@@ -220,6 +220,7 @@ class MonitoringHttpServer:
         lines.extend(self._decode_lines(wl))
         lines.extend(self._tracing_lines(wl))
         lines.extend(self._ledger_lines(wl))
+        lines.extend(self._tenancy_lines(wl))
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -745,6 +746,63 @@ class MonitoringHttpServer:
         lines.append(series("pathway_hbm_budget_bytes", snap["budget_bytes"]))
         return lines
 
+    @staticmethod
+    def _tenancy_lines(wl: str = "") -> list[str]:
+        """Per-tenant plane (``tenant``-labeled series under the
+        serving/index/hbm prefixes). Rendered only once a tenant was
+        ever named on an admit or index — single-tenant runs scrape
+        byte-identical. Tenants past PATHWAY_METRIC_TENANTS fold into
+        ``tenant="other"`` (the fold happens in snapshot(), so the
+        label set stays bounded no matter how many tenants exist)."""
+        from ..tenancy.metrics import TENANCY_METRICS
+
+        if not TENANCY_METRICS.active():
+            return []
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+
+        snap = TENANCY_METRICS.snapshot()
+        tenants = snap["tenants"]
+        lines: list[str] = []
+        for metric, key, kind, fmt in (
+            ("pathway_serving_tenant_admitted_total", "admitted", "counter", str),
+            ("pathway_serving_tenant_degraded_total", "degraded", "counter", str),
+            ("pathway_serving_tenant_inflight", "inflight", "gauge", str),
+            (
+                "pathway_serving_tenant_chip_seconds_total",
+                "chip_seconds",
+                "counter",
+                lambda v: f"{v:.6f}",
+            ),
+            ("pathway_index_tenant_docs", "docs", "gauge", str),
+            ("pathway_index_tenant_searches_total", "searches", "counter", str),
+            ("pathway_hbm_tenant_bytes", "hbm_bytes", "gauge", str),
+        ):
+            lines.append(f"# TYPE {metric} {kind}")
+            for tenant, row in tenants.items():
+                lines.append(
+                    series(metric, fmt(row[key]), f'tenant="{_escape_label(tenant)}"')
+                )
+        shed_lines = [
+            series(
+                "pathway_serving_tenant_shed_total",
+                n,
+                f'tenant="{_escape_label(tenant)}",reason="{_escape_label(reason)}"',
+            )
+            for tenant, row in tenants.items()
+            for reason, n in sorted(row["shed"].items())
+        ]
+        if shed_lines:
+            lines.append("# TYPE pathway_serving_tenant_shed_total counter")
+            lines.extend(shed_lines)
+        lines.append("# TYPE pathway_tenant_count gauge")
+        lines.append(series("pathway_tenant_count", snap["tenant_count"]))
+        lines.append("# TYPE pathway_tenant_folded gauge")
+        lines.append(series("pathway_tenant_folded", snap["folded"]))
+        return lines
+
     def _status(self) -> str:
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
 
@@ -806,6 +864,10 @@ class MonitoringHttpServer:
 
         if LEDGER.active():
             status["hbm"] = LEDGER.snapshot()
+        from ..tenancy.metrics import TENANCY_METRICS
+
+        if TENANCY_METRICS.active():
+            status["tenants"] = TENANCY_METRICS.snapshot()
         return json.dumps(status)
 
     # -- lifecycle --

@@ -634,7 +634,20 @@ class HealthWatchdog:
                 "dump_path": self.dump_path,
                 "dump_error": self.dump_error,
                 "hbm": LEDGER.snapshot() if LEDGER.active() else None,
+                "tenants": self._tenants_snapshot(),
             }
+
+    @staticmethod
+    def _tenants_snapshot() -> dict | None:
+        """Per-tenant block for the verdict (``pathway doctor``'s
+        tenant rows); None unless the tenancy plane saw activity."""
+        try:
+            from ..tenancy.metrics import TENANCY_METRICS
+        except Exception:
+            return None
+        if not TENANCY_METRICS.active():
+            return None
+        return TENANCY_METRICS.snapshot()
 
     # -- thread --
 
@@ -800,6 +813,24 @@ def render_verdict(verdict: dict) -> str:
                 f"    {account:<14} {acc.get('bytes', 0) / 2**20:8.1f} MiB "
                 f"({acc.get('owners', 0)} owners, "
                 f"frag {acc.get('fragmentation', 0.0) * 100:.0f}%)"
+            )
+    tenants = verdict.get("tenants")
+    if tenants:
+        rows = tenants.get("tenants") or {}
+        folded = tenants.get("folded", 0)
+        summary = f"  tenants: {tenants.get('tenant_count', len(rows))} active"
+        if folded:
+            summary += f" ({folded} folded into \"other\")"
+        lines.append(summary)
+        for tenant, row in rows.items():
+            shed = sum((row.get("shed") or {}).values())
+            state = "cold" if row.get("cold") else "hot"
+            lines.append(
+                f"    {tenant:<14} {row.get('docs', 0):>7} docs "
+                f"{row.get('hbm_bytes', 0) / 2**20:8.1f} MiB {state:<4} "
+                f"admitted={row.get('admitted', 0)} shed={shed} "
+                f"inflight={row.get('inflight', 0)} "
+                f"chip={row.get('chip_seconds', 0.0):.3f}s"
             )
     if verdict.get("dump_path"):
         lines.append(f"  flight recorder dump: {verdict['dump_path']}")

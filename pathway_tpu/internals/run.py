@@ -87,6 +87,7 @@ def run(
     mesh: Any = None,
     index_tiers: Any = None,
     decode: Any = None,
+    tenancy: Any = None,
     cluster_accept_timeout: float | None = None,
     cluster_hello_timeout: float | None = None,
     cluster_lease_ms: float | None = None,
@@ -119,6 +120,15 @@ def run(
     verdict lands in :attr:`RunResult.health` (and, when
     PATHWAY_HEALTH_OUT names a path, as JSON on disk for ``pathway
     doctor``).
+    ``tenancy``: enables the multi-tenant serving plane for this run —
+    ``True``/``"on"`` for defaults, a spec string
+    (``"demote_every=64,qps=50,inflight=8"`` — quota knobs become the
+    default per-tenant quota), a dict
+    (``{"quotas": {"acme": {"qps": 100, "hbm": "64M", "weight": 2.0}},
+    "default": {...}}``), or a
+    :class:`~pathway_tpu.tenancy.TenancyConfig`. Admission, batching,
+    and tenant-packed indexes built during the run read it via
+    ``active_tenancy()``. Defaults to the PATHWAY_TENANCY env var.
     ``monitoring_http_port``: explicit /metrics port for
     ``with_http_server`` (0 = ephemeral); default 20000 + process_id.
 
@@ -238,6 +248,17 @@ def run(
         _decode_cfg = parse_decode_spec(_decode_spec)
     except ValueError:
         _decode_cfg = None
+    # tenancy spec parsed jax-free too: PWL016 (tenancy without quotas)
+    # reads this off the graph
+    from ..tenancy.config import parse_tenancy_spec
+
+    _tenancy_spec = (
+        tenancy if tenancy is not None else (os.environ.get("PATHWAY_TENANCY") or None)
+    )
+    try:
+        _tenancy_cfg = parse_tenancy_spec(_tenancy_spec)
+    except ValueError:
+        _tenancy_cfg = None
     # explicit tracing= wins over PATHWAY_TRACING (tracing=False turns
     # an env-enabled plane off for this run)
     _tracing_on = (
@@ -280,6 +301,9 @@ def run(
         # device decode plane available) treats a configured decode as
         # the on-chip alternative being ready
         "decode": _decode_cfg.as_dict() if _decode_cfg is not None else None,
+        # TenancyConfig knob dict or None; PWL016 (tenancy without
+        # per-tenant quotas / oversubscribed quota HBM) reads this
+        "tenancy": _tenancy_cfg.as_dict() if _tenancy_cfg is not None else None,
         # request-journey tracing + profiler intent, resolved jax-free;
         # PWL014 (SLO budget with no observability) reads both
         "tracing": _tracing_on,
@@ -463,6 +487,12 @@ def run(
 
     if decode is not None and _decode_cfg is not None:
         set_active_decode(_decode_cfg)
+    # and the run-scoped tenancy config: admission / batching / packed
+    # indexes during this run pick it up via active_tenancy()
+    from ..tenancy.config import set_active_tenancy
+
+    if tenancy is not None and _tenancy_cfg is not None:
+        set_active_tenancy(_tenancy_cfg)
     with mon_ctx as monitor:
         http_server = None
         if with_http_server:
@@ -642,6 +672,8 @@ def run(
                 set_active_tiers(None)
             if decode is not None and _decode_cfg is not None:
                 set_active_decode(None)
+            if tenancy is not None and _tenancy_cfg is not None:
+                set_active_tenancy(None)
             if _watchdog is not None:
                 _watchdog.stop()
                 # one final evaluation so even runs shorter than the

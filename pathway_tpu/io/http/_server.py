@@ -206,6 +206,7 @@ def rest_connector(
         SERVING_METRICS,
         AdaptiveBatcher,
     )
+    from ...tenancy.config import TENANT_HEADER, active_tenancy
     from ...tracing import (
         TRACE_RESPONSE_HEADER,
         TRACEPARENT_HEADER,
@@ -268,8 +269,18 @@ def rest_connector(
             inbound = TraceContext.from_traceparent(
                 request.headers.get(TRACEPARENT_HEADER)
             )
+        # multi-tenant serving: the tenant named in X-Pathway-Tenant
+        # follows the request through admission (per-tenant quotas),
+        # batching (fair-share heaps), tracing, and the tenant-labeled
+        # metrics; absent header = the single-tenant legacy path
+        tenant = request.headers.get(TENANT_HEADER) or None
         with trace_span(
-            "request", ctx=inbound, new_trace=True, boundary=True, route=route
+            "request",
+            ctx=inbound,
+            new_trace=True,
+            boundary=True,
+            route=route,
+            **({"tenant": tenant} if tenant else {}),
         ) as root_sp:
             trace_id = root_sp.trace_id if root_sp is not None else ""
 
@@ -295,11 +306,13 @@ def rest_connector(
                         status=500,
                     )
                 try:
-                    ticket = admission.admit(deadline)
+                    ticket = admission.admit(deadline, tenant=tenant)
                 except OverloadError as exc:
                     return _overload_response(respond, exc)
             try:
-                return await _serve_admitted(request, respond, deadline, ticket, qid)
+                return await _serve_admitted(
+                    request, respond, deadline, ticket, qid, tenant
+                )
             finally:
                 if admission is not None and ticket is not None:
                     admission.release(ticket)
@@ -307,7 +320,7 @@ def rest_connector(
                         "total", asyncio.get_running_loop().time() - t_start
                     )
 
-    async def _serve_admitted(request, respond, deadline, ticket, qid):
+    async def _serve_admitted(request, respond, deadline, ticket, qid, tenant=None):
         if request.method == "GET":
             payload = dict(request.rel_url.query)
         elif format == "raw":
@@ -342,10 +355,18 @@ def rest_connector(
         degraded = ticket is not None and ticket.degraded
         if degraded and serving is not None:
             # shed="degrade": serve reduced top-k instead of rejecting —
-            # clamp the retrieval fan-out fields RAG endpoints carry
+            # clamp the retrieval fan-out fields RAG endpoints carry.
+            # A tenant quota's min_top_k is that tenant's SLO floor:
+            # degradation never clamps below it.
+            floor_k = serving.degrade_top_k
+            if tenant is not None:
+                cfg = active_tenancy()
+                quota = cfg.quota_for(tenant) if cfg is not None else None
+                if quota is not None and quota.min_top_k is not None:
+                    floor_k = max(floor_k, quota.min_top_k)
             k = values.get("k")
-            if isinstance(k, int) and k > serving.degrade_top_k:
-                values["k"] = serving.degrade_top_k
+            if isinstance(k, int) and k > floor_k:
+                values["k"] = floor_k
             if isinstance(values.get("rerank"), bool):
                 values["rerank"] = False
         key = int(ref_scalar(qid))
@@ -361,7 +382,7 @@ def rest_connector(
         if batcher is not None:
             # adaptive batching: the batcher fuses concurrent queries
             # into one engine commit, sized by observed device latency
-            batcher.submit((key, row), deadline)
+            batcher.submit((key, row), deadline, tenant=tenant)
         else:
             ctx.session.insert(key, row)
             ctx.session.commit()

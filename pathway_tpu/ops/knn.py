@@ -550,11 +550,21 @@ class DeviceKnnIndex:
             out.append(self._free_shard[s].pop())
         return out
 
+    def _live_docs_shard(self) -> list[int]:
+        """Per-shard live row counts from the validity mask — what the
+        imbalance gauge must see. Identical to ``_docs_shard`` for a
+        flat index; for a tenant-packed slab, segment rows that are
+        reserved to a tenant but not yet occupied must not read as
+        skew (``pathway_index_imbalance`` is live rows, not granted
+        capacity)."""
+        v = self._valid_host.reshape(self.n_shards, self.shard_capacity)
+        return [int(n) for n in v.sum(axis=1)]
+
     def _publish_metrics(self) -> None:
         from .index_metrics import INDEX_METRICS
 
         INDEX_METRICS.update_index(
-            self.name, list(self._docs_shard), self.shard_capacity
+            self.name, self._live_docs_shard(), self.shard_capacity
         )
         self._ledger_update()
 

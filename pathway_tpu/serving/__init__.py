@@ -44,6 +44,7 @@ from .admission import (
     RateLimited,
     ServingConfig,
     ShardUnavailable,
+    TenantRateLimited,
     Ticket,
     TokenBucket,
 )
@@ -70,6 +71,7 @@ __all__ = [
     "ServingConfig",
     "ServingMetrics",
     "ShardUnavailable",
+    "TenantRateLimited",
     "Ticket",
     "TokenBucket",
     "bind_deadline",

@@ -61,6 +61,7 @@ __all__ = [
     "RateLimited",
     "ServingConfig",
     "ShardUnavailable",
+    "TenantRateLimited",
     "Ticket",
     "TokenBucket",
 ]
@@ -92,6 +93,34 @@ class OverloadError(RuntimeError):
 class RateLimited(OverloadError):
     status = 429
     reason = "rate_limited"
+
+
+class TenantRateLimited(RateLimited):
+    """One tenant exhausted *its own* quota (QPS bucket or inflight
+    cap from :class:`~pathway_tpu.tenancy.TenantQuotas`) — the endpoint
+    as a whole is healthy; only this tenant backs off. Checked before
+    every endpoint-wide gate (including shard health), so a tenant at
+    its cap always sees 429 ``tenant_rate_limited`` deterministically,
+    never a racy 503."""
+
+    status = 429
+    reason = "tenant_rate_limited"
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        retry_after_s: float | None = None,
+        tenant: str = "",
+    ):
+        super().__init__(message, retry_after_s=retry_after_s)
+        self.tenant = tenant
+
+    def to_response(self) -> dict:
+        body = super().to_response()
+        if self.tenant:
+            body["tenant"] = self.tenant
+        return body
 
 
 class QueueFull(OverloadError):
@@ -196,7 +225,15 @@ class TokenBucket:
 class Ticket:
     """One admitted request's slot in the ledger."""
 
-    __slots__ = ("deadline", "seq", "degraded", "admitted_at", "route", "trace")
+    __slots__ = (
+        "deadline",
+        "seq",
+        "degraded",
+        "admitted_at",
+        "route",
+        "trace",
+        "tenant",
+    )
 
     def __init__(
         self,
@@ -206,6 +243,7 @@ class Ticket:
         degraded: bool = False,
         route: str = "/",
         trace=None,  # pathway_tpu.tracing.TraceContext | None
+        tenant: str | None = None,
     ):
         self.deadline = deadline
         self.seq = seq
@@ -213,6 +251,7 @@ class Ticket:
         self.admitted_at = _time.monotonic()
         self.route = route
         self.trace = trace
+        self.tenant = tenant
 
 
 class AdmissionController:
@@ -246,6 +285,11 @@ class AdmissionController:
             self._bucket = TokenBucket(
                 self.config.rate_limit_qps, self.config.rate_limit_burst
             )
+        # per-tenant fair-share state (lazy: populated only when a
+        # tenant-carrying request arrives under an active tenancy
+        # config, so untenanted endpoints pay nothing)
+        self._tenant_buckets: dict[str, tuple[float, int, TokenBucket]] = {}
+        self._tenant_inflight: dict[str, int] = {}
 
     @property
     def depth(self) -> int:
@@ -260,17 +304,27 @@ class AdmissionController:
             return self._heap[0][0] if self._heap else None
 
     def admit(
-        self, deadline: Deadline | None = None, *, shard: int | None = None
+        self,
+        deadline: Deadline | None = None,
+        *,
+        shard: int | None = None,
+        tenant: str | None = None,
     ) -> Ticket:
-        """Admit or shed. Raises :class:`RateLimited` /
-        :class:`QueueFull` / :class:`DeadlineExceeded` /
-        :class:`ShardUnavailable`.
+        """Admit or shed. Raises :class:`TenantRateLimited` /
+        :class:`RateLimited` / :class:`QueueFull` /
+        :class:`DeadlineExceeded` / :class:`ShardUnavailable`.
 
         ``shard`` pins the request to one engine shard; while the
         cluster fault domain has that shard marked down (worker died,
         partial restart in flight) the request is shed — or, under
         ``shed="degrade"``, admitted as a degraded ticket the endpoint
         answers from the healthy shards only.
+
+        ``tenant`` names the requesting tenant; when a tenancy config
+        is active its quotas (QPS bucket, inflight cap) are enforced
+        *before* any endpoint-wide gate — a tenant at its cap always
+        sees a 429 ``tenant_rate_limited``, even while a shard is down,
+        so quota/degrade interactions stay deterministic.
         """
         from ..internals import flight_recorder
         from ..resilience import chaos as _chaos
@@ -293,6 +347,11 @@ class AdmissionController:
         # burst-arrival chaos site: a delay rule here simulates a
         # thundering herd piling up at the front door
         _chaos.inject("serving.admit")
+
+        quota = None
+        if tenant is not None:
+            tenant = str(tenant)
+            quota = self._check_tenant(tenant, trace_ctx, trace_extra)
 
         shard_degraded = False
         if shard is not None and CLUSTER_HEALTH.is_down(shard):
@@ -374,11 +433,25 @@ class AdmissionController:
             self._live.add(seq)
             heapq.heappush(self._heap, (deadline.expires_at, seq))
             new_depth = len(self._live)
+            tenant_inflight = None
+            if tenant is not None:
+                tenant_inflight = self._tenant_inflight.get(tenant, 0) + 1
+                self._tenant_inflight[tenant] = tenant_inflight
 
         ticket = Ticket(
-            deadline, seq, degraded=degraded, route=self.route, trace=trace_ctx
+            deadline,
+            seq,
+            degraded=degraded,
+            route=self.route,
+            trace=trace_ctx,
+            tenant=tenant,
         )
         self.metrics.record_admit(degraded=degraded)
+        if tenant is not None:
+            from ..tenancy.metrics import TENANCY_METRICS
+
+            TENANCY_METRICS.record_admit(tenant, degraded=degraded)
+            TENANCY_METRICS.set_inflight(tenant, tenant_inflight)
         self.metrics.set_queue_depth(new_depth)
         self.metrics.observe_stage("admission", _time.monotonic() - t0)
         flight_recorder.record(
@@ -401,6 +474,78 @@ class AdmissionController:
             )
         return ticket
 
+    def _check_tenant(self, tenant: str, trace_ctx, trace_extra) -> "TenantQuotas | None":
+        """Per-tenant quota gates (QPS bucket, inflight cap), enforced
+        before every endpoint-wide gate. Returns the tenant's quotas
+        (None when no tenancy config names this tenant — the request
+        is still tenant-attributed, just unquota'd)."""
+        from ..internals import flight_recorder
+        from ..tenancy.config import active_tenancy
+        from ..tenancy.metrics import TENANCY_METRICS
+
+        cfg = active_tenancy()
+        quota = cfg.quota_for(tenant) if cfg is not None else None
+        if quota is None:
+            return None
+        if quota.qps is not None:
+            with self._lock:
+                entry = self._tenant_buckets.get(tenant)
+                if (
+                    entry is None
+                    or entry[0] != quota.qps
+                    or entry[1] != quota.burst
+                ):
+                    entry = (
+                        quota.qps,
+                        quota.burst,
+                        TokenBucket(quota.qps, quota.burst),
+                    )
+                    self._tenant_buckets[tenant] = entry
+            bucket = entry[2]
+            if not bucket.try_acquire():
+                retry_after = bucket.retry_after()
+                self.metrics.record_shed("tenant_rate_limited")
+                TENANCY_METRICS.record_shed(tenant, "tenant_rate_limited")
+                flight_recorder.record(
+                    "tenant.shed",
+                    route=self.route,
+                    tenant=tenant,
+                    reason="qps",
+                    **trace_extra,
+                )
+                raise self._traced(
+                    TenantRateLimited(
+                        f"tenant {tenant!r} exceeded its rate quota "
+                        f"({quota.qps:g} qps)",
+                        retry_after_s=retry_after,
+                        tenant=tenant,
+                    ),
+                    trace_ctx,
+                )
+        if quota.max_inflight is not None:
+            with self._lock:
+                inflight = self._tenant_inflight.get(tenant, 0)
+            if inflight >= quota.max_inflight:
+                self.metrics.record_shed("tenant_rate_limited")
+                TENANCY_METRICS.record_shed(tenant, "tenant_rate_limited")
+                flight_recorder.record(
+                    "tenant.shed",
+                    route=self.route,
+                    tenant=tenant,
+                    reason="inflight",
+                    inflight=inflight,
+                    **trace_extra,
+                )
+                raise self._traced(
+                    TenantRateLimited(
+                        f"tenant {tenant!r} is at its inflight cap "
+                        f"({inflight}/{quota.max_inflight})",
+                        tenant=tenant,
+                    ),
+                    trace_ctx,
+                )
+        return quota
+
     @staticmethod
     def _traced(exc: OverloadError, trace_ctx) -> OverloadError:
         if trace_ctx is not None:
@@ -408,10 +553,19 @@ class AdmissionController:
         return exc
 
     def release(self, ticket: Ticket) -> None:
+        tenant = ticket.tenant
+        tenant_inflight = None
         with self._lock:
             self._live.discard(ticket.seq)
             depth = len(self._live)
+            if tenant is not None:
+                tenant_inflight = max(0, self._tenant_inflight.get(tenant, 0) - 1)
+                self._tenant_inflight[tenant] = tenant_inflight
         self.metrics.set_queue_depth(depth)
+        if tenant is not None:
+            from ..tenancy.metrics import TENANCY_METRICS
+
+            TENANCY_METRICS.set_inflight(tenant, tenant_inflight)
 
     def expire(self, ticket: Ticket) -> DeadlineExceeded:
         """Record a mid-pipeline budget expiry (the response wait ran

@@ -17,7 +17,13 @@ into *fused* dispatches instead:
   remainder of the slot, so ingest epochs keep landing while queries
   burst (``query_share=1.0`` disables the yield);
 - queries whose deadline expired while queued are *dropped*, not
-  dispatched — dead work never reaches the device.
+  dispatched — dead work never reaches the device;
+- queries submitted with a ``tenant=`` go into per-tenant deadline
+  heaps drained by weighted deficit round-robin (weights from the
+  active :class:`~pathway_tpu.tenancy.TenancyConfig` quotas), so one
+  flooding tenant cannot monopolise fused batches; tenant-less
+  submissions keep the legacy single heap and that path is untouched
+  byte-for-byte.
 
 Chaos sites (``resilience/chaos.py`` rules target these):
 ``serving.before_dispatch`` — a ``delay`` rule here is the
@@ -76,6 +82,11 @@ class AdaptiveBatcher:
         self._lock = threading.Lock()
         self._heap: list[tuple[float, int, Any, float]] = []
         # (expires_at, seq, item, enqueued_at)
+        # per-tenant deadline heaps (same entry shape) + deficit
+        # round-robin state; empty unless submit() ever names a tenant
+        self._tenant_heaps: dict[str, list] = {}
+        self._deficit: dict[str, float] = {}
+        self._rr: list[str] = []  # tenant service order (first-seen)
         self._wake = threading.Event()
         self._halt = False
         self._thread: Optional[threading.Thread] = None
@@ -105,28 +116,44 @@ class AdaptiveBatcher:
 
     # -- producer side --
 
-    def submit(self, item: Any, deadline: Deadline | None = None, trace=None) -> None:
+    def submit(
+        self,
+        item: Any,
+        deadline: Deadline | None = None,
+        trace=None,
+        tenant: str | None = None,
+    ) -> None:
         """Queue one item for the next fused dispatch (starts the
         worker on first use). ``trace`` (a TraceContext) defaults to
         the submitter's bound context, so the request journey follows
-        the item onto the batcher thread without caller changes."""
+        the item onto the batcher thread without caller changes.
+        ``tenant`` routes the item into that tenant's fair-share heap
+        (see the module docstring); ``None`` keeps the legacy heap."""
         if deadline is None:
             deadline = Deadline.none()
         if trace is None and _tracing_enabled():
             from ..tracing import current_trace
 
             trace = current_trace()
+        entry = (deadline.expires_at, next(self._seq), item, _time.monotonic(), trace)
         with self._lock:
-            heapq.heappush(
-                self._heap,
-                (deadline.expires_at, next(self._seq), item, _time.monotonic(), trace),
-            )
+            if tenant is None:
+                heapq.heappush(self._heap, entry)
+            else:
+                tenant = str(tenant)
+                heap = self._tenant_heaps.get(tenant)
+                if heap is None:
+                    heap = self._tenant_heaps[tenant] = []
+                    self._rr.append(tenant)
+                heapq.heappush(heap, entry)
         self.start()
         self._wake.set()
 
     def pending(self) -> int:
         with self._lock:
-            return len(self._heap)
+            return len(self._heap) + sum(
+                len(h) for h in self._tenant_heaps.values()
+            )
 
     # -- engine integration --
 
@@ -165,24 +192,33 @@ class AdaptiveBatcher:
 
     # -- worker --
 
-    def _take_batch(self) -> tuple[list[Any], list[float], list[Any]]:
+    def _take_batch(
+        self,
+    ) -> tuple[list[Any], list[float], list[Any], list[Any]]:
         """Pop up to current_batch_size() live items in deadline order;
-        expired items are dropped (never dispatched)."""
+        expired items are dropped (never dispatched). With tenant heaps
+        present, items are drained by weighted deficit round-robin so
+        the batch interleaves tenants by quota weight."""
         limit = self.current_batch_size()
         now = _time.monotonic()
         items: list[Any] = []
         enqueued: list[float] = []
         traces: list[Any] = []
+        tenants: list[Any] = []
         expired: list[tuple[Any, float, Any]] = []
         with self._lock:
-            while self._heap and len(items) < limit:
-                expires_at, _seq, item, enq, trace = heapq.heappop(self._heap)
-                if expires_at <= now:
-                    expired.append((item, enq, trace))
-                else:
-                    items.append(item)
-                    enqueued.append(enq)
-                    traces.append(trace)
+            if self._tenant_heaps:
+                self._take_weighted(limit, now, items, enqueued, traces, tenants, expired)
+            else:
+                while self._heap and len(items) < limit:
+                    expires_at, _seq, item, enq, trace = heapq.heappop(self._heap)
+                    if expires_at <= now:
+                        expired.append((item, enq, trace))
+                    else:
+                        items.append(item)
+                        enqueued.append(enq)
+                        traces.append(trace)
+                        tenants.append(None)
         for item, enq, trace in expired:
             self.dropped_expired_total += 1
             self.metrics.record_deadline_expired()
@@ -199,7 +235,52 @@ class AdaptiveBatcher:
                     self._on_expired(item)
                 except Exception:
                     pass
-        return items, enqueued, traces
+        return items, enqueued, traces, tenants
+
+    def _take_weighted(
+        self, limit, now, items, enqueued, traces, tenants, expired
+    ) -> None:
+        """Deficit round-robin drain across the tenant heaps (plus the
+        legacy heap as an anonymous weight-1.0 participant). Each pass
+        credits every backlogged tenant ``weight`` units of deficit and
+        pops one item per whole unit, so over a window each tenant's
+        share of fused-batch slots converges to its quota weight.
+        Caller holds ``self._lock``."""
+        from ..tenancy.config import active_tenancy
+
+        cfg = active_tenancy()
+
+        def _weight(t) -> float:
+            if t is None or cfg is None:
+                return 1.0
+            quota = cfg.quota_for(t)
+            w = quota.weight if quota is not None else 1.0
+            return max(float(w), 1e-3)
+
+        while len(items) < limit:
+            backlog: list[Any] = [t for t in self._rr if self._tenant_heaps.get(t)]
+            if self._heap:
+                backlog.append(None)
+            if not backlog:
+                break
+            for t in backlog:
+                heap = self._heap if t is None else self._tenant_heaps[t]
+                self._deficit[t] = self._deficit.get(t, 0.0) + _weight(t)
+                while heap and self._deficit[t] >= 1.0 and len(items) < limit:
+                    expires_at, _seq, item, enq, trace = heapq.heappop(heap)
+                    if expires_at <= now:
+                        expired.append((item, enq, trace))
+                        continue
+                    self._deficit[t] -= 1.0
+                    items.append(item)
+                    enqueued.append(enq)
+                    traces.append(trace)
+                    tenants.append(t)
+                if not heap:
+                    # classic DRR: an emptied queue forfeits its credit
+                    self._deficit[t] = 0.0
+                if len(items) >= limit:
+                    break
 
     def _loop(self) -> None:
         from ..internals import flight_recorder
@@ -219,7 +300,7 @@ class AdaptiveBatcher:
                 if window_s > 0.0 and self.pending() < self.current_batch_size():
                     _time.sleep(window_s)
                 while not self._halt:
-                    items, enqueued, traces = self._take_batch()
+                    items, enqueued, traces, tenants = self._take_batch()
                     if not items:
                         break
                     now = _time.monotonic()
@@ -278,6 +359,12 @@ class AdaptiveBatcher:
                                 size=len(items),
                             )
                     per_item = wall / len(items)
+                    if any(t is not None for t in tenants):
+                        from ..tenancy.metrics import TENANCY_METRICS
+
+                        for t in tenants:
+                            if t is not None:
+                                TENANCY_METRICS.add_chip_seconds(t, per_item)
                     if self._ewma_item_s == 0.0:
                         self._ewma_item_s = per_item
                     else:

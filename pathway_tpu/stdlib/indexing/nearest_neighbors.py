@@ -94,6 +94,49 @@ class _TieredPayloadIndex(TieredKnnIndex):
         return super().search_batch(q, k, filter_fns)
 
 
+class _TenantPayloadView:
+    """One tenant's slice of a shared :class:`TenantPackedIndex` slab,
+    with the same payload coercion as :class:`_VectorPayloadIndex` —
+    what ``tenant=`` hands the engine instead of a private index."""
+
+    def __init__(self, view):
+        self._view = view
+
+    @property
+    def dim(self):
+        return self._view.dim
+
+    @property
+    def metric(self):
+        return self._view.metric
+
+    def __len__(self):
+        return len(self._view)
+
+    def add(self, key, payload, metadata=None):
+        self._view.add(key, _as_vector(payload), metadata)
+
+    def add_batch(self, items):
+        self._view.add_batch([(k, _as_vector(p), m) for k, p, m in items])
+
+    def add_batch_arrays(self, keys, vectors, metadatas=None):
+        self._view.add_batch_arrays(keys, vectors, metadatas)
+
+    def remove(self, key):
+        self._view.remove(key)
+
+    def search_batch(self, payloads, k, filter_fns=None):
+        if not len(payloads):
+            return []
+        q = np.stack([_as_vector(p) for p in payloads])
+        return self._view.search_batch(q, k, filter_fns)
+
+    def search_one(self, payload, k, filter_fn=None):
+        return self.search_batch(
+            [payload], k, [filter_fn] if filter_fn is not None else None
+        )[0]
+
+
 def fused_query_encoder(embedder) -> Any | None:
     """The SentenceEncoder behind ``embedder`` when its internals
     (module/params/tokenizer) are exposed for the fused query path."""
@@ -117,6 +160,12 @@ class AbstractKnn(InnerIndex):
     #: ops.tiered_knn.parse_tier_spec); None defers to the run-scoped
     #: config from ``pw.run(index_tiers=...)`` / ``PATHWAY_INDEX_TIERS``
     tiers: Any = None
+    #: tenant id: this index becomes one tenant's segment of the shared
+    #: :class:`~pathway_tpu.tenancy.TenantPackedIndex` slab for its
+    #: (dimensions, metric, mesh) geometry — 10k tiny tenants cost one
+    #: compile. Takes precedence over ``tiers`` (the slab manages its
+    #: own hot/cold movement via cold-tenant demotion).
+    tenant: str | None = None
 
     # device-index classes (DeviceKnnIndex-backed) opt in to the
     # HBM-resident ingest + fused text-query paths; host-side tiers
@@ -136,6 +185,7 @@ class AbstractKnn(InnerIndex):
             "device_backed": True,
             "mesh": self.mesh is not None,
             "tiers": self.tiers is not None,
+            "tenant": self.tenant,
         }
 
     def _embed_fns(self):
@@ -182,6 +232,7 @@ class AbstractKnn(InnerIndex):
         enc = fused_query_encoder(self.embedder) if self.embedder else None
         mesh_spec = self.mesh
         tier_spec = self.tiers
+        tenant = self.tenant
 
         def make():
             # mesh + tier resolution happens HERE — at lowering time
@@ -192,6 +243,16 @@ class AbstractKnn(InnerIndex):
             from ...parallel.mesh import active_mesh, resolve_mesh
 
             mesh = resolve_mesh(mesh_spec) if mesh_spec is not None else active_mesh()
+            if tenant is not None:
+                # tenant-packed path: this "index" is one tenant's
+                # segment of the process-wide shared slab for the
+                # (dim, metric, mesh) geometry
+                from ...tenancy import shared_slab
+
+                slab = shared_slab(
+                    dim, metric=metric, reserved_space=max(64, res), mesh=mesh
+                )
+                return _TenantPayloadView(slab.view(tenant))
             tiers = (
                 parse_tier_spec(tier_spec)
                 if tier_spec is not None
@@ -339,6 +400,7 @@ class KnnIndexFactory(InnerIndexFactory):
     embedder: Callable | None = None
     mesh: Any = None  # explicit Mesh/spec; None -> run-scoped mesh
     tiers: Any = None  # explicit tier spec; None -> run-scoped tiers
+    tenant: str | None = None  # tenant id -> shared packed slab segment
 
     def _get_embed_dimensions(self) -> int:
         if self.dimensions:
@@ -362,6 +424,7 @@ class BruteForceKnnFactory(KnnIndexFactory):
             embedder=self.embedder,
             mesh=self.mesh,
             tiers=self.tiers,
+            tenant=self.tenant,
         )
 
 
@@ -381,6 +444,7 @@ class UsearchKnnFactory(KnnIndexFactory):
             embedder=self.embedder,
             mesh=self.mesh,
             tiers=self.tiers,
+            tenant=self.tenant,
         )
 
 

@@ -37,6 +37,7 @@ class KNNIndex:
         reserved_space: int = 1024,
         mesh=None,
         tiers=None,
+        tenant: str | None = None,
         rerank=None,
         rerank_column: str = "data",
     ):
@@ -54,7 +55,9 @@ class KNNIndex:
         # mesh=None / tiers=None defer to pw.run(mesh=...,
         # index_tiers=...) / PATHWAY_MESH / PATHWAY_INDEX_TIERS at
         # lowering time, so existing call sites scale out (or go
-        # two-tier) with zero query-API change
+        # two-tier) with zero query-API change. tenant= packs this
+        # index into the shared per-geometry tenant slab instead of
+        # allocating (and compiling for) a private device matrix.
         self.inner = BruteForceKnn(
             data_embedding,
             metadata,
@@ -63,6 +66,7 @@ class KNNIndex:
             metric=metric,
             mesh=mesh,
             tiers=tiers,
+            tenant=tenant,
         )
 
     def _get(

@@ -1,0 +1,24 @@
+"""Analysis fixture: the multi-tenant serving plane is configured
+(``pw.run(tenancy=True)``) but no per-tenant quotas and no default
+quota exist — tenants get routed and labeled yet nothing throttles
+them, so one flooding tenant still monopolizes chip time and HBM. The
+verifier must flag PWL016 (warning). ``serving=`` is set so PWL008
+stays quiet, and monitoring is on so PWL007 stays quiet too."""
+
+import pathway_tpu as pw
+
+
+class QuerySchema(pw.Schema):
+    value: int
+
+
+queries, response_writer = pw.io.http.rest_connector(
+    host="127.0.0.1",
+    port=0,
+    schema=QuerySchema,
+    delete_completed_queries=False,
+    serving=pw.ServingConfig(max_queue=32),
+)
+response_writer(queries.select(result=pw.this.value * 2))
+
+pw.run(monitoring_level="in_out", tenancy=True)

@@ -1038,3 +1038,80 @@ def test_pwl015_negative_without_run_context(monkeypatch):
     _combined_budget(monkeypatch)
     _knn_sink(reserved=20_000)
     assert "PWL015" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL016
+
+
+def test_pwl016_tenancy_without_quotas(monkeypatch):
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out", tenancy=True)
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL016"]
+    assert len(hits) == 1 and hits[0].severity is Severity.WARNING
+    assert "quota" in hits[0].message
+    assert hits[0].detail["tenancy"]["quotas"] == {}
+
+
+def test_pwl016_env_knob_counts_as_tenancy(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TENANCY", "on")
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL016" in _rules(pw.analysis.analyze())
+
+
+def test_pwl016_default_quota_silences(monkeypatch):
+    # quota knobs in the flat spec become the default quota: every
+    # tenant is bounded, nothing to warn about
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out", tenancy="qps=50,inflight=8")
+    assert "PWL016" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl016_named_quotas_silence(monkeypatch):
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        tenancy={"quotas": {"acme": {"qps": 100, "hbm": "1M"}}},
+    )
+    assert "PWL016" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl016_quota_hbm_oversubscription(monkeypatch):
+    # each tenant's HBM quota fits alone, but the three sum past the
+    # 4 MiB budget: admission would book segments the device can't hold
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(4 * 1024 * 1024))
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        tenancy={"quotas": {t: {"hbm": "2M"} for t in ("a", "b", "c")}},
+    )
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL016"]
+    assert len(hits) == 1
+    assert hits[0].detail["total_bytes"] == 3 * 2 * 1024 * 1024
+    assert hits[0].detail["total_bytes"] > hits[0].detail["hbm_budget_bytes"]
+
+
+def test_pwl016_quota_hbm_fits(monkeypatch):
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(64 * 1024 * 1024))
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        tenancy={"quotas": {t: {"hbm": "2M"} for t in ("a", "b", "c")}},
+    )
+    assert "PWL016" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl016_negative_tenancy_off(monkeypatch):
+    monkeypatch.delenv("PATHWAY_TENANCY", raising=False)
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL016" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl016_negative_without_run_context():
+    _null_sink()
+    # unit-built graph, pw.run never described: rule stays quiet
+    assert "PWL016" not in _rules(pw.analysis.analyze())

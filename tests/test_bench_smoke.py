@@ -1011,3 +1011,148 @@ def test_bench_smoke_hbm_ledger_suite_runs_green():
     assert frac["exact_cpu_check"] is True
     err = by_name["time_to_oom_forecast_error"]
     assert err["value"] < 0.1, err
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant serving plane (pathway_tpu/tenancy/)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _tenancy_reset():
+    from pathway_tpu.internals.ledger import LEDGER
+    from pathway_tpu.ops.index_metrics import INDEX_METRICS
+    from pathway_tpu.tenancy.config import set_active_tenancy
+    from pathway_tpu.tenancy.metrics import TENANCY_METRICS
+    from pathway_tpu.tenancy.packed import reset_slabs
+
+    def clean():
+        set_active_tenancy(None)
+        TENANCY_METRICS.reset()
+        INDEX_METRICS.reset()
+        LEDGER.reset()
+        reset_slabs()
+
+    clean()
+    yield
+    clean()
+
+
+def test_bench_smoke_tenancy_off_scrape_byte_identical(_tenancy_reset):
+    """A run that never names a tenant scrapes byte-identical /metrics
+    and /status — and even an ACTIVE tenancy config with zero tenant
+    traffic must not change a single byte (the plane renders on first
+    tenant activity, not on configuration)."""
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+    from pathway_tpu.internals.monitoring import StatsMonitor
+    from pathway_tpu.tenancy import TenancyConfig
+    from pathway_tpu.tenancy.config import set_active_tenancy
+    from pathway_tpu.tenancy.metrics import TENANCY_METRICS
+
+    monitor = StatsMonitor()
+    server = MonitoringHttpServer(monitor, port=0)
+
+    def scrape():
+        # the wall-clock latency gauges tick between any two scrapes;
+        # everything else must match byte-for-byte
+        return "\n".join(
+            line
+            for line in server._prometheus().splitlines()
+            if not line.startswith(
+                ("pathway_input_latency_ms", "pathway_output_latency_ms")
+            )
+        )
+
+    baseline_metrics = scrape()
+    baseline_status = server._status()
+    assert "pathway_tenant" not in baseline_metrics
+    assert "tenants" not in baseline_status
+
+    set_active_tenancy(TenancyConfig())  # configured, zero tenant traffic
+    assert scrape() == baseline_metrics
+    assert server._status() == baseline_status
+
+    # first tenant-attributed admit and the plane appears
+    TENANCY_METRICS.record_admit("acme")
+    body = server._prometheus()
+    assert 'pathway_serving_tenant_admitted_total{tenant="acme"} 1' in body
+    assert "pathway_tenant_count 1" in body
+    assert "tenants" in server._status()
+
+
+def test_bench_smoke_tenant_routing_overhead_within_5pct(_tenancy_reset):
+    """A single tenant on a packed slab prices in at <5% query wall
+    versus the same corpus in a plain untenanted index — the routing
+    column mask must be bookkeeping-cheap, and the answers are
+    bit-identical."""
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+    from pathway_tpu.tenancy.packed import TenantPackedIndex
+
+    rng = np.random.default_rng(30)
+    dim, n_docs = 64, 2048
+    vecs = rng.normal(size=(n_docs, dim)).astype(np.float32)
+    keys = list(range(n_docs))
+    q = rng.normal(size=(64, dim)).astype(np.float32)
+
+    flat = DeviceKnnIndex(dim=dim, metric="cos", reserved_space=n_docs)
+    flat.add_batch_arrays(keys, vecs)
+    slab = TenantPackedIndex(dim, metric="cos", reserved_space=n_docs)
+    slab.add_tenant_batch("solo", keys, vecs)
+
+    flat.search_batch(q, 10)  # warm both compile caches
+    slab.search_tenant_batch("solo", q, 10)
+    assert slab.search_tenant_batch("solo", q, 10) == flat.search_batch(q, 10), (
+        "single-tenant slab answers diverged from the untenanted index"
+    )
+
+    def wall(search):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                search()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    wall_off = wall(lambda: flat.search_batch(q, 10))
+    wall_on = wall(lambda: slab.search_tenant_batch("solo", q, 10))
+    # min-of-3 plus an absolute epsilon so a loaded CI box cannot fail
+    # a millisecond-scale claim
+    assert wall_on <= wall_off * 1.05 + 0.10, (wall_on, wall_off)
+
+
+def test_bench_smoke_tenant_isolation_suite_runs_green(_tenancy_reset, monkeypatch):
+    """`bench.py suite_tenant_isolation` miniature (3 quiet tenants):
+    the flooder is held to its quota, the packed results stay
+    bit-identical to a private index, and the quiet tenants' p99 under
+    contention clears the 1.2x isolation gate."""
+    import importlib.util
+    import os
+
+    monkeypatch.setenv("PATHWAY_BENCH_TENANT_QUIET", "3")
+    monkeypatch.setenv("PATHWAY_BENCH_TENANT_QUERIES", "25")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_smoke_tenant_target", os.path.join(root, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    try:
+        bench.suite_tenant_isolation()
+    finally:
+        # the suite churns a packed slab + admission in-process; leave
+        # the plane registries quiet for later tests in the session
+        from pathway_tpu.serving.metrics import SERVING_METRICS
+
+        SERVING_METRICS.reset()
+    (rec,) = [
+        r for r in bench._RECORDS if r["metric"] == "tenant_isolation_p99_ratio"
+    ]
+    assert rec["bit_identical_packed_results"] is True
+    assert rec["flooder_shed"] > 0, rec  # the quota actually bit
+    assert rec["gate"] == 1.2  # the full-suite (99-tenant) bench gate
+    # With only 3 quiet tenants the p99 sits on a handful of samples and
+    # the flooder thread's GIL stalls land on the tail, so the miniature
+    # asserts containment (2x) rather than the full suite's 1.2x gate —
+    # an unthrottled flooder blows past 2x immediately.
+    assert rec["value"] <= 2.0, rec

@@ -139,6 +139,52 @@ def test_admit_without_shard_ignores_cluster_health():
     ctl.release(t)
 
 
+def test_tenant_cap_beats_down_shard_deterministically():
+    """A tenant at its inflight cap querying a down shard must always
+    see 429 ``tenant_rate_limited``, never 503 ``shard_unavailable``:
+    the tenant gates run BEFORE the shard-health check, so the client's
+    typed reason does not depend on which internal check loses a race.
+    Repeated to pin determinism."""
+    from pathway_tpu.serving import TenantRateLimited
+    from pathway_tpu.tenancy import use_tenancy
+
+    CLUSTER_HEALTH.mark_down([1], retry_after_s=2.0)
+    ctl = AdmissionController(
+        ServingConfig(max_queue=8), metrics=ServingMetrics()
+    )
+    with use_tenancy({"quotas": {"acme": {"inflight": 1}}}):
+        held = ctl.admit(shard=0, tenant="acme")  # cap reached
+        for _ in range(10):
+            with pytest.raises(TenantRateLimited) as ei:
+                ctl.admit(shard=1, tenant="acme")
+            assert ei.value.status == 429
+            assert ei.value.reason == "tenant_rate_limited"
+            assert ei.value.tenant == "acme"
+        ctl.release(held)
+
+
+def test_under_cap_tenant_still_sheds_down_shard():
+    """The same tenant under its cap hitting the same down shard gets
+    the shard verdict — 503 ``shard_unavailable`` — deterministically:
+    the quota gate passes, so the shard-health check owns the refusal
+    (and the failed admit must not leak quota inflight)."""
+    from pathway_tpu.tenancy import use_tenancy
+
+    CLUSTER_HEALTH.mark_down([1], retry_after_s=2.0)
+    ctl = AdmissionController(
+        ServingConfig(max_queue=8), metrics=ServingMetrics()
+    )
+    with use_tenancy({"quotas": {"acme": {"inflight": 1}}}):
+        for _ in range(10):
+            with pytest.raises(ShardUnavailable) as ei:
+                ctl.admit(shard=1, tenant="acme")
+            assert ei.value.status == 503
+            assert ei.value.reason == "shard_unavailable"
+        # shard-shed admits never consumed the tenant's inflight slot
+        t = ctl.admit(shard=0, tenant="acme")
+        ctl.release(t)
+
+
 # ------------------------------------------- cluster-channel chaos family
 
 

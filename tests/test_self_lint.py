@@ -478,3 +478,47 @@ def test_doctor_json_contract():
 def test_doctor_broken_program_exits_3():
     proc = _doctor_cli(os.path.join(DOCTOR_FIXTURES, "does_not_exist.py"))
     assert proc.returncode == 3
+
+
+# ---------------------------------------------------------------------------
+# PWL016 — tenancy configured without per-tenant quotas
+# ---------------------------------------------------------------------------
+
+
+def test_tenancy_no_quotas_warns_pwl016(monkeypatch):
+    """The tenancy plane on with nothing bounding any tenant: PWL016
+    warns (exit 0), nonzero only under --strict-warnings."""
+    monkeypatch.delenv("PATHWAY_TENANCY", raising=False)
+    fixture = os.path.join(FIXTURES, "tenancy_no_quotas.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL016" in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--strict-warnings")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl016_json_carries_tenancy_config(monkeypatch):
+    monkeypatch.delenv("PATHWAY_TENANCY", raising=False)
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "tenancy_no_quotas.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL016"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["tenancy"]["quotas"] == {}
+    assert diag["detail"]["tenancy"]["default"] is None
+
+
+def test_pwl016_explicit_arg_wins_over_env_cli(monkeypatch):
+    """The fixture passes tenancy=True explicitly, so a quota-carrying
+    PATHWAY_TENANCY env spec does NOT silence it — explicit args win
+    over env, same precedence as decode=/index_tiers=. The warning
+    still fires."""
+    monkeypatch.setenv("PATHWAY_TENANCY", "qps=50,inflight=8")
+    fixture = os.path.join(FIXTURES, "tenancy_no_quotas.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL016" in proc.stdout

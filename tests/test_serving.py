@@ -237,7 +237,7 @@ def test_batcher_take_batch_deadline_order():
     b.submit("late", Deadline(60_000.0))
     b.submit("soon", Deadline(1_000.0))
     b.submit("mid", Deadline(10_000.0))
-    items, _, _ = b._take_batch()
+    items, _, _, _ = b._take_batch()
     assert items == ["soon", "mid", "late"]
 
 
@@ -247,7 +247,7 @@ def test_batcher_drops_expired_instead_of_dispatching():
     b._on_expired = expired_seen.append
     b.submit("dead", Deadline(0.0))
     b.submit("live", Deadline(60_000.0))
-    items, _, _ = b._take_batch()
+    items, _, _, _ = b._take_batch()
     assert items == ["live"]
     assert expired_seen == ["dead"]
     assert b.dropped_expired_total == 1

@@ -489,7 +489,7 @@ def test_batcher_expired_member_records_dropped_queue_span():
     b._halt = True
     with span("request", new_trace=True) as root:
         b.submit("dead", Deadline(0.0))
-    items, _, _ = b._take_batch()
+    items, _, _, _ = b._take_batch()
     assert items == []
     spans = TRACE_STORE.get_trace(root.trace_id)
     queue = [s for s in spans if s["stage"] == "queue"]
