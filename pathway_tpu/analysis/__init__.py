@@ -37,10 +37,11 @@ from .diagnostics import (
 from .engine_rules import analyze_engine
 from .graph_view import GraphView
 from .program import analyze_program
-from .rules import LOGICAL_RULES, RULES
+from .rules import DEEP_RULE_IDS, LOGICAL_RULES, RULES
 
 __all__ = [
     "AnalysisError",
+    "DEEP_RULE_IDS",
     "Diagnostic",
     "GraphView",
     "RULES",
@@ -120,22 +121,35 @@ class suppress:
         return False
 
 
-def analyze(graph=None, *, engine=None) -> list[Diagnostic]:
+def analyze(
+    graph=None, *, engine=None, deep: bool = False, stats: dict | None = None
+) -> list[Diagnostic]:
     """Run the whole rule pack over a parse graph (default: the global
     graph ``G``). Pass ``engine=`` a lowered ``EngineGraph`` to include
-    the engine-level checks. Returns diagnostics in stable order with
-    per-table suppressions applied."""
+    the engine-level checks; ``deep=True`` adds the jaxpr-level pass
+    (rules PWL017-PWL020, see :mod:`.deep`). Returns diagnostics in
+    stable order with per-table suppressions applied; when ``stats`` is
+    a dict, ``stats["suppressed"]`` is set to the number of findings
+    the suppressions dropped."""
     view = GraphView(graph)
     diags: list[Diagnostic] = []
     for rule_fn in LOGICAL_RULES:
         diags.extend(rule_fn(view))
+    if deep:
+        from .deep import analyze_deep
+
+        diags.extend(analyze_deep(view))
     if engine is not None:
         diags.extend(analyze_engine(engine))
     by_id = {t._id: t for t in view.tables}
     kept = []
+    n_suppressed = 0
     for d in diags:
         t = by_id.get(d.table_id) if d.table_id is not None else None
         if t is not None and d.rule in getattr(t, _SUPPRESS_ATTR, ()):
+            n_suppressed += 1
             continue
         kept.append(d)
+    if stats is not None:
+        stats["suppressed"] = n_suppressed
     return sort_diagnostics(kept)

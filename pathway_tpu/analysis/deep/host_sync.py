@@ -1,0 +1,189 @@
+"""PWL017 — host-sync detector.
+
+Two sweeps over the same hazard class (an unplanned device→host round
+trip inside the epoch hot loop — the WindVE failure mode, where one
+blocking transfer in the embedding path serializes the whole pipeline):
+
+1. **jaxpr level** — walk every traced deep target's jaxpr, recursing
+   through nested closed jaxprs (pjit bodies, scan/while/cond
+   branches), and flag callback primitives (``pure_callback``,
+   ``io_callback``, ``debug_callback``) and infeed/outfeed: each is a
+   synchronous host round trip per dispatch of a kernel this repo
+   promises is device-resident.
+2. **UDF level** — scan the bytecode of user UDFs sitting on the
+   staging path into a device-facing node (the anchor table and its
+   ancestors — the DeviceRing-staged path that re-runs every epoch)
+   for explicit sync calls: ``jax.device_get``, ``block_until_ready``,
+   ``.item()`` on device values, callback registrations, and
+   ``np.asarray``/``np.array`` applied to jax values (an implicit
+   transfer). The ``np.*`` markers only fire when the UDF also
+   references jax and is *not* jit-batched — numpy inside a jit-batched
+   UDF is already PWL004's finding, and one hazard must not fire twice
+   under two rule ids.
+"""
+
+from __future__ import annotations
+
+import dis
+from typing import Any, Iterable, Iterator
+
+from ..diagnostics import Diagnostic
+from ..graph_view import GraphView, expr_applies, iter_param_exprs
+from ..rules import _batch_fn, _diag, _unwrap_fn, _user_fn
+
+__all__ = ["check_host_sync"]
+
+#: jaxpr primitives that are host round trips by construction
+_SYNC_PRIM_EXACT = frozenset({"infeed", "outfeed"})
+
+#: explicit host-sync call names in UDF bytecode
+_SYNC_NAMES = frozenset(
+    {
+        "device_get",
+        "block_until_ready",
+        "pure_callback",
+        "io_callback",
+        "debug_callback",
+    }
+)
+
+#: implicit-transfer names: only a sync when applied to jax values
+_TRANSFER_NAMES = frozenset({"asarray", "array", "item", "tolist"})
+
+
+def _iter_eqns(jaxpr) -> Iterator[Any]:
+    """Every equation of ``jaxpr`` and of all jaxprs nested in its
+    params (pjit bodies, scan/while carries, cond branches)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for value in eqn.params.values():
+            for sub in _sub_jaxprs(value):
+                yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(value: Any) -> Iterator[Any]:
+    inner = getattr(value, "jaxpr", None)  # ClosedJaxpr -> Jaxpr
+    if inner is not None and hasattr(inner, "eqns"):
+        yield inner
+    elif hasattr(value, "eqns"):
+        yield value
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _sub_jaxprs(v)
+
+
+def jaxpr_sync_primitives(closed_jaxpr) -> list[str]:
+    """Names of host-sync primitives anywhere in a (closed) jaxpr."""
+    root = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    found: list[str] = []
+    for eqn in _iter_eqns(root):
+        name = eqn.primitive.name
+        if "callback" in name or name in _SYNC_PRIM_EXACT:
+            found.append(name)
+    return found
+
+
+def _udf_sync_markers(fn: Any, jit_batched: bool) -> list[str]:
+    """Sync markers in one user callable's bytecode."""
+    inner = _unwrap_fn(fn)
+    code = getattr(inner, "__code__", None)
+    if code is None:
+        return []
+    names = set(code.co_names)
+    for ins in dis.get_instructions(code):
+        if ins.opname in ("LOAD_METHOD", "LOAD_ATTR") and isinstance(
+            ins.argval, str
+        ):
+            names.add(ins.argval)
+    markers = sorted(names & _SYNC_NAMES)
+    fn_globals = getattr(inner, "__globals__", {})
+
+    def _mod(n: str) -> str:
+        v = fn_globals.get(n)
+        return getattr(v, "__name__", "") if type(v).__name__ == "module" else ""
+
+    refs_jax = any(_mod(n).startswith("jax") for n in code.co_names)
+    refs_numpy = any(_mod(n) == "numpy" for n in code.co_names)
+    if refs_jax and not jit_batched:
+        # implicit transfer: np.asarray/.item on values produced by jax
+        # code in the same function body. Jit-batched UDFs are PWL004's
+        # jurisdiction (numpy under jit), so skip them here.
+        transfer = sorted(names & _TRANSFER_NAMES)
+        if transfer and (refs_numpy or "item" in transfer or "tolist" in transfer):
+            markers.extend(t for t in transfer if t not in markers)
+    return markers
+
+
+def _staging_path_tables(view: GraphView, targets) -> dict[int, tuple[Any, Any]]:
+    """table id -> (table, anchor target) for every table on a staging
+    path into a device-facing node (the anchor itself included)."""
+    out: dict[int, tuple[Any, Any]] = {}
+    for target in targets:
+        anchor = target.table
+        if anchor is None:
+            continue
+        if anchor._id not in out:
+            out[anchor._id] = (anchor, target)
+        for t in view.ancestors(anchor):
+            if t._id not in out:
+                out[t._id] = (t, target)
+    return out
+
+
+def check_host_sync(view: GraphView, targets) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    # 1) jaxpr sweep over the traced device callables
+    for target in targets:
+        jx = target.jaxpr()
+        if jx is None:
+            continue
+        prims = jaxpr_sync_primitives(jx)
+        if prims:
+            out.append(
+                _diag(
+                    "PWL017",
+                    f"device callable {target.name} contains host-callback "
+                    f"primitive(s) {sorted(set(prims))}: every dispatch "
+                    "pays a synchronous device->host round trip inside "
+                    "the epoch hot loop",
+                    target.table,
+                    detail={"target": target.name, "primitives": sorted(set(prims))},
+                )
+            )
+    # 2) UDF sweep over the staging paths
+    staged = _staging_path_tables(view, targets)
+    seen_fns: set[int] = set()
+    for _tid, (table, target) in sorted(staged.items()):
+        for key, expr in iter_param_exprs(table._op.params):
+            for ap in expr_applies(expr):
+                jit_batched = _batch_fn(ap) is not None
+                fn = _user_fn(ap)
+                if fn is None or id(fn) in seen_fns:
+                    continue
+                seen_fns.add(id(fn))
+                markers = _udf_sync_markers(fn, jit_batched)
+                if not markers:
+                    continue
+                name = getattr(fn, "__name__", "udf")
+                where = (
+                    "the streaming epoch hot loop"
+                    if target.hot_loop
+                    else "the DeviceRing-staged path"
+                )
+                out.append(
+                    _diag(
+                        "PWL017",
+                        f"UDF {name!r} forces a device->host sync "
+                        f"({', '.join(markers)}) on {where} into "
+                        f"{target.name}: the transfer blocks dispatch "
+                        "pipelining every epoch — keep the value on "
+                        "device or move the readback behind the sink",
+                        table,
+                        detail={
+                            "param": key,
+                            "markers": markers,
+                            "target": target.name,
+                        },
+                    )
+                )
+    return out

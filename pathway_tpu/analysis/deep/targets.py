@@ -1,0 +1,139 @@
+"""DeepTarget — one device-facing jitted callable of the lowered graph,
+reconstructed from graph-build-time specs.
+
+Analyze-only runs never lower the graph: ``pw.run()`` returns at the
+``PATHWAY_ANALYZE_ONLY`` gate, after recording ``G.run_context`` but
+before sinks, connectors, or any device allocation exist — so the deep
+pass cannot inspect live jit callables. Instead the ops modules export
+``deep_trace_spec`` hooks (``ops/knn.py``, ``ops/paged_attention.py``)
+that rebuild a *representative* callable with the same op structure
+under abstract ``jax.ShapeDtypeStruct`` arguments; ``jax.make_jaxpr``
+traces it without compiling anything or touching a device, and the
+jaxpr's op set is what the host-sync detector (PWL017) audits. The
+encoder forward is covered arithmetically (its bucket space, PWL018)
+rather than traced: building a flax module just to count host
+callbacks in a path this repo owns end-to-end is not worth the
+analyze-time cost.
+
+Every target carries the anchor :class:`~...internals.table.Table` of
+the graph node that dispatches it, so deep findings cite the same
+build-time trace runtime ``EngineError`` s do.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..graph_view import GraphView
+
+__all__ = ["DeepTarget", "build_targets"]
+
+
+@dataclass
+class DeepTarget:
+    """One device-facing callable the deep rules analyze."""
+
+    name: str
+    kind: str  # "knn" | "encoder" | "decode"
+    table: Any = None  # anchor Table for diagnostics (may be None)
+    spec: dict = field(default_factory=dict)
+    trace: dict | None = None  # {"name", "fn", "args"} from an ops hook
+    #: True when the dispatching node sits on a streaming epoch path —
+    #: every epoch re-enters it, so a host sync there is paid per epoch
+    hot_loop: bool = False
+    _jaxpr: Any = None
+    _jaxpr_failed: bool = False
+
+    def jaxpr(self):
+        """The traced ClosedJaxpr of the representative callable, or
+        None when no trace hook exists / tracing failed (the jaxpr-level
+        checks then skip this target rather than failing analysis)."""
+        if self._jaxpr is None and not self._jaxpr_failed and self.trace:
+            try:
+                import jax
+
+                self._jaxpr = jax.make_jaxpr(self.trace["fn"])(*self.trace["args"])
+            except Exception:
+                self._jaxpr_failed = True
+        return self._jaxpr
+
+
+def _anchor_is_streaming(view: GraphView, table) -> bool:
+    if table is None:
+        return False
+    try:
+        return any(view.is_streaming(src) for src in view.op_inputs(table._op))
+    except Exception:
+        return False
+
+
+def build_targets(view: GraphView) -> list[DeepTarget]:
+    """Materialize the deep targets of one parse graph: one KNN search
+    target per device-backed index spec (plus an encoder target when the
+    index carries a fused query encoder), and one decode-step target
+    when the run configures the decode plane."""
+    targets: list[DeepTarget] = []
+    graph = view.graph
+    ctx = getattr(graph, "run_context", None) or {}
+    specs = [
+        s
+        for s in (getattr(graph, "external_indexes", None) or [])
+        if s.get("device_backed")
+    ]
+    from ...ops import knn as ops_knn
+
+    for spec in specs:
+        table = spec.get("_table")
+        hot = _anchor_is_streaming(view, table)
+        dim = int(spec.get("dimensions") or 0)
+        metric = spec.get("metric", "cos")
+        try:
+            trace = ops_knn.deep_trace_spec(spec)
+        except Exception:
+            trace = None
+        targets.append(
+            DeepTarget(
+                name=f"knn.search[{metric},d={dim}]",
+                kind="knn",
+                table=table,
+                spec=spec,
+                trace=trace,
+                hot_loop=hot,
+            )
+        )
+        enc = spec.get("encoder")
+        if enc:
+            targets.append(
+                DeepTarget(
+                    name=(
+                        f"encoder.fwd[seq<={enc.get('max_seq_len')},"
+                        f"batch<={enc.get('max_batch')}]"
+                    ),
+                    kind="encoder",
+                    table=table,
+                    spec=spec,
+                    hot_loop=hot,
+                )
+            )
+    decode = ctx.get("decode")
+    if decode:
+        from ...ops import paged_attention as ops_pa
+
+        try:
+            trace = ops_pa.deep_trace_spec(decode)
+        except Exception:
+            trace = None
+        targets.append(
+            DeepTarget(
+                name=(
+                    f"decode.step[lanes={decode.get('lanes')},"
+                    f"page={decode.get('page_size')}]"
+                ),
+                kind="decode",
+                spec=dict(decode),
+                trace=trace,
+                hot_loop=True,
+            )
+        )
+    return targets

@@ -69,7 +69,7 @@ class Diagnostic:
                 "function": self.trace.function,
             }
         if self.detail:
-            out["detail"] = dict(sorted(self.detail.items()))
+            out["detail"] = _json_safe(dict(sorted(self.detail.items())))
         return out
 
     def render(self) -> str:
@@ -91,6 +91,24 @@ class Diagnostic:
             if src:
                 loc += f"\n        {src}"
         return f"{self.rule} {self.severity.value}: {self.message}{where}{loc}"
+
+
+def _json_safe(value):
+    """Make a detail payload JSON-renderable: drop underscore-prefixed
+    keys (graph-object anchors like an index spec's ``_table``) and
+    stringify anything the json encoder cannot take, so a rule can put
+    rich objects in ``detail`` without breaking ``--json`` output."""
+    if isinstance(value, dict):
+        return {
+            k: _json_safe(v)
+            for k, v in value.items()
+            if not (isinstance(k, str) and k.startswith("_"))
+        }
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    if value is None or isinstance(value, (str, int, float, bool)):
+        return value
+    return type(value).__name__
 
 
 def sort_diagnostics(diags: Iterable[Diagnostic]) -> list[Diagnostic]:
@@ -124,15 +142,30 @@ def render_human(diags: Sequence[Diagnostic]) -> str:
     return "\n".join(lines)
 
 
-def render_json(diags: Sequence[Diagnostic]) -> str:
+def render_json(diags: Sequence[Diagnostic], *, suppressed: int = 0) -> str:
     """Machine-readable output; key order and diagnostic order are stable
-    so the golden test in tests/test_analysis_rules.py can byte-compare."""
+    so the golden test in tests/test_analysis_rules.py can byte-compare.
+
+    Diagnostics sort by (rule, node id, message) — not by severity — so
+    a severity downgrade or a new unrelated rule does not reorder the
+    whole CI diff; ``suppressed`` reports how many findings per-table
+    suppressions dropped, keeping the summary stable across runs that
+    only differ in suppression placement."""
+    ordered = sorted(
+        diags,
+        key=lambda d: (
+            d.rule,
+            d.table_id if d.table_id is not None else -1,
+            d.message,
+        ),
+    )
     payload = {
-        "diagnostics": [d.as_dict() for d in sort_diagnostics(diags)],
+        "diagnostics": [d.as_dict() for d in ordered],
         "summary": {
             "error": sum(d.severity is Severity.ERROR for d in diags),
             "warning": sum(d.severity is Severity.WARNING for d in diags),
             "info": sum(d.severity is Severity.INFO for d in diags),
+            "suppressed": int(suppressed),
         },
     }
     return json.dumps(payload, indent=2, sort_keys=True)

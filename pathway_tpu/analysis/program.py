@@ -27,14 +27,25 @@ def analyze_program(
     *,
     as_json: bool = False,
     strict_warnings: bool = False,
+    fail_on: str = "error",
+    deep: bool = False,
     out=None,
 ) -> int:
     """Execute ``program`` (a .py path) in analyze-only mode, run the
     verifier over the graph it builds, print diagnostics, and return the
-    process exit code."""
+    process exit code.
+
+    ``fail_on`` picks the exit-code threshold: ``"error"`` (default)
+    exits 1 only on error-severity findings, ``"warn"`` on warnings
+    too. ``strict_warnings`` is the deprecated spelling of
+    ``fail_on="warn"``. ``deep=True`` adds the jaxpr-level pass
+    (PWL017-PWL020)."""
     from ..internals.parse_graph import G, clear_graph
     from . import analyze
     from .diagnostics import Severity, render_human, render_json
+
+    if fail_on not in ("warn", "error"):
+        raise ValueError(f"fail_on={fail_on!r}: expected 'warn' or 'error'")
 
     out = out if out is not None else sys.stdout
     clear_graph()
@@ -59,9 +70,15 @@ def analyze_program(
         else:
             os.environ[ANALYZE_ONLY_ENV] = old_env
 
-    diags = analyze(G)
-    print(render_json(diags) if as_json else render_human(diags), file=out)
-    worst_rank = 1 if strict_warnings else 0
+    stats: dict = {}
+    diags = analyze(G, deep=deep, stats=stats)
+    rendered = (
+        render_json(diags, suppressed=stats.get("suppressed", 0))
+        if as_json
+        else render_human(diags)
+    )
+    print(rendered, file=out)
+    worst_rank = 1 if (strict_warnings or fail_on == "warn") else 0
     if any(d.severity.rank <= worst_rank for d in diags):
         return EXIT_FINDINGS
     return EXIT_CLEAN

@@ -71,6 +71,31 @@ PWL016 (warning) tenancy without quotas: the multi-tenant plane is
                  the named quotas' HBM budgets sum past
                  PATHWAY_HBM_BYTES (the admission booking would let
                  tenants collectively OOM the slab).
+
+Deep rules (``pathway analyze --deep`` / ``pw.run(analysis="deep")``,
+implemented in :mod:`.deep`):
+
+PWL017 (warning) host sync inside a device hot path: a callback /
+                 device_get / block_until_ready / implicit np.asarray
+                 transfer inside the epoch hot loop — in a UDF feeding
+                 a device-backed node, or as a callback primitive in a
+                 traced jitted callable.
+PWL018 (warning) recompilation storm: the symbolic shape-bucket
+                 enumeration over every device callable (seq buckets x
+                 batch buckets x capacity ladder x k ladder x tiers x
+                 tenant extents) predicts more distinct compiles than
+                 the budget (PATHWAY_COMPILE_BUDGET, default 256), or a
+                 dynamic dimension has no bucket ladder at all.
+PWL019 (warning) placement: an index pinned to an explicit mesh whose
+                 axes differ from the run mesh (implicit cross-mesh
+                 resharding collective per batch), or host-pool ingest
+                 staged off-mesh so every epoch bounces through host.
+PWL020 (warning) exactly-once/determinism: an effectful node (async
+                 UDF / AsyncTransformer) under recovery/persistence
+                 with no on_error route, a commit plane with no
+                 registered chaos site, or a default-deterministic UDF
+                 reading wall clock / unseeded RNG upstream of
+                 persisted state.
 """
 
 from __future__ import annotations
@@ -121,7 +146,17 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL014": (Severity.WARNING, "SLO-budgeted endpoint with tracing and profiler off"),
     "PWL015": (Severity.WARNING, "combined planes oversubscribe the HBM budget"),
     "PWL016": (Severity.WARNING, "tenancy configured without per-tenant quotas"),
+    # deep (jaxpr-level) rules — emitted by analysis.deep, registered
+    # here so suppress() and the generated README table cover them
+    "PWL017": (Severity.WARNING, "host sync inside a device hot path"),
+    "PWL018": (Severity.WARNING, "predicted compile count exceeds the budget"),
+    "PWL019": (Severity.WARNING, "implicit cross-mesh resharding / host bounce"),
+    "PWL020": (Severity.WARNING, "effectful node outside the exactly-once contract"),
 }
+
+#: rule ids that only the deep pass (``pathway analyze --deep`` /
+#: ``pw.run(analysis="deep")``) can emit
+DEEP_RULE_IDS: tuple[str, ...] = ("PWL017", "PWL018", "PWL019", "PWL020")
 
 _MUTABLE_TYPES = (list, dict, set, bytearray)
 

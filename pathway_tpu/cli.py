@@ -136,18 +136,31 @@ def spawn_from_env():
 @click.option(
     "--strict-warnings",
     is_flag=True,
-    help="exit nonzero on warnings too, not only errors",
+    help="deprecated alias for --fail-on=warn",
+)
+@click.option(
+    "--fail-on",
+    type=click.Choice(["warn", "error"]),
+    default="error",
+    show_default=True,
+    help="lowest severity that makes the exit code nonzero",
+)
+@click.option(
+    "--deep",
+    is_flag=True,
+    help="also run the jaxpr-level deep pass (rules PWL017..PWL020)",
 )
 @click.argument("program", required=True)
 @click.argument("arguments", nargs=-1)
-def analyze(as_json, strict_warnings, program, arguments):
+def analyze(as_json, strict_warnings, fail_on, deep, program, arguments):
     """Statically verify PROGRAM's dataflow graph without running it.
 
     The program executes with PATHWAY_ANALYZE_ONLY=1, so pw.run()
     returns before building sinks or starting connectors; the verifier
-    (pathway_tpu.analysis, rules PWL001..PWL006) then walks the graph it
-    described. Exits 1 when errors are found, 3 when the program itself
-    fails to build its graph.
+    (pathway_tpu.analysis, rules PWL001..PWL016 — plus PWL017..PWL020
+    with --deep) then walks the graph it described. Exits 1 when
+    findings at or above --fail-on severity exist, 3 when the program
+    itself fails to build its graph.
     """
     from .analysis.program import analyze_program
 
@@ -157,6 +170,8 @@ def analyze(as_json, strict_warnings, program, arguments):
             list(arguments),
             as_json=as_json,
             strict_warnings=strict_warnings,
+            fail_on=fail_on,
+            deep=deep,
         )
     )
 

@@ -33,7 +33,22 @@ import threading
 import weakref
 from typing import Any
 
-__all__ = ["DeviceRing", "quiesce_all", "active_rings"]
+__all__ = ["DeviceRing", "quiesce_all", "active_rings", "staging_placement"]
+
+
+def staging_placement(mesh_axes: dict | None) -> dict:
+    """Declarative placement intent of ring-staged wire payloads for
+    the deep verifier (analysis.deep, PWL019), resolved without
+    constructing a ring or touching a device: with a run mesh the ring
+    stages onto that mesh's data axis (the ``sharding=`` each epoch
+    pipeline passes); without one, payloads land on the default device
+    and any mesh-sharded consumer must reshard through host."""
+    axes = dict(mesh_axes) if mesh_axes else None
+    return {
+        "kind": "device_ring",
+        "mesh_axes": axes,
+        "sharded": bool(axes and int(axes.get("data", 1)) > 1),
+    }
 
 _ring_seq = itertools.count()
 

@@ -41,6 +41,19 @@ _AUTOSCALE_COOLDOWN_S = 0.05
 _CHAOS_SENTINEL = object()
 
 
+def placement_intent(workers: int) -> dict:
+    """Declarative output-placement intent of the host-ingest pool for
+    the deep verifier (analysis.deep, PWL019): pool workers produce
+    HOST buffers (numpy id matrices, packed rows) — the single
+    committer performs the device staging — so its output is on-mesh
+    exactly when the committer's ring staging is."""
+    return {
+        "kind": "ingest_pool",
+        "workers": max(0, int(workers)),
+        "host_output": True,
+    }
+
+
 class _Task:
     __slots__ = ("seq", "fn", "args", "kwargs", "value", "error", "chaos", "done")
 

@@ -53,16 +53,18 @@ def _run_analysis(mode: str | None) -> None:
         mode = os.environ.get("PATHWAY_ANALYSIS", "off")
     if mode in ("off", None):
         return
-    if mode not in ("strict", "warn"):
+    if mode not in ("strict", "warn", "deep"):
         raise ValueError(
-            f"analysis={mode!r}: expected 'strict', 'warn', or 'off'"
+            f"analysis={mode!r}: expected 'strict', 'warn', 'deep', or 'off'"
         )
     from ..analysis import AnalysisError, analyze, has_errors, render_human
 
-    diags = analyze(G)
+    # "deep" = strict + the jaxpr-level pass (PWL017..PWL020): the
+    # pre-flight gate run before a composed graph touches a real chip
+    diags = analyze(G, deep=(mode == "deep"))
     if not diags:
         return
-    if mode == "strict" and has_errors(diags):
+    if mode in ("strict", "deep") and has_errors(diags):
         raise AnalysisError(diags)
     print(render_human(diags), file=sys.stderr)
 

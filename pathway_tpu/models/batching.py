@@ -98,3 +98,63 @@ def pad_token_batch(
 def chunks(seq, size: int):
     for i in range(0, len(seq), size):
         yield seq[i : i + size]
+
+
+def _capped_batch_buckets(max_batch: int, batch_buckets) -> tuple[int, ...]:
+    return tuple(b for b in batch_buckets if b < max_batch) + (max_batch,)
+
+
+def effective_max_batch(max_batch: int, mesh_ndata: int = 1) -> int:
+    """The chunk size ``SentenceEncoder.encode_tokens`` actually uses:
+    with a data mesh the batch rounds down to a multiple of the data
+    axis so every shard gets whole rows."""
+    if mesh_ndata > 1:
+        return max(max_batch - max_batch % mesh_ndata, mesh_ndata)
+    return max_batch
+
+
+def predict_compile_keys(
+    lengths,
+    *,
+    max_batch: int,
+    seq_buckets=DEFAULT_SEQ_BUCKETS,
+    batch_buckets=DEFAULT_BATCH_BUCKETS,
+    mesh_ndata: int = 1,
+) -> set[tuple[int, int]]:
+    """Exact set of (B, S) jit compile keys the bucketed encode path
+    produces for a workload of token ``lengths`` — the model the deep
+    verifier's recompilation predictor (PWL018) is validated against:
+    this must mirror ``SentenceEncoder.encode_tokens`` (sort by length,
+    chunk by the mesh-rounded max batch, pad each chunk to its bucket)
+    exactly, and the bucket-sweep test asserts it matches the live jit
+    cache entry count."""
+    if not lengths:
+        return set()
+    order = sorted(int(l) for l in lengths)
+    batch = effective_max_batch(max_batch, mesh_ndata)
+    bb = _capped_batch_buckets(batch, batch_buckets)
+    keys: set[tuple[int, int]] = set()
+    for i in range(0, len(order), batch):
+        g = order[i : i + batch]
+        s = bucket(max(max(g), 1), seq_buckets)
+        b = max(bucket(len(g), bb), len(g))
+        keys.add((b, s))
+    return keys
+
+
+def compile_bucket_space(
+    max_seq_len: int,
+    max_batch: int,
+    *,
+    seq_buckets=DEFAULT_SEQ_BUCKETS,
+    batch_buckets=DEFAULT_BATCH_BUCKETS,
+    mesh_ndata: int = 1,
+) -> int:
+    """Upper bound of distinct (B, S) compile keys any workload can
+    drive through the bucketed encode path at this geometry — the
+    symbolic enumeration PWL018 sums against the compile budget."""
+    batch = effective_max_batch(max_batch, mesh_ndata)
+    bb = _capped_batch_buckets(batch, batch_buckets)
+    scap = bucket(max(1, int(max_seq_len)), seq_buckets)
+    n_seq = sum(1 for s in seq_buckets if s <= scap) or 1
+    return n_seq * len(bb)

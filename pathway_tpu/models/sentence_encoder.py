@@ -92,6 +92,25 @@ class SentenceEncoder:
     def dim(self) -> int:
         return self.cfg.hidden_size
 
+    def jit_cache_size(self) -> int:
+        """Distinct compiled entries in the forward jit's cache — the
+        ground truth the deep verifier's recompilation predictor
+        (``models.batching.predict_compile_keys`` / PWL018) is
+        validated against in the bucket-sweep test."""
+        inner = getattr(self._fwd, "__wrapped__", self._fwd)
+        cache_size = getattr(inner, "_cache_size", None)
+        return int(cache_size()) if cache_size is not None else -1
+
+    def predict_compile_keys(self, lengths) -> set[tuple[int, int]]:
+        """The (B, S) jit keys ``encode_tokens`` would compile for a
+        workload of token ``lengths`` at this encoder's geometry."""
+        from .batching import predict_compile_keys
+
+        ndata = self.mesh.shape[self.data_axis] if self.mesh is not None else 1
+        return predict_compile_keys(
+            lengths, max_batch=self.max_batch, mesh_ndata=ndata
+        )
+
     def _run_padded(self, ids, mask):
         if self._data_sharding is not None:
             ndata = self.mesh.shape[self.data_axis]

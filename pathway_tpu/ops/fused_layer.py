@@ -416,6 +416,20 @@ def use_fused_encoder(cfg, seq_len: int) -> bool:
     return jax.default_backend() == "tpu" and supports_fused_encoder(cfg, seq_len)
 
 
+def deep_route_info(cfg, seq_len: int) -> dict:
+    """Static dispatch-routing metadata for the deep verifier
+    (analysis.deep): which layer path the encode jits would take at
+    this geometry and the kernel's internal bucket knobs, resolved
+    without touching a device (``use_fused_encoder`` additionally gates
+    on the live backend, which analyze-only runs must not query)."""
+    return {
+        "fused_supported": supports_fused_encoder(cfg, seq_len),
+        "layer_impl": getattr(cfg, "layer_impl", "auto"),
+        "diag_attention_min_seq": DIAG_ATTENTION_MIN_SEQ,
+        "ffn_chunk": FFN_CHUNK,
+    }
+
+
 def fused_encoder_interpret(cfg) -> bool:
     """True when ``cfg.layer_impl`` asks for the kernel in interpret
     mode (exercises the exact pallas path on the CPU backend)."""

@@ -162,6 +162,86 @@ def _k_bucket(k: int) -> int:
     return b
 
 
+def k_bucket_ladder(k_max: int) -> tuple[int, ...]:
+    """Every fetch width the pow2 k-bucketing can produce up to
+    ``k_max`` — the compile-key ladder of the top-k kernels. A dynamic
+    per-row ``number_of_matches`` walks this ladder instead of
+    compiling per distinct k; the deep verifier (PWL018) counts it."""
+    out = []
+    b = 8
+    while b < max(8, int(k_max)):
+        out.append(b)
+        b *= 2
+    out.append(b)
+    return tuple(out)
+
+
+def deep_trace_spec(spec: dict) -> dict | None:
+    """Representative jitted search callable for a device-backed index
+    spec, for the deep verifier's jaxpr pass (analysis.deep). The
+    op-structure of the traced program is shape-independent, so a tiny
+    abstract geometry stands in for the real capacity — nothing is
+    compiled and no device memory is touched. Returns None when jax is
+    unavailable (the deep pass then skips jaxpr-level checks)."""
+    try:
+        import jax
+    except Exception:  # pragma: no cover - jax is baked into the image
+        return None
+    import numpy as _np
+
+    dim = max(1, int(spec.get("dimensions") or 1))
+    metric = spec.get("metric", "cos")
+    if metric not in ("cos", "ip", "l2"):
+        metric = "cos"
+    cap, nq, k = 64, 8, 8
+    fn = _topk_fn(metric)
+    args = (
+        jax.ShapeDtypeStruct((cap, dim), _np.float32),
+        jax.ShapeDtypeStruct((cap,), _np.bool_),
+        jax.ShapeDtypeStruct((nq, dim), _np.float32),
+    )
+    return {
+        "name": f"knn.search[{metric},d={dim}]",
+        "fn": lambda matrix, valid, queries: fn(matrix, valid, queries, k),
+        "args": args,
+    }
+
+
+def deep_compile_profile(spec: dict, mesh_axes: dict | None = None) -> dict:
+    """Predicted distinct-compile count for one device-backed index
+    (analysis.deep, PWL018). The model mirrors the actual jit keying:
+    scatter/grow/empty compile once per capacity, the top-k family once
+    per (capacity, fetch-bucket). A literal ``query_k`` pins one fetch
+    bucket; a dynamic (per-row) k walks the pow2 ladder up to capacity.
+    Sharding divides per-shard capacity but does not multiply compiles
+    (shard_map reuses one program)."""
+    cap = max(1, int(spec.get("reserved_space") or 1))
+    ndata = int((mesh_axes or {}).get("data", 1) or 1)
+    per_shard = max(1, -(-cap // ndata))
+    if spec.get("query_k_dynamic"):
+        k_ladder = k_bucket_ladder(per_shard)
+    else:
+        k_ladder = (_k_bucket(int(spec.get("query_k") or 3)),)
+    # scatter + grow + empty-template families compile once each per
+    # capacity; the search family once per fetch bucket
+    base = 3
+    compiles = base + len(k_ladder)
+    if spec.get("tiers"):
+        # hot + cold tier each own a search family (cold adds the
+        # cluster-probe kernel); scatter stays on the hot tier
+        compiles += 1 + len(k_ladder)
+    return {
+        "compiles": compiles,
+        "detail": {
+            "per_shard_capacity": per_shard,
+            "k_buckets": list(k_ladder),
+            "kernel_families": base,
+            "tiered": bool(spec.get("tiers")),
+        },
+        "unbucketed": [],
+    }
+
+
 _UPDATE_JIT: dict[str, Callable] = {}
 
 

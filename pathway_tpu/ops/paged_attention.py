@@ -61,6 +61,58 @@ def pages_for(length: int, page_size: int) -> int:
     return max(0, (int(length) + page_size - 1) // page_size)
 
 
+def deep_trace_spec(decode_cfg: dict) -> dict | None:
+    """Representative decode-step callable for the deep verifier's
+    jaxpr pass (analysis.deep): the gather-then-dense reference path,
+    which carries the same op structure as the production step minus
+    the pallas kernel body. Shapes follow the configured pool geometry
+    at a tiny hidden dim — tracing only, nothing compiles."""
+    try:
+        import jax
+    except Exception:  # pragma: no cover - jax is baked into the image
+        return None
+    import numpy as _np
+
+    lanes = max(1, int(decode_cfg.get("lanes") or 1))
+    page_size = max(1, int(decode_cfg.get("page_size") or 16))
+    max_seq = max(page_size, int(decode_cfg.get("max_seq") or 512))
+    pps = pages_for(max_seq, page_size)
+    n_pages = max(int(decode_cfg.get("pages") or 0), pps, 1)
+    d, n_heads = 64, 4
+    args = (
+        jax.ShapeDtypeStruct((lanes, d), _np.float32),
+        jax.ShapeDtypeStruct((n_pages, page_size, d), _np.float32),
+        jax.ShapeDtypeStruct((n_pages, page_size, d), _np.float32),
+        jax.ShapeDtypeStruct((lanes, pps), _np.int32),
+        jax.ShapeDtypeStruct((lanes,), _np.int32),
+    )
+    return {
+        "name": f"decode.step[lanes={lanes},page={page_size}]",
+        "fn": lambda q, kp, vp, pt, ln: paged_attention_reference(
+            q, kp, vp, pt, ln, n_heads=n_heads
+        ),
+        "args": args,
+    }
+
+
+def deep_compile_profile(decode_cfg: dict) -> dict:
+    """Predicted distinct-compile count for the decode plane
+    (analysis.deep, PWL018): the step always runs at the padded
+    (lanes, pages_per_seq) width — one program regardless of live
+    sequences — plus one prefill program per seq bucket up to
+    ``max_seq``."""
+    from ..models.batching import DEFAULT_SEQ_BUCKETS, bucket
+
+    max_seq = int(decode_cfg.get("max_seq") or 512)
+    cap = bucket(max_seq, DEFAULT_SEQ_BUCKETS)
+    prefill = [s for s in DEFAULT_SEQ_BUCKETS if s <= cap] or [cap]
+    return {
+        "compiles": 1 + len(prefill),
+        "detail": {"prefill_seq_buckets": prefill, "step_programs": 1},
+        "unbucketed": [],
+    }
+
+
 def kv_pool_bytes(
     n_pages: int, page_size: int, layers: int, dim: int, dtype_bytes: int = 4
 ) -> int:

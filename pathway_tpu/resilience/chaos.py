@@ -68,6 +68,45 @@ import time as _time
 from typing import Any
 
 _SIGNALS = {"kill": signal.SIGKILL, "term": signal.SIGTERM}
+
+# --- static site registry -------------------------------------------------
+# Every production inject()/channel() call site declares itself here so
+# the deep verifier (analysis.deep, rule PWL020) can prove that each
+# effectful plane of a graph has a fault-injection point covering its
+# commit path — an effectful node whose plane has no registered site is
+# untestable under the chaos harness and therefore outside the
+# exactly-once contract. Keys are exact site names; values name the
+# commit plane the site covers (matched by prefix in the verifier).
+
+SITE_REGISTRY: dict[str, str] = {}
+
+
+def register_site(site: str, plane: str) -> None:
+    """Declare a chaos site statically (idempotent). Call at import time
+    next to the code that owns the ``inject(site)`` call."""
+    SITE_REGISTRY[site] = plane
+
+
+def registered_sites(plane: str | None = None) -> list[str]:
+    """All registered site names, optionally filtered by plane prefix."""
+    if plane is None:
+        return sorted(SITE_REGISTRY)
+    return sorted(s for s, p in SITE_REGISTRY.items() if p.startswith(plane))
+
+
+for _site, _plane in (
+    ("worker.after_feed_log", "persistence"),
+    ("coordinator.after_mark_delivered", "persistence"),
+    ("engine.before_stage_commit", "pipeline"),
+    ("engine.after_stage_commit", "pipeline"),
+    ("serving.admit", "serving"),
+    ("serving.before_dispatch", "serving"),
+    ("serving.batch_inflight", "serving"),
+    ("cluster.send", "cluster"),
+    ("ingest.worker", "ingest"),
+):
+    register_site(_site, _plane)
+del _site, _plane
 # channel verdict actions apply only at sites that call channel()
 _CHANNEL_ACTIONS = ("drop", "duplicate", "partition")
 _ACTIONS = ("kill", "term", "exit", "raise", "delay") + _CHANNEL_ACTIONS

@@ -73,19 +73,32 @@ class InnerIndex:
             "asof_now": as_of_now,
         }
         op = LogicalOp("external_index", [query_table, data_table], params)
-        spec = self._index_spec()
-        if spec is not None:
-            # visible to analysis (PWL010 HBM-budget check) at graph
-            # build time, before any device allocation happens
-            from ...internals.parse_graph import G
-
-            G.external_indexes.append(spec)
         cols = {n: Column(c.dtype) for n, c in query_table._columns.items()}
         cols[_INDEX_REPLY] = Column(dt.ANY)
         cols[_SCORE] = Column(dt.ANY)
         for n in data_cols:
             cols[f"_pw_data_{n}"] = Column(dt.ANY)
-        return Table(cols, query_table._universe, op, name="index_reply")
+        result = Table(cols, query_table._universe, op, name="index_reply")
+        spec = self._index_spec()
+        if spec is not None:
+            # visible to analysis (PWL010 HBM-budget check, deep rules
+            # PWL017-PWL019) at graph build time, before any device
+            # allocation happens; the query-k dynamism and the result
+            # table anchor let the deep pass count compile buckets and
+            # cite the operator's build-time trace in its findings
+            spec = dict(spec)
+            spec["query_k"] = (
+                int(number_of_matches)
+                if isinstance(number_of_matches, int)
+                else None
+            )
+            spec["query_k_dynamic"] = not isinstance(number_of_matches, int)
+            # underscore key: diagnostics detail rendering strips it
+            spec["_table"] = result
+            from ...internals.parse_graph import G
+
+            G.external_indexes.append(spec)
+        return result
 
     def query(
         self,

@@ -173,10 +173,33 @@ class AbstractKnn(InnerIndex):
     _device_backed = False
 
     def _index_spec(self) -> dict | None:
-        """Static description for analysis rules (PWL010): enough to
-        estimate the index's HBM footprint without building it."""
+        """Static description for analysis rules (PWL010, and the deep
+        pass PWL017-PWL019): enough to estimate the index's HBM
+        footprint, compile-bucket space, and placement without building
+        it."""
         if not self._device_backed:
             return None
+        # explicit per-index mesh, parsed jax-free so analyze-only runs
+        # can compare it against the run mesh (PWL019); unparseable
+        # specs (a live Mesh on a device-less host) degrade to None
+        mesh_axes = None
+        if self.mesh is not None:
+            from ...parallel.mesh import parse_mesh_spec
+
+            try:
+                mesh_axes = parse_mesh_spec(self.mesh)
+            except (ValueError, TypeError):
+                mesh_axes = None
+        encoder = None
+        enc = fused_query_encoder(self.embedder) if self.embedder is not None else None
+        if enc is not None:
+            # fused-path encoder geometry: the deep recompile predictor
+            # (PWL018) enumerates its (batch, seq) bucket space
+            encoder = {
+                "max_seq_len": int(getattr(enc, "max_seq_len", 256) or 256),
+                "max_batch": int(getattr(enc, "max_batch", 1024) or 1024),
+                "hidden": int(getattr(getattr(enc, "cfg", None), "hidden_size", 0) or 0),
+            }
         return {
             "kind": type(self).__name__,
             "dimensions": int(self.dimensions),
@@ -184,8 +207,11 @@ class AbstractKnn(InnerIndex):
             "metric": self.metric,
             "device_backed": True,
             "mesh": self.mesh is not None,
+            "mesh_axes": mesh_axes,
             "tiers": self.tiers is not None,
+            "tier_spec": self.tiers if isinstance(self.tiers, (dict, str)) else None,
             "tenant": self.tenant,
+            "encoder": encoder,
         }
 
     def _embed_fns(self):

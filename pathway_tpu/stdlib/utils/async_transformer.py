@@ -22,6 +22,13 @@ from ...internals.expression import AsyncApplyExpression, MakeTupleExpression
 from ...internals.schema import Schema
 from ...internals.table import Table
 from ...internals.udfs import AsyncRetryStrategy, coerce_async
+from ...resilience import chaos
+
+# the commit point of every async UDF plane: between invoke() resolving
+# and the engine making the row durable — a raise here must route to
+# the node's on_error path, which is what chaos runs verify and what
+# the deep verifier (PWL020) requires a registered site for
+chaos.register_site("udf.async_commit", "udf")
 
 
 class AsyncTransformer:
@@ -138,6 +145,7 @@ class AsyncTransformer:
             _ensure_open()
             kwargs = dict(zip(names, values))
             result = await self.invoke(**kwargs)
+            chaos.inject("udf.async_commit")
             return tuple(result.get(n) for n in out_names)
 
         wrapped = call

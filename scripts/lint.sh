@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Repo self-lint: the full deep verifier (--deep, rules PWL001-PWL020)
+# over every shipped demo pipeline and every *_clean analysis fixture,
+# with error findings fatal (--fail-on=error, the CLI default). This is
+# the command the CI hook runs; tests/test_bench_smoke.py gates the
+# same sweep's latency (<10s per program on the CPU backend).
+#
+# Usage: scripts/lint.sh [extra analyze flags...]
+#   scripts/lint.sh                 # error findings fail
+#   scripts/lint.sh --fail-on=warn # warnings fail too
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+
+PROGRAMS=()
+for demo in pathway_tpu/debug/demos/*.py; do
+    [[ "$(basename "$demo")" == "__init__.py" ]] && continue
+    PROGRAMS+=("$demo")
+done
+for fixture in tests/fixtures/analysis/*_clean.py tests/fixtures/analysis/composed_planes.py; do
+    [[ -f "$fixture" ]] && PROGRAMS+=("$fixture")
+done
+
+rc=0
+for prog in "${PROGRAMS[@]}"; do
+    echo "== analyze --deep $* $prog"
+    if ! python -m pathway_tpu.cli analyze --deep "$@" "$prog"; then
+        rc=1
+    fi
+done
+
+if [[ $rc -ne 0 ]]; then
+    echo "lint.sh: FAIL — unsuppressed deep findings above" >&2
+else
+    echo "lint.sh: OK (${#PROGRAMS[@]} programs clean)"
+fi
+exit $rc

@@ -1,0 +1,58 @@
+"""Interaction fixture: every HBM plane composed in one run — a
+data-sharded mesh, a tiered device index, a multi-tenant serving plane
+with quotas on every tenant plus a default, and a small decode KV pool.
+Each plane is sized to fit and every rule's fix is in place, so the
+whole composition must lint clean (zero findings) under the full deep
+pass: PWL010/012 see the tier bound, PWL015 sees the combined
+footprint fit, PWL016 sees the quotas, PWL017-020 see clean device
+callables and placement that follows the run mesh."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+docs = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  1 | 1.0 | 0.0
+  2 | 0.0 | 1.0
+    """
+)
+docs = docs.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, docs.x, docs.y)
+)
+
+queries = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  9 | 1.0 | 1.0
+    """
+)
+queries = queries.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.x, queries.y)
+)
+
+# no per-index mesh: the index follows the run mesh, so staging and
+# search shards agree (PWL019's fix in place)
+index = KNNIndex(
+    docs.emb,
+    docs,
+    n_dimensions=384,
+    reserved_space=20_000,
+    distance_type="cosine",
+)
+res = index.get_nearest_items(queries.emb, k=3)
+
+pw.io.null.write(res)
+
+pw.run(
+    mesh="data=2",
+    index_tiers="hot=10000",
+    decode="pages=64,page=16",
+    tenancy={
+        "quotas": {
+            "acme": {"qps": 100.0, "hbm": "8M"},
+            "globex": {"qps": 50.0, "hbm": "8M"},
+        },
+        "default": {"qps": 10.0},
+    },
+)

@@ -1,0 +1,39 @@
+"""Deep-analysis fixture (PWL020 positive): a recovery run whose
+persisted output depends on a default-deterministic UDF that reads the
+wall clock — replay after a crash recomputes a *different* value than
+the one the crashed epoch persisted. ``--deep`` must flag PWL020
+(warning). A second hazard rides along: an async UDF with the default
+``on_error="raise"`` (no dead-letter route), whose replayed side
+effects are not idempotent."""
+
+import time
+
+import pathway_tpu as pw
+
+
+def stamp(word: str) -> str:
+    # nondeterministic under replay: the recomputed timestamp differs
+    # from the one the pre-crash epoch persisted
+    return f"{word}@{time.time():.0f}"
+
+
+async def notify(word: str) -> str:
+    return f"notified:{word}"
+
+
+t = pw.debug.table_from_markdown(
+    """
+    | word
+  1 | cat
+  2 | dog
+    """
+)
+
+tagged = t.select(
+    tagged=pw.apply_with_type(stamp, str, t.word),
+    sent=pw.apply_async(notify, t.word),
+)
+
+pw.io.null.write(tagged)
+
+pw.run(recovery=True, monitoring_level="auto")

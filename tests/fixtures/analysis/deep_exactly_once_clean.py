@@ -1,0 +1,34 @@
+"""Deep-analysis fixture (PWL020 clean): the same recovery run with the
+hazards fixed — the tag comes from the row itself (deterministic under
+replay) and the async notifier routes failures to the dead-letter table
+(``on_error="dead_letter"``), making its retry idempotent from the
+graph's perspective. ``--deep`` reports nothing."""
+
+import pathway_tpu as pw
+
+
+def stamp(word: str) -> str:
+    return f"{word}@epoch"
+
+
+@pw.udf(on_error="dead_letter")
+async def notify(word: str) -> str:
+    return f"notified:{word}"
+
+
+t = pw.debug.table_from_markdown(
+    """
+    | word
+  1 | cat
+  2 | dog
+    """
+)
+
+tagged = t.select(
+    tagged=pw.apply_with_type(stamp, str, t.word),
+    sent=notify(t.word),
+)
+
+pw.io.null.write(tagged)
+
+pw.run(recovery=True, monitoring_level="auto")

@@ -1,0 +1,52 @@
+"""Deep-analysis fixture (PWL017 positive): a UDF on the staging path
+into a device-backed KNN index calls ``jax.device_get`` — a synchronous
+device->host transfer paid on every epoch's staged batch. The deep pass
+(``--deep``) must flag PWL017 (warning); the plain pass stays silent
+about it."""
+
+import jax
+import jax.numpy as jnp
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+
+def embed_on_device(x, y):
+    # a device round trip inside host-side staging: the readback blocks
+    # dispatch pipelining — exactly the hazard PWL017 exists for
+    vec = jnp.asarray([x, y])
+    host = jax.device_get(vec / (jnp.linalg.norm(vec) + 1e-6))
+    return (float(host[0]), float(host[1]))
+
+
+docs = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  1 | 1.0 | 0.0
+  2 | 0.0 | 1.0
+    """
+)
+docs = docs.select(emb=pw.apply_with_type(embed_on_device, pw.ANY, docs.x, docs.y))
+
+queries = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  9 | 1.0 | 1.0
+    """
+)
+queries = queries.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.x, queries.y)
+)
+
+index = KNNIndex(
+    docs.emb,
+    docs,
+    n_dimensions=2,
+    reserved_space=100,
+    distance_type="cosine",
+)
+res = index.get_nearest_items(queries.emb, k=2)
+
+pw.io.null.write(res)
+
+pw.run()

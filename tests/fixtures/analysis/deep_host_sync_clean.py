@@ -1,0 +1,46 @@
+"""Deep-analysis fixture (PWL017 clean): the same pipeline shape as
+deep_host_sync.py but the staging UDF is pure host Python — no jax
+references, no device readback — so ``--deep`` reports nothing."""
+
+import math
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+
+def embed_on_host(x, y):
+    norm = math.sqrt(x * x + y * y) + 1e-6
+    return (x / norm, y / norm)
+
+
+docs = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  1 | 1.0 | 0.0
+  2 | 0.0 | 1.0
+    """
+)
+docs = docs.select(emb=pw.apply_with_type(embed_on_host, pw.ANY, docs.x, docs.y))
+
+queries = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  9 | 1.0 | 1.0
+    """
+)
+queries = queries.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.x, queries.y)
+)
+
+index = KNNIndex(
+    docs.emb,
+    docs,
+    n_dimensions=2,
+    reserved_space=100,
+    distance_type="cosine",
+)
+res = index.get_nearest_items(queries.emb, k=2)
+
+pw.io.null.write(res)
+
+pw.run()

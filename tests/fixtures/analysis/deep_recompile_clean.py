@@ -1,0 +1,45 @@
+"""Deep-analysis fixture (PWL018 clean): the same index as
+deep_recompile.py under the default compile budget (256) — the
+predicted compile count (4) is far inside it, so ``--deep`` reports
+nothing."""
+
+import os
+
+os.environ.pop("PATHWAY_COMPILE_BUDGET", None)
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+docs = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  1 | 1.0 | 0.0
+  2 | 0.0 | 1.0
+    """
+)
+docs = docs.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, docs.x, docs.y)
+)
+
+queries = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  9 | 1.0 | 1.0
+    """
+)
+queries = queries.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.x, queries.y)
+)
+
+index = KNNIndex(
+    docs.emb,
+    docs,
+    n_dimensions=2,
+    reserved_space=100,
+    distance_type="cosine",
+)
+res = index.get_nearest_items(queries.emb, k=2)
+
+pw.io.null.write(res)
+
+pw.run()

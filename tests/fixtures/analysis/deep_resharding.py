@@ -1,0 +1,43 @@
+"""Deep-analysis fixture (PWL019 positive): an index pinned to its own
+``mesh="data=2"`` in a run with *no* mesh — DeviceRing staging lands
+each epoch's payload on the default device and the engine bounces it
+through host onto the index shards. ``--deep`` must flag PWL019
+(warning) and suggest passing the same mesh to pw.run()."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+docs = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  1 | 1.0 | 0.0
+  2 | 0.0 | 1.0
+    """
+)
+docs = docs.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, docs.x, docs.y)
+)
+
+queries = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  9 | 1.0 | 1.0
+    """
+)
+queries = queries.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.x, queries.y)
+)
+
+index = KNNIndex(
+    docs.emb,
+    docs,
+    n_dimensions=2,
+    reserved_space=100,
+    distance_type="cosine",
+    mesh="data=2",
+)
+res = index.get_nearest_items(queries.emb, k=2)
+
+pw.io.null.write(res)
+
+pw.run()

@@ -1,0 +1,41 @@
+"""Deep-analysis fixture (PWL019 clean): the index's mesh and the run
+mesh agree (``data=2`` on both sides), so staging is mesh-aware and no
+resharding or host bounce happens — ``--deep`` reports nothing."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+docs = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  1 | 1.0 | 0.0
+  2 | 0.0 | 1.0
+    """
+)
+docs = docs.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, docs.x, docs.y)
+)
+
+queries = pw.debug.table_from_markdown(
+    """
+    | x   | y
+  9 | 1.0 | 1.0
+    """
+)
+queries = queries.select(
+    emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.x, queries.y)
+)
+
+index = KNNIndex(
+    docs.emb,
+    docs,
+    n_dimensions=2,
+    reserved_space=100,
+    distance_type="cosine",
+    mesh="data=2",
+)
+res = index.get_nearest_items(queries.emb, k=2)
+
+pw.io.null.write(res)
+
+pw.run(mesh="data=2")

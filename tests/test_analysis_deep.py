@@ -1,0 +1,336 @@
+"""Deep verifier (analysis.deep, rules PWL017-PWL020): fixture-driven
+CLI tests — one positive and one clean fixture per rule — plus the
+bucket-sweep test that validates the recompilation predictor's encoder
+model against the live jit cache, and unit tests for the jaxpr walker
+and the compile-key arithmetic."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import pathway_tpu as pw
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+
+def _analyze_cli(program: str, *flags: str) -> subprocess.CompletedProcess:
+    env = os.environ.copy()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PATHWAY_COMPILE_BUDGET", None)
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", "analyze", *flags, program],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+
+
+# ---------------------------------------------------------------------------
+# fixture matrix: one positive + one clean program per deep rule
+# ---------------------------------------------------------------------------
+
+_CASES = [
+    ("PWL017", "deep_host_sync.py", "deep_host_sync_clean.py"),
+    ("PWL018", "deep_recompile.py", "deep_recompile_clean.py"),
+    ("PWL019", "deep_resharding.py", "deep_resharding_clean.py"),
+    ("PWL020", "deep_exactly_once.py", "deep_exactly_once_clean.py"),
+]
+
+
+@pytest.mark.parametrize("rule,positive,clean", _CASES, ids=[c[0] for c in _CASES])
+def test_deep_rule_fires_on_positive_fixture(rule, positive, clean):
+    """The positive fixture warns (exit 0 under the default
+    --fail-on=error), and --fail-on=warn makes the finding fatal."""
+    fixture = os.path.join(FIXTURES, positive)
+    proc = _analyze_cli(fixture, "--deep")
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert rule in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--deep", "--fail-on=warn")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+@pytest.mark.parametrize("rule,positive,clean", _CASES, ids=[c[0] for c in _CASES])
+def test_deep_rule_silent_on_clean_fixture(rule, positive, clean):
+    proc = _analyze_cli(os.path.join(FIXTURES, clean), "--deep", "--fail-on=warn")
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert rule not in proc.stdout
+
+
+@pytest.mark.parametrize("rule,positive,clean", _CASES, ids=[c[0] for c in _CASES])
+def test_deep_rules_require_deep_flag(rule, positive, clean):
+    """Without --deep the jaxpr-level pass never runs — the positive
+    fixtures lint clean under the plain rule pack."""
+    proc = _analyze_cli(os.path.join(FIXTURES, positive))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert rule not in proc.stdout
+
+
+def test_deep_json_carries_anchor_and_detail():
+    """--deep --json: the PWL017 finding names the sync markers, the
+    target callable, and the fixture's own source line; the summary
+    carries the suppressed count (satellite JSON contract)."""
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "deep_host_sync.py"), "--deep", "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["suppressed"] == 0
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL017"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["markers"] == ["device_get"]
+    assert diag["detail"]["target"].startswith("knn.search[")
+    assert diag["location"]["file"].endswith("deep_host_sync.py")
+    assert diag["location"]["line"] > 0
+
+
+def test_deep_json_sorted_by_rule_then_node():
+    """Diagnostics in --json order by (rule id, node id), not severity —
+    the PWL020 fixture emits two findings and their relative order is
+    stable across runs."""
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "deep_exactly_once.py"), "--deep", "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    rules = [d["rule"] for d in payload["diagnostics"]]
+    assert rules == sorted(rules)
+    assert rules.count("PWL020") == 2
+
+
+def test_pwl018_json_breakdown_matches_total():
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "deep_recompile.py"), "--deep", "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL018"]
+    detail = diag["detail"]
+    assert detail["budget"] == 2
+    assert detail["predicted_compiles"] == sum(detail["per_target"].values())
+    assert detail["predicted_compiles"] > detail["budget"]
+
+
+# ---------------------------------------------------------------------------
+# suppression + in-process surface
+# ---------------------------------------------------------------------------
+
+
+def _build_sync_udf_graph():
+    import jax
+
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    def embed(x, y):
+        return tuple(jax.device_get(jax.numpy.asarray([x, y])).tolist())
+
+    docs = pw.debug.table_from_markdown(
+        """
+        | x   | y
+      1 | 1.0 | 0.0
+        """
+    )
+    docs = docs.select(emb=pw.apply_with_type(embed, pw.ANY, docs.x, docs.y))
+    queries = docs.select(emb=docs.emb)
+    index = KNNIndex(
+        docs.emb, docs, n_dimensions=2, reserved_space=16, distance_type="cosine"
+    )
+    res = index.get_nearest_items(queries.emb, k=2)
+    return res
+
+
+def test_suppress_drops_deep_finding_and_counts_it():
+    pw.clear_graph()
+    try:
+        _build_sync_udf_graph()
+        diags = pw.analysis.analyze(deep=True)
+        assert any(d.rule == "PWL017" for d in diags)
+
+        pw.clear_graph()
+        with pw.analysis.suppress("PWL017"):
+            _build_sync_udf_graph()
+        stats: dict = {}
+        diags = pw.analysis.analyze(deep=True, stats=stats)
+        assert not any(d.rule == "PWL017" for d in diags)
+        assert stats["suppressed"] >= 1
+    finally:
+        pw.clear_graph()
+
+
+def test_deep_rule_ids_registered():
+    """Every deep rule id is a first-class member of the rule registry —
+    suppress() accepts it and the generated README table covers it."""
+    assert pw.analysis.DEEP_RULE_IDS == ("PWL017", "PWL018", "PWL019", "PWL020")
+    for rule in pw.analysis.DEEP_RULE_IDS:
+        assert rule in pw.analysis.RULES
+
+
+# ---------------------------------------------------------------------------
+# PWL018 ground truth: predicted keys == live jit cache entries
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_encoder():
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.models.sentence_encoder import SentenceEncoder
+
+    cfg = EncoderConfig(
+        vocab_size=30000,
+        hidden_size=32,
+        num_layers=1,
+        num_heads=2,
+        intermediate_size=64,
+        max_position=64,
+        pooling="mean",
+    )
+    return SentenceEncoder(
+        config=cfg, checkpoint_dir="/nonexistent", max_seq_len=32, max_batch=16
+    )
+
+
+def test_bucket_sweep_predictor_matches_jit_cache(tiny_encoder):
+    """The recompilation predictor's encoder model validated against
+    reality: drive a length sweep through encode_tokens and assert the
+    live jit cache holds exactly the predicted (B, S) key set."""
+    enc = tiny_encoder
+    lengths = [3, 5, 9, 17, 20, 31, 12, 7, 28, 2, 16, 33, 4, 4, 8, 19, 25, 30]
+    toks = [[(i * 7 + j) % 29000 + 1 for j in range(n)] for i, n in enumerate(lengths)]
+
+    predicted = enc.predict_compile_keys(lengths)
+    assert predicted  # nonempty sweep
+
+    base = enc.jit_cache_size()
+    assert base >= 0, "jit cache introspection unavailable"
+    out = enc.encode_tokens(toks)
+    assert out.shape == (len(lengths), enc.dim)
+    assert enc.jit_cache_size() - base == len(predicted)
+
+    # a second pass over the same workload compiles nothing new
+    enc.encode_tokens(toks)
+    assert enc.jit_cache_size() - base == len(predicted)
+
+    # growing the sweep compiles exactly the new keys
+    more = lengths + [1, 32, 32, 32, 6, 6]
+    more_toks = [[(i * 5 + j) % 29000 + 1 for j in range(n)] for i, n in enumerate(more)]
+    enc.encode_tokens(more_toks)
+    assert enc.jit_cache_size() - base == len(enc.predict_compile_keys(more))
+
+
+def test_compile_bucket_space_bounds_any_workload():
+    from pathway_tpu.models.batching import (
+        compile_bucket_space,
+        predict_compile_keys,
+    )
+
+    bound = compile_bucket_space(32, 16)
+    for lengths in ([1] * 100, list(range(1, 33)) * 4, [32] * 7):
+        assert len(predict_compile_keys(lengths, max_batch=16)) <= bound
+
+
+# ---------------------------------------------------------------------------
+# unit level: jaxpr walker + ladder arithmetic + unbucketed path
+# ---------------------------------------------------------------------------
+
+
+def test_jaxpr_walker_finds_callback_in_nested_jaxpr():
+    jax = pytest.importorskip("jax")
+    import numpy as np
+
+    from pathway_tpu.analysis.deep.host_sync import jaxpr_sync_primitives
+
+    def leaky(x):
+        # nested under jit so the callback sits in an inner jaxpr
+        def inner(v):
+            return jax.pure_callback(
+                lambda a: np.asarray(a), jax.ShapeDtypeStruct(v.shape, v.dtype), v
+            )
+
+        return jax.jit(inner)(x) * 2.0
+
+    jxp = jax.make_jaxpr(leaky)(jax.ShapeDtypeStruct((4,), np.float32))
+    assert any("callback" in p for p in jaxpr_sync_primitives(jxp))
+
+    def clean(x):
+        return jax.jit(lambda v: v * 2.0)(x)
+
+    jxp = jax.make_jaxpr(clean)(jax.ShapeDtypeStruct((4,), np.float32))
+    assert jaxpr_sync_primitives(jxp) == []
+
+
+def test_k_bucket_ladder_is_pow2_and_covers_k():
+    from pathway_tpu.ops.knn import k_bucket_ladder
+
+    assert k_bucket_ladder(1) == (8,)
+    assert k_bucket_ladder(8) == (8,)
+    assert k_bucket_ladder(9) == (8, 16)
+    assert k_bucket_ladder(100) == (8, 16, 32, 64, 128)
+    for k_max in (1, 7, 8, 33, 250):
+        assert k_bucket_ladder(k_max)[-1] >= k_max
+
+
+def test_knn_compile_profile_dynamic_k_walks_ladder():
+    from pathway_tpu.ops.knn import deep_compile_profile, k_bucket_ladder
+
+    static = deep_compile_profile(
+        {"reserved_space": 1000, "query_k": 5, "query_k_dynamic": False}
+    )
+    assert static["compiles"] == 3 + 1  # one pinned fetch bucket
+
+    dynamic = deep_compile_profile(
+        {"reserved_space": 1000, "query_k_dynamic": True}
+    )
+    assert dynamic["compiles"] == 3 + len(k_bucket_ladder(1000))
+    assert dynamic["detail"]["k_buckets"] == list(k_bucket_ladder(1000))
+
+    sharded = deep_compile_profile(
+        {"reserved_space": 1000, "query_k_dynamic": True}, {"data": 4, "model": 1}
+    )
+    # sharding shrinks per-shard capacity (and with it the ladder) but
+    # never multiplies compiles across shards
+    assert sharded["detail"]["per_shard_capacity"] == 250
+    assert sharded["compiles"] <= dynamic["compiles"]
+
+    tiered = deep_compile_profile(
+        {"reserved_space": 1000, "query_k": 5, "query_k_dynamic": False, "tiers": "auto"}
+    )
+    assert tiered["compiles"] == static["compiles"] + 1 + 1
+
+
+def test_unbucketed_dimension_flagged_unconditionally(monkeypatch):
+    """A profile hook reporting an unbucketed dynamic dimension draws a
+    PWL018 finding regardless of the budget headroom."""
+    from pathway_tpu.analysis.deep.recompile import check_recompile_storm
+    from pathway_tpu.analysis.deep.targets import DeepTarget
+    from pathway_tpu.analysis.graph_view import GraphView
+    from pathway_tpu.ops import knn as ops_knn
+
+    monkeypatch.setattr(
+        ops_knn,
+        "deep_compile_profile",
+        lambda spec, mesh_axes=None: {
+            "compiles": 1,
+            "detail": {},
+            "unbucketed": ["query_width"],
+        },
+    )
+    pw.clear_graph()
+    try:
+        target = DeepTarget(name="knn.search[cos,d=2]", kind="knn", spec={})
+        diags = check_recompile_storm(GraphView(), [target])
+    finally:
+        pw.clear_graph()
+    assert len(diags) == 1
+    assert diags[0].rule == "PWL018"
+    assert "query_width" in diags[0].message
+    assert diags[0].detail["dimension"] == "query_width"

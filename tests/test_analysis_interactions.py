@@ -1,0 +1,200 @@
+"""Cross-rule interaction contract for the HBM footprint family
+(PWL010 index-over-HBM, PWL012 no-cold-tier, PWL015 combined
+oversubscription, PWL016 tenancy quotas): all four price planes with
+the same shared footprint model (``internals/ledger``) and the same
+PATHWAY_HBM_BYTES budget, each owns a disjoint failure window (no
+double-firing on one hazard), and the fully composed
+mesh+tiers+tenancy+decode run lints clean when every fix is in place."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.analysis.rules import (
+    check_combined_hbm_oversubscription,
+    check_index_hbm_budget,
+    check_index_tier_budget,
+    check_tenancy_without_quotas,
+)
+from pathway_tpu.internals.parse_graph import G
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+
+def _analyze_cli(program: str, *flags: str) -> subprocess.CompletedProcess:
+    env = os.environ.copy()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", "analyze", *flags, program],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+
+
+def _build_index_graph(reserved: int = 20_000_000, dim: int = 384):
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    docs = pw.debug.table_from_markdown(
+        """
+        | x   | y
+      1 | 1.0 | 0.0
+        """
+    )
+    docs = docs.select(
+        emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, docs.x, docs.y)
+    )
+    index = KNNIndex(
+        docs.emb,
+        docs,
+        n_dimensions=dim,
+        reserved_space=reserved,
+        distance_type="cosine",
+    )
+    res = index.get_nearest_items(docs.emb, k=3)
+    pw.io.null.write(res)
+    return res
+
+
+@pytest.fixture
+def graph():
+    pw.clear_graph()
+    yield G
+    pw.clear_graph()
+
+
+def test_composed_planes_fixture_lints_clean_deep():
+    """The all-planes composition (mesh + tiers + tenancy-with-quotas +
+    decode) with every fix in place: zero findings even with warnings
+    fatal and the deep pass on."""
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "composed_planes.py"), "--deep", "--fail-on=warn"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "no findings" in proc.stdout
+
+
+def test_pwl010_and_pwl012_agree_on_footprint(graph):
+    """Both over-budget rules fire on the same untried index and price
+    it identically: same total bytes, same per-device bytes, same
+    budget — one shared footprint model, two different fixes."""
+    _build_index_graph()
+    view = pw.analysis.GraphView(graph)
+    (d10,) = check_index_hbm_budget(view)
+    (d12,) = check_index_tier_budget(view)
+    assert d10.rule == "PWL010" and d12.rule == "PWL012"
+    assert d10.detail["bytes"] == d12.detail["bytes"]
+    assert d10.detail["per_device_bytes"] == d12.detail["per_device_bytes"]
+    assert d10.detail["hbm_budget_bytes"] == d12.detail["hbm_budget_bytes"]
+    # and both anchor to the same index spec (no diverging copies)
+    assert d10.detail["index"] is d12.detail["index"]
+
+
+def test_run_tiers_silence_both_hbm_rules(graph):
+    """index_tiers= is the accepted fix for the resident-set hazard:
+    with it configured neither PWL010 nor PWL012 fires — the fixed
+    hazard is not re-reported under another rule id."""
+    _build_index_graph()
+    G.run_context = {"mesh_axes": None, "index_tiers": {"hot_rows": 10_000}}
+    view = pw.analysis.GraphView(graph)
+    assert check_index_hbm_budget(view) == []
+    assert check_index_tier_budget(view) == []
+    assert check_combined_hbm_oversubscription(view) == []
+
+
+def test_pwl015_owns_the_each_fits_alone_window(graph, monkeypatch):
+    """In the combined-oversubscription window (each plane fits alone)
+    PWL015 fires and the single-plane rules stay silent — and PWL015's
+    index term equals exactly what PWL010 would have priced."""
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(48 * 1024 * 1024))
+    _build_index_graph(reserved=20_000, dim=384)
+    G.run_context = {
+        "mesh_axes": None,
+        "decode": {"pages": 256, "page_size": 16},
+    }
+    view = pw.analysis.GraphView(graph)
+    assert check_index_hbm_budget(view) == []
+    assert check_index_tier_budget(view) == []
+    (d15,) = check_combined_hbm_oversubscription(view)
+    fp = d15.detail["footprint"]
+    from pathway_tpu.analysis.rules import _index_hbm_bytes
+
+    (spec,) = [s for s in G.external_indexes if s.get("device_backed")]
+    assert fp["index"] == _index_hbm_bytes(spec)
+    assert fp["total"] == fp["index"] + fp["decode_kv"]
+    assert d15.detail["hbm_budget_bytes"] == 48 * 1024 * 1024
+    assert fp["index"] <= d15.detail["hbm_budget_bytes"]
+    assert fp["decode_kv"] <= d15.detail["hbm_budget_bytes"]
+
+
+def test_mesh_sharding_scales_every_rules_per_device_term(graph):
+    """PWL010/012/015 all divide the index footprint by the data axis —
+    the mesh composes identically into each rule's arithmetic."""
+    _build_index_graph(reserved=40_000_000)  # ~57 GiB: over budget even halved
+    G.run_context = {"mesh_axes": {"data": 2, "model": 1}}
+    view = pw.analysis.GraphView(graph)
+    (d10,) = check_index_hbm_budget(view)
+    (d12,) = check_index_tier_budget(view)
+    assert d10.detail["per_device_bytes"] == d10.detail["bytes"] // 2
+    assert d12.detail["per_device_bytes"] == d10.detail["per_device_bytes"]
+    assert d10.detail["mesh_axes"] == {"data": 2, "model": 1}
+
+
+def test_pwl016_prices_quotas_against_the_shared_budget(graph, monkeypatch):
+    """Tenancy quota booking is gated by the same PATHWAY_HBM_BYTES
+    knob the index rules use — overbooked quotas fire PWL016 with the
+    identical budget value, and fitting quotas are silent."""
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(64 * 1024 * 1024))
+    _build_index_graph(reserved=20_000, dim=384)
+    quotas = {
+        "acme": {"hbm_bytes": 40 * 1024 * 1024},
+        "globex": {"hbm_bytes": 40 * 1024 * 1024},
+    }
+    G.run_context = {"mesh_axes": None, "tenancy": {"quotas": quotas}}
+    view = pw.analysis.GraphView(graph)
+    (d16,) = check_tenancy_without_quotas(view)
+    assert d16.rule == "PWL016"
+    assert d16.detail["hbm_budget_bytes"] == 64 * 1024 * 1024
+    assert d16.detail["total_bytes"] == 80 * 1024 * 1024
+    # the index rules read the same knob in the same run
+    assert check_index_hbm_budget(view) == []  # 29 MiB index fits 64 MiB
+
+    # shrink the booking into the budget: PWL016 goes silent
+    quotas["globex"]["hbm_bytes"] = 16 * 1024 * 1024
+    assert check_tenancy_without_quotas(view) == []
+
+
+def test_composed_hazard_fires_exactly_one_rule_per_window(graph, monkeypatch):
+    """All four planes composed with ONE hazard (overbooked tenant
+    quotas): exactly one PWL016 finding, nothing else from the
+    footprint family — composition never double-fires."""
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(64 * 1024 * 1024))
+    _build_index_graph(reserved=20_000, dim=384)
+    G.run_context = {
+        "mesh_axes": {"data": 2, "model": 1},
+        "index_tiers": {"hot_rows": 10_000},
+        "decode": {"pages": 64, "page_size": 16},
+        "tenancy": {
+            "quotas": {
+                "acme": {"hbm_bytes": 40 * 1024 * 1024},
+                "globex": {"hbm_bytes": 40 * 1024 * 1024},
+            }
+        },
+    }
+    view = pw.analysis.GraphView(graph)
+    fired = (
+        check_index_hbm_budget(view)
+        + check_index_tier_budget(view)
+        + check_combined_hbm_oversubscription(view)
+        + check_tenancy_without_quotas(view)
+    )
+    assert [d.rule for d in fired] == ["PWL016"]

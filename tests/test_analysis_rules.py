@@ -425,7 +425,7 @@ def test_json_output_is_stable():
                 "table": "t",
             },
         ],
-        "summary": {"error": 1, "info": 1, "warning": 0},
+        "summary": {"error": 1, "info": 1, "suppressed": 0, "warning": 0},
     }
 
 

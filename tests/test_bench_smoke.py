@@ -1156,3 +1156,41 @@ def test_bench_smoke_tenant_isolation_suite_runs_green(_tenancy_reset, monkeypat
     # asserts containment (2x) rather than the full suite's 1.2x gate —
     # an unthrottled flooder blows past 2x immediately.
     assert rec["value"] <= 2.0, rec
+
+
+def test_bench_smoke_deep_analyze_rag_demo():
+    """The lint-gate latency bench: the full deep verifier pass
+    (--deep, PWL001-PWL020 including jaxpr tracing of the device
+    callables) over the heaviest shipped demo must finish inside the
+    10 s budget scripts/lint.sh is sized for, with zero findings — a
+    deep pass too slow for the pre-commit loop stops being run."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    demo = os.path.join(root, "pathway_tpu", "debug", "demos", "rag_chunks.py")
+    env = os.environ.copy()
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    start = time.monotonic()
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pathway_tpu.cli",
+            "analyze",
+            "--deep",
+            "--fail-on=warn",
+            demo,
+        ],
+        cwd=root,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    elapsed = time.monotonic() - start
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "no findings" in proc.stdout
+    assert elapsed < 10.0, f"deep lint pass took {elapsed:.1f}s (budget 10s)"

@@ -79,7 +79,11 @@ def test_broken_program_exits_3():
 
 def test_rag_template_lints_clean_in_process():
     """The llm-xpack vector store template must stay free of
-    error-severity findings (warnings/info are reported, not fatal)."""
+    error-severity findings (warnings/info are reported, not fatal)
+    and of ALL deep-pass findings (PWL017-PWL020): the template's
+    device callables are ours end to end, so any host sync, compile
+    storm, placement mismatch, or exactly-once hazard there is a
+    regression, not an accepted risk."""
     from pathway_tpu.xpacks.llm import VectorStoreServer
 
     pw.clear_graph()
@@ -88,16 +92,18 @@ def test_rag_template_lints_clean_in_process():
             [("pathway is a streaming dataflow framework", "/data/pathway.txt")]
         )
         VectorStoreServer(docs, embedder=fake_embeddings_model)
-        diags = pw.analysis.analyze()
+        diags = pw.analysis.analyze(deep=True)
         errors = [d for d in diags if d.severity is pw.analysis.Severity.ERROR]
         assert not errors, [d.render() for d in errors]
+        deep = [d for d in diags if d.rule in pw.analysis.DEEP_RULE_IDS]
+        assert not deep, [d.render() for d in deep]
     finally:
         pw.clear_graph()
 
 
 def test_recovery_without_monitoring_warns_pwl007():
     """recovery= with monitoring fully off: a warning (exit 0), nonzero
-    only under --strict-warnings — the CLI sees the run configuration
+    only under --fail-on=warn — the CLI sees the run configuration
     because pw.run records it before the analyze-only return."""
     fixture = os.path.join(FIXTURES, "recovery_no_monitoring.py")
     proc = _analyze_cli(fixture)
@@ -105,7 +111,7 @@ def test_recovery_without_monitoring_warns_pwl007():
     assert "PWL007" in proc.stdout
     assert "warning" in proc.stdout
 
-    proc = _analyze_cli(fixture, "--strict-warnings")
+    proc = _analyze_cli(fixture, "--fail-on=warn")
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
 
 
@@ -122,7 +128,7 @@ def test_pwl007_json_carries_run_context():
 
 def test_unprotected_serving_endpoint_warns_pwl008():
     """rest_connector without serving= in a recovery/pipelined run: a
-    warning (exit 0), nonzero only under --strict-warnings. The CLI
+    warning (exit 0), nonzero only under --fail-on=warn. The CLI
     sees the endpoint because rest_connector records it on the parse
     graph (serving_endpoints) at build time."""
     fixture = os.path.join(FIXTURES, "serving_unprotected.py")
@@ -131,7 +137,7 @@ def test_unprotected_serving_endpoint_warns_pwl008():
     assert "PWL008" in proc.stdout
     assert "warning" in proc.stdout
 
-    proc = _analyze_cli(fixture, "--strict-warnings")
+    proc = _analyze_cli(fixture, "--fail-on=warn")
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
 
 
@@ -149,7 +155,7 @@ def test_pwl008_json_names_route_and_pressure():
 
 def test_cluster_without_fault_domain_warns_pwl009():
     """A 2-process run with recovery= off and cluster_lease_ms=0: two
-    PWL009 warnings (exit 0), nonzero only under --strict-warnings.
+    PWL009 warnings (exit 0), nonzero only under --fail-on=warn.
     The fixture sets PATHWAY_PROCESSES itself, so the CLI sees the
     cluster shape through the recorded run configuration."""
     fixture = os.path.join(FIXTURES, "cluster_no_recovery.py")
@@ -158,7 +164,7 @@ def test_cluster_without_fault_domain_warns_pwl009():
     assert proc.stdout.count("PWL009") == 2
     assert "warning" in proc.stdout
 
-    proc = _analyze_cli(fixture, "--strict-warnings")
+    proc = _analyze_cli(fixture, "--fail-on=warn")
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
 
 
@@ -180,7 +186,7 @@ def test_pwl009_json_carries_world_and_lease():
 
 def test_index_over_hbm_warns_pwl010():
     """A device-backed index bigger than one device's HBM with no mesh:
-    a warning (exit 0), nonzero only under --strict-warnings. The CLI
+    a warning (exit 0), nonzero only under --fail-on=warn. The CLI
     sees the index because query building records its spec on the parse
     graph (external_indexes) — no device allocation happens."""
     fixture = os.path.join(FIXTURES, "index_over_hbm.py")
@@ -189,7 +195,7 @@ def test_index_over_hbm_warns_pwl010():
     assert "PWL010" in proc.stdout
     assert "warning" in proc.stdout
 
-    proc = _analyze_cli(fixture, "--strict-warnings")
+    proc = _analyze_cli(fixture, "--fail-on=warn")
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
 
 
@@ -207,14 +213,14 @@ def test_pwl010_json_carries_footprint_and_suggestion():
 def test_host_bound_ingest_warns_pwl011():
     """Streaming connector -> device KNN with the serial epoch loop and
     no ingest stage: a warning (exit 0), nonzero only under
-    --strict-warnings."""
+    --fail-on=warn."""
     fixture = os.path.join(FIXTURES, "host_bound_ingest.py")
     proc = _analyze_cli(fixture)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "PWL011" in proc.stdout
     assert "warning" in proc.stdout
 
-    proc = _analyze_cli(fixture, "--strict-warnings")
+    proc = _analyze_cli(fixture, "--fail-on=warn")
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
 
 
@@ -240,14 +246,14 @@ def test_pwl011_env_knob_silences_cli(monkeypatch):
 
 def test_index_no_cold_tier_warns_pwl012():
     """A beyond-HBM device index with no cold tier: PWL012 warns (exit
-    0), nonzero only under --strict-warnings."""
+    0), nonzero only under --fail-on=warn."""
     fixture = os.path.join(FIXTURES, "index_no_cold_tier.py")
     proc = _analyze_cli(fixture)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "PWL012" in proc.stdout
     assert "warning" in proc.stdout
 
-    proc = _analyze_cli(fixture, "--strict-warnings")
+    proc = _analyze_cli(fixture, "--fail-on=warn")
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
 
 
@@ -280,14 +286,14 @@ def test_pwl012_env_knob_silences_cli(monkeypatch):
 def test_http_llm_with_decode_warns_pwl013():
     """An HTTP LLM rerank hop in a run that configures the device
     decode plane: PWL013 warns (exit 0), nonzero only under
-    --strict-warnings."""
+    --fail-on=warn."""
     fixture = os.path.join(FIXTURES, "http_llm_with_device_decode.py")
     proc = _analyze_cli(fixture)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "PWL013" in proc.stdout
     assert "warning" in proc.stdout
 
-    proc = _analyze_cli(fixture, "--strict-warnings")
+    proc = _analyze_cli(fixture, "--fail-on=warn")
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
 
 
@@ -317,7 +323,7 @@ def test_pwl013_silent_without_decode_plane(monkeypatch):
 def test_slo_without_tracing_warns_pwl014(monkeypatch):
     """A deadline-budgeted serving endpoint in a run with tracing and
     the profiler both off: PWL014 warns (exit 0), nonzero only under
-    --strict-warnings."""
+    --fail-on=warn."""
     monkeypatch.delenv("PATHWAY_TRACING", raising=False)
     monkeypatch.delenv("PATHWAY_PROFILE", raising=False)
     fixture = os.path.join(FIXTURES, "slo_without_tracing.py")
@@ -326,7 +332,7 @@ def test_slo_without_tracing_warns_pwl014(monkeypatch):
     assert "PWL014" in proc.stdout
     assert "warning" in proc.stdout
 
-    proc = _analyze_cli(fixture, "--strict-warnings")
+    proc = _analyze_cli(fixture, "--fail-on=warn")
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
 
 
@@ -358,7 +364,7 @@ def test_pwl014_tracing_env_silences_cli(monkeypatch):
 def test_combined_over_hbm_warns_pwl015(monkeypatch):
     """An index plane and a decode KV pool that each fit the HBM budget
     alone but jointly oversubscribe it: PWL015 warns (exit 0), nonzero
-    only under --strict-warnings — and neither single-plane rule
+    only under --fail-on=warn — and neither single-plane rule
     (PWL010/PWL012) fires."""
     monkeypatch.setenv("PATHWAY_HBM_BYTES", str(48 * 1024 * 1024))
     fixture = os.path.join(FIXTURES, "combined_over_hbm.py")
@@ -369,7 +375,7 @@ def test_combined_over_hbm_warns_pwl015(monkeypatch):
     assert "PWL012" not in proc.stdout
     assert "warning" in proc.stdout
 
-    proc = _analyze_cli(fixture, "--strict-warnings")
+    proc = _analyze_cli(fixture, "--fail-on=warn")
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
 
 
@@ -487,7 +493,7 @@ def test_doctor_broken_program_exits_3():
 
 def test_tenancy_no_quotas_warns_pwl016(monkeypatch):
     """The tenancy plane on with nothing bounding any tenant: PWL016
-    warns (exit 0), nonzero only under --strict-warnings."""
+    warns (exit 0), nonzero only under --fail-on=warn."""
     monkeypatch.delenv("PATHWAY_TENANCY", raising=False)
     fixture = os.path.join(FIXTURES, "tenancy_no_quotas.py")
     proc = _analyze_cli(fixture)
@@ -495,7 +501,7 @@ def test_tenancy_no_quotas_warns_pwl016(monkeypatch):
     assert "PWL016" in proc.stdout
     assert "warning" in proc.stdout
 
-    proc = _analyze_cli(fixture, "--strict-warnings")
+    proc = _analyze_cli(fixture, "--fail-on=warn")
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
 
 

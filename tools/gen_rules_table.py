@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Generate the README's static-analysis rules table from the rule
+registry (``pathway_tpu.analysis.RULES``).
+
+The table between the ``<!-- rules-table:begin -->`` /
+``<!-- rules-table:end -->`` markers in README.md is machine-written:
+rule ids and severities come straight from the registry (so the table
+can never disagree with what ``suppress()`` accepts or what the CLI
+emits), and the long-form "what it catches" prose lives in
+``DESCRIPTIONS`` below. A registered rule with no description — or a
+description for a rule that no longer exists — fails generation, which
+is how adding PWL021 without documenting it breaks the build.
+
+Usage::
+
+    python tools/gen_rules_table.py          # rewrite README.md in place
+    python tools/gen_rules_table.py --check  # exit 1 if README is stale
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+README = os.path.join(REPO, "README.md")
+BEGIN = "<!-- rules-table:begin -->"
+END = "<!-- rules-table:end -->"
+
+# Long-form right-hand column, one entry per registered rule. Keep the
+# prose in sync with the rule docstrings in pathway_tpu/analysis/.
+DESCRIPTIONS: dict[str, str] = {
+    "PWL001": (
+        "dtype mismatches across operator boundaries: join keys that cannot "
+        "unify (and hash to different shards), non-`BOOL` filter predicates, "
+        "`concat`/`update` columns with incompatible types"
+    ),
+    "PWL002": (
+        "unbounded state: `groupby`/`join`/`deduplicate` fed by a streaming "
+        "connector with no window and no temporal behavior with a "
+        "cutoff/freeze threshold (one-sided streaming joins and "
+        "instance-keyed deduplicates are warnings)"
+    ),
+    "PWL003": (
+        "shard safety: UDFs capturing mutable globals/closures, "
+        "non-deterministic UDFs computing grouping/join/reindex keys "
+        "(`shard_of_value` routing becomes unstable), reducers that are not "
+        "commutative/associative per the engine registry (`earliest`, "
+        "`latest`, stateful)"
+    ),
+    "PWL004": (
+        "jit-batched UDF purity: closing over a dead JAX tracer (error), "
+        "calling host `numpy` on traced values, `print`/`open`/global writes "
+        "that run once per trace instead of once per batch"
+    ),
+    "PWL005": (
+        "dead columns: computed and exchanged but never read on any path to "
+        "an output (reported once, at the operator that materializes them)"
+    ),
+    "PWL006": (
+        "unconnected tables/nodes: built but feeding no output or "
+        "subscription — they will never execute"
+    ),
+    "PWL007": (
+        "`pw.run(recovery=...)` with monitoring fully off: restarts and "
+        "escalations would be invisible — no dashboard, no `/metrics`, no "
+        "restart counters"
+    ),
+    "PWL008": (
+        "a serving endpoint (`rest_connector`) with no `serving=` overload "
+        "protection in a run configured for sustained pressure (`recovery=` "
+        "or `pipeline_depth>1`): under load it queues unboundedly and times "
+        "out instead of shedding early with typed 429/503"
+    ),
+    "PWL009": (
+        "a multi-worker run (`processes*threads > 1`) without a cluster "
+        "fault domain: `recovery=` off means one worker crash fails the "
+        "whole run instead of a partial restart, and `cluster_lease_ms=0` "
+        "disables heartbeats so a hung or partitioned worker stalls the "
+        "epoch barrier forever"
+    ),
+    "PWL010": (
+        "a device-backed KNN index whose reserved capacity "
+        "(`reserved_space × n_dimensions` f32 + masks) exceeds one device's "
+        "HBM budget (16 GiB default, `PATHWAY_HBM_BYTES` to override) in a "
+        "run with no mesh — or a mesh too small to bring the per-device "
+        "shard under budget. The diagnostic carries the footprint and a "
+        "`suggested_mesh`; shard it with `pw.run(mesh=...)` / `PATHWAY_MESH`"
+    ),
+    "PWL011": (
+        "a streaming connector feeding a device-backed index/model with "
+        "`pipeline_depth <= 1` and no collaborative ingest stage: host prep "
+        "(tokenize/pack/resolve) runs serially in line with device dispatch, "
+        "starving the chip. Fix with `pw.run(ingest_workers=N)` / "
+        "`PATHWAY_INGEST_WORKERS` or `pipeline_depth >= 2` — output is "
+        "byte-identical either way"
+    ),
+    "PWL012": (
+        "a device-backed index whose projected footprint exceeds the "
+        "per-device HBM budget with **no cold tier configured** — the "
+        "complement to `PWL010`'s \"shard it\" advice. The detail carries "
+        "the footprint, a `suggested_tier_split` (hot/cold rows at the "
+        "budget) and the int8 `quantized_cold_bytes` estimate; fix with "
+        "`pw.run(index_tiers=...)` / `PATHWAY_INDEX_TIERS` (see \"Tiered "
+        "index\" below). Either tier config silences both rules"
+    ),
+    "PWL013": (
+        "an HTTP LLM stage (`LLMReranker`, a chat UDF) in a run that also "
+        "configures the device decode plane (`pw.run(decode=...)` / "
+        "`PATHWAY_DECODE`): the rerank/generate hop would leave the chip for "
+        "the slowest, least controlled dependency in the RAG loop while an "
+        "on-chip path exists. The detail lists the endpoints and the decode "
+        "config; migrate with `KNNIndex(rerank=...)` and "
+        "`decode.DecodeService` (see \"On-chip query path\" below). "
+        "Device-native rerankers (`CrossEncoderReranker`) never trigger it"
+    ),
+    "PWL014": (
+        "a serving endpoint with a per-request deadline budget "
+        "(`default_deadline_ms`) in a run where request tracing **and** the "
+        "profiler are both off: a missed deadline sheds as a bare 429/503 "
+        "with no record of which stage spent the budget. The detail lists "
+        "the budgeted endpoints; fix with `pw.run(tracing=True)` / "
+        "`PATHWAY_TRACING=1` (see \"Request tracing\" below) — an attached "
+        "profiler also silences it"
+    ),
+    "PWL015": (
+        "the index and decode planes **each** fit the per-device HBM budget "
+        "alone but jointly oversubscribe it — the case `PWL010`/`PWL012` "
+        "can never see because each audits one plane. Fired from the same "
+        "shared footprint model (`internals/ledger.py`) those rules use: "
+        "the detail carries the combined `footprint` (index, KV pool, total "
+        "vs budget). Shrink one plane (`index_tiers=`, fewer `pages=`), "
+        "raise `PATHWAY_HBM_BYTES`, or shard the index with `mesh=`"
+    ),
+    "PWL016": (
+        "the multi-tenant plane is configured (`pw.run(tenancy=)` / "
+        "`PATHWAY_TENANCY`) but **no per-tenant quotas and no default "
+        "quota** exist: every tenant is unthrottled, so one flooding tenant "
+        "takes whatever chip time and HBM it wants and the isolation the "
+        "plane exists for never engages. Also fires when the named quotas' "
+        "HBM budgets sum past `PATHWAY_HBM_BYTES` — the admission booking "
+        "would let tenants collectively OOM the slab. Fix with "
+        "`tenancy=\"qps=...,hbm=...\"` or a `{\"quotas\": ...}` dict (see "
+        "\"Multi-tenant serving\" below)"
+    ),
+    # -- deep (jaxpr-level) rules: `pathway analyze --deep` only --
+    "PWL017": (
+        "**(deep)** a host sync inside a device hot path: callback/infeed "
+        "primitives traced in a device callable's jaxpr "
+        "(`pure_callback`/`io_callback`/`debug_callback`), or a staging-path "
+        "UDF that calls `jax.device_get`/`block_until_ready`/`.item()`/"
+        "`np.asarray` on device values — every epoch pays a synchronous "
+        "device→host round trip that blocks dispatch pipelining. Keep the "
+        "value on device or move the readback behind the sink"
+    ),
+    "PWL018": (
+        "**(deep)** a predicted recompilation storm: the enumerated compile "
+        "space of every device callable (encoder `(batch, seq)` buckets, "
+        "KNN pow2 fetch ladder, decode prefill buckets; tenant slabs dedupe "
+        "per geometry) sums past `PATHWAY_COMPILE_BUDGET` (default 256), or "
+        "a dynamic dimension reaches a jit key with no bucket ladder at "
+        "all. The detail carries the per-target breakdown; shrink the "
+        "bucket space or raise the budget. The encoder model is validated "
+        "against the live jit cache in the bucket-sweep test"
+    ),
+    "PWL019": (
+        "**(deep)** implicit cross-mesh resharding / host bounce: an index "
+        "pinned to its own `mesh=` whose axes differ from the run mesh "
+        "(every staged batch crosses meshes via all-to-all or host gather), "
+        "or a mesh-sharded index in a run *without* a mesh (DeviceRing "
+        "staging lands on the default device and bounces payloads through "
+        "host every epoch). Placement facts come from the owning modules' "
+        "hooks (`engine/device_ring.py`, `ingest/stage.py`); use one mesh "
+        "for both, or drop the per-index `mesh=`"
+    ),
+    "PWL020": (
+        "**(deep)** an effectful node outside the exactly-once contract in "
+        "a recovery/persistence run: an async UDF with `on_error=\"raise\"` "
+        "(replay re-issues side effects already sent — route failures to "
+        "the dead-letter table), an effectful plane with no registered "
+        "chaos site (the exactly-once claim is untestable), or a "
+        "default-deterministic UDF upstream of persisted state that reads "
+        "wall clock / unseeded RNG (replay persists a different value — "
+        "seed it or declare `deterministic=False`)"
+    ),
+}
+
+
+def build_table() -> str:
+    from pathway_tpu.analysis import RULES
+
+    missing = sorted(set(RULES) - set(DESCRIPTIONS))
+    stale = sorted(set(DESCRIPTIONS) - set(RULES))
+    if missing:
+        raise SystemExit(
+            f"gen_rules_table: registered rule(s) with no description: "
+            f"{', '.join(missing)} — add them to DESCRIPTIONS"
+        )
+    if stale:
+        raise SystemExit(
+            f"gen_rules_table: description(s) for unregistered rule(s): "
+            f"{', '.join(stale)} — remove them from DESCRIPTIONS"
+        )
+    lines = ["| Rule | Severity | What it catches |", "|---|---|---|"]
+    for rule in sorted(RULES):
+        severity, _summary = RULES[rule]
+        lines.append(f"| `{rule}` | {severity.value} | {DESCRIPTIONS[rule]} |")
+    return "\n".join(lines)
+
+
+def render_readme(text: str) -> str:
+    try:
+        head, rest = text.split(BEGIN, 1)
+        _old, tail = rest.split(END, 1)
+    except ValueError:
+        raise SystemExit(
+            f"gen_rules_table: README.md is missing the {BEGIN} / {END} "
+            "markers around the rules table"
+        )
+    return f"{head}{BEGIN}\n{build_table()}\n{END}{tail}"
+
+
+def main(argv: list[str]) -> int:
+    check = "--check" in argv
+    with open(README, encoding="utf-8") as f:
+        current = f.read()
+    rendered = render_readme(current)
+    if rendered == current:
+        print("gen_rules_table: README.md is up to date")
+        return 0
+    if check:
+        print(
+            "gen_rules_table: README.md rules table is stale — run "
+            "`python tools/gen_rules_table.py`",
+            file=sys.stderr,
+        )
+        return 1
+    with open(README, "w", encoding="utf-8") as f:
+        f.write(rendered)
+    print("gen_rules_table: README.md rules table rewritten")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
