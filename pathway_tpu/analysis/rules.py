@@ -152,6 +152,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL018": (Severity.WARNING, "predicted compile count exceeds the budget"),
     "PWL019": (Severity.WARNING, "implicit cross-mesh resharding / host bounce"),
     "PWL020": (Severity.WARNING, "effectful node outside the exactly-once contract"),
+    "PWL021": (Severity.WARNING, "SLO/watchdog run with chip-time accounting off"),
 }
 
 #: rule ids that only the deep pass (``pathway analyze --deep`` /
@@ -1185,6 +1186,59 @@ def check_slo_without_tracing(view: GraphView) -> list[Diagnostic]:
 
 
 # --------------------------------------------------------------------------
+# PWL021 — SLO/watchdog run with chip-time accounting off
+
+
+def check_slo_without_chip_accounting(view: GraphView) -> list[Diagnostic]:
+    """The run declares a latency/health contract — a serving endpoint
+    with a per-request deadline budget, or ``pw.run(watchdog=)`` — but
+    the chip-time ledger (``pw.run(chip_ledger=True)`` /
+    PATHWAY_CHIP_LEDGER=1) is off. When the contract is breached, the
+    first question is always *where the device-seconds went* (encode?
+    index search? rerank? decode? stranded behind host prep?), and
+    without the ledger there is no answer: ``pathway top`` renders
+    empty, the watchdog's stranded_chip_time rule never fires, and
+    ``pathway perf diff`` has no per-plane baseline. Tracing (PWL014)
+    attributes *one request's* wall time; the chip ledger attributes
+    the *fleet's* device time — an SLO needs both. Intent is recorded
+    on the parse graph by ``pw.run`` (``run_context``: ``watchdog``,
+    ``chip_ledger``) and ``rest_connector`` (``serving_endpoints``
+    carrying ``deadline_ms``)."""
+    ctx = getattr(view.graph, "run_context", None) or {}
+    if not ctx:
+        return []  # no pw.run configuration recorded (unit-built graph)
+    if ctx.get("chip_ledger"):
+        return []
+    endpoints = getattr(view.graph, "serving_endpoints", None) or []
+    budgeted = [e for e in endpoints if e.get("deadline_ms")]
+    if not budgeted and not ctx.get("watchdog"):
+        return []
+    reasons = []
+    if budgeted:
+        routes = ", ".join(sorted(str(e.get("route", "?")) for e in budgeted))
+        reasons.append(f"endpoint(s) {routes} enforce a deadline budget")
+    if ctx.get("watchdog"):
+        reasons.append("the health watchdog is on")
+    return [
+        _diag(
+            "PWL021",
+            f"{' and '.join(reasons)} but chip-time accounting is off: "
+            "a breach leaves no record of where the device-seconds "
+            "went (per-plane chip time, MFU, stranded fraction and "
+            "its causes). Turn on pw.run(chip_ledger=True) (or "
+            "PATHWAY_CHIP_LEDGER=1) so `pathway top` / `pathway perf "
+            "snapshot` can attribute the budget, and the watchdog's "
+            "stranded_chip_time rule has a signal",
+            detail={
+                "endpoints": budgeted,
+                "watchdog": bool(ctx.get("watchdog")),
+                "chip_ledger": False,
+            },
+        )
+    ]
+
+
+# --------------------------------------------------------------------------
 # PWL015 — combined planes oversubscribe the HBM budget
 
 
@@ -1337,6 +1391,7 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_host_bound_ingest,
     check_http_llm_with_device_decode,
     check_slo_without_tracing,
+    check_slo_without_chip_accounting,
     check_combined_hbm_oversubscription,
     check_tenancy_without_quotas,
 ]

@@ -536,6 +536,126 @@ def trace_slow(directory, top_n):
     click.echo(render_slow_report(slow_report(exemplars, top_n=top_n)))
 
 
+@cli.group()
+def perf():
+    """Chip-time performance snapshots and regression diffs.
+
+    A run with the chip ledger on (``pw.run(chip_ledger=True)`` /
+    PATHWAY_CHIP_LEDGER=1) and a journal directory (PATHWAY_JOURNAL_DIR)
+    persists periodic samples plus every bench FINAL SUMMARY. These
+    commands fold that journal into a BENCH_r*-style snapshot JSON and
+    compare two snapshots with per-metric regression gates.
+    """
+
+
+_JOURNAL_DIR_HELP = "metrics journal directory [default: PATHWAY_JOURNAL_DIR]"
+
+
+@perf.command(name="snapshot")
+@click.option("--journal", "directory", default=None, help=_JOURNAL_DIR_HELP)
+@click.option(
+    "--output",
+    "-o",
+    default=None,
+    help="write the snapshot JSON here instead of stdout",
+)
+def perf_snapshot(directory, output):
+    """Build a BENCH_r*-style snapshot from the journal's bench records
+    (automates the BENCH_r06 runbook's 'save the FINAL SUMMARY' step)."""
+    import json as _json
+
+    from .perf.snapshot import build_snapshot
+
+    try:
+        snap = build_snapshot(directory)
+    except ValueError as exc:
+        raise click.ClickException(str(exc))
+    text = _json.dumps(snap, indent=2, sort_keys=True)
+    if output:
+        with open(output, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+        click.echo(f"snapshot written to {output}", err=True)
+    else:
+        click.echo(text)
+
+
+@perf.command(name="diff")
+@click.option(
+    "--gate",
+    default=None,
+    type=float,
+    help="relative regression gate [default: 0.10]",
+)
+@click.argument("path_a", required=True)
+@click.argument("path_b", required=True)
+def perf_diff(gate, path_a, path_b):
+    """Compare two snapshots (baseline A vs candidate B) per metric.
+
+    Exits 1 when any metric regresses past its gate — the per-record
+    absolute ``gate`` field when present, else --gate relative.
+    """
+    from .perf.snapshot import DEFAULT_GATE, diff_snapshots, load_snapshot, render_diff
+
+    try:
+        a = load_snapshot(path_a)
+        b = load_snapshot(path_b)
+    except Exception as exc:
+        raise click.ClickException(str(exc))
+    result = diff_snapshots(a, b, gate=DEFAULT_GATE if gate is None else gate)
+    click.echo(render_diff(result))
+    sys.exit(result["rc"])
+
+
+@cli.command()
+@click.option("--url", default=None, help="monitoring server base URL (reads /status)")
+@click.option("--journal", "directory", default=None, help=_JOURNAL_DIR_HELP)
+@click.option("--once", is_flag=True, help="render one frame and exit")
+@click.option(
+    "--interval",
+    default=2.0,
+    show_default=True,
+    type=float,
+    help="refresh interval in seconds",
+)
+def top(url, directory, once, interval):
+    """Live chip-time view: per-plane share, MFU, stranded causes,
+    per-tenant share vs DRR weight, HBM per account.
+
+    Reads --url's /status when given, else the newest journal sample
+    (--journal / PATHWAY_JOURNAL_DIR). With --once, exits 0 when a
+    chip-time sample was rendered, 1 when there is none yet.
+    """
+    import time as _time
+
+    from .perf.top import load_from_journal, load_status_from_url, render_top
+
+    def _frame():
+        if url:
+            data = load_status_from_url(url)
+        else:
+            data = load_from_journal(directory)
+        return render_top(data)
+
+    if once:
+        try:
+            text, state = _frame()
+        except Exception as exc:
+            raise click.ClickException(str(exc))
+        click.echo(text)
+        sys.exit(0 if state != "empty" else 1)
+    try:
+        while True:
+            try:
+                text, _state = _frame()
+            except Exception as exc:
+                text = f"pathway top — error: {exc}"
+            # clear screen + home, like watch(1)
+            click.echo("\033[2J\033[H" + text)
+            _time.sleep(max(0.1, interval))
+    except KeyboardInterrupt:
+        pass
+
+
 def main() -> None:
     cli()
 

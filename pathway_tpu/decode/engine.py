@@ -38,10 +38,13 @@ import math
 import threading
 import time as _time
 from collections import deque
+from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Any, Optional
 
 import numpy as np
+
+from ..internals.chip_ledger import CHIP_LEDGER
 
 from ..ops.paged_attention import (
     PagedKvPool,
@@ -548,23 +551,30 @@ class DecodeEngine:
                 return  # pool pressure: stay queued, retry next tick
             self._pending.popleft()
             w0 = _time.monotonic()
-            seq = bucket(plen, _PREFILL_BUCKETS)
-            seq = min(seq, self.max_prompt_len())
-            ids = np.zeros(seq, np.int32)
-            ids[:plen] = ticket.prompt
-            k_rows, v_rows, tok0 = self._prefill_fn(seq)(
-                self.params, jnp.asarray(ids), jnp.int32(plen)
-            )
-            page_ids = np.full(self._pages_per_seq, self.pool.sentinel, np.int32)
-            page_ids[: len(pages)] = pages
-            self.pool.k, self.pool.v = self._scatter_fn(seq)(
-                self.pool.k,
-                self.pool.v,
-                k_rows,
-                v_rows,
-                jnp.asarray(page_ids[: max(1, (seq + self.config.page_size - 1) // self.config.page_size)]),
-                jnp.int32(plen),
-            )
+            chip = CHIP_LEDGER.on()
+            with CHIP_LEDGER.timed("decode") if chip else nullcontext():
+                seq = bucket(plen, _PREFILL_BUCKETS)
+                seq = min(seq, self.max_prompt_len())
+                ids = np.zeros(seq, np.int32)
+                ids[:plen] = ticket.prompt
+                k_rows, v_rows, tok0 = self._prefill_fn(seq)(
+                    self.params, jnp.asarray(ids), jnp.int32(plen)
+                )
+                page_ids = np.full(self._pages_per_seq, self.pool.sentinel, np.int32)
+                page_ids[: len(pages)] = pages
+                self.pool.k, self.pool.v = self._scatter_fn(seq)(
+                    self.pool.k,
+                    self.pool.v,
+                    k_rows,
+                    v_rows,
+                    jnp.asarray(page_ids[: max(1, (seq + self.config.page_size - 1) // self.config.page_size)]),
+                    jnp.int32(plen),
+                )
+                if chip:
+                    # sync to read the clock (accounting opt-in trade)
+                    import jax
+
+                    jax.block_until_ready((self.pool.k, self.pool.v, tok0))
             wall = _time.monotonic() - w0
             # commit: install the lane and emit the prefill token
             self._lanes[i] = _Lane(ticket, pages)
@@ -609,15 +619,16 @@ class DecodeEngine:
         # lane's journey still belongs to this tick's step span)
         lane_tickets = [self._lanes[i].ticket for i in live]
         w0 = _time.monotonic()
-        nxt, new_k, new_v = self._step_fn()(
-            self.params,
-            self.pool.k,
-            self.pool.v,
-            jnp.asarray(self._page_tables),
-            jnp.asarray(self._lens),
-            jnp.asarray(toks),
-        )
-        nxt = np.asarray(nxt)
+        with CHIP_LEDGER.timed("decode") if CHIP_LEDGER.on() else nullcontext():
+            nxt, new_k, new_v = self._step_fn()(
+                self.params,
+                self.pool.k,
+                self.pool.v,
+                jnp.asarray(self._page_tables),
+                jnp.asarray(self._lens),
+                jnp.asarray(toks),
+            )
+            nxt = np.asarray(nxt)
         wall = _time.monotonic() - w0
         # ---- point of no state: everything above is functional ----
         # (time = the step counter, so plans can target "the Nth step")

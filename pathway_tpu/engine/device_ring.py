@@ -189,7 +189,19 @@ class DeviceRing:
                 flight_recorder.record(
                     "ring.donate", ring=self.name, buffers=len(prev), total=self.donated
                 )
-            handles = [_device_put(a, s) for a, s in zip(items, per_item)]
+            from ..internals.chip_ledger import CHIP_LEDGER
+
+            if CHIP_LEDGER.on():
+                import time as _time
+
+                c0 = _time.perf_counter()
+                handles = [_device_put(a, s) for a, s in zip(items, per_item)]
+                # put-issue wall only: staging stays non-blocking even
+                # under accounting (the stall above is already a
+                # stranded-time cause, not chip work)
+                CHIP_LEDGER.book("ingest.stage", _time.perf_counter() - c0)
+            else:
+                handles = [_device_put(a, s) for a, s in zip(items, per_item)]
             nbytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in items)
             with self._lock:
                 self._slots[idx] = handles

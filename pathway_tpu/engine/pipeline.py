@@ -105,6 +105,12 @@ class PipelineStats:
             o = self._active.get(other)
             if o is not None:
                 self.overlap_s += max(0.0, now - max(start, o))
+        if kind == "prep":
+            # host-bound prep is the first stranded-chip-time cause the
+            # attribution snapshot checks (no-op when accounting is off)
+            from ..internals.chip_ledger import CHIP_LEDGER
+
+            CHIP_LEDGER.note_stall("host_prep", dur)
         return dur
 
     def add_device_wait(self, seconds: float) -> None:

@@ -161,6 +161,24 @@ class FlightRecorder:
                     header["open_trace_spans"] = open_spans
             except Exception:
                 pass
+            # chip-time attribution at the moment of death: where the
+            # device-seconds went (and the last journal samples leading
+            # up to it) ride along when the planes are active
+            try:
+                from .chip_ledger import CHIP_LEDGER
+
+                if CHIP_LEDGER.active():
+                    header["chip"] = CHIP_LEDGER.snapshot()
+            except Exception:
+                pass
+            try:
+                from ..perf.journal import tail_samples
+
+                tail = tail_samples(10)
+                if tail:
+                    header["journal_tail"] = tail
+            except Exception:
+                pass
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(header, f, indent=1, default=repr)
@@ -268,6 +286,37 @@ def render(dump_data: dict[str, Any], tail_epochs: int = 3) -> str:
         lines.append(f"last {min(tail_epochs, len(epoch_events))} epoch transitions:")
         for ev in epoch_events[-tail_epochs:]:
             lines.append("  " + _format_event(ev))
+    chip = dump_data.get("chip")
+    if chip:
+        lines.append("")
+        lines.append(
+            f"chip time at dump: {chip.get('busy_seconds', 0.0):.3f}s busy / "
+            f"{chip.get('wall_seconds', 0.0):.3f}s wall "
+            f"(accounted {chip.get('accounted_fraction', 0.0) * 100:.0f}%, "
+            f"stranded {chip.get('stranded_fraction', 0.0) * 100:.0f}%)"
+        )
+        for account, row in (chip.get("accounts") or {}).items():
+            lines.append(
+                f"  {account:<14} {row.get('seconds', 0.0):8.3f}s "
+                f"({row.get('share', 0.0) * 100:5.1f}%, "
+                f"{row.get('dispatches', 0)} dispatches)"
+            )
+        causes = chip.get("stranded_causes") or {}
+        cause_txt = ", ".join(f"{c}={s:.3f}s" for c, s in causes.items() if s)
+        if cause_txt:
+            lines.append(f"  stranded causes: {cause_txt}")
+    journal_tail = dump_data.get("journal_tail") or []
+    if journal_tail:
+        lines.append("")
+        lines.append(f"journal samples before dump ({len(journal_tail)}):")
+        for rec in journal_tail:
+            c = rec.get("chip") or {}
+            stamp = time.strftime("%H:%M:%S", time.gmtime(rec.get("t", 0)))
+            lines.append(
+                f"  {stamp} busy={c.get('busy_seconds', 0.0):.3f}s "
+                f"stranded={c.get('stranded_fraction', 0.0) * 100:.0f}% "
+                f"accounts={len(c.get('accounts') or {})}"
+            )
     lines.append("")
     lines.append(f"events ({len(events)} ringed):")
     for ev in events:

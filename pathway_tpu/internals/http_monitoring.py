@@ -221,6 +221,7 @@ class MonitoringHttpServer:
         lines.extend(self._tracing_lines(wl))
         lines.extend(self._ledger_lines(wl))
         lines.extend(self._tenancy_lines(wl))
+        lines.extend(self._chip_lines(wl))
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -803,6 +804,104 @@ class MonitoringHttpServer:
         lines.append(series("pathway_tenant_folded", snap["folded"]))
         return lines
 
+    @staticmethod
+    def _chip_lines(wl: str = "") -> list[str]:
+        """Chip-time attribution plane (``pathway_chip_*``): per-account
+        device-seconds/dispatches/share, the stranded residual with its
+        cause split, encode MFU, and per-tenant chip share vs DRR
+        weight. Rendered only once a dispatch booked chip time — runs
+        with accounting off scrape byte-identical."""
+        from .chip_ledger import CHIP_LEDGER
+
+        if not CHIP_LEDGER.active():
+            return []
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+
+        snap = CHIP_LEDGER.snapshot()
+        lines: list[str] = []
+        for metric, key, kind, fmt in (
+            (
+                "pathway_chip_seconds_total",
+                "seconds",
+                "counter",
+                lambda v: f"{v:.6f}",
+            ),
+            ("pathway_chip_dispatches_total", "dispatches", "counter", str),
+            ("pathway_chip_share", "share", "gauge", lambda v: f"{v:.4f}"),
+        ):
+            lines.append(f"# TYPE {metric} {kind}")
+            for account in snap["accounts"]:
+                lines.append(
+                    series(
+                        metric,
+                        fmt(snap["accounts"][account][key]),
+                        f'account="{_escape_label(account)}"',
+                    )
+                )
+        lines.append("# TYPE pathway_chip_busy_seconds_total counter")
+        lines.append(
+            series("pathway_chip_busy_seconds_total", f"{snap['busy_seconds']:.6f}")
+        )
+        lines.append("# TYPE pathway_chip_accounted_fraction gauge")
+        lines.append(
+            series(
+                "pathway_chip_accounted_fraction",
+                f"{snap['accounted_fraction']:.4f}",
+            )
+        )
+        lines.append("# TYPE pathway_chip_stranded_seconds_total counter")
+        lines.append(
+            series(
+                "pathway_chip_stranded_seconds_total",
+                f"{snap['stranded_seconds']:.6f}",
+            )
+        )
+        lines.append("# TYPE pathway_chip_stranded_fraction gauge")
+        lines.append(
+            series(
+                "pathway_chip_stranded_fraction", f"{snap['stranded_fraction']:.4f}"
+            )
+        )
+        causes = snap.get("stranded_causes") or {}
+        if causes:
+            lines.append("# TYPE pathway_chip_stranded_cause_seconds_total counter")
+            for cause in sorted(causes):
+                lines.append(
+                    series(
+                        "pathway_chip_stranded_cause_seconds_total",
+                        f"{causes[cause]:.6f}",
+                        f'cause="{_escape_label(cause)}"',
+                    )
+                )
+        mfu = snap.get("encode_mfu")
+        if mfu:
+            lines.append("# TYPE pathway_chip_encode_mfu gauge")
+            lines.append(series("pathway_chip_encode_mfu", f"{mfu['mfu']:.6f}"))
+        tenants = snap.get("tenants") or {}
+        if tenants:
+            lines.append("# TYPE pathway_chip_tenant_seconds_total counter")
+            for tenant in tenants:
+                lines.append(
+                    series(
+                        "pathway_chip_tenant_seconds_total",
+                        f"{tenants[tenant]['seconds']:.6f}",
+                        f'tenant="{_escape_label(tenant)}"',
+                    )
+                )
+            lines.append("# TYPE pathway_chip_tenant_share gauge")
+            for tenant in tenants:
+                lines.append(
+                    series(
+                        "pathway_chip_tenant_share",
+                        f"{tenants[tenant]['share']:.4f}",
+                        f'tenant="{_escape_label(tenant)}"',
+                    )
+                )
+        return lines
+
     def _status(self) -> str:
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
 
@@ -868,6 +967,10 @@ class MonitoringHttpServer:
 
         if TENANCY_METRICS.active():
             status["tenants"] = TENANCY_METRICS.snapshot()
+        from .chip_ledger import CHIP_LEDGER
+
+        if CHIP_LEDGER.active():
+            status["chip"] = CHIP_LEDGER.snapshot()
         return json.dumps(status)
 
     # -- lifecycle --

@@ -373,6 +373,10 @@ DEFAULT_RULES: tuple[WatchRule, ...] = (
         "hot_hit_ratio", "index", "hot_hit_ratio", warn=0.5, critical=0.2,
         higher_is_bad=False,
     ),
+    WatchRule(
+        "stranded_chip_time", "chip", "stranded_fraction",
+        warn=0.5, critical=0.8,
+    ),
 )
 
 _LEVEL_RANK = {"ok": 0, "warn": 1, "critical": 2}
@@ -471,6 +475,15 @@ class HealthWatchdog:
                 ]
                 if ratios:
                     sample["hot_hit_ratio"] = sum(ratios) / len(ratios)
+        except Exception:
+            pass
+        try:
+            from .chip_ledger import CHIP_LEDGER
+
+            if CHIP_LEDGER.active():
+                chip = CHIP_LEDGER.snapshot()
+                sample["stranded_fraction"] = chip["stranded_fraction"]
+                sample["chip_accounted_fraction"] = chip["accounted_fraction"]
         except Exception:
             pass
         return sample
@@ -635,7 +648,21 @@ class HealthWatchdog:
                 "dump_error": self.dump_error,
                 "hbm": LEDGER.snapshot() if LEDGER.active() else None,
                 "tenants": self._tenants_snapshot(),
+                "chip": self._chip_snapshot(),
             }
+
+    @staticmethod
+    def _chip_snapshot() -> dict | None:
+        """Chip-time attribution block for the verdict (``pathway
+        doctor``'s per-plane utilization rows); None unless the chip
+        ledger saw a booking."""
+        try:
+            from .chip_ledger import CHIP_LEDGER
+        except Exception:
+            return None
+        if not CHIP_LEDGER.active():
+            return None
+        return CHIP_LEDGER.snapshot()
 
     @staticmethod
     def _tenants_snapshot() -> dict | None:
@@ -692,6 +719,8 @@ _THRESHOLD_KEYS = {
     "shed_critical": ("shed_rate", "critical"),
     "hit_warn": ("hot_hit_ratio", "warn"),
     "hit_critical": ("hot_hit_ratio", "critical"),
+    "stranded_warn": ("stranded_chip_time", "warn"),
+    "stranded_critical": ("stranded_chip_time", "critical"),
 }
 
 
@@ -813,6 +842,33 @@ def render_verdict(verdict: dict) -> str:
                 f"    {account:<14} {acc.get('bytes', 0) / 2**20:8.1f} MiB "
                 f"({acc.get('owners', 0)} owners, "
                 f"frag {acc.get('fragmentation', 0.0) * 100:.0f}%)"
+            )
+    chip = verdict.get("chip")
+    if chip:
+        lines.append(
+            f"  chip-time: {chip.get('busy_seconds', 0.0):.3f}s busy / "
+            f"{chip.get('wall_seconds', 0.0):.3f}s wall "
+            f"(accounted {chip.get('accounted_fraction', 0.0) * 100:.0f}%, "
+            f"stranded {chip.get('stranded_fraction', 0.0) * 100:.0f}%)"
+        )
+        for account, row in (chip.get("accounts") or {}).items():
+            lines.append(
+                f"    {account:<14} {row.get('seconds', 0.0):8.3f}s "
+                f"({row.get('share', 0.0) * 100:5.1f}%, "
+                f"{row.get('dispatches', 0)} dispatches)"
+            )
+        causes = chip.get("stranded_causes") or {}
+        cause_txt = ", ".join(
+            f"{c}={s:.3f}s" for c, s in causes.items() if s
+        )
+        if cause_txt:
+            lines.append(f"    stranded causes: {cause_txt}")
+        mfu = chip.get("encode_mfu")
+        if mfu:
+            lines.append(
+                f"    encode MFU {mfu.get('mfu', 0.0) * 100:.2f}% "
+                f"({mfu.get('achieved_tflops', 0.0):.1f} / "
+                f"{mfu.get('peak_tflops', 0.0):.1f} TFLOPs)"
             )
     tenants = verdict.get("tenants")
     if tenants:

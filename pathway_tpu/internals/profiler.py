@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Any
 
+from .chip_ledger import CHIP_LEDGER
+
 # Prometheus-style le bounds for the bounded per-node self-time
 # histograms (seconds). 12 buckets + +Inf: 10us .. 30s covers a python
 # operator epoch from trivial map to a pathological stall.
@@ -644,23 +646,32 @@ def record_jit(name: str, phase: str, dur_ns: int, n_rows: int = 0) -> None:
 
 def wrap_jit(name: str, fn):
     """Wrap a ``jax.jit``-compiled callable so each call reports its
-    compile-vs-execute split to the active profiler. Compile detection:
-    a call that grows the jit cache traced+compiled synchronously, so
-    its wall time is (almost entirely) compile time; cache hits report
-    dispatch time (device work is async). Zero-cost when no profiler is
-    active beyond one module-global read."""
+    compile-vs-execute split to the active profiler, and books compile
+    walls into the chip-time ledger. Compile detection: a call that
+    grows the jit cache traced+compiled synchronously, so its wall time
+    is (almost entirely) compile time; cache hits report dispatch time
+    (device work is async). Zero-cost when neither a profiler nor the
+    chip ledger is active beyond two cheap reads."""
 
     cache_size = getattr(fn, "_cache_size", None)
 
     def profiled(*args, **kwargs):
         prof = _current
-        if prof is None:
+        chip = CHIP_LEDGER.on()
+        if prof is None and not chip:
             return fn(*args, **kwargs)
         before = cache_size() if cache_size is not None else None
         t0 = time.perf_counter_ns()
         out = fn(*args, **kwargs)
         dur = time.perf_counter_ns() - t0
         compiled = cache_size is not None and cache_size() > before
+        if compiled and chip:
+            # booked via the ledger's nested-counter path so a dispatch
+            # site timing this same call (encode, decode, ...) subtracts
+            # the compile wall instead of double-counting it
+            CHIP_LEDGER.book("compile", dur / 1e9)
+        if prof is None:
+            return out
         n_rows = 0
         for a in args:
             shape = getattr(a, "shape", None)

@@ -83,6 +83,7 @@ def run(
     profile: Any = None,
     tracing: Any = None,
     watchdog: Any = None,
+    chip_ledger: Any = None,
     recovery: Any = None,
     pipeline_depth: int | None = None,
     ingest_workers: int | None = None,
@@ -122,6 +123,18 @@ def run(
     verdict lands in :attr:`RunResult.health` (and, when
     PATHWAY_HEALTH_OUT names a path, as JSON on disk for ``pathway
     doctor``).
+    ``chip_ledger``: ``True`` turns on chip-time accounting for this
+    run — every device dispatch books its device-seconds into the
+    process-wide :data:`~pathway_tpu.internals.chip_ledger.CHIP_LEDGER`
+    under plane accounts (encode, index.*, rerank, decode,
+    ingest.stage, compile), surfaced on ``/metrics``/``/status``,
+    ``pathway top`` and the flight recorder. Booking sites sync the
+    dispatch to read the clock, so leave it off for latency-critical
+    runs. Defaults to the PATHWAY_CHIP_LEDGER env var;
+    ``chip_ledger=False`` overrides an env-enabled plane. Set
+    PATHWAY_JOURNAL_DIR to also sample the ledger (plus the HBM ledger
+    and serving/index gauges) into the on-disk metrics journal.
+
     ``tenancy``: enables the multi-tenant serving plane for this run —
     ``True``/``"on"`` for defaults, a spec string
     (``"demote_every=64,qps=50,inflight=8"`` — quota knobs become the
@@ -279,6 +292,13 @@ def run(
         if watchdog is not None
         else (os.environ.get("PATHWAY_WATCHDOG") or None)
     )
+    # explicit chip_ledger= wins over PATHWAY_CHIP_LEDGER, same shape
+    # as tracing; resolved jax-free (chip_ledger.py is stdlib-only)
+    from .chip_ledger import CHIP_LEDGER, chip_ledger_enabled
+
+    _chip_on = (
+        bool(chip_ledger) if chip_ledger is not None else chip_ledger_enabled()
+    )
     G.run_context = {
         "recovery": bool(recovery),
         "monitoring_level": monitoring_level,
@@ -312,6 +332,9 @@ def run(
         "profile": bool(profile) or bool(os.environ.get("PATHWAY_PROFILE")),
         # live health watchdog intent, resolved jax-free like tracing
         "watchdog": _watchdog_cfg is not None,
+        # chip-time accounting intent, resolved jax-free; PWL021
+        # (SLO/watchdog run with no chip-time attribution) reads this
+        "chip_ledger": _chip_on,
     }
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
@@ -372,6 +395,19 @@ def run(
             interval_s=_watchdog_cfg["interval_s"],
         )
         _watchdog.start()
+    # chip-time accounting override for this run (restored on exit so
+    # nested test runs do not leak the setting)
+    _prev_chip = CHIP_LEDGER._override
+    CHIP_LEDGER.set_enabled(bool(chip_ledger) if chip_ledger is not None else None)
+    # metrics journal sampler: periodic chip/HBM/serving/index samples
+    # under PATHWAY_JOURNAL_DIR for the duration of the run
+    _journal_sampler = None
+    from ..perf.journal import JournalSampler, get_journal
+
+    _journal = get_journal()
+    if _journal is not None:
+        _journal_sampler = JournalSampler(_journal)
+        _journal_sampler.start()
 
     n_workers = max(1, pwcfg.threads)
     processes = max(1, pwcfg.processes)
@@ -703,6 +739,10 @@ def run(
                     result.trace_dumps.append(tp)
                     logger.info("request trace dump written to %s", tp)
             _req_tracing.set_tracing_enabled(_prev_tracing)
+            if _journal_sampler is not None:
+                # writes one final sample (the run's parting state)
+                _journal_sampler.stop()
+            CHIP_LEDGER.set_enabled(_prev_chip)
     try:
         from ..io.http._server import bound_serving_ports
 

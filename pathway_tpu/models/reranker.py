@@ -50,14 +50,22 @@ class DeviceReranker:
         """Relevance score per (query, doc) text pair, higher = better."""
         if not pairs:
             return []
+        from contextlib import nullcontext
+
         import numpy as np
 
+        from ..internals.chip_ledger import CHIP_LEDGER
         from ..tracing import span as _trace_span
 
         with _trace_span("rerank", pairs=len(pairs)):
-            return [
-                float(s) for s in np.asarray(self.scorer.score(list(pairs)))
-            ]
+            with (
+                CHIP_LEDGER.timed("rerank")
+                if CHIP_LEDGER.on()
+                else nullcontext()
+            ):
+                return [
+                    float(s) for s in np.asarray(self.scorer.score(list(pairs)))
+                ]
 
     def order(self, query: str, docs) -> tuple[int, ...]:
         """Permutation of ``docs`` by descending device score (stable:

@@ -279,7 +279,18 @@ class SentenceEncoder:
         ids_dev, lens_dev = self._wire_ring.stage(
             [ids.astype(wire, copy=False), lens.astype(np.int32, copy=False)]
         )
-        out = self._fwd_group(self.params, ids_dev, lens_dev)
+        from ..internals.chip_ledger import CHIP_LEDGER
+
+        if CHIP_LEDGER.on():
+            # chip-time accounting syncs the dispatch to read the clock
+            # (the opt-in trade: exact encode device-seconds for lost
+            # dispatch pipelining); jit compiles nested in this window
+            # book under `compile`, not here
+            with CHIP_LEDGER.timed("encode"):
+                out = self._fwd_group(self.params, ids_dev, lens_dev)
+                jax.block_until_ready(out)
+        else:
+            out = self._fwd_group(self.params, ids_dev, lens_dev)
         self._wire_ring.retire([ids_dev, lens_dev])
         self._record_dispatch(ids.shape[0], ids.shape[1], lens)
         return out

@@ -363,20 +363,35 @@ class FusedRagPipeline:
     def _dispatch(self, texts: Sequence[str], k: int, k_retrieve: int):
         """Tokenize/pad and launch the fused kernel; returns the raw
         device (slots, scores) arrays without blocking."""
+        from contextlib import nullcontext
+
+        from ..internals.chip_ledger import CHIP_LEDGER
+
         texts = ["" if t is None else str(t) for t in texts]
         ids, lens_p, kr = self._padded_queries(texts, k_retrieve)
-        fslots, fvals, _, _ = self._fused_fn()(
-            self.enc.params,
-            self.cross.params if self.cross is not None else None,
-            ids,
-            lens_p,
-            self.index._dev_matrix,
-            self.index._dev_valid,
-            self._tok_dev,
-            self._len_dev,
-            kr=kr,
-            kf=min(k, kr),
-        )
+        # the fused kernel spans embed->retrieve->rerank in one XLA call,
+        # so it books under the composite ``rag.fused`` account (the
+        # per-plane split is unobservable inside a single dispatch);
+        # syncing to read the clock is the accounting-mode tax, and it
+        # costs overlap on the query_async path — accounting is opt-in
+        chip = CHIP_LEDGER.on()
+        with CHIP_LEDGER.timed("rag.fused") if chip else nullcontext():
+            fslots, fvals, _, _ = self._fused_fn()(
+                self.enc.params,
+                self.cross.params if self.cross is not None else None,
+                ids,
+                lens_p,
+                self.index._dev_matrix,
+                self.index._dev_valid,
+                self._tok_dev,
+                self._len_dev,
+                kr=kr,
+                kf=min(k, kr),
+            )
+            if chip:
+                import jax
+
+                jax.block_until_ready((fslots, fvals))
         return fslots, fvals
 
     def query_batch(

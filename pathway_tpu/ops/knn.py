@@ -1041,20 +1041,36 @@ class DeviceKnnIndex:
         self._sync()
         fn = _topk_fn(self.metric)
 
+        from contextlib import nullcontext
+
+        from ..internals.chip_ledger import CHIP_LEDGER
+
         def dispatch(todo, fetch):
-            if _pallas_eligible(self.metric, fetch, self.mesh):
-                return _pallas_topk(
-                    self.metric,
-                    self._dev_matrix,
-                    self._dev_valid,
-                    q[todo],
-                    fetch,
-                    bias=self._dev_bias,
-                    mesh=self.mesh,
-                )
-            if self.mesh is not None:
+            use_pallas = _pallas_eligible(self.metric, fetch, self.mesh)
+            if not use_pallas and self.mesh is not None:
                 return self._sharded_topk(q[todo], fetch)
-            return fn(self._dev_matrix, self._dev_valid, q[todo], fetch)
+            # single-dispatch paths (pallas kernel or the plain jit):
+            # chip-time accounting syncs to read the clock, same trade
+            # as the sharded path's phase timing
+            chip = CHIP_LEDGER.on()
+            with CHIP_LEDGER.timed("index.search") if chip else nullcontext():
+                if use_pallas:
+                    out = _pallas_topk(
+                        self.metric,
+                        self._dev_matrix,
+                        self._dev_valid,
+                        q[todo],
+                        fetch,
+                        bias=self._dev_bias,
+                        mesh=self.mesh,
+                    )
+                else:
+                    out = fn(self._dev_matrix, self._dev_valid, q[todo], fetch)
+                if chip:
+                    import jax
+
+                    jax.block_until_ready(out)
+            return out
 
         from ..tracing import span as _trace_span
 
@@ -1110,10 +1126,12 @@ class DeviceKnnIndex:
         candidate width always reaches ``fetch`` because
         n_shards*k_local >= min(fetch, capacity)."""
         import time
+        from contextlib import nullcontext
 
         import jax
 
         from .index_metrics import INDEX_METRICS
+        from ..internals.chip_ledger import CHIP_LEDGER
         from ..tracing import current_trace, record_span, tracing_enabled
 
         fns = _mesh_fns(self.mesh)
@@ -1128,29 +1146,35 @@ class DeviceKnnIndex:
         else:
             qd = queries
         # a bound request trace forces phase timing too: the journey
-        # wants per-shard local top-k and merge as separate spans
+        # wants per-shard local top-k and merge as separate spans; the
+        # chip-time ledger forces it the same way (its device-seconds
+        # need the same block-to-read-the-clock sync)
         traced = block and tracing_enabled() and current_trace() is not None
-        l0 = time.monotonic()
-        vals, idx = fns["local_topk"](
-            self._dev_matrix, self._dev_valid, qd, k_local=k_local, l2=l2
-        )
-        timing = block and (INDEX_METRICS.active() or traced)
+        chip = block and CHIP_LEDGER.on()
+        timing = block and (INDEX_METRICS.active() or traced or chip)
         t0 = m0 = None
-        if timing:
-            jax.block_until_ready((vals, idx))
-            t0 = time.perf_counter()
-            m0 = time.monotonic()
-            if traced:
-                record_span(
-                    "index_local_topk",
-                    start_mono=l0,
-                    end_mono=m0,
-                    shards=self.n_shards,
-                    k_local=k_local,
-                )
-        out_v, out_i = fns["merge_topk"](vals, idx, qd, k=k_final, l2=l2)
+        with CHIP_LEDGER.timed("index.search") if chip else nullcontext():
+            l0 = time.monotonic()
+            vals, idx = fns["local_topk"](
+                self._dev_matrix, self._dev_valid, qd, k_local=k_local, l2=l2
+            )
+            if timing:
+                jax.block_until_ready((vals, idx))
+                t0 = time.perf_counter()
+                m0 = time.monotonic()
+                if traced:
+                    record_span(
+                        "index_local_topk",
+                        start_mono=l0,
+                        end_mono=m0,
+                        shards=self.n_shards,
+                        k_local=k_local,
+                    )
+        with CHIP_LEDGER.timed("index.merge") if chip else nullcontext():
+            out_v, out_i = fns["merge_topk"](vals, idx, qd, k=k_final, l2=l2)
+            if block:
+                jax.block_until_ready((out_v, out_i))
         if block:
-            jax.block_until_ready((out_v, out_i))
             if t0 is not None:
                 self._last_merge_s = time.perf_counter() - t0
                 INDEX_METRICS.observe_merge(self._last_merge_s)

@@ -710,23 +710,34 @@ class TieredKnnIndex:
         cold_scores = None
         cold_fetch_s = 0.0
         if cand_keys:
+            from contextlib import nullcontext
+
+            from ..internals.chip_ledger import CHIP_LEDGER
+
             t0 = _time.perf_counter()
-            g0 = _time.monotonic()
-            cvecs = self._cold.fetch([self._cold_slot[key] for key in cand_keys])
-            g1 = _time.monotonic()
-            record_span(
-                "tier_cold_gather",
-                start_mono=g0,
-                end_mono=g1,
-                candidates=len(cand_keys),
-            )
-            cold_scores = self._cold_score(q, cvecs)
-            record_span(
-                "tier_cold_rescore",
-                start_mono=g1,
-                end_mono=_time.monotonic(),
-                candidates=len(cand_keys),
-            )
+            with (
+                CHIP_LEDGER.timed("index.tier")
+                if CHIP_LEDGER.on()
+                else nullcontext()
+            ):
+                g0 = _time.monotonic()
+                cvecs = self._cold.fetch(
+                    [self._cold_slot[key] for key in cand_keys]
+                )
+                g1 = _time.monotonic()
+                record_span(
+                    "tier_cold_gather",
+                    start_mono=g0,
+                    end_mono=g1,
+                    candidates=len(cand_keys),
+                )
+                cold_scores = self._cold_score(q, cvecs)
+                record_span(
+                    "tier_cold_rescore",
+                    start_mono=g1,
+                    end_mono=_time.monotonic(),
+                    candidates=len(cand_keys),
+                )
             cold_fetch_s = _time.perf_counter() - t0
         # 4. resolve hot candidates (blocking half)
         hot_lists = [[] for _ in range(nq)]

@@ -1,0 +1,34 @@
+"""Performance surfaces: the on-disk metrics journal, BENCH_r*-style
+snapshots, per-plane regression diffs, and the ``pathway top`` renderer.
+
+Everything here is host-side and import-light (no JAX at module level):
+the journal is written by live runs and ``bench.py``, and read back by
+the ``pathway perf`` / ``pathway top`` CLI — possibly from a different
+process, possibly after a crash.
+"""
+
+from .journal import (
+    MetricsJournal,
+    append_record,
+    get_journal,
+    journal_active,
+    journal_dir,
+    tail_samples,
+)
+from .snapshot import build_snapshot, diff_snapshots, parse_summary_lines
+from .top import load_from_journal, load_status_from_url, render_top
+
+__all__ = [
+    "MetricsJournal",
+    "append_record",
+    "build_snapshot",
+    "diff_snapshots",
+    "get_journal",
+    "journal_active",
+    "journal_dir",
+    "load_from_journal",
+    "load_status_from_url",
+    "parse_summary_lines",
+    "render_top",
+    "tail_samples",
+]

@@ -360,11 +360,18 @@ class AdaptiveBatcher:
                             )
                     per_item = wall / len(items)
                     if any(t is not None for t in tenants):
+                        from ..internals.chip_ledger import CHIP_LEDGER
                         from ..tenancy.metrics import TENANCY_METRICS
 
+                        chip_on = CHIP_LEDGER.on()
                         for t in tenants:
                             if t is not None:
                                 TENANCY_METRICS.add_chip_seconds(t, per_item)
+                                if chip_on:
+                                    # tenant sub-account mirrors the DRR
+                                    # per-item split; the plane work was
+                                    # booked at its dispatch site
+                                    CHIP_LEDGER.book_tenant(t, per_item)
                     if self._ewma_item_s == 0.0:
                         self._ewma_item_s = per_item
                     else:

@@ -1,0 +1,24 @@
+"""Analysis fixture: a REST endpoint with admission control and a
+per-request deadline budget plus a health watchdog, but chip-time
+accounting off — a breach leaves no record of where the device-seconds
+went. The verifier must flag PWL021 (warning). ``tracing=True`` keeps
+PWL014 quiet (this fixture is about the chip ledger, not tracing),
+``serving=`` keeps PWL008 quiet, and monitoring is on for PWL007."""
+
+import pathway_tpu as pw
+
+
+class QuerySchema(pw.Schema):
+    value: int
+
+
+queries, response_writer = pw.io.http.rest_connector(
+    host="127.0.0.1",
+    port=0,
+    schema=QuerySchema,
+    delete_completed_queries=False,
+    serving=pw.ServingConfig(max_queue=32, default_deadline_ms=250.0),
+)
+response_writer(queries.select(result=pw.this.value * 2))
+
+pw.run(monitoring_level="in_out", tracing=True, watchdog=True)

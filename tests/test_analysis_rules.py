@@ -964,6 +964,62 @@ def test_pwl014_negative_without_run_context():
     assert "PWL014" not in _rules(pw.analysis.analyze())
 
 
+# ---------------------------------------------------------------- PWL021
+
+
+def test_pwl021_deadline_budget_without_chip_accounting(monkeypatch):
+    monkeypatch.delenv("PATHWAY_CHIP_LEDGER", raising=False)
+    _rest_endpoint(serving=pw.ServingConfig(default_deadline_ms=250.0))
+    # tracing on: PWL014 is satisfied yet PWL021 still fires — wall
+    # attribution and device-second attribution are different planes
+    _describe_run(monkeypatch, monitoring_level="in_out", tracing=True)
+    diags = pw.analysis.analyze()
+    hits = [d for d in diags if d.rule == "PWL021"]
+    assert len(hits) == 1 and hits[0].severity is Severity.WARNING
+    assert "chip-time accounting is off" in hits[0].message
+    assert hits[0].detail["endpoints"][0]["deadline_ms"] == 250.0
+    assert hits[0].detail["chip_ledger"] is False
+    assert "PWL014" not in _rules(diags)
+
+
+def test_pwl021_watchdog_without_chip_accounting(monkeypatch):
+    monkeypatch.delenv("PATHWAY_CHIP_LEDGER", raising=False)
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out", watchdog=True)
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL021"]
+    assert len(hits) == 1
+    assert hits[0].detail["watchdog"] is True
+    assert "watchdog is on" in hits[0].message
+
+
+def test_pwl021_chip_ledger_arg_silences(monkeypatch):
+    _rest_endpoint(serving=pw.ServingConfig(default_deadline_ms=250.0))
+    _describe_run(
+        monkeypatch, monitoring_level="in_out", tracing=True, chip_ledger=True
+    )
+    assert "PWL021" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl021_chip_ledger_env_silences(monkeypatch):
+    monkeypatch.setenv("PATHWAY_CHIP_LEDGER", "1")
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out", watchdog=True)
+    assert "PWL021" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl021_negative_no_contract(monkeypatch):
+    monkeypatch.delenv("PATHWAY_CHIP_LEDGER", raising=False)
+    # no deadline budget and no watchdog: nothing promised, no warning
+    _rest_endpoint(serving=pw.ServingConfig(default_deadline_ms=None))
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL021" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl021_negative_without_run_context():
+    _rest_endpoint(serving=pw.ServingConfig(default_deadline_ms=250.0))
+    assert "PWL021" not in _rules(pw.analysis.analyze())
+
+
 # ---------------------------------------------------------------- PWL015
 
 

@@ -1194,3 +1194,146 @@ def test_bench_smoke_deep_analyze_rag_demo():
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "no findings" in proc.stdout
     assert elapsed < 10.0, f"deep lint pass took {elapsed:.1f}s (budget 10s)"
+
+
+# ---------------------------------------------------------------------------
+# chip-time attribution plane (internals/chip_ledger.py + perf/)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_smoke_chip_accounting_overhead():
+    """Chip-time accounting costs <5% on the miniature serving hot loop
+    (``set_enabled`` as the A/B lever): the per-dispatch tax is one
+    clock read plus a lock-guarded dict bump, and the sync to read the
+    clock replaces a host readback the serving path pays anyway."""
+    from pathway_tpu.internals.chip_ledger import CHIP_LEDGER
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    rng = np.random.default_rng(5)
+    dim = 32
+    idx = DeviceKnnIndex(dim=dim, metric="cos", reserved_space=600)
+    idx.add_batch_arrays(
+        list(range(600)), rng.normal(size=(600, dim)).astype(np.float32)
+    )
+    q = rng.normal(size=(8, dim)).astype(np.float32)
+
+    def churn():
+        t0 = time.perf_counter()
+        for _ in range(40):
+            idx.search_batch(q, 5)
+        return time.perf_counter() - t0
+
+    churn()  # compile outside both timed windows
+    CHIP_LEDGER.reset()
+    CHIP_LEDGER.set_enabled(True)
+    try:
+        wall_on = min(churn() for _ in range(3))
+        assert CHIP_LEDGER.active()  # the lever actually booked
+    finally:
+        CHIP_LEDGER.set_enabled(None)
+        CHIP_LEDGER.reset()
+    wall_off = min(churn() for _ in range(3))
+
+    # min-of-3 vs min-of-3 plus a small absolute epsilon so scheduler
+    # noise on a loaded CI box cannot fail a microsecond-scale claim
+    assert wall_on <= wall_off * 1.05 + 0.05, (wall_on, wall_off)
+
+
+def test_bench_smoke_top_once_green_on_rag_demo(tmp_path, monkeypatch):
+    """``pathway top --once`` over the miniature RAG demo: run the fused
+    embed->retrieve pipeline with chip accounting and the journal on,
+    then the real CLI (a fresh subprocess, so it proves the on-disk
+    journal alone carries the frame) must render a green attribution
+    view and exit 0 — the operator loop the README documents."""
+    import os
+    import subprocess
+    import sys
+
+    import pathway_tpu.perf.journal as pj
+    from pathway_tpu.internals.chip_ledger import CHIP_LEDGER
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.models.sentence_encoder import SentenceEncoder
+    from pathway_tpu.ops.fused_rag import FusedRagPipeline
+
+    jdir = str(tmp_path / "journal")
+    monkeypatch.setenv("PATHWAY_JOURNAL_DIR", jdir)
+    pj._JOURNALS.clear()
+    CHIP_LEDGER.reset()
+    CHIP_LEDGER.set_enabled(True)
+    try:
+        cfg = EncoderConfig(
+            vocab_size=30522,
+            hidden_size=64,
+            num_layers=1,
+            num_heads=2,
+            intermediate_size=128,
+            max_position=64,
+        )
+        enc = SentenceEncoder(config=cfg, max_seq_len=32, max_batch=16)
+        p = FusedRagPipeline(enc, None, reserved_space=64)
+        docs = [f"chunk {i} of the demo corpus about topic {i % 5}" for i in range(12)]
+        p.add_docs(list(range(12)), docs)
+        hits = p.query("chunk 7 of the demo corpus about topic 2", k=3, k_retrieve=8)
+        assert hits  # the demo actually retrieved something
+        assert CHIP_LEDGER.active()
+        pj.get_journal().sample()
+    finally:
+        CHIP_LEDGER.set_enabled(None)
+        CHIP_LEDGER.reset()
+        pj._JOURNALS.clear()
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = os.environ.copy()
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PATHWAY_JOURNAL_DIR", None)  # --journal must stand alone
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pathway_tpu.cli",
+            "top",
+            "--once",
+            "--journal",
+            jdir,
+        ],
+        cwd=root,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "[green]" in proc.stdout
+    assert "rag.fused" in proc.stdout  # the fused dispatch was attributed
+
+
+def test_bench_smoke_chip_attribution_suite_runs_green():
+    """`bench.py suite_chip_attribution` on the CPU backend: the
+    composed encode->retrieve window must come back >=95% accounted
+    with <5% accounting overhead — the suite's two headline gates."""
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_smoke_chip_target", os.path.join(root, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    try:
+        bench.suite_chip_attribution()
+    finally:
+        # the suite churns an encoder + index in-process; leave the
+        # activity-gated registries quiet for later tests in the session
+        from pathway_tpu.internals.profiler import ENCODER_KERNEL_STATS
+        from pathway_tpu.ops.index_metrics import INDEX_METRICS
+
+        INDEX_METRICS.reset()
+        ENCODER_KERNEL_STATS.reset()
+    by_name = {r["metric"]: r for r in bench._RECORDS}
+    frac = by_name["chip_time_accounted_fraction"]
+    assert frac["value"] >= 0.95, frac
+    assert frac["gate"] == 0.95
+    over = by_name["chip_accounting_overhead"]
+    assert over["value"] < 0.05, over

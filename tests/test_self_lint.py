@@ -361,6 +361,46 @@ def test_pwl014_tracing_env_silences_cli(monkeypatch):
     assert "PWL014" not in proc.stdout
 
 
+def test_slo_without_chip_accounting_warns_pwl021(monkeypatch):
+    """A deadline-budgeted endpoint plus a watchdog with the chip
+    ledger off: PWL021 warns (exit 0), nonzero only under
+    --fail-on=warn — and PWL014 stays quiet (the fixture traces)."""
+    monkeypatch.delenv("PATHWAY_CHIP_LEDGER", raising=False)
+    fixture = os.path.join(FIXTURES, "slo_without_chip_accounting.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL021" in proc.stdout
+    assert "PWL014" not in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--fail-on=warn")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl021_json_carries_contract_and_intent(monkeypatch):
+    monkeypatch.delenv("PATHWAY_CHIP_LEDGER", raising=False)
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "slo_without_chip_accounting.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL021"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["endpoints"][0]["deadline_ms"] == 250.0
+    assert diag["detail"]["watchdog"] is True
+    assert diag["detail"]["chip_ledger"] is False
+
+
+def test_pwl021_chip_ledger_env_silences_cli(monkeypatch):
+    """The fix the diagnostic suggests (PATHWAY_CHIP_LEDGER=1) makes
+    the same program lint clean."""
+    monkeypatch.setenv("PATHWAY_CHIP_LEDGER", "1")
+    fixture = os.path.join(FIXTURES, "slo_without_chip_accounting.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL021" not in proc.stdout
+
+
 def test_combined_over_hbm_warns_pwl015(monkeypatch):
     """An index plane and a decode KV pool that each fit the HBM budget
     alone but jointly oversubscribe it: PWL015 warns (exit 0), nonzero

@@ -185,6 +185,15 @@ DESCRIPTIONS: dict[str, str] = {
         "wall clock / unseeded RNG (replay persists a different value — "
         "seed it or declare `deterministic=False`)"
     ),
+    "PWL021": (
+        "the run declares a latency/health contract — a serving endpoint "
+        "with a `default_deadline_ms` budget or `pw.run(watchdog=)` — but "
+        "chip-time accounting (`pw.run(chip_ledger=True)` / "
+        "`PATHWAY_CHIP_LEDGER=1`) is off: a breach leaves no record of "
+        "where the device-seconds went (per-plane chip time, MFU, "
+        "stranded fraction), `pathway top` renders empty, and the "
+        "watchdog's stranded_chip_time rule has no signal"
+    ),
 }
 
 
