@@ -232,6 +232,8 @@ from . import decode  # on-chip generation (paged-KV continuous batching)
 from .decode import DecodeConfig
 from . import tenancy  # multi-tenant serving plane (packed slabs/quotas)
 from .tenancy import TenancyConfig, TenantQuotas
+from . import elastic  # live grow/shrink/reshard under traffic
+from .elastic import ElasticConfig
 
 
 def __getattr__(name):
@@ -266,4 +268,5 @@ __all__ = [
     "resilience", "Recovery", "RecoveryEscalated", "RetryPolicy",
     "RunResult", "serving", "ServingConfig", "decode", "DecodeConfig",
     "tenancy", "TenancyConfig", "TenantQuotas",
+    "elastic", "ElasticConfig",
 ]

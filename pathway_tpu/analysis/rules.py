@@ -153,6 +153,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL019": (Severity.WARNING, "implicit cross-mesh resharding / host bounce"),
     "PWL020": (Severity.WARNING, "effectful node outside the exactly-once contract"),
     "PWL021": (Severity.WARNING, "SLO/watchdog run with chip-time accounting off"),
+    "PWL022": (Severity.WARNING, "elastic reshard configured without durable persistence"),
 }
 
 #: rule ids that only the deep pass (``pathway analyze --deep`` /
@@ -1239,6 +1240,69 @@ def check_slo_without_chip_accounting(view: GraphView) -> list[Diagnostic]:
 
 
 # --------------------------------------------------------------------------
+# PWL022 — elastic reshard configured without durable persistence
+
+
+def check_elastic_without_persistence(view: GraphView) -> list[Diagnostic]:
+    """The elastic plane is armed — reshard watermarks / ``auto`` mode
+    (``pw.run(elastic=...)`` / PATHWAY_ELASTIC), a fixed ``shards=``
+    target, or ``mesh=\"auto\"`` — but the run has no persistence
+    backend. A live reshard is a two-phase protocol fenced by a
+    *durable* cluster-generation token plus a durable reshard intent:
+    without a backend the generation bump and intent live only in
+    process memory, so a crash mid-migration cannot tell a zombie
+    writer from the new generation (no StaleGeneration fence survives
+    the restart) and ``recover_pending_reshard`` has nothing to read —
+    the zero-dropped / byte-identical recovery guarantees silently
+    degrade to best-effort. Intent is recorded on the parse graph by
+    ``pw.run`` (``run_context``: ``elastic``, ``mesh_axes``,
+    ``persistence``)."""
+    ctx = getattr(view.graph, "run_context", None) or {}
+    if not ctx:
+        return []  # no pw.run configuration recorded (unit-built graph)
+    if ctx.get("persistence"):
+        return []
+    elastic = ctx.get("elastic") or {}
+    mesh_axes = ctx.get("mesh_axes") or {}
+    watermarks = bool(
+        elastic.get("auto")
+        or elastic.get("oom_warn_s") is not None
+        or elastic.get("hbm_frac") is not None
+        or elastic.get("stranded_frac") is not None
+    )
+    fixed_target = elastic.get("shards") is not None
+    mesh_auto = bool(mesh_axes.get("auto"))
+    if not (watermarks or fixed_target or mesh_auto):
+        return []
+    reasons = []
+    if watermarks:
+        reasons.append("elastic reshard watermarks are armed")
+    elif fixed_target:
+        reasons.append(f"a fixed elastic target (shards={elastic['shards']}) is set")
+    if mesh_auto:
+        reasons.append('mesh="auto" elects the data axis elastically')
+    return [
+        _diag(
+            "PWL022",
+            f"{' and '.join(reasons)} but no persistence backend is "
+            "configured: the migration's cluster-generation fence and "
+            "reshard intent are durable-by-contract, and without "
+            "persistence_config= a crash mid-reshard loses both — "
+            "zombie writes are not fenced across restart and the "
+            "pending reshard cannot be recovered or rolled back. Pass "
+            "pw.run(persistence_config=pw.persistence.Config."
+            "simple_config(pw.persistence.Backend.filesystem(...))) "
+            "so the generation token and intent survive a crash",
+            detail={
+                "elastic": elastic or None,
+                "mesh_auto": mesh_auto,
+                "persistence": False,
+            },
+        )
+    ]
+
+
+# --------------------------------------------------------------------------
 # PWL015 — combined planes oversubscribe the HBM budget
 
 
@@ -1394,4 +1458,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_slo_without_chip_accounting,
     check_combined_hbm_oversubscription,
     check_tenancy_without_quotas,
+    check_elastic_without_persistence,
 ]

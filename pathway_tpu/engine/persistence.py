@@ -456,6 +456,14 @@ class EnginePersistence:
         self._writers: dict[str, Any] = {}
         # per-source trim frontier discovered at recovery (KIND_COMPACT)
         self.compacted_to: dict[str, int] = {}
+        pid = os.environ.get("PATHWAY_PROCESS_ID")
+        if not pid or pid == "0":
+            # enroll as the elastic plane's durable token store (weakly
+            # held): reshard generation bumps and intents write through
+            # the same single-record logs as the cluster generation
+            from ..elastic.controller import register_persistence
+
+            register_persistence(self)
 
     @staticmethod
     def _parse_s3_root(backend) -> tuple[str, str]:
@@ -758,6 +766,7 @@ class EnginePersistence:
     OPS_SOURCE = "__operators__"
     DELIVERED_SOURCE = "__delivered__"
     CLUSTER_SOURCE = "__cluster__"
+    ELASTIC_SOURCE = "__elastic__"
 
     def mark_delivered(self, time: int) -> None:
         """Process 0 only: durably record that sinks flushed epoch
@@ -824,6 +833,44 @@ class EnginePersistence:
         )
         flight_recorder.record("cluster.generation", generation=gen)
         return gen
+
+    def record_reshard_intent(self, target_shards: int, generation: int) -> None:
+        """Durably declare an in-flight elastic reshard: a single-record
+        log carrying (generation, target shard count). Written AFTER the
+        generation bump and cleared only once the cutover committed, so
+        a crash at any chunk/cutover boundary leaves an intent behind
+        and recovery (``elastic.recover_pending_reshard``) can decide
+        complete-vs-rollback deterministically."""
+        self._writers.pop(self.ELASTIC_SOURCE, None)
+        self._replace_single_record(
+            self.ELASTIC_SOURCE,
+            (KIND_ADVANCE, int(generation), int(target_shards), b""),
+        )
+        flight_recorder.record(
+            "elastic.intent",
+            generation=int(generation),
+            target_shards=int(target_shards),
+        )
+
+    def reshard_intent(self) -> tuple[int, int] | None:
+        """The pending (target_shards, generation) reshard intent, or
+        None when the last reshard committed (or none ever ran). Read
+        from the process-0 namespace like the generation token."""
+        reader = self._open_reader_base(self.ELASTIC_SOURCE)
+        if reader is None:
+            return None
+        out = None
+        try:
+            for kind, time, key, _blob in reader:
+                if kind == KIND_ADVANCE:
+                    out = (int(key), int(time))
+        finally:
+            reader.close()
+        return out
+
+    def clear_reshard_intent(self) -> None:
+        self._writers.pop(self.ELASTIC_SOURCE, None)
+        self._replace_single_record(self.ELASTIC_SOURCE, None)
 
     def _open_reader_base(self, source_id: str):
         """Open a source log in the PROCESS-0 namespace regardless of

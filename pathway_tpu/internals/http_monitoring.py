@@ -222,6 +222,7 @@ class MonitoringHttpServer:
         lines.extend(self._ledger_lines(wl))
         lines.extend(self._tenancy_lines(wl))
         lines.extend(self._chip_lines(wl))
+        lines.extend(self._elastic_lines(wl))
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -902,6 +903,81 @@ class MonitoringHttpServer:
                 )
         return lines
 
+    @staticmethod
+    def _elastic_lines(wl: str = "") -> list[str]:
+        """Elastic reshard plane (``pathway_elastic_*``): completed
+        reshards by trigger reason, migrated chunk/row counters, cutover
+        and rollback totals, the dual-window dedup and fence counters,
+        last reshard MTTR, the generation gauge, and — while a migration
+        is in flight — its progress. Rendered only once the plane saw a
+        migration, so elastic-off runs scrape byte-identical."""
+        from ..elastic.metrics import ELASTIC_METRICS
+
+        if not ELASTIC_METRICS.active():
+            return []
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+
+        snap = ELASTIC_METRICS.snapshot()
+        lines = ["# TYPE pathway_elastic_reshards_total counter"]
+        for reason in sorted(snap["reshards"]):
+            lines.append(
+                series(
+                    "pathway_elastic_reshards_total",
+                    snap["reshards"][reason],
+                    f'reason="{_escape_label(reason)}"',
+                )
+            )
+        lines.extend(
+            [
+                "# TYPE pathway_elastic_chunks_migrated_total counter",
+                series(
+                    "pathway_elastic_chunks_migrated_total", snap["chunks_migrated"]
+                ),
+                "# TYPE pathway_elastic_rows_migrated_total counter",
+                series("pathway_elastic_rows_migrated_total", snap["rows_migrated"]),
+                "# TYPE pathway_elastic_cutovers_total counter",
+                series("pathway_elastic_cutovers_total", snap["cutovers_total"]),
+                "# TYPE pathway_elastic_rollbacks_total counter",
+                series("pathway_elastic_rollbacks_total", snap["rollbacks_total"]),
+                "# TYPE pathway_elastic_dedup_dropped_total counter",
+                series(
+                    "pathway_elastic_dedup_dropped_total", snap["dedup_dropped_total"]
+                ),
+                "# TYPE pathway_elastic_fenced_writes_total counter",
+                series(
+                    "pathway_elastic_fenced_writes_total", snap["fenced_writes_total"]
+                ),
+                "# TYPE pathway_elastic_last_mttr_seconds gauge",
+                series(
+                    "pathway_elastic_last_mttr_seconds", f"{snap['last_mttr_s']:.6f}"
+                ),
+                "# TYPE pathway_elastic_generation gauge",
+                series("pathway_elastic_generation", snap["generation"]),
+            ]
+        )
+        mig = snap.get("migration")
+        if mig:
+            lines.extend(
+                [
+                    "# TYPE pathway_elastic_migration_chunks_done gauge",
+                    series(
+                        "pathway_elastic_migration_chunks_done", mig["chunks_done"]
+                    ),
+                    "# TYPE pathway_elastic_migration_chunks_total gauge",
+                    series(
+                        "pathway_elastic_migration_chunks_total", mig["chunks_total"]
+                    ),
+                    "# TYPE pathway_elastic_migration_target_shards gauge",
+                    series(
+                        "pathway_elastic_migration_target_shards", mig["to_shards"]
+                    ),
+                ]
+            )
+        return lines
+
     def _status(self) -> str:
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
 
@@ -971,6 +1047,10 @@ class MonitoringHttpServer:
 
         if CHIP_LEDGER.active():
             status["chip"] = CHIP_LEDGER.snapshot()
+        from ..elastic.metrics import ELASTIC_METRICS
+
+        if ELASTIC_METRICS.active():
+            status["elastic"] = ELASTIC_METRICS.snapshot()
         return json.dumps(status)
 
     # -- lifecycle --

@@ -91,6 +91,7 @@ def run(
     index_tiers: Any = None,
     decode: Any = None,
     tenancy: Any = None,
+    elastic: Any = None,
     cluster_accept_timeout: float | None = None,
     cluster_hello_timeout: float | None = None,
     cluster_lease_ms: float | None = None,
@@ -274,6 +275,17 @@ def run(
         _tenancy_cfg = parse_tenancy_spec(_tenancy_spec)
     except ValueError:
         _tenancy_cfg = None
+    # elastic spec parsed jax-free too: PWL022 (elastic watermarks with
+    # no durable generation token) reads this off the graph
+    from ..elastic.config import parse_elastic_spec
+
+    _elastic_spec = (
+        elastic if elastic is not None else (os.environ.get("PATHWAY_ELASTIC") or None)
+    )
+    try:
+        _elastic_cfg = parse_elastic_spec(_elastic_spec)
+    except ValueError:
+        _elastic_cfg = None
     # explicit tracing= wins over PATHWAY_TRACING (tracing=False turns
     # an env-enabled plane off for this run)
     _tracing_on = (
@@ -326,6 +338,9 @@ def run(
         # TenancyConfig knob dict or None; PWL016 (tenancy without
         # per-tenant quotas / oversubscribed quota HBM) reads this
         "tenancy": _tenancy_cfg.as_dict() if _tenancy_cfg is not None else None,
+        # ElasticConfig knob dict or None; PWL022 (elastic reshard
+        # configured without durable persistence) reads this
+        "elastic": _elastic_cfg.as_dict() if _elastic_cfg is not None else None,
         # request-journey tracing + profiler intent, resolved jax-free;
         # PWL014 (SLO budget with no observability) reads both
         "tracing": _tracing_on,
@@ -531,6 +546,28 @@ def run(
 
     if tenancy is not None and _tenancy_cfg is not None:
         set_active_tenancy(_tenancy_cfg)
+    # and the run-scoped elastic config: register_handle-wrapped indexes
+    # and the reshard controller pick it up via active_elastic(); the
+    # watermark loop only starts when there is something to watch
+    from ..elastic.config import set_active_elastic
+
+    _elastic_ctl = None
+    if elastic is not None and _elastic_cfg is not None:
+        set_active_elastic(_elastic_cfg)
+    elif _mesh_axes is not None and _mesh_axes.get("auto") and _elastic_cfg is None:
+        # mesh="auto" with no explicit elastic= arms the default
+        # auto-watermark envelope
+        from ..elastic.config import ElasticConfig
+
+        _elastic_cfg = ElasticConfig(auto=True)
+        set_active_elastic(_elastic_cfg)
+    if _elastic_cfg is not None and (
+        _elastic_cfg.watermarks_armed() or _elastic_cfg.shards is not None
+    ):
+        from ..elastic.controller import ElasticController
+
+        _elastic_ctl = ElasticController(_elastic_cfg)
+        _elastic_ctl.start()
     with mon_ctx as monitor:
         http_server = None
         if with_http_server:
@@ -712,6 +749,10 @@ def run(
                 set_active_decode(None)
             if tenancy is not None and _tenancy_cfg is not None:
                 set_active_tenancy(None)
+            if _elastic_ctl is not None:
+                _elastic_ctl.stop()
+            if _elastic_cfg is not None:
+                set_active_elastic(None)
             if _watchdog is not None:
                 _watchdog.stop()
                 # one final evaluation so even runs shorter than the

@@ -40,6 +40,22 @@ _NEG = -3.0e38
 _NAME_SEQ = itertools.count()
 
 
+class StaleGeneration(RuntimeError):
+    """Write rejected: this index belongs to a fenced (pre-reshard)
+    cluster generation. A zombie writer still holding the old index
+    after an elastic cutover gets this instead of silently mutating a
+    dead generation; retry against the current handle."""
+
+    def __init__(self, name: str, generation: int):
+        super().__init__(
+            f"index {name!r} is fenced at generation {generation}: a newer "
+            "generation serves now (elastic reshard cut over); retry "
+            "through the live handle"
+        )
+        self.index_name = name
+        self.generation = generation
+
+
 def _shard_of_key(key, n_shards: int) -> int:
     """Owning shard for an index key: the engine's canonical key hash
     (``shard.rs``-style low bits mod n) so an index sharded over the
@@ -607,9 +623,35 @@ class DeviceKnnIndex:
         self._dev_valid = None
         self._dev_bias = None
         self._query_ring = None  # mesh-aware staging ring, built lazily
+        # elastic reshard plumbing: which cluster generation owns this
+        # index, whether writes are fenced (post-cutover zombie guard),
+        # and whether imports bypass normalization (migration chunks
+        # carry already-normalized rows that must transplant bit-exact)
+        self.generation = 0
+        self._fenced = False
+        self._import_raw = False
 
     def __len__(self) -> int:
         return len(self._slot_of)
+
+    def _check_fence(self) -> None:
+        if self._fenced:
+            from ..elastic.metrics import ELASTIC_METRICS
+            from ..internals import flight_recorder
+
+            ELASTIC_METRICS.record_fenced_write()
+            flight_recorder.record(
+                "elastic.fenced_write", index=self.name, generation=self.generation
+            )
+            raise StaleGeneration(self.name, self.generation)
+
+    def fence(self, generation: int | None = None) -> None:
+        """Freeze this index as a dead generation: every later write
+        raises :class:`StaleGeneration` (reads still work — the cutover
+        dual-serve window reads the old generation)."""
+        self._fenced = True
+        if generation is not None:
+            self.generation = max(self.generation, int(generation))
 
     def _alloc_slots(self, keys) -> list[int]:
         """Batch slot allocation: route every key to its shard, grow
@@ -709,11 +751,12 @@ class DeviceKnnIndex:
         n = len(keys)
         if n != len(vecs):
             raise ValueError("keys/vectors length mismatch")
+        self._check_fence()
         for key in keys:
             if key in self._slot_of:
                 self.remove(key)
         slots = self._alloc_slots(keys)
-        if self.metric == "cos":
+        if self.metric == "cos" and not self._import_raw:
             norms = np.linalg.norm(vecs, axis=1, keepdims=True)
             vecs = vecs / np.maximum(norms, 1e-12)
         sl = np.asarray(slots)
@@ -744,6 +787,7 @@ class DeviceKnnIndex:
         n = len(keys)
         if n == 0:
             return
+        self._check_fence()
         if self._full or self._dev_matrix is None:
             if not self._slot_of and not self._pending:
                 # cold start on an EMPTY index (the streaming engine's
@@ -817,6 +861,7 @@ class DeviceKnnIndex:
         self._publish_metrics()
 
     def remove(self, key) -> None:
+        self._check_fence()
         slot = self._slot_of.pop(key, None)
         if slot is None:
             return
@@ -829,6 +874,65 @@ class DeviceKnnIndex:
         if not self._full:
             self._pending[slot] = None
         self._publish_metrics()
+
+    # --- elastic reshard protocol (elastic/controller.py drives) ---
+
+    def spawn_like(self, mesh, reserved_space: int | None = None):
+        """An EMPTY index with this one's schema on a target mesh — the
+        destination of a live reshard. Deliberately starts small
+        (unless told otherwise): imports grow it shard-by-shard through
+        the per-shard-growth path, so the target reuses the compiled
+        per-slab-shape programs instead of compiling a bespoke global
+        capacity."""
+        return DeviceKnnIndex(
+            self.dim,
+            metric=self.metric,
+            reserved_space=int(reserved_space) if reserved_space else 64,
+            dtype=self.dtype,
+            mesh=mesh,
+            name=self.name,
+        )
+
+    def reshard_export_chunks(self, chunk_rows: int):
+        """Yield this index's live rows in bounded chunks of at most
+        ``chunk_rows``, in slot order (deterministic). The key list is
+        snapshotted up front; rows removed between chunks are skipped
+        (the delta replay carries the removal), rows re-added keep
+        their snapshot value here and are overwritten by the replay —
+        either way the target converges to the source's final state."""
+        snapshot = sorted(self._slot_of.items(), key=lambda kv: kv[1])
+        keys = [k for k, _ in snapshot]
+        step = max(1, int(chunk_rows))
+        for i in range(0, len(keys), step):
+            batch = [k for k in keys[i : i + step] if k in self._slot_of]
+            if not batch:
+                continue
+            self._refresh_host()
+            slots = np.asarray([self._slot_of[k] for k in batch])
+            yield {
+                "kind": "rows",
+                "keys": batch,
+                "vecs": self._host[slots].copy(),
+                "metas": [self._meta.get(k) for k in batch],
+            }
+
+    def reshard_import_chunk(self, chunk: dict) -> None:
+        """Land one exported chunk. Rows arrive already normalized
+        (the source normalized at original add time); import must NOT
+        re-normalize or the transplant stops being bit-exact."""
+        if chunk.get("kind") != "rows":
+            raise ValueError(f"flat index cannot import chunk kind {chunk.get('kind')!r}")
+        self._import_raw = True
+        try:
+            self.add_batch_arrays(chunk["keys"], chunk["vecs"], chunk["metas"])
+        finally:
+            self._import_raw = False
+
+    def reshard_finish(self) -> None:
+        """All chunks landed: commit staged rows to the device slabs
+        (the barrier before cutover calls this then blocks on the
+        device arrays)."""
+        self._sync()
 
     def _grow(self) -> None:
         old_shard = self.shard_capacity

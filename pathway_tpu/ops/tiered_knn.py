@@ -338,6 +338,40 @@ class ColdStore:
             return dequantize_int8(self._q[sl], self._scale[sl])
         return self._f[sl].copy()
 
+    def export_rows(self, slots) -> dict:
+        """Raw row payload for an elastic migration chunk — the stored
+        bytes, NOT a dequantized view. Re-quantizing a dequantized
+        vector is not an identity in general; transplanting the q/scale
+        (or f32) bytes keeps cold scores bit-identical across a
+        reshard."""
+        sl = np.asarray(slots, np.int64)
+        if self.dtype == "int8":
+            return {
+                "dtype": "int8",
+                "q": self._q[sl].copy(),
+                "scale": self._scale[sl].copy(),
+            }
+        return {"dtype": self.dtype, "f": self._f[sl].copy()}
+
+    def import_rows(self, payload: dict) -> np.ndarray:
+        """Land an :meth:`export_rows` payload byte-exactly; returns the
+        slots the rows were placed in."""
+        if payload.get("dtype") != self.dtype:
+            raise ValueError(
+                f"cold store dtype mismatch: {payload.get('dtype')!r} vs {self.dtype!r}"
+            )
+        n = len(payload["q" if self.dtype == "int8" else "f"])
+        while len(self._free) < n:
+            self._grow()
+        slots = np.array([self._free.pop() for _ in range(n)], np.int64)
+        if self.dtype == "int8":
+            self._q[slots] = payload["q"]
+            self._scale[slots] = payload["scale"]
+        else:
+            self._f[slots] = payload["f"]
+        self.rows += n
+        return slots
+
 
 # ---------------------------------------------------------------------------
 # cold rescoring (one jitted matmul on the flat index's score scale)
@@ -441,6 +475,7 @@ class TieredKnnIndex:
         # snapshot-restore staging: exact assignment + hot set replay
         self._restore_assign: dict[Any, int] | None = None
         self._restore_hot: list | None = None
+        self.generation = 0  # elastic reshard fencing token
 
     # -- sizing ------------------------------------------------------------
 
@@ -567,6 +602,7 @@ class TieredKnnIndex:
             raise ValueError(
                 f"index {self.name}: expected dim {self.dim}, got {vecs.shape[1]}"
             )
+        self.hot._check_fence()  # fenced generation: reject cold-landing writes too
         for key in keys:
             if key in self._cluster_of:
                 self.remove(key)
@@ -619,6 +655,7 @@ class TieredKnnIndex:
         assert cap_before == self.hot.shard_capacity
 
     def remove(self, key) -> None:
+        self.hot._check_fence()
         c = self._cluster_of.pop(key, None)
         if c is None:
             return
@@ -1071,3 +1108,139 @@ class TieredKnnIndex:
                         ] -= 1
                         self._cold_total -= 1
         self._publish_metrics()
+
+    # -- elastic reshard protocol (elastic/controller.py drives) -----------
+
+    def fence(self, generation: int | None = None) -> None:
+        """Freeze this index as a dead generation (reads still serve the
+        cutover dual-answer window; writes raise ``StaleGeneration``)."""
+        self.hot.fence(generation)
+        if generation is not None:
+            self.generation = max(self.generation, int(generation))
+
+    def spawn_like(self, mesh, reserved_space: int | None = None):
+        """An EMPTY tiered index with this one's tier config on a target
+        mesh. The hot slab re-derives from the same budget (explicit
+        ``hot_rows`` carries over; budget-derived sizing re-splits over
+        the new shard count)."""
+        return TieredKnnIndex(
+            self.dim,
+            metric=self.metric,
+            reserved_space=(
+                int(reserved_space) if reserved_space else self.hot.capacity
+            ),
+            tiers=self.tiers,
+            dtype=self.hot.dtype,
+            mesh=mesh,
+            name=self.name,
+        )
+
+    def reshard_export_chunks(self, chunk_rows: int):
+        """Migration stream: one tier-state chunk (assignment, centroids,
+        hits, hot set), then every doc's COLD payload as raw stored
+        bytes in bounded chunks, then the hot-resident rows as the exact
+        post-normalization (or dequantized-int8) values the hot slab
+        holds. Raw transplant on both tiers is what keeps a resharded
+        tiered index score-bit-identical to one that never moved."""
+        yield {"kind": "tier_state", "state": self.tier_state()}
+        step = max(1, int(chunk_rows))
+        keys = list(self._cluster_of)
+        for i in range(0, len(keys), step):
+            batch = [k for k in keys[i : i + step] if k in self._cluster_of]
+            if not batch:
+                continue
+            slots = [self._cold_slot[k] for k in batch]
+            yield {
+                "kind": "tier_rows",
+                "keys": batch,
+                "payload": self._cold.export_rows(slots),
+                "metas": [self._meta.get(k) for k in batch],
+            }
+        self.hot._refresh_host()
+        hot_keys = sorted(self.hot._slot_of.items(), key=lambda kv: kv[1])
+        hot_keys = [k for k, _ in hot_keys]
+        for i in range(0, len(hot_keys), step):
+            batch = [
+                k
+                for k in hot_keys[i : i + step]
+                if k in self._cluster_of and k in self.hot._slot_of
+            ]
+            if not batch:
+                continue
+            slots = np.asarray([self.hot._slot_of[k] for k in batch])
+            yield {
+                "kind": "tier_hot",
+                "keys": batch,
+                "vecs": self.hot._host[slots].copy(),
+                "metas": [self._meta.get(k) for k in batch],
+            }
+
+    def reshard_import_chunk(self, chunk: dict) -> None:
+        kind = chunk.get("kind")
+        if kind == "tier_state":
+            self.restore_tier_state(chunk["state"])
+            return
+        if kind == "tier_rows":
+            assign = self._restore_assign or {}
+            keys = chunk["keys"]
+            for key in keys:
+                if key in self._cluster_of:
+                    self.remove(key)
+            slots = self._cold.import_rows(chunk["payload"])
+            metas = chunk["metas"]
+            for i, key in enumerate(keys):
+                c = int(assign.get(key, 0))
+                self._cluster_of[key] = c
+                self._members[c].add(key)
+                self._cold_slot[key] = int(slots[i])
+                self._cold_keys[c].add(key)
+                # shard routing under the TARGET shard count
+                self._cold_docs_shard[_shard_of_key(key, self.n_shards)] += 1
+                self._cold_total += 1
+                if metas[i] is not None:
+                    self._meta[key] = metas[i]
+            self._publish_metrics()
+            return
+        if kind == "tier_hot":
+            # promote exactly the source's hot rows (byte-exact: the hot
+            # slab normalizes on add, these are its POST-normalization
+            # values, so the import bypasses normalization). The hot
+            # slab grows per-shard on demand, so the full hot set always
+            # transplants — hot/cold membership is preserved exactly.
+            fit: list = []
+            fit_idx: list[int] = []
+            for i, key in enumerate(chunk["keys"]):
+                if key not in self._cluster_of or key in self.hot._slot_of:
+                    continue
+                fit.append(key)
+                fit_idx.append(i)
+            if fit:
+                self.hot.reshard_import_chunk(
+                    {
+                        "kind": "rows",
+                        "keys": fit,
+                        "vecs": np.asarray(chunk["vecs"])[fit_idx],
+                        "metas": [self._meta.get(k) for k in fit],
+                    }
+                )
+                for key in fit:
+                    c = self._cluster_of[key]
+                    if key in self._cold_keys[c]:
+                        self._cold_keys[c].discard(key)
+                        self._cold_docs_shard[
+                            _shard_of_key(key, self.n_shards)
+                        ] -= 1
+                        self._cold_total -= 1
+                self._publish_metrics()
+            return
+        raise ValueError(f"tiered index cannot import chunk kind {kind!r}")
+
+    def reshard_finish(self) -> None:
+        """Leave restore mode (hot promotion already happened via the
+        ``tier_hot`` chunks, byte-exact) and commit the hot slab."""
+        if self._restore_hot is not None:
+            self._restore_hot = [
+                k for k in self._restore_hot if k not in self.hot._slot_of
+            ]
+        self.finish_tier_restore()
+        self.hot._sync()

@@ -15,6 +15,8 @@ Accepted specs::
     "4x2"        # data=4, model=2
     "data=4,model=2"
     {"data": 4, "model": 2}
+    "auto"       # data axis over every visible device; arms the
+                 # elastic plane's watermarks (see pathway_tpu/elastic)
     Mesh(...)    # passed through verbatim
 """
 
@@ -62,11 +64,19 @@ def parse_mesh_spec(spec: Any) -> dict[str, int] | None:
         model = int(spec.get("model", 1))
         if data <= 0 or model <= 0:
             raise ValueError(f"mesh axes must be positive, got {spec!r}")
-        return {"data": data, "model": model}
+        out = {"data": data, "model": model}
+        if spec.get("auto"):
+            out["auto"] = True
+        return out
     if isinstance(spec, str):
         text = spec.strip()
         if not text:
             return None
+        if text.lower() == "auto":
+            # device count resolves at mesh-build time; the parsed shape
+            # stays conservative (1x1) so jax-free analysis (PWL010,
+            # PWL022) sees the auto flag without a backend
+            return {"data": 1, "model": 1, "auto": True}
         if "=" in text:
             axes = {"data": 1, "model": 1}
             for part in text.replace(";", ",").split(","):
@@ -101,6 +111,10 @@ def resolve_mesh(spec: Any):
 
     from .sharding import make_mesh
 
+    if axes.get("auto"):
+        # every visible device on the data axis; the elastic controller
+        # reshards within [min_shards, max_shards] from here
+        return make_mesh(n_devices=len(jax.devices()), model_parallel=1)
     want = axes["data"] * axes["model"]
     have = len(jax.devices())
     if want > have:

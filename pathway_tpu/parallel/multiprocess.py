@@ -270,6 +270,12 @@ class CoordinatorCluster(ShardCluster):
         self._fence = {int(p): int(g) for p, g in (fence or {}).items()}
         self.generation = _stored_generation(engines)
         CLUSTER_METRICS.set_generation(self.generation)
+        # enroll with the elastic plane: a live reshard advances this
+        # cluster's generation through advance_generation (weakly held —
+        # the registry never keeps a dead cluster alive)
+        from ..elastic.controller import register_cluster
+
+        register_cluster(self)
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("127.0.0.1", first_port))
@@ -600,6 +606,20 @@ class CoordinatorCluster(ShardCluster):
             self._begin_partial_restart(exc)
         finally:
             self._stop_heartbeats()
+
+    def advance_generation(self, generation: int) -> None:
+        """Adopt an externally bumped (already durable) cluster
+        generation — the elastic reshard path calls this after cutover
+        so protocol frames stamped with the pre-reshard generation are
+        fenced by the existing stale-frame machinery."""
+        gen = int(generation)
+        if gen <= self.generation:
+            return
+        self.generation = gen
+        CLUSTER_METRICS.set_generation(gen)
+        flight_recorder.record(
+            "cluster.generation_advanced", generation=gen, reason="elastic"
+        )
 
     def _begin_partial_restart(self, exc: WorkerLost) -> None:
         """Convert a lost worker into a partial restart: bump the

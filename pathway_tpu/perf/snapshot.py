@@ -134,12 +134,36 @@ def diff_snapshots(a: dict, b: dict, *, gate: float = DEFAULT_GATE) -> dict:
     A metric regresses when it moves past ``gate`` in its bad direction,
     or when it carries an absolute ``gate`` field (accounted-fraction
     style) that the candidate value no longer clears.
+
+    A metric present in only one snapshot is reported as status
+    ``"new"`` (candidate only) or ``"removed"`` (baseline only) with
+    the missing side ``None`` — suite membership drift is information,
+    not a regression, so one-sided rows never fail the diff.
     """
     am, bm = _metrics_of(a), _metrics_of(b)
     rows: list[dict] = []
     regressions: list[dict] = []
-    for name in sorted(set(am) & set(bm)):
-        ra, rb = am[name], bm[name]
+    for name in sorted(set(am) | set(bm)):
+        ra, rb = am.get(name), bm.get(name)
+        if ra is None or rb is None:
+            only = rb if ra is None else ra
+            try:
+                val = float(only["value"])
+            except (TypeError, ValueError):
+                continue
+            row = {
+                "metric": name,
+                "unit": str(only.get("unit", "")),
+                "a": None if ra is None else val,
+                "b": val if ra is None else None,
+                "rel_change": None,
+                "direction": _direction(name, str(only.get("unit", ""))),
+                "status": "new" if ra is None else "removed",
+            }
+            if only.get("gate") is not None:
+                row["gate"] = only["gate"]
+            rows.append(row)
+            continue
         try:
             va, vb = float(ra["value"]), float(rb["value"])
         except (TypeError, ValueError):
@@ -195,15 +219,24 @@ def render_diff(result: dict) -> str:
     out = [f"{'metric'.ljust(name_w)}  {'baseline':>12}  {'candidate':>12}  {'Δ%':>8}  status"]
     for r in rows:
         rel = r["rel_change"]
-        pct = "inf" if rel == float("inf") else f"{100 * rel:+.1f}"
-        mark = "REGRESSION" if r["status"] == "regression" else "ok"
+        if rel is None:
+            pct = "-"
+        elif rel == float("inf"):
+            pct = "inf"
+        else:
+            pct = f"{100 * rel:+.1f}"
+        mark = "REGRESSION" if r["status"] == "regression" else r["status"]
         gate = f" (gate {r['gate']})" if "gate" in r else ""
+        va = "-".rjust(12) if r["a"] is None else f"{r['a']:>12.3f}"
+        vb = "-".rjust(12) if r["b"] is None else f"{r['b']:>12.3f}"
         out.append(
-            f"{r['metric'].ljust(name_w)}  {r['a']:>12.3f}  {r['b']:>12.3f}  "
-            f"{pct:>8}  {mark}{gate}"
+            f"{r['metric'].ljust(name_w)}  {va}  {vb}  {pct:>8}  {mark}{gate}"
         )
     n = len(result["regressions"])
-    out.append(f"-- {n} regression(s) across {len(rows)} shared metric(s)")
+    shared = sum(1 for r in rows if r["status"] not in ("new", "removed"))
+    extra = len(rows) - shared
+    tail = f" (+{extra} new/removed)" if extra else ""
+    out.append(f"-- {n} regression(s) across {shared} shared metric(s){tail}")
     return "\n".join(out)
 
 

@@ -104,6 +104,9 @@ for _site, _plane in (
     ("serving.batch_inflight", "serving"),
     ("cluster.send", "cluster"),
     ("ingest.worker", "ingest"),
+    ("elastic.migrate_chunk", "elastic"),
+    ("elastic.cutover", "elastic"),
+    ("elastic.abort", "elastic"),
 ):
     register_site(_site, _plane)
 del _site, _plane

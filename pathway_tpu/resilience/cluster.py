@@ -164,8 +164,32 @@ class ClusterHealth:
         self._down: set[int] = set()
         self._down_since: float | None = None
         self._retry_after_s = 1.0
+        # an explicit retry_after_s= declaration pins the constant for
+        # the current outage — the caller knows better than the
+        # learned previous-outage heuristic
+        self._retry_after_pinned = False
+        self._eta_s: float | None = None  # declared recovery ETA
+        self._eta_set_at: float | None = None
+        self._last_outage_s = 1.0  # learned from the previous outage
+        self._eta_source: Any = None  # callable -> float | None
 
-    def mark_down(self, shards, *, retry_after_s: float | None = None) -> None:
+    def set_eta_source(self, fn, *, if_unset: bool = False) -> None:
+        """Register a live recovery-ETA provider (e.g. the elastic
+        plane's migration ETA). Consulted first by
+        :meth:`retry_after_s`; must return seconds or None. With
+        ``if_unset`` a source that is already installed wins."""
+        with self._lock:
+            if if_unset and self._eta_source is not None:
+                return
+            self._eta_source = fn
+
+    def mark_down(
+        self,
+        shards,
+        *,
+        retry_after_s: float | None = None,
+        eta_s: float | None = None,
+    ) -> None:
         import time as _time
 
         with self._lock:
@@ -174,11 +198,27 @@ class ClusterHealth:
                 self._down_since = _time.monotonic()
             if retry_after_s is not None:
                 self._retry_after_s = max(0.0, float(retry_after_s))
+                self._retry_after_pinned = True
+            if eta_s is not None:
+                self._eta_s = max(0.0, float(eta_s))
+                self._eta_set_at = _time.monotonic()
 
     def mark_all_up(self) -> None:
+        import time as _time
+
         with self._lock:
+            # remember how long this outage actually took — the next
+            # one's Retry-After starts from an observed figure instead
+            # of the constant
+            if self._down_since is not None:
+                self._last_outage_s = max(
+                    0.1, _time.monotonic() - self._down_since
+                )
             self._down.clear()
             self._down_since = None
+            self._retry_after_pinned = False
+            self._eta_s = None
+            self._eta_set_at = None
 
     def is_down(self, shard: int) -> bool:
         with self._lock:
@@ -193,9 +233,37 @@ class ClusterHealth:
             return bool(self._down)
 
     def retry_after_s(self) -> float:
-        """Hint for Retry-After on shed responses: roughly the lease —
-        by then the partial restart either completed or escalated."""
+        """Hint for Retry-After on shed responses, proportional to how
+        long recovery will actually take instead of a constant:
+
+        1. a registered live ETA source (the elastic plane's migration
+           ETA while a reshard is in flight) wins;
+        2. else a declared ETA from :meth:`mark_down`, decayed by the
+           time already elapsed since it was declared;
+        3. else, while shards are down with no explicitly declared
+           ``retry_after_s``, the duration of the *previous* outage
+           minus time already waited — regroups of the same cluster
+           tend to take similar time;
+        4. else the legacy constant.
+
+        Always >= 0.1 s so clients never busy-spin."""
+        import time as _time
+
         with self._lock:
+            src = self._eta_source
+        if src is not None:
+            try:
+                eta = src()  # outside the lock: the source locks itself
+            except Exception:
+                eta = None
+            if eta is not None:
+                return max(0.1, float(eta))
+        with self._lock:
+            now = _time.monotonic()
+            if self._eta_s is not None and self._eta_set_at is not None:
+                return max(0.1, self._eta_s - (now - self._eta_set_at))
+            if self._down_since is not None and not self._retry_after_pinned:
+                return max(0.1, self._last_outage_s - (now - self._down_since))
             return self._retry_after_s
 
 

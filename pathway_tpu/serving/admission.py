@@ -354,6 +354,16 @@ class AdmissionController:
             quota = self._check_tenant(tenant, trace_ctx, trace_extra)
 
         shard_degraded = False
+        # elastic migration in flight: degrade-not-reject — requests
+        # keep flowing against the old generation (the reshard plane
+        # guarantees zero drops) but carry the degraded marker so
+        # downstream stages can cheapen, and shed responses (if the
+        # queue does fill) derive Retry-After from the migration ETA
+        # via CLUSTER_HEALTH's registered eta source
+        from ..elastic.metrics import ELASTIC_METRICS
+
+        if cfg.shed == "degrade" and ELASTIC_METRICS.migrating():
+            shard_degraded = True
         if shard is not None and CLUSTER_HEALTH.is_down(shard):
             if cfg.shed == "degrade":
                 shard_degraded = True

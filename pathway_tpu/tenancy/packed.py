@@ -303,6 +303,7 @@ class TenantPackedIndex(DeviceKnnIndex):
         self.remove((str(tenant), key))
 
     def remove(self, key) -> None:
+        self._check_fence()
         slot = self._slot_of.pop(key, None)
         if slot is None:
             self._cold_remove(key)
@@ -514,6 +515,92 @@ class TenantPackedIndex(DeviceKnnIndex):
                     break
             out.append(row)
         return out
+
+    # -- elastic reshard protocol (elastic/controller.py drives) --
+
+    def spawn_like(self, mesh, reserved_space: int | None = None):
+        """An EMPTY packed slab with this one's tenancy config on a
+        target mesh; extent grants replay as tenants re-land, growing
+        shard-by-shard through the compiled per-slab-shape programs."""
+        return TenantPackedIndex(
+            self.dim,
+            metric=self.metric,
+            reserved_space=int(reserved_space) if reserved_space else 64,
+            mesh=mesh,
+            name=self.name,
+            config=self._config,
+        )
+
+    def reshard_export_chunks(self, chunk_rows: int):
+        """Migration stream, tenant by tenant in registration order:
+        hot tenants' live rows from the slab (slot order, already
+        normalized — the import bypasses re-normalization), cold
+        tenants' host-store rows followed by a ``tenant_cold`` marker
+        so the target demotes them back to exactly a host store."""
+        step = max(1, int(chunk_rows))
+        self._refresh_host()
+        for tenant in list(self._tid):
+            if tenant in self._cold:
+                store = self._cold[tenant]
+                keys = list(store["keys"])
+                for i in range(0, len(keys), step):
+                    batch = keys[i : i + step]
+                    idx = [
+                        store["index_of"][k]
+                        for k in batch
+                        if k in store["index_of"]
+                    ]
+                    batch = [k for k in batch if k in store["index_of"]]
+                    if not batch:
+                        continue
+                    yield {
+                        "kind": "tenant_rows",
+                        "tenant": tenant,
+                        "keys": batch,
+                        "vecs": store["vecs"][idx].copy(),
+                        "metas": [store["meta"].get(k) for k in batch],
+                    }
+                yield {"kind": "tenant_cold", "tenant": tenant, "keys": []}
+                continue
+            slots = sorted(
+                slot
+                for start, size in self._segments.get(tenant, ())
+                for slot in range(start, start + size)
+                if self._keys[slot] is not None
+            )
+            for i in range(0, len(slots), step):
+                batch = [
+                    s for s in slots[i : i + step] if self._keys[s] is not None
+                ]
+                if not batch:
+                    continue
+                ns_keys = [self._keys[s] for s in batch]
+                yield {
+                    "kind": "tenant_rows",
+                    "tenant": tenant,
+                    "keys": [nk[1] for nk in ns_keys],
+                    "vecs": self._host[np.asarray(batch)].copy(),
+                    "metas": [self._meta.get(nk) for nk in ns_keys],
+                }
+
+    def reshard_import_chunk(self, chunk: dict) -> None:
+        kind = chunk.get("kind")
+        tenant = str(chunk.get("tenant", ""))
+        if kind == "tenant_rows":
+            self._import_raw = True
+            try:
+                self.add_tenant_batch(
+                    tenant, chunk["keys"], chunk["vecs"], chunk["metas"]
+                )
+            finally:
+                self._import_raw = False
+            return
+        if kind == "tenant_cold":
+            self._ensure_rows(tenant, 0)  # register the tenant id
+            if tenant not in self._cold:
+                self._demote(tenant)
+            return
+        raise ValueError(f"packed index cannot import chunk kind {kind!r}")
 
     # -- introspection / accounting --
 

@@ -1,0 +1,23 @@
+"""Analysis fixture: elastic reshard watermarks armed (auto mode with
+an HBM pressure threshold) but no persistence backend — a crash
+mid-migration loses the durable cluster-generation fence and the
+reshard intent, so zombie writes are not fenced across restart and the
+pending reshard cannot be recovered. The verifier must flag PWL022
+(warning). The table is finite (PWL002 quiet) and single-process
+(PWL009 quiet); this fixture is about durability, not cluster shape."""
+
+import pathway_tpu as pw
+
+t = pw.debug.table_from_markdown(
+    """
+    | word
+  1 | cat
+  2 | dog
+    """
+)
+
+counts = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+
+pw.io.null.write(counts)
+
+pw.run(elastic={"auto": True, "hbm_frac": 0.85, "max_shards": 4})

@@ -1020,6 +1020,66 @@ def test_pwl021_negative_without_run_context():
     assert "PWL021" not in _rules(pw.analysis.analyze())
 
 
+# ---------------------------------------------------------------- PWL022
+
+
+def test_pwl022_watermarks_without_persistence(monkeypatch):
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        elastic={"auto": True, "hbm_frac": 0.85},
+    )
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL022"]
+    assert len(hits) == 1 and hits[0].severity is Severity.WARNING
+    assert "watermarks are armed" in hits[0].message
+    assert hits[0].detail["elastic"]["hbm_frac"] == 0.85
+    assert hits[0].detail["persistence"] is False
+
+
+def test_pwl022_mesh_auto_without_persistence(monkeypatch):
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out", mesh="auto")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL022"]
+    assert len(hits) == 1
+    assert 'mesh="auto"' in hits[0].message
+    assert hits[0].detail["mesh_auto"] is True
+
+
+def test_pwl022_fixed_target_without_persistence(monkeypatch):
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out", elastic=4)
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL022"]
+    assert len(hits) == 1
+    assert "shards=4" in hits[0].message
+
+
+def test_pwl022_persistence_silences(monkeypatch, tmp_path):
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        elastic={"auto": True, "hbm_frac": 0.85},
+        persistence_config=pw.persistence.Config.simple_config(
+            pw.persistence.Backend.filesystem(str(tmp_path))
+        ),
+    )
+    assert "PWL022" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl022_negative_no_elastic_plane(monkeypatch):
+    # neither an elastic spec nor mesh="auto": nothing migrates,
+    # nothing to fence
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out")
+    assert "PWL022" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl022_negative_without_run_context():
+    _null_sink()
+    assert "PWL022" not in _rules(pw.analysis.analyze())
+
+
 # ---------------------------------------------------------------- PWL015
 
 

@@ -381,6 +381,40 @@ def test_perf_diff_absolute_gate_field_wins():
     assert result["rc"] == 1
 
 
+def test_perf_diff_one_sided_metrics_reported_not_fatal():
+    """A metric present in only one snapshot must not crash the diff:
+    it reports as `new` (candidate only) / `removed` (baseline only)
+    with the missing side None, and never fails the gate (rc 0)."""
+    from pathway_tpu.perf.snapshot import diff_snapshots, render_diff
+
+    a = _snap([("ingest_eps", 1000.0, "rows/s"), ("old_only_ms", 5.0, "ms")])
+    b = _snap([("ingest_eps", 1000.0, "rows/s"), ("brand_new_qps", 50.0, "qps")])
+    result = diff_snapshots(a, b, gate=0.10)
+    by_metric = {r["metric"]: r for r in result["rows"]}
+    assert by_metric["brand_new_qps"]["status"] == "new"
+    assert by_metric["brand_new_qps"]["a"] is None
+    assert by_metric["brand_new_qps"]["b"] == 50.0
+    assert by_metric["old_only_ms"]["status"] == "removed"
+    assert by_metric["old_only_ms"]["a"] == 5.0
+    assert by_metric["old_only_ms"]["b"] is None
+    assert by_metric["brand_new_qps"]["rel_change"] is None
+    assert result["rc"] == 0 and not result["regressions"]
+    # the rendered table must survive the None sides
+    text = render_diff(result)
+    assert "brand_new_qps" in text and "removed" in text and "new" in text
+
+
+def test_perf_diff_disjoint_snapshots_exit_zero():
+    from pathway_tpu.perf.snapshot import diff_snapshots, render_diff
+
+    a = _snap([("alpha_ms", 1.0, "ms")])
+    b = _snap([("beta_ms", 2.0, "ms")])
+    result = diff_snapshots(a, b, gate=0.10)
+    assert result["rc"] == 0
+    assert {r["status"] for r in result["rows"]} == {"new", "removed"}
+    assert "0 regression(s)" in render_diff(result)
+
+
 def test_perf_snapshot_builds_from_journal(tmp_path, monkeypatch):
     from pathway_tpu.perf.snapshot import SUMMARY_MARKER, build_snapshot
     from pathway_tpu.perf.journal import MetricsJournal

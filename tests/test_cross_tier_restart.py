@@ -1,0 +1,218 @@
+"""Cross-feature: chaos-SIGKILL during tiered-index promotion x cluster
+partial restart.
+
+The tiered index (PR 11) promotes cold rows to the hot slab inside
+``maybe_rebalance`` (chaos site ``index.tier.promote``); the cluster
+fault domain (PR 7) respawns only a dead worker and fences zombies by
+generation. This test crosses them: worker 1 is SIGKILLed *inside* a
+tier promotion, the coordinator partial-restarts it, and the respawned
+worker (bumped generation, so the chaos rule no longer matches) must
+both finish the streaming run with exact final counts AND complete a
+tier promotion cycle cleanly — a crash inside index code must look to
+the fault domain exactly like any other worker death.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROGRAM = textwrap.dedent(
+    """
+    import os, threading, time
+    import numpy as np
+    import pathway_tpu as pw
+    from pathway_tpu.io._connector import input_table_from_reader
+    from pathway_tpu.ops.tiered_knn import TieredKnnIndex, TierConfig
+
+    N = int(os.environ["XT_N"])
+    PID = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    NPROC = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+    GEN = int(os.environ.get("PATHWAY_CLUSTER_GENERATION", "0") or 0)
+    WORDS = ["cat", "dog", "bird"]
+
+    def tier_churn():
+        rng = np.random.default_rng(7)
+        centers = rng.normal(size=(4, 16)).astype(np.float32) * 2.0
+        assign = rng.integers(0, 4, size=100)
+        vecs = (centers[assign] + rng.normal(size=(100, 16))).astype(
+            np.float32
+        )
+        qs = (
+            centers[rng.integers(0, 4, size=4)]
+            + rng.normal(size=(4, 16))
+        ).astype(np.float32)
+        idx = TieredKnnIndex(
+            dim=16,
+            reserved_space=128,
+            tiers=TierConfig(n_clusters=4, n_probe=4, cold_dtype="f32"),
+        )
+        idx.add_batch_arrays(list(range(100)), vecs)
+        while True:
+            idx.force_demote()
+            for _ in range(8):
+                idx.search_batch(qs, 5)
+            # generation 0: the chaos rule SIGKILLs the process HERE,
+            # mid-promotion. After the partial restart (GEN > 0) the
+            # rule no longer matches and the cycle must complete.
+            idx.maybe_rebalance(force=True)
+            if GEN > 0:
+                got = idx.search_batch(np.asarray(vecs, np.float32), 1)
+                found = sorted(row[0][0] for row in got if row)
+                ok = (
+                    found == list(range(100))
+                    and idx.hot_docs() + idx.cold_docs() == 100
+                )
+                with open(os.environ["XT_MARKER"], "w") as f:
+                    f.write("ok" if ok else f"bad coverage={len(found)}")
+                return
+
+    churn = None
+    if PID == 1:
+        # non-daemon: a respawned worker must not exit before the
+        # verification marker lands
+        churn = threading.Thread(target=tier_churn, daemon=False)
+        churn.start()
+
+    class S(pw.Schema):
+        word: str
+
+    def reader(ctx):
+        start = int(ctx.offsets.get("pos", 0))
+        for i in range(N):
+            if i % NPROC != ctx.process_id:
+                continue
+            if i < start:
+                continue
+            ctx.insert({"word": WORDS[i % 3]}, offsets={"pos": i + 1})
+            ctx.commit()
+            time.sleep(0.01)
+
+    t = input_table_from_reader(
+        S, reader, name="slow_src", parallel_readers=True,
+        persistent_id="xt", supports_offsets=True,
+        autocommit_duration_ms=50,
+    )
+    c = t.groupby(pw.this.word).reduce(pw.this.word, n=pw.reducers.count())
+    pw.io.jsonlines.write(c, os.environ["XT_OUT"] + "." + str(PID))
+    pw.run(
+        monitoring_level="none",
+        persistence_config=pw.persistence.Config.simple_config(
+            pw.persistence.Backend.filesystem(os.environ["XT_STORE"]),
+            snapshot_interval_ms=200,
+        ),
+    )
+    if churn is not None:
+        churn.join(timeout=60)
+    """
+)
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.parametrize("hit", [1, 2])
+def test_sigkill_in_tier_promotion_partial_restart(tmp_path, hit):
+    """SIGKILL worker 1 at the ``hit``-th visit to index.tier.promote
+    (the promotion moves keys in two halves, so hit=2 lands mid-move
+    with the hot slab torn); the fault domain must partial-restart it
+    and the respawned worker must complete both the stream and a clean
+    promotion cycle."""
+    n = 120
+    out = str(tmp_path / "out.jsonl")
+    marker = str(tmp_path / "tier.ok")
+    spec = json.dumps(
+        {
+            "site": "index.tier.promote",
+            "process": 1,
+            "generation": 0,
+            "hit": hit,
+            "action": "kill",
+        }
+    )
+    prog = tmp_path / "xt.py"
+    prog.write_text(PROGRAM)
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("PATHWAY_CHAOS", None)
+        env.update(
+            XT_N=str(n),
+            XT_OUT=out,
+            XT_STORE=str(tmp_path / "store"),
+            XT_MARKER=marker,
+            JAX_PLATFORMS="cpu",
+            PATHWAY_THREADS="1",
+            PATHWAY_PROCESSES="2",
+            PATHWAY_PROCESS_ID=str(pid),
+            PATHWAY_FIRST_PORT=str(port),
+            PATHWAY_CLUSTER_TOKEN="xt-test",
+            PATHWAY_CLUSTER_LEASE_MS="1500",
+            PATHWAY_CLUSTER_RESPAWN="1",
+            PATHWAY_CHAOS=spec,
+            PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(prog)],
+                env=env,
+                cwd=str(tmp_path),
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    p0, p1 = procs
+    try:
+        _, err0 = p0.communicate(timeout=240)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        p1.wait(timeout=10)
+
+    # the original worker died inside the promotion...
+    assert p1.returncode == -signal.SIGKILL, (p1.returncode, err0[-3000:])
+    # ...and the coordinator executed a PARTIAL restart, finishing the
+    # run in its one original process
+    assert p0.returncode == 0, err0[-3000:]
+    assert "cluster partial restart" in err0
+
+    # stream contract: exact net final counts, nothing lost or doubled
+    state: dict = {}
+    with open(out + ".0") as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec["diff"] > 0:
+                state[rec["word"]] = rec["n"]
+            else:
+                state.pop(rec["word"], None)
+    assert state == {"cat": 40, "dog": 40, "bird": 40}
+
+    # index contract: the respawned worker completed a full promotion
+    # cycle — every key answered exactly once, tiers account for all
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not os.path.exists(marker):
+        time.sleep(0.2)
+    assert os.path.exists(marker), "respawned worker never verified its index"
+    with open(marker) as f:
+        assert f.read() == "ok"

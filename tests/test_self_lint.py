@@ -401,6 +401,32 @@ def test_pwl021_chip_ledger_env_silences_cli(monkeypatch):
     assert "PWL021" not in proc.stdout
 
 
+def test_elastic_no_recovery_warns_pwl022():
+    """Elastic watermarks armed with no persistence backend: PWL022
+    warns (exit 0), nonzero only under --fail-on=warn."""
+    fixture = os.path.join(FIXTURES, "elastic_no_recovery.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL022" in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--fail-on=warn")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl022_json_carries_elastic_intent():
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "elastic_no_recovery.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL022"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["elastic"]["auto"] is True
+    assert diag["detail"]["elastic"]["hbm_frac"] == 0.85
+    assert diag["detail"]["persistence"] is False
+
+
 def test_combined_over_hbm_warns_pwl015(monkeypatch):
     """An index plane and a decode KV pool that each fit the HBM budget
     alone but jointly oversubscribe it: PWL015 warns (exit 0), nonzero

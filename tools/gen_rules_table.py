@@ -194,6 +194,16 @@ DESCRIPTIONS: dict[str, str] = {
         "stranded fraction), `pathway top` renders empty, and the "
         "watchdog's stranded_chip_time rule has no signal"
     ),
+    "PWL022": (
+        "the elastic plane is armed — reshard watermarks / `auto` mode "
+        "(`pw.run(elastic=...)` / `PATHWAY_ELASTIC`), a fixed `shards=` "
+        "target, or `mesh=\"auto\"` — but no persistence backend is "
+        "configured: the live migration's cluster-generation fence and "
+        "reshard intent are durable-by-contract, and without "
+        "`persistence_config=` a crash mid-reshard loses both — zombie "
+        "writes are not fenced across restart and the pending reshard "
+        "cannot be recovered or rolled back"
+    ),
 }
 
 
