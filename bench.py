@@ -1350,6 +1350,163 @@ def suite_decode_serving() -> None:
         "pass pays the on-device cross-encoder per query",
     )
 
+    # --- prefix-cache phase: a 10x-longer shared prefix must cost ~0
+    # extra prefill at steady state (every shared full page is served
+    # from the refcounted cache, only the tail re-prefills) ---
+    N_CACHED = 32
+
+    def build_prefix_engine(prefix_len: int):
+        cfg = DecodeConfig(**{**dcfg.as_dict(), "prefix_cache": True})
+        engine = DecodeEngine(mcfg, cfg)
+        prng = np.random.default_rng(11)
+        shared = prng.integers(1, mcfg.vocab_size, prefix_len).tolist()
+        qs = [
+            shared + prng.integers(1, mcfg.vocab_size, 8).tolist()
+            for _ in range(N_CACHED)
+        ]
+        # warmup: compile the buckets AND publish the shared prefix so
+        # the timed rounds measure the steady (warm-cache) state — the
+        # second query takes the warm-hit path, compiling its tail-chunk
+        # program outside the timed windows
+        engine.submit(qs[0])
+        engine.drain()
+        engine.submit(qs[1])
+        engine.drain()
+        return engine, qs
+
+    def timed_round(engine, qs) -> tuple:
+        t0 = time.perf_counter()
+        tickets = [engine.submit(q) for q in qs]
+        occupancy = 0.0
+        while engine.busy():
+            engine.step()
+            occupancy = max(
+                occupancy, engine.pool.pages_in_use / engine.pool.n_pages
+            )
+        wall = time.perf_counter() - t0
+        return sum(len(tk.tokens) for tk in tickets) / wall, occupancy
+
+    def run_prefix() -> tuple:
+        """Interleaved A/B rounds: wall-clock drift (frequency scaling,
+        allocator aging) lands on BOTH prefix lengths, so the ratio
+        compares like with like; per-phase value is the round median."""
+        import statistics
+
+        eng_s, qs_s = build_prefix_engine(8)
+        eng_l, qs_l = build_prefix_engine(80)
+        DECODE_METRICS.reset()
+        tps_s, tps_l, occupancy = [], [], 0.0
+        for _ in range(3):
+            tp, _occ = timed_round(eng_s, qs_s)
+            tps_s.append(tp)
+            tp, occ = timed_round(eng_l, qs_l)
+            tps_l.append(tp)
+            occupancy = max(occupancy, occ)
+        snap = DECODE_METRICS.snapshot()
+        short = {"tok_per_s": statistics.median(tps_s)}
+        long = {
+            "tok_per_s": statistics.median(tps_l),
+            "hit_ratio": float(snap.get("prefix_hit_ratio", 0.0)),
+            "cached_pages": int(snap.get("prefix_cached_pages", 0)),
+            "occupancy": occupancy,
+        }
+        return short, long
+
+    short, long = run_prefix()
+    assert long["hit_ratio"] > 0.5, f"cold cache at steady state: {long}"
+    assert long["tok_per_s"] * 1.1 >= short["tok_per_s"], (
+        "10x shared prefix degraded tokens/s by more than 1.1x: "
+        f"{short['tok_per_s']:.1f} -> {long['tok_per_s']:.1f}"
+    )
+    _emit(
+        "decode_prefix_hit_ratio",
+        long["hit_ratio"],
+        "ratio",
+        gate=0.5,
+        cached_pages=long["cached_pages"],
+        prefix_tokens=80,
+        queries=N_CACHED,
+        mode="80-token shared prefix + 8 unique tokens per prompt, "
+        "cache warmed by one query outside the timed window",
+    )
+    _emit(
+        "decode_kv_pool_occupancy",
+        long["occupancy"],
+        "ratio",
+        pages=dcfg.pages,
+        note="physical pages in use / pool pages with every query "
+        "admitted (shared prefix pages booked once, not per lane)",
+    )
+    _emit(
+        "decode_prefix_cache_speedup_10x",
+        long["tok_per_s"] / max(short["tok_per_s"], 1e-9),
+        "x",
+        gate=1 / 1.1,
+        tok_per_s_short_prefix=round(short["tok_per_s"], 1),
+        tok_per_s_10x_prefix=round(long["tok_per_s"], 1),
+    )
+
+    # --- speculative phase: layer-skip self-draft proposes k tokens,
+    # the target verifies them in one batched forward; on the
+    # self-similar toy workload acceptance must clear 0.5 and the
+    # emitted-tokens/s headline must beat the greedy baseline 1.5x ---
+    N_SPEC = 32
+    spec_prompts = [
+        rng.integers(1, mcfg.vocab_size, 12).tolist() for _ in range(N_SPEC)
+    ]
+
+    def run_spec(spec: int, **draft) -> dict:
+        DECODE_METRICS.reset()
+        cfg = DecodeConfig(**{**dcfg.as_dict(), "spec_tokens": spec, **draft})
+        engine = DecodeEngine(mcfg, cfg)
+        for q in spec_prompts[:4]:  # compile draft/verify outside timing
+            engine.submit(q)
+        engine.drain()
+        DECODE_METRICS.reset()
+        t0 = time.perf_counter()
+        tickets = [engine.submit(q) for q in spec_prompts]
+        engine.drain()
+        wall = time.perf_counter() - t0
+        snap = DECODE_METRICS.snapshot()
+        return {
+            "tok_per_s": sum(len(tk.tokens) for tk in tickets) / wall,
+            "acceptance": float(snap.get("spec_acceptance_rate", 0.0)),
+        }
+
+    greedy = run_spec(spec=0)
+    spec = run_spec(spec=4, draft_ngram=2)
+    selfdraft = run_spec(spec=4, draft_layers=1)
+    speedup = spec["tok_per_s"] / max(greedy["tok_per_s"], 1e-9)
+    assert spec["acceptance"] >= 0.5, (
+        f"draft acceptance below the 0.5 gate: {spec['acceptance']:.3f}"
+    )
+    assert speedup >= 1.5, (
+        f"speculative decode speedup below 1.5x: {speedup:.2f} "
+        f"({greedy['tok_per_s']:.1f} -> {spec['tok_per_s']:.1f} tok/s)"
+    )
+    _emit(
+        "decode_spec_acceptance_rate",
+        spec["acceptance"],
+        "ratio",
+        gate=0.5,
+        spec_tokens=4,
+        draft_ngram=2,
+        acceptance_selfdraft=round(selfdraft["acceptance"], 4),
+        queries=N_SPEC,
+    )
+    _emit(
+        "decode_spec_tokens_per_s",
+        spec["tok_per_s"],
+        "tokens/s",
+        gate_speedup=1.5,
+        speedup_vs_greedy=round(speedup, 2),
+        greedy_tok_per_s=round(greedy["tok_per_s"], 1),
+        tok_per_s_selfdraft=round(selfdraft["tok_per_s"], 1),
+        mode="prompt-lookup draft (ngram=2), k=4, commit = longest "
+        "agreeing prefix + first correction; streams bitwise equal to "
+        "greedy; self-draft (1 of 2 layers) reported alongside",
+    )
+
 
 def suite_etl() -> None:
     """ETL micro-bench: 1M-row select+filter+groupby through the

@@ -71,6 +71,17 @@ PWL016 (warning) tenancy without quotas: the multi-tenant plane is
                  the named quotas' HBM budgets sum past
                  PATHWAY_HBM_BYTES (the admission booking would let
                  tenants collectively OOM the slab).
+PWL023 (warning) decode serving economics: the decode plane serves
+                 multi-tenant (pw.run(tenancy=)) or RAG traffic (a
+                 device-backed index feeding the same run) with prefix
+                 caching off — both workloads re-prefill a shared
+                 prefix (system prompt / retrieved context template)
+                 per request that decode='cache=1' would serve from
+                 refcounted pages for free. Second arm: a speculative
+                 draft checkpoint (decode='draft_weights=...') whose
+                 weights booking is the straw that pushes the KV pool +
+                 target weights past PATHWAY_HBM_BYTES — the plane fits
+                 until the draft loads, then OOMs at admission.
 
 Deep rules (``pathway analyze --deep`` / ``pw.run(analysis="deep")``,
 implemented in :mod:`.deep`):
@@ -154,6 +165,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL020": (Severity.WARNING, "effectful node outside the exactly-once contract"),
     "PWL021": (Severity.WARNING, "SLO/watchdog run with chip-time accounting off"),
     "PWL022": (Severity.WARNING, "elastic reshard configured without durable persistence"),
+    "PWL023": (Severity.WARNING, "decode plane leaves prefix caching off / draft overflows HBM"),
 }
 
 #: rule ids that only the deep pass (``pathway analyze --deep`` /
@@ -1440,6 +1452,119 @@ def check_tenancy_without_quotas(view: GraphView) -> list[Diagnostic]:
     return []
 
 
+# --------------------------------------------------------------------------
+# PWL023 — decode plane leaves prefix caching off / draft overflows HBM
+
+
+def check_decode_serving_economics(view: GraphView) -> list[Diagnostic]:
+    """Two decode-plane misconfigurations that cost real money at
+    serving time, both visible jax-free on ``run_context``.
+
+    Arm 1 — *prefix caching off under shareable traffic*: the run
+    configures the decode plane AND serves either multiple tenants
+    (``pw.run(tenancy=...)``) or RAG traffic (a device-backed index in
+    the same program — retrieved-context prompts share the system /
+    template prefix), but ``decode='cache=1'`` is off. Every request
+    then re-prefills the shared prefix the refcounted page table would
+    serve at ~zero cost (one content-hash lookup, COW-shared pages,
+    booked once in the ledger) — measured as tokens/s/chip, that is
+    money left on the table.
+
+    Arm 2 — *draft checkpoint as the HBM straw*: speculative decode is
+    on (``spec_tokens>0``) with an external draft checkpoint declared
+    (``draft_weights=...``; the built-in layer-skip self-draft adds
+    zero weight bytes and never trips this). The KV pool plus the
+    target's weights fit the PATHWAY_HBM_BYTES budget, but adding the
+    draft's ``weights`` booking does not — the plane admits fine until
+    the draft loads, then the ledger (or the device) refuses
+    mid-deploy. Pool/KV sizing uses the shared static footprint model
+    (``internals/ledger``: ``kv_pool_bytes`` at the nominal decoder
+    geometry, ``decoder_weights_bytes`` for the target)."""
+    ctx = getattr(view.graph, "run_context", None) or {}
+    if not ctx:
+        return []  # no pw.run configuration recorded (unit-built graph)
+    decode_cfg = ctx.get("decode") or None
+    if not decode_cfg:
+        return []
+    out: list[Diagnostic] = []
+    tenancy = bool(ctx.get("tenancy"))
+    specs = getattr(view.graph, "external_indexes", None) or []
+    rag = any(s.get("device_backed") for s in specs)
+    if (tenancy or rag) and not decode_cfg.get("prefix_cache"):
+        traffic = []
+        if tenancy:
+            traffic.append("multi-tenant (tenancy=)")
+        if rag:
+            traffic.append("RAG (a device-backed index feeds this run)")
+        out.append(
+            _diag(
+                "PWL023",
+                f"the decode plane serves {' and '.join(traffic)} "
+                "traffic with prefix caching off: every request "
+                "re-prefills the shared system/template prefix that "
+                "decode='cache=1' would serve from refcounted COW "
+                "pages at ~zero cost (content-hash lookup, pages "
+                "booked once in the decode.kv ledger account). Turn "
+                "on prefix_cache — `pathway perf snapshot` reports "
+                "decode_prefix_hit_ratio so the win is measurable",
+                detail={
+                    "decode": decode_cfg,
+                    "tenancy": tenancy,
+                    "rag_indexes": [s for s in specs if s.get("device_backed")],
+                    "prefix_cache": False,
+                },
+            )
+        )
+    draft_bytes = int(decode_cfg.get("draft_weights") or 0)
+    if int(decode_cfg.get("spec_tokens") or 0) > 0 and draft_bytes > 0:
+        from ..internals.ledger import (
+            NOMINAL_DECODER_HIDDEN,
+            NOMINAL_DECODER_LAYERS,
+            decoder_weights_bytes,
+            kv_pool_bytes,
+        )
+
+        budget = _hbm_budget()
+        kv_bytes = kv_pool_bytes(
+            int(decode_cfg.get("pages") or 0),
+            int(decode_cfg.get("page_size") or 0),
+            NOMINAL_DECODER_LAYERS,
+            NOMINAL_DECODER_HIDDEN,
+        )
+        target_bytes = decoder_weights_bytes(
+            NOMINAL_DECODER_LAYERS, NOMINAL_DECODER_HIDDEN
+        )
+        base = kv_bytes + target_bytes
+        # the draft being the *straw* is the point: a plane that
+        # overflows without the draft is PWL015/PWL010 territory
+        if base <= budget < base + draft_bytes:
+            out.append(
+                _diag(
+                    "PWL023",
+                    f"the speculative draft checkpoint "
+                    f"(draft_weights=~{draft_bytes / 1024**2:.0f} MiB) "
+                    "is the straw that overflows HBM: the KV page pool "
+                    f"(~{kv_bytes / 1024**2:.0f} MiB) plus the target "
+                    f"weights (~{target_bytes / 1024**2:.0f} MiB) fit "
+                    f"the {budget / 1024**2:.0f} MiB budget, but adding "
+                    f"the draft needs ~{(base + draft_bytes) / 1024**2:.0f} "
+                    "MiB — the plane deploys, then OOMs when the draft "
+                    "loads. Use the built-in layer-skip self-draft "
+                    "(draft_layers=, zero extra weights), shrink the "
+                    "pool (pages=), or raise PATHWAY_HBM_BYTES",
+                    detail={
+                        "decode": decode_cfg,
+                        "kv_pool_bytes": kv_bytes,
+                        "target_weights_bytes": target_bytes,
+                        "draft_weights_bytes": draft_bytes,
+                        "total_bytes": base + draft_bytes,
+                        "hbm_budget_bytes": budget,
+                    },
+                )
+            )
+    return out
+
+
 LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dtype_consistency,
     check_unbounded_state,
@@ -1459,4 +1584,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_combined_hbm_oversubscription,
     check_tenancy_without_quotas,
     check_elastic_without_persistence,
+    check_decode_serving_economics,
 ]

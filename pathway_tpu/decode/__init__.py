@@ -20,6 +20,7 @@ from .config import (
     use_decode,
 )
 from .metrics import DECODE_METRICS, DecodeMetrics
+from .prefix_cache import PrefixCache
 
 _ENGINE_SYMBOLS = (
     "DecodeEngine",
@@ -42,6 +43,7 @@ __all__ = [
     "decode_greedy",
     "init_decoder_params",
     "parse_decode_spec",
+    "PrefixCache",
     "set_active_decode",
     "use_decode",
 ]

@@ -55,6 +55,44 @@ class DecodeConfig:
     ``impl`` picks the attention path (``auto`` = paged kernel on TPU,
     XLA gather elsewhere; ``interpret`` = Pallas interpret mode, the
     CPU parity path); ``hbm_bytes`` overrides the pool budget check.
+
+    Serving extensions (all default off — the defaults reproduce the
+    original single-token greedy engine byte-for-byte):
+
+    ``prefix_cache``
+        Refcounted hash-addressed sharing of full prompt pages across
+        requests: a request whose prompt starts with an already-cached
+        prefix maps the shared physical pages instead of re-prefilling
+        them. Shared pages are read-only by construction (decode writes
+        land past the prompt) and booked once in the ``decode.kv``
+        ledger account regardless of reference count.
+    ``spec_tokens`` / ``draft_layers`` / ``draft_ngram`` / ``draft_weights``
+        Speculative multi-token steps: a draft proposes ``spec_tokens``
+        tokens per tick and the target verifies them in one batched
+        forward. The default draft is layer-skip self-drafting — the
+        first ``draft_layers`` target layers (0 = half) plus the tied
+        head, so it shares weights *and* KV pages with the target.
+        ``draft_ngram > 0`` selects prompt-lookup drafting instead: the
+        proposal is copied from the last place the stream's trailing
+        n-gram occurred in the lane's own prompt + output, costing zero
+        device time (RAG answers quote their retrieved context, so
+        lookup hits are the common case — the chip ledger's
+        ``decode.draft`` account shows ~0 device-seconds, all the chip
+        time is verify). ``draft_weights`` declares the HBM bytes of an
+        external draft checkpoint for budget math (0 = self-draft, no
+        extra weights). Requires greedy decode (``temperature == 0``):
+        verification is exact argmax equality, so the emitted stream is
+        bitwise the single-token stream.
+    ``prefill_chunk``
+        Prefill at most this many prompt tokens per engine tick
+        (0 = whole prompt in one dispatch), interleaved with decode
+        steps so a long prefill never stalls in-flight decodes. Chunk
+        admission follows deadline order (the AdaptiveBatcher's).
+    ``temperature`` / ``top_k`` / ``top_p`` / ``seed``
+        Sampled decode. Draws are counter-based — keyed on the ticket
+        seed and the absolute token position, never on global RNG
+        state — so recovery replay and co-batching cannot perturb a
+        stream. ``temperature == 0`` is exact greedy (the default).
     """
 
     pages: int = 256
@@ -66,6 +104,16 @@ class DecodeConfig:
     rerank: bool = True
     impl: str = "auto"
     hbm_bytes: int | None = None
+    prefix_cache: bool = False
+    spec_tokens: int = 0
+    draft_layers: int = 0
+    draft_ngram: int = 0
+    draft_weights: int = 0
+    prefill_chunk: int = 0
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
 
     def __post_init__(self):
         if self.pages <= 0:
@@ -86,6 +134,27 @@ class DecodeConfig:
             raise ValueError(f"decode: impl must be one of {_IMPLS}")
         if self.hbm_bytes is not None and self.hbm_bytes <= 0:
             raise ValueError("decode: hbm_bytes must be positive")
+        if self.spec_tokens < 0:
+            raise ValueError("decode: spec_tokens must be >= 0")
+        if self.draft_layers < 0:
+            raise ValueError("decode: draft_layers must be >= 0")
+        if self.draft_ngram < 0:
+            raise ValueError("decode: draft_ngram must be >= 0")
+        if self.draft_weights < 0:
+            raise ValueError("decode: draft_weights must be >= 0")
+        if self.prefill_chunk < 0:
+            raise ValueError("decode: prefill_chunk must be >= 0")
+        if self.temperature < 0:
+            raise ValueError("decode: temperature must be >= 0")
+        if self.top_k < 0:
+            raise ValueError("decode: top_k must be >= 0")
+        if not 0 < self.top_p <= 1:
+            raise ValueError("decode: top_p must be in (0, 1]")
+        if self.spec_tokens > 0 and self.temperature > 0:
+            raise ValueError(
+                "decode: speculative steps require greedy decode "
+                "(temperature=0) — verification is exact argmax equality"
+            )
 
     def as_dict(self) -> dict[str, Any]:
         return {
@@ -98,6 +167,16 @@ class DecodeConfig:
             "rerank": self.rerank,
             "impl": self.impl,
             "hbm_bytes": self.hbm_bytes,
+            "prefix_cache": self.prefix_cache,
+            "spec_tokens": self.spec_tokens,
+            "draft_layers": self.draft_layers,
+            "draft_ngram": self.draft_ngram,
+            "draft_weights": self.draft_weights,
+            "prefill_chunk": self.prefill_chunk,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "top_p": self.top_p,
+            "seed": self.seed,
         }
 
     def pages_per_seq(self) -> int:
@@ -144,7 +223,27 @@ _SPEC_KEYS = {
     "impl": "impl",
     "hbm": "hbm_bytes",
     "hbm_bytes": "hbm_bytes",
+    "cache": "prefix_cache",
+    "prefix_cache": "prefix_cache",
+    "spec": "spec_tokens",
+    "spec_tokens": "spec_tokens",
+    "draft": "draft_layers",
+    "draft_layers": "draft_layers",
+    "ngram": "draft_ngram",
+    "draft_ngram": "draft_ngram",
+    "draft_weights": "draft_weights",
+    "chunk": "prefill_chunk",
+    "prefill_chunk": "prefill_chunk",
+    "temp": "temperature",
+    "temperature": "temperature",
+    "top_k": "top_k",
+    "top_p": "top_p",
+    "seed": "seed",
 }
+
+_BOOL_FIELDS = ("rerank", "prefix_cache")
+_FLOAT_FIELDS = ("temperature", "top_p")
+_BYTES_FIELDS = ("hbm_bytes", "draft_weights")
 
 _OFF = ("off", "none", "0", "false", "no")
 _ON = ("on", "true", "auto", "yes", "1", "")
@@ -159,14 +258,16 @@ def _coerce(kw: dict[str, Any]) -> dict[str, Any]:
                 f"{sorted(set(_SPEC_KEYS))})"
             )
         field = _SPEC_KEYS[key]
-        if field == "rerank":
+        if field in _BOOL_FIELDS:
             if isinstance(value, str):
                 value = value.strip().lower() not in _OFF
             out[field] = bool(value)
         elif field == "impl":
             out[field] = str(value).strip().lower()
-        elif field == "hbm_bytes":
+        elif field in _BYTES_FIELDS:
             out[field] = parse_bytes(value)
+        elif field in _FLOAT_FIELDS:
+            out[field] = float(value)
         else:
             out[field] = int(value)
     return out

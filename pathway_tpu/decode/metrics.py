@@ -43,6 +43,11 @@ class DecodeMetrics:
             self.kv_page_pool = 0
             self.active_lanes = 0
             self.tokens_per_second = 0.0
+            self.prefix_hit_pages_total = 0
+            self.prefix_miss_pages_total = 0
+            self.prefix_cached_pages = 0
+            self.spec_proposed_total = 0
+            self.spec_accepted_total = 0
             self.stages = {s: StageHistogram() for s in DECODE_STAGES}
 
     # -- recording (engine side) --
@@ -74,6 +79,28 @@ class DecodeMetrics:
     def record_preempt(self) -> None:
         with self._lock:
             self.preempted_total += 1
+
+    def record_prefix(self, hit_pages: int, miss_pages: int) -> None:
+        """One prefix-cache lookup: ``hit_pages`` prompt pages mapped
+        from the cache, ``miss_pages`` pages that had to be prefilled.
+        Only ever called with the cache enabled, so the off path keeps
+        these counters at zero and the scrape byte-identical."""
+        with self._lock:
+            self.prefix_hit_pages_total += int(hit_pages)
+            self.prefix_miss_pages_total += int(miss_pages)
+
+    def set_cached_pages(self, n: int) -> None:
+        with self._lock:
+            self.prefix_cached_pages = int(n)
+
+    def record_spec(self, proposed: int, accepted: int) -> None:
+        """One speculative tick: the draft proposed ``proposed`` tokens
+        across live lanes, of which ``accepted`` matched the target's
+        argmax (bonus tokens are not counted — the rate is a pure
+        draft-quality signal)."""
+        with self._lock:
+            self.spec_proposed_total += int(proposed)
+            self.spec_accepted_total += int(accepted)
 
     def set_pool(self, in_use: int, total: int) -> None:
         with self._lock:
@@ -109,8 +136,40 @@ class DecodeMetrics:
                 or self.preempted_total
             )
 
+    def prefix_hit_ratio(self) -> float:
+        """Fraction of looked-up prompt pages served from the cache."""
+        with self._lock:
+            seen = self.prefix_hit_pages_total + self.prefix_miss_pages_total
+            return self.prefix_hit_pages_total / seen if seen else 0.0
+
+    def spec_acceptance_rate(self) -> float:
+        """Fraction of draft proposals the target model confirmed."""
+        with self._lock:
+            if not self.spec_proposed_total:
+                return 0.0
+            return self.spec_accepted_total / self.spec_proposed_total
+
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
+            # Prefix-cache and speculative keys appear only once those
+            # features have recorded something: a cache-off / spec-off
+            # deployment's /status block and /metrics scrape stay
+            # byte-identical to the pre-feature surface.
+            extra: dict[str, Any] = {}
+            seen = self.prefix_hit_pages_total + self.prefix_miss_pages_total
+            if seen:
+                extra["prefix_hit_pages_total"] = self.prefix_hit_pages_total
+                extra["prefix_miss_pages_total"] = self.prefix_miss_pages_total
+                extra["prefix_cached_pages"] = self.prefix_cached_pages
+                extra["prefix_hit_ratio"] = round(
+                    self.prefix_hit_pages_total / seen, 4
+                )
+            if self.spec_proposed_total:
+                extra["spec_proposed_total"] = self.spec_proposed_total
+                extra["spec_accepted_total"] = self.spec_accepted_total
+                extra["spec_acceptance_rate"] = round(
+                    self.spec_accepted_total / self.spec_proposed_total, 4
+                )
             return {
                 "tokens_total": self.tokens_total,
                 "prefill_total": self.prefill_total,
@@ -127,6 +186,7 @@ class DecodeMetrics:
                     for stage, h in self.stages.items()
                     if h.count
                 },
+                **extra,
             }
 
 

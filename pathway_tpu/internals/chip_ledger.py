@@ -14,9 +14,16 @@ account               booked by
 ``index.tier``        tiered-index cold fetch → rescore
 ``rerank``            device cross-encoder scoring
 ``decode``            decode prefill + per-tick step dispatch
+``decode.draft``      speculative tick: draft proposal scan
+``decode.verify``     speculative tick: target verification scan
 ``ingest.stage``      DeviceRing host→device staging copies
 ``compile``           jit cache misses (trace + compile wall)
 ====================  =================================================
+
+Speculative decode splits its tick across ``decode.draft`` and
+``decode.verify`` (never plain ``decode``), so the draft model's cost —
+the overhead speculation pays for its acceptance rate — reads directly
+off the ledger instead of hiding inside the decode plane's total.
 
 The residual between booked device-seconds and wall time is the
 **stranded** chip time — the VectorLiteRAG-style static-partition waste
@@ -61,6 +68,8 @@ PLANE_ACCOUNTS: tuple[str, ...] = (
     "index.tier",
     "rerank",
     "decode",
+    "decode.draft",
+    "decode.verify",
     "ingest.stage",
     "compile",
 )

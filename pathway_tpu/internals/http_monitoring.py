@@ -654,6 +654,22 @@ class MonitoringHttpServer:
         ):
             lines.append(f"# TYPE {metric} {kind}")
             lines.append(series(metric, snap[key]))
+        # prefix-cache / speculative series render only once those
+        # features recorded something (snapshot gates the keys) — the
+        # cache-off / spec-off scrape stays byte-identical
+        for metric, key, kind in (
+            ("pathway_decode_prefix_hit_pages_total", "prefix_hit_pages_total", "counter"),
+            ("pathway_decode_prefix_miss_pages_total", "prefix_miss_pages_total", "counter"),
+            ("pathway_decode_prefix_cached_pages", "prefix_cached_pages", "gauge"),
+            ("pathway_decode_prefix_hit_ratio", "prefix_hit_ratio", "gauge"),
+            ("pathway_decode_spec_proposed_total", "spec_proposed_total", "counter"),
+            ("pathway_decode_spec_accepted_total", "spec_accepted_total", "counter"),
+            ("pathway_decode_spec_acceptance_rate", "spec_acceptance_rate", "gauge"),
+        ):
+            if key not in snap:
+                continue
+            lines.append(f"# TYPE {metric} {kind}")
+            lines.append(series(metric, snap[key]))
         for stage, hist in DECODE_METRICS.stages.items():
             if not hist.count:
                 continue

@@ -136,6 +136,34 @@ def kv_pool_bytes(
 #: the real model geometry at engine construction.
 NOMINAL_DECODER_LAYERS = 4
 NOMINAL_DECODER_HIDDEN = 256
+NOMINAL_DECODER_VOCAB = 32000
+NOMINAL_DECODER_MAX_POSITION = 512
+
+
+def decoder_weights_bytes(
+    layers: int,
+    hidden: int,
+    vocab: int = NOMINAL_DECODER_VOCAB,
+    max_position: int = NOMINAL_DECODER_MAX_POSITION,
+    intermediate: int | None = None,
+    dtype_bytes: int = 4,
+) -> int:
+    """Static ``weights``-account estimate for a GPT-2-style decoder
+    (tied head, learned positions — the ``decode/engine`` geometry).
+    PWL023 uses it to size a speculative *draft* checkpoint from its
+    layer count; live engines book exact ``pytree_nbytes`` instead."""
+    d = int(hidden)
+    f = int(intermediate) if intermediate else 4 * d
+    embed = vocab * d + max_position * d + 2 * d  # tok + pos + final LN
+    per_layer = (
+        2 * d  # ln1
+        + d * 3 * d + 3 * d  # wqkv + bqkv
+        + d * d + d  # wo + bo
+        + 2 * d  # ln2
+        + d * f + f  # w1 + b1
+        + f * d + d  # w2 + b2
+    )
+    return (embed + layers * per_layer) * dtype_bytes
 
 
 def footprint(

@@ -106,9 +106,25 @@ def deep_compile_profile(decode_cfg: dict) -> dict:
     max_seq = int(decode_cfg.get("max_seq") or 512)
     cap = bucket(max_seq, DEFAULT_SEQ_BUCKETS)
     prefill = [s for s in DEFAULT_SEQ_BUCKETS if s <= cap] or [cap]
+    detail: dict = {"prefill_seq_buckets": prefill, "step_programs": 1}
+    compiles = 1 + len(prefill)
+    if decode_cfg.get("spec_tokens"):
+        # speculative serving swaps the step for a draft scan plus a
+        # verify scan — two programs regardless of spec_tokens
+        detail["spec_programs"] = 2
+        compiles += 2
+    if decode_cfg.get("prefix_cache") or decode_cfg.get("prefill_chunk"):
+        # chunked prefill compiles per chunk bucket, capped by the
+        # configured chunk size (or max_seq when only the cache is on)
+        chunk_cap = bucket(
+            int(decode_cfg.get("prefill_chunk") or max_seq), DEFAULT_SEQ_BUCKETS
+        )
+        chunks = [s for s in DEFAULT_SEQ_BUCKETS if s <= chunk_cap] or [chunk_cap]
+        detail["chunk_buckets"] = chunks
+        compiles += len(chunks)
     return {
-        "compiles": 1 + len(prefill),
-        "detail": {"prefill_seq_buckets": prefill, "step_programs": 1},
+        "compiles": compiles,
+        "detail": detail,
         "unbucketed": [],
     }
 
@@ -324,7 +340,16 @@ class PagedKvPool:
     updated functionally by the decode step jits; the allocator is pure
     host bookkeeping (LIFO free list, so recently-evicted pages — hot
     in cache — are reused first). ``alloc`` returning ``None`` is the
-    backpressure signal the scheduler turns into queueing."""
+    backpressure signal the scheduler turns into queueing.
+
+    Pages are refcounted so the prefix cache can map one physical page
+    into many sequences' page tables: ``alloc`` grants at refcount 1,
+    ``share`` adds a holder, ``free`` drops one — the page returns to
+    the free list only when the last holder releases it. A shared page
+    is read-only by convention (every holder's writes land at positions
+    past the shared prefix), which is what makes the sharing safe with
+    the kernel's page-table indirection: two rows of ``page_tables``
+    naming the same physical page read the same bytes, bitwise."""
 
     #: scatter/gather sentinel for unused page-table slots — one past
     #: the pool, so ``mode="drop"`` scatters skip and gathers clamp
@@ -350,30 +375,57 @@ class PagedKvPool:
         self.k = jnp.zeros((layers, n_pages, page_size, dim), dtype)
         self.v = jnp.zeros((layers, n_pages, page_size, dim), dtype)
         self._free = list(range(n_pages - 1, -1, -1))
+        self._refs: dict[int, int] = {}
 
     @property
     def pages_in_use(self) -> int:
+        """Physical pages allocated — what the ``decode.kv`` ledger
+        books. Shared pages count once here no matter how many holders
+        reference them; that is the book-once invariant."""
         return self.n_pages - len(self._free)
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
 
     @property
     def pool_bytes(self) -> int:
         return int(self.k.nbytes) + int(self.v.nbytes)
 
+    def refcount(self, page) -> int:
+        return self._refs.get(int(page), 0)
+
     def alloc(self, n: int) -> list[int] | None:
-        """Take ``n`` pages, or ``None`` (and take nothing) if the pool
-        cannot cover the request — never a partial grant."""
+        """Take ``n`` pages at refcount 1, or ``None`` (and take
+        nothing) if the pool cannot cover the request — never a partial
+        grant."""
         if n < 0:
             raise ValueError("paged kv pool: cannot allocate a negative page count")
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
         return pages
 
+    def share(self, pages) -> None:
+        """Add one holder to each (already-allocated) page."""
+        for p in pages:
+            p = int(p)
+            if p not in self._refs:
+                raise ValueError(f"paged kv pool: cannot share unallocated page {p}")
+        for p in pages:
+            self._refs[int(p)] += 1
+
     def free(self, pages) -> None:
+        """Drop one holder from each page; physically free at zero."""
         for p in pages:
             p = int(p)
             if not 0 <= p < self.n_pages:
                 raise ValueError(f"paged kv pool: page {p} is not in the pool")
-            if p in self._free:
+            if p not in self._refs:
                 raise ValueError(f"paged kv pool: double free of page {p}")
-            self._free.append(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)

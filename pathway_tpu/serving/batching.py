@@ -155,6 +155,19 @@ class AdaptiveBatcher:
                 len(h) for h in self._tenant_heaps.values()
             )
 
+    def next_deadline(self) -> float | None:
+        """``expires_at`` of the most urgent queued item, or ``None``
+        when nothing waits. This is the batcher's dispatch ordering made
+        visible: the decode engine's chunked-prefill scheduler admits
+        the same way (earliest deadline first), so a consumer can ask
+        "is anything queued here more urgent than my current chunk?"
+        without popping."""
+        with self._lock:
+            heads = [h[0][0] for h in self._tenant_heaps.values() if h]
+            if self._heap:
+                heads.append(self._heap[0][0])
+            return min(heads) if heads else None
+
     # -- engine integration --
 
     def attach_engine(self, engine) -> None:

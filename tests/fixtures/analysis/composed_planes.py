@@ -4,7 +4,8 @@ with quotas on every tenant plus a default, and a small decode KV pool.
 Each plane is sized to fit and every rule's fix is in place, so the
 whole composition must lint clean (zero findings) under the full deep
 pass: PWL010/012 see the tier bound, PWL015 sees the combined
-footprint fit, PWL016 sees the quotas, PWL017-020 see clean device
+footprint fit, PWL016 sees the quotas, PWL023 sees prefix caching on
+for the multi-tenant+RAG traffic, PWL017-020 see clean device
 callables and placement that follows the run mesh."""
 
 import pathway_tpu as pw
@@ -47,7 +48,7 @@ pw.io.null.write(res)
 pw.run(
     mesh="data=2",
     index_tiers="hot=10000",
-    decode="pages=64,page=16",
+    decode="pages=64,page=16,cache=1",
     tenancy={
         "quotas": {
             "acme": {"qps": 100.0, "hbm": "8M"},

@@ -1,10 +1,11 @@
-"""Analysis fixture: a device-backed KNN index (20k x 384 f32 ~= 29.4
-MiB) and a decode KV page pool (256 pages x 16 ~= 32 MiB at nominal
-decoder geometry) that each fit the HBM budget alone but jointly
-oversubscribe it — with PATHWAY_HBM_BYTES=48M the verifier must flag
-PWL015 (warning) while PWL010/PWL012 stay silent. Prefix caching is on
-so PWL023 stays out of the way (single-issue fixture). Analyze-only
-never builds either plane, so nothing allocates."""
+"""Analysis fixture: a RAG pipeline — a device-backed KNN index feeding
+retrieval in the same program — whose run configures the decode plane
+with prefix caching off. The verifier must flag PWL023 (warning):
+retrieved-context prompts share the system/template prefix, and
+decode="cache=1" would serve it from refcounted COW pages at ~zero cost
+instead of re-prefilling it per request. The index is small enough to
+fit HBM (PWL010/PWL012 stay silent) and the run is single-tenant, so
+the RAG arm alone carries the diagnostic."""
 
 import pathway_tpu as pw
 from pathway_tpu.stdlib.ml.index import KNNIndex
@@ -34,11 +35,11 @@ index = KNNIndex(
     docs.emb,
     docs,
     n_dimensions=384,
-    reserved_space=20_000,
+    reserved_space=10_000,
     distance_type="cosine",
 )
 res = index.get_nearest_items(queries.emb, k=3)
 
 pw.io.null.write(res)
 
-pw.run(decode="pages=256,page=16,cache=1")
+pw.run(decode="pages=128,page=16,max_new=32")

@@ -1231,3 +1231,136 @@ def test_pwl016_negative_without_run_context():
     _null_sink()
     # unit-built graph, pw.run never described: rule stays quiet
     assert "PWL016" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL023
+
+
+def test_pwl023_multi_tenant_without_prefix_cache(monkeypatch):
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        decode="pages=64,page=16",
+        tenancy="qps=50,inflight=8",
+    )
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL023"]
+    assert len(hits) == 1 and hits[0].severity is Severity.WARNING
+    assert "multi-tenant" in hits[0].message
+    assert "prefix caching off" in hits[0].message
+    assert hits[0].detail["tenancy"] is True
+    assert hits[0].detail["prefix_cache"] is False
+
+
+def test_pwl023_rag_traffic_without_prefix_cache(monkeypatch):
+    _knn_sink(reserved=20_000)
+    _describe_run(monkeypatch, monitoring_level="in_out", decode="pages=64,page=16")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL023"]
+    assert len(hits) == 1
+    assert "RAG" in hits[0].message
+    assert hits[0].detail["rag_indexes"][0]["device_backed"]
+
+
+def test_pwl023_prefix_cache_on_silences(monkeypatch):
+    _knn_sink(reserved=20_000)
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        decode="pages=64,page=16,cache=1",
+        tenancy="qps=50",
+    )
+    assert "PWL023" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl023_negative_single_tenant_no_rag(monkeypatch):
+    # decode alone — no tenancy, no device-backed index: nothing shares
+    # a prefix across requests, nothing to warn about
+    _null_sink()
+    _describe_run(monkeypatch, monitoring_level="in_out", decode="pages=64,page=16")
+    assert "PWL023" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl023_negative_no_decode_plane(monkeypatch):
+    _knn_sink(reserved=20_000)
+    _describe_run(monkeypatch, monitoring_level="in_out", tenancy="qps=50")
+    assert "PWL023" not in _rules(pw.analysis.analyze())
+
+
+def _spec_draft_budget(monkeypatch):
+    """96 MiB budget: the 256x16 KV pool (~32 MiB at nominal geometry)
+    plus the nominal target weights (~44 MiB) fit alone; a 32 MiB draft
+    checkpoint is the straw."""
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(96 * 1024 * 1024))
+
+
+def test_pwl023_draft_weights_overflow_hbm(monkeypatch):
+    _spec_draft_budget(monkeypatch)
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        decode="pages=256,page=16,cache=1,spec=4,draft_weights=32M",
+    )
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL023"]
+    assert len(hits) == 1 and hits[0].severity is Severity.WARNING
+    assert "straw" in hits[0].message
+    detail = hits[0].detail
+    assert detail["draft_weights_bytes"] == 32 * 1024 * 1024
+    base = detail["kv_pool_bytes"] + detail["target_weights_bytes"]
+    assert base <= detail["hbm_budget_bytes"]
+    assert detail["total_bytes"] > detail["hbm_budget_bytes"]
+
+
+def test_pwl023_both_arms_fire_together(monkeypatch):
+    _spec_draft_budget(monkeypatch)
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        decode="pages=256,page=16,spec=4,draft_weights=32M",
+        tenancy="qps=50",
+    )
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL023"]
+    assert len(hits) == 2
+
+
+def test_pwl023_negative_draft_fits_budget(monkeypatch):
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(256 * 1024 * 1024))
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        decode="pages=256,page=16,cache=1,spec=4,draft_weights=32M",
+    )
+    assert "PWL023" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl023_negative_self_draft_books_no_weights(monkeypatch):
+    # the built-in layer-skip self-draft (spec= without draft_weights=)
+    # adds zero weight bytes: never the straw
+    _spec_draft_budget(monkeypatch)
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        decode="pages=256,page=16,cache=1,spec=4,draft=1",
+    )
+    assert "PWL023" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl023_negative_base_already_over_budget(monkeypatch):
+    # the plane overflows even without the draft: PWL015/decode budget
+    # territory, the draft is not the straw
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(48 * 1024 * 1024))
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        decode="pages=256,page=16,cache=1,spec=4,draft_weights=32M",
+    )
+    assert "PWL023" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl023_negative_without_run_context():
+    _knn_sink(reserved=20_000)
+    assert "PWL023" not in _rules(pw.analysis.analyze())

@@ -1472,3 +1472,59 @@ def test_bench_smoke_elastic_miniature_reshard_green(_elastic_reset):
     snap = ELASTIC_METRICS.snapshot()
     assert snap["cutovers_total"] == 1 and snap["rollbacks_total"] == 0
     assert snap["rows_migrated"] == 120
+
+
+def test_bench_smoke_decode_serving_off_scrape_byte_identical(tiny_decoder):
+    """suite_decode_serving gate 4 (PR 19): a decode run with prefix
+    caching, speculation, and sampling all off scrapes /metrics with
+    exactly the pre-serving-feature series — not one prefix/spec line
+    may appear, and turning the features on only ADDS lines (every
+    shared line stays byte-identical)."""
+    from pathway_tpu.decode import DecodeConfig, DecodeEngine
+    from pathway_tpu.decode.metrics import DECODE_METRICS
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+    from pathway_tpu.internals.monitoring import StatsMonitor
+
+    model, cfg, params = tiny_decoder
+    monitor = StatsMonitor()
+    server = MonitoringHttpServer(monitor, port=0)
+    prompts = [[(3 * i + j) % 97 for j in range(3)] for i in range(4)]
+
+    DECODE_METRICS.reset()
+    try:
+        DecodeEngine(model, cfg, params=params).generate(prompts)
+        off = server._prometheus()
+        assert "pathway_decode_tokens_total" in off
+        assert "prefix" not in off and "spec" not in off
+        # the off-path snapshot carries no serving-feature keys at all:
+        # the scrape is byte-identical to the pre-feature plane
+        snap = DECODE_METRICS.snapshot()
+        assert not any("prefix" in k or "spec" in k for k in snap)
+
+        DECODE_METRICS.reset()
+        on_cfg = DecodeConfig(
+            **{**cfg.as_dict(), "prefix_cache": True, "spec_tokens": 3,
+               "draft_ngram": 2}
+        )
+        eng = DecodeEngine(model, on_cfg, params=params)
+        eng.generate(prompts)
+        eng.generate(prompts)  # second pass actually hits the cache
+        on = server._prometheus()
+        assert "pathway_decode_prefix_hit_ratio" in on
+        assert "pathway_decode_spec_acceptance_rate" in on
+        # feature series strictly extend the off-path scrape: every
+        # decode line the off run rendered is still rendered, unchanged
+        # in name (values move with traffic; the SHAPE may only grow)
+        names_off = {
+            ln.split("{")[0].split(" ")[0]
+            for ln in off.splitlines()
+            if ln.startswith("pathway_decode_")
+        }
+        names_on = {
+            ln.split("{")[0].split(" ")[0]
+            for ln in on.splitlines()
+            if ln.startswith("pathway_decode_")
+        }
+        assert names_off < names_on
+    finally:
+        DECODE_METRICS.reset()

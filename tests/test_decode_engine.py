@@ -386,3 +386,223 @@ def test_fused_rag_answer_path_on_chip():
     assert out["tokens"] == again["tokens"]  # greedy decode is reproducible
     bare = pipe.answer("what do pelicans eat", k=2, max_new=4, rerank=False)
     assert len(bare["tokens"]) == 4
+
+
+# ------------------------------------------------- serving spec keys (PR 19)
+
+
+def test_parse_decode_spec_serving_keys():
+    cfg = parse_decode_spec("cache=1,spec=4,draft=1,chunk=8,draft_weights=32M")
+    assert cfg.prefix_cache is True
+    assert cfg.spec_tokens == 4
+    assert cfg.draft_layers == 1
+    assert cfg.prefill_chunk == 8
+    assert cfg.draft_weights == 32 * 1024 * 1024
+    cfg = parse_decode_spec("spec=4,ngram=2")
+    assert (cfg.spec_tokens, cfg.draft_ngram) == (4, 2)
+    cfg = parse_decode_spec("temp=0.5,top_k=10,top_p=0.9,seed=3")
+    assert (cfg.temperature, cfg.top_k, cfg.top_p, cfg.seed) == (0.5, 10, 0.9, 3)
+    with pytest.raises(ValueError, match="greedy"):
+        parse_decode_spec("spec=4,temp=0.5")
+    with pytest.raises(ValueError, match="draft_ngram"):
+        DecodeConfig(draft_ngram=-1)
+
+
+# -------------------------------------------------------- prefix caching
+
+
+def test_prefix_cache_on_streams_equal_cache_off():
+    """The correctness gate: mapping shared pages instead of
+    re-prefilling must not change a single token — cold paths, warm
+    paths, and mixed-prefix batches alike."""
+    shared = [11, 22, 33, 44, 55, 66, 77, 88, 99]
+    prompts = PROMPTS + [shared + [5], shared + [7, 9], shared + [7, 9]]
+    off = _engine().generate(prompts)
+    on = _engine(prefix_cache=True).generate(prompts)
+    assert on == off
+
+
+def test_prefix_cache_warm_hit_skips_prefill_work():
+    shared = [11, 22, 33, 44, 55, 66, 77, 88, 99]
+    eng = _engine(prefix_cache=True)
+    eng.generate([shared + [5]])  # warms the cache
+    assert eng.cache.cached_pages == 2  # (9-1) // page_size=4
+    before = fr.RECORDER._seq
+    eng.generate([shared + [7, 9]])
+    hits = [
+        e for e in fr.RECORDER.events()
+        if e["seq"] > before and e["kind"] == "decode.prefill"
+    ]
+    assert hits and hits[0]["prefix_hit_tokens"] == 8
+    snap = DECODE_METRICS.snapshot()
+    assert snap["prefix_hit_ratio"] > 0
+    assert snap["prefix_cached_pages"] == eng.cache.cached_pages
+
+
+def test_shared_prefix_pages_booked_once_in_flight():
+    """Two co-resident lanes holding the same prefix must book its
+    physical pages once — the ``decode.kv`` ledger invariant, observed
+    mid-flight through ``pool.pages_in_use``."""
+    shared = [11, 22, 33, 44, 55, 66, 77, 88, 99]
+    a, b = shared + [5], shared + [7]
+
+    def admit(cache: bool) -> tuple[int, DecodeEngine]:
+        eng = _engine(prefix_cache=cache, lanes=2)
+        eng.generate([a])  # warm (pages stay cached only when cache=True)
+        eng.submit(a)
+        eng.submit(b)
+        eng.step()  # admission + first decode tick, both lanes resident
+        return eng.pool.pages_in_use, eng
+
+    with_cache, eng_on = admit(True)
+    without, eng_off = admit(False)
+    # the 2 shared prefix pages are booked once instead of once per lane
+    assert with_cache < without
+    assert without - with_cache == 2
+    eng_on.drain()
+    eng_off.drain()
+    assert eng_off.pool.pages_in_use == 0
+    # retired lanes release holds; only the cached prefix remains
+    assert eng_on.pool.pages_in_use == eng_on.cache.cached_pages
+
+
+def test_prefix_cache_reclaims_under_pool_pressure():
+    """A full pool evicts idle cached prefixes instead of queueing."""
+    shared = [11, 22, 33, 44, 55, 66, 77, 88, 99]
+    eng = _engine(prefix_cache=True, pages=8, lanes=1, max_seq=32)
+    eng.generate([shared + [5]])
+    assert eng.cache.cached_pages > 0
+    # a disjoint prompt needing most of the pool forces reclaim
+    t = eng.submit([7] * 13)
+    eng.drain()
+    assert len(t.result()) == CONFIG.max_new_tokens
+    assert t.result() == _engine().generate([[7] * 13])[0]
+
+
+# -------------------------------------------------------- chunked prefill
+
+
+def test_chunked_prefill_streams_equal_unchunked():
+    long = [(3 * i + 1) % 97 for i in range(30)]
+    prompts = PROMPTS + [long]
+    whole = _engine().generate(prompts)
+    chunked = _engine(prefill_chunk=4).generate(prompts)
+    assert chunked == whole
+    combo = _engine(prefill_chunk=4, prefix_cache=True).generate(prompts)
+    assert combo == whole
+
+
+def test_long_prefill_interleaves_with_decode_ticks():
+    """A long chunked prefill must not stall in-flight decodes: the
+    short lane keeps emitting while the long lane is mid-prefill."""
+    eng = _engine(prefill_chunk=2)
+    short = eng.submit(PROMPTS[0])
+    eng.step()
+    emitted_before = len(short.tokens)
+    long = eng.submit([(3 * i + 1) % 97 for i in range(30)])
+    saw_interleave = False
+    while eng.busy() and not long.done.is_set():
+        eng.step()
+        lanes = [ln for ln in eng._lanes if ln is not None]
+        mid_prefill = any(ln.prefilling for ln in lanes)
+        if mid_prefill and len(short.tokens) > emitted_before:
+            saw_interleave = True
+    eng.drain()
+    assert saw_interleave, "short lane starved during the long prefill"
+    assert short.result() == _engine().generate([PROMPTS[0]])[0]
+
+
+# ----------------------------------------------------- speculative decode
+
+
+def test_speculative_layer_skip_streams_equal_greedy():
+    greedy = _engine().generate(PROMPTS)
+    spec = _engine(spec_tokens=3, draft_layers=1).generate(PROMPTS)
+    assert spec == greedy
+    snap = DECODE_METRICS.snapshot()
+    assert 0.0 <= snap["spec_acceptance_rate"] <= 1.0
+
+
+def test_speculative_prompt_lookup_streams_equal_greedy():
+    greedy = _engine().generate(PROMPTS)
+    spec = _engine(spec_tokens=4, draft_ngram=2).generate(PROMPTS)
+    assert spec == greedy
+    assert "spec_acceptance_rate" in DECODE_METRICS.snapshot()
+
+
+def test_speculative_composes_with_cache_and_chunking():
+    shared = [11, 22, 33, 44, 55, 66, 77, 88, 99]
+    prompts = PROMPTS + [shared + [5], shared + [7, 9]]
+    greedy = _engine().generate(prompts)
+    combo = _engine(
+        spec_tokens=3, draft_layers=1, prefix_cache=True, prefill_chunk=3
+    ).generate(prompts)
+    assert combo == greedy
+
+
+def test_chip_ledger_books_draft_and_verify_separately(monkeypatch):
+    from pathway_tpu.internals.chip_ledger import CHIP_LEDGER
+
+    monkeypatch.delenv("PATHWAY_CHIP_LEDGER", raising=False)
+    CHIP_LEDGER.reset()
+    CHIP_LEDGER.set_enabled(True)
+    try:
+        _engine(spec_tokens=3, draft_layers=1).generate(PROMPTS[:2])
+        accounts = CHIP_LEDGER.snapshot()["accounts"]
+        assert accounts["decode.draft"]["seconds"] > 0
+        assert accounts["decode.verify"]["seconds"] > 0
+        CHIP_LEDGER.reset()
+        # prompt-lookup drafting books (near-)zero draft device-seconds:
+        # the verify forward is the tick's only real chip spend
+        _engine(spec_tokens=3, draft_ngram=2).generate(PROMPTS[:2])
+        accounts = CHIP_LEDGER.snapshot()["accounts"]
+        assert accounts["decode.verify"]["seconds"] > accounts["decode.draft"]["seconds"]
+    finally:
+        CHIP_LEDGER.set_enabled(None)
+        CHIP_LEDGER.reset()
+
+
+def test_chaos_kill_mid_spec_tick_then_retry_is_identical():
+    eng = _engine(spec_tokens=3, draft_layers=1)
+    tickets = [eng.submit(p) for p in PROMPTS]
+    chaos.activate([{"site": "decode.step", "time": 1, "action": "raise"}])
+    with pytest.raises(chaos.ChaosInjected):
+        eng.drain()
+    chaos.deactivate()
+    eng.drain()
+    assert [t.result() for t in tickets] == _engine().generate(PROMPTS)
+
+
+# --------------------------------------------------------- sampled decode
+
+
+SAMPLED = dict(temperature=0.7, top_k=5, top_p=0.9, seed=11)
+
+
+def test_sampled_decode_is_deterministic_per_seed():
+    first = _engine(**SAMPLED).generate(PROMPTS)
+    again = _engine(**SAMPLED).generate(PROMPTS)
+    assert first == again
+    other = _engine(**{**SAMPLED, "seed": 12}).generate(PROMPTS)
+    assert first != other  # seed actually reaches the draws
+    greedy = _engine().generate(PROMPTS)
+    assert first != greedy  # temperature actually samples
+
+
+def test_sampled_decode_batching_is_invisible():
+    together = _engine(**SAMPLED).generate(PROMPTS)
+    alone = [_engine(**SAMPLED).generate([p])[0] for p in PROMPTS]
+    assert together == alone
+
+
+def test_sampled_decode_replays_identically_after_chaos():
+    """Counter-based draws: a chaos kill + resume may not perturb a
+    sampled stream (the recovery-replay determinism contract)."""
+    eng = _engine(**SAMPLED)
+    tickets = [eng.submit(p) for p in PROMPTS]
+    chaos.activate([{"site": "decode.step", "time": 2, "action": "raise"}])
+    with pytest.raises(chaos.ChaosInjected):
+        eng.drain()
+    chaos.deactivate()
+    eng.drain()
+    assert [t.result() for t in tickets] == _engine(**SAMPLED).generate(PROMPTS)

@@ -189,3 +189,94 @@ def test_pool_rejects_double_free_and_foreign_pages():
         pool.alloc(-1)
     with pytest.raises(ValueError, match="positive"):
         PagedKvPool(layers=1, dim=8, n_pages=0, page_size=4)
+
+
+# --------------------------------------------- shared-page parity (PR 19)
+
+
+def _shared_vs_private_case(seed, page_size, bucket):
+    """Two sequences that share their physical prefix pages (prefix
+    caching's COW layout) vs the same two sequences with private page
+    copies. Outputs must be bitwise identical: attention only ever
+    reads pages, so aliasing the table entries is invisible."""
+    rng = np.random.default_rng(seed)
+    prefix_pages = max(1, pages_for(bucket, page_size) // 2)
+    lens = [bucket, max(prefix_pages * page_size + 1, bucket - 3)]
+    pages_per_seq = max(pages_for(ln, page_size) for ln in lens)
+    tails = [pages_for(ln, page_size) - prefix_pages for ln in lens]
+    n_pages = prefix_pages * 3 + sum(tails) + 1  # shared + 2 copies + tails
+    q = rng.normal(size=(2, DIM)).astype(np.float32)
+    k_pages = rng.normal(size=(n_pages, page_size, DIM)).astype(np.float32)
+    v_pages = rng.normal(size=(n_pages, page_size, DIM)).astype(np.float32)
+    perm = list(rng.permutation(n_pages - 1))  # keep one sentinel-free slot
+
+    def take(n):
+        return [int(perm.pop()) for _ in range(n)]
+
+    shared = take(prefix_pages)
+    tail_pages = [take(t) for t in tails]
+    copies = [take(prefix_pages) for _ in range(2)]
+    for copy in copies:  # private copies carry identical bytes
+        k_pages[copy] = k_pages[shared]
+        v_pages[copy] = v_pages[shared]
+    aliased = np.full((2, pages_per_seq), n_pages, np.int32)
+    private = np.full((2, pages_per_seq), n_pages, np.int32)
+    for b in range(2):
+        aliased[b, : prefix_pages + tails[b]] = shared + tail_pages[b]
+        private[b, : prefix_pages + tails[b]] = copies[b] + tail_pages[b]
+    lens = jnp.asarray(np.asarray(lens, np.int32))
+    args = (jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages))
+    return args, jnp.asarray(aliased), jnp.asarray(private), lens
+
+
+def _assert_shared_page_parity(seed, page_size, bucket):
+    args, aliased, private, lens = _shared_vs_private_case(
+        seed, page_size, bucket
+    )
+    out_aliased = _assert_bitwise((*args, aliased, lens))
+    out_private = _assert_bitwise((*args, private, lens))
+    assert np.array_equal(out_aliased, out_private), (
+        "aliased prefix pages diverged from private copies "
+        f"(page_size={page_size}, bucket={bucket})"
+    )
+
+
+def test_shared_prefix_pages_score_like_private_copies():
+    """Tier-1 witness of the sweep below: page tables that alias the
+    same physical prefix pages are bitwise equal to private copies."""
+    _assert_shared_page_parity(seed=101, page_size=4, bucket=16)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("page_size", [4, 8, 16])
+@pytest.mark.parametrize("bucket", [16, 32, 64])
+def test_shared_page_parity_sweep(page_size, bucket):
+    """The full (page_size, bucket) sweep of the shared-page layout —
+    interpret mode is slow, so only one combo runs in tier-1."""
+    _assert_shared_page_parity(
+        seed=page_size * 100 + bucket, page_size=page_size, bucket=bucket
+    )
+
+
+def test_pool_share_refcount_lifecycle():
+    """COW sharing contract: ``share`` adds holders, ``free`` drops
+    them, and the physical page only returns to the free list when the
+    last holder lets go — ``pages_in_use`` never double-books."""
+    pool = PagedKvPool(layers=1, dim=8, n_pages=4, page_size=4)
+    pages = pool.alloc(2)
+    assert [pool.refcount(p) for p in pages] == [1, 1]
+    pool.share(pages)
+    pool.share(pages[:1])
+    assert pool.refcount(pages[0]) == 3
+    assert pool.refcount(pages[1]) == 2
+    assert pool.pages_in_use == 2  # three holders, two bookings
+    pool.free(pages)
+    pool.free(pages)
+    assert pool.pages_in_use == 1  # pages[1] fully released
+    assert pool.refcount(pages[0]) == 1
+    pool.free(pages[:1])
+    assert pool.pages_in_use == 0
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(pages[:1])
+    with pytest.raises(ValueError, match="unallocated"):
+        pool.share(pages[:1])

@@ -1,0 +1,294 @@
+"""Prefix cache over the paged-KV pool (decode/prefix_cache): the
+hash-chain lookup/publish contract, refcounted page sharing with the
+book-once ``decode.kv`` accounting invariant, leaf-only LRU eviction,
+pool-pressure reclaim, and the two races the module docstring pins —
+lookup-vs-eviction under the lock and eviction-vs-in-flight-decode
+through the pool's immutable array snapshots."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from pathway_tpu.decode import (
+    DECODE_METRICS,
+    DecodeConfig,
+    DecodeEngine,
+    DecoderConfig,
+    PrefixCache,
+    init_decoder_params,
+)
+from pathway_tpu.ops.paged_attention import PagedKvPool
+from pathway_tpu.resilience import chaos
+
+PAGE = 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    DECODE_METRICS.reset()
+    yield
+    DECODE_METRICS.reset()
+    chaos.deactivate()
+
+
+def _pool(n_pages=16):
+    return PagedKvPool(layers=1, dim=8, n_pages=n_pages, page_size=PAGE)
+
+
+def _cache(pool, version=""):
+    return PrefixCache(pool, page_size=PAGE, model_version=version)
+
+
+def _prefilled(pool, n):
+    pages = pool.alloc(n)
+    assert pages is not None
+    return pages
+
+
+# ------------------------------------------------------- lookup / publish
+
+
+def test_cold_lookup_misses_and_takes_nothing():
+    pool = _pool()
+    cache = _cache(pool)
+    assert cache.lookup(list(range(12))) == []
+    assert pool.pages_in_use == 0
+    assert cache.cached_pages == 0
+
+
+def test_publish_then_lookup_maps_the_shared_pages():
+    pool = _pool()
+    cache = _cache(pool)
+    prompt = list(range(10))  # 2 full pages + partial
+    pages = _prefilled(pool, 3)
+    assert cache.publish(prompt, pages, len(prompt)) == 2
+    assert cache.cached_pages == 2
+    # cache holds its own reference on top of the request's
+    assert pool.refcount(pages[0]) == 2
+    assert pool.refcount(pages[2]) == 1  # partial page never cached
+    hit = cache.lookup(prompt)
+    assert hit == pages[:2]
+    assert pool.refcount(pages[0]) == 3  # lookup acquired for the caller
+
+
+def test_only_full_pages_short_of_the_last_token_are_shareable():
+    pool = _pool()
+    cache = _cache(pool)
+    # 8 tokens = 2 exact pages, but the last token must re-prefill to
+    # produce first-token logits, so only 1 page (7 tokens span) shares
+    pages = _prefilled(pool, 2)
+    assert cache.publish(list(range(8)), pages, 8) == 1
+    assert cache.lookup(list(range(8))) == pages[:1]
+    pool.free(pages[:1])  # release the lookup hold
+
+
+def test_lookup_walks_the_chain_to_the_first_miss():
+    pool = _pool()
+    cache = _cache(pool)
+    a = list(range(20))
+    pages = _prefilled(pool, 4)
+    cache.publish(a, pages, len(a))  # 4 full pages cached... (19//4)
+    # a prompt diverging inside page 2 maps only the agreeing prefix
+    b = a[:6] + [77] * 14
+    assert cache.lookup(b) == pages[:1]
+    pool.free(pages[:1])
+
+
+def test_model_version_keys_the_chain():
+    pool = _pool()
+    prompt = list(range(12))
+    pages = _prefilled(pool, 2)
+    _cache(pool, version="v1").publish(prompt, pages, len(prompt))
+    assert _cache(pool, version="v2").lookup(prompt) == []
+
+
+def test_publish_is_idempotent_for_cached_pages():
+    pool = _pool()
+    cache = _cache(pool)
+    prompt = list(range(10))
+    pages = _prefilled(pool, 3)
+    assert cache.publish(prompt, pages, len(prompt)) == 2
+    assert cache.publish(prompt, pages, len(prompt)) == 0
+    assert pool.refcount(pages[0]) == 2  # no double cache-hold
+
+
+# --------------------------------------------------- book-once accounting
+
+
+def test_shared_pages_book_once_in_pages_in_use():
+    """The ledger invariant: N holders of the same physical prefix are
+    one booking — ``pages_in_use`` counts pages, not references."""
+    pool = _pool()
+    cache = _cache(pool)
+    prompt = list(range(13))  # 3 full pages
+    pages = _prefilled(pool, 4)
+    cache.publish(prompt, pages, len(prompt))
+    base = pool.pages_in_use
+    holds = [cache.lookup(prompt) for _ in range(5)]
+    assert all(h == pages[:3] for h in holds)
+    assert pool.pages_in_use == base  # five sharers, zero new pages
+    for h in holds:
+        pool.free(h)
+    assert pool.pages_in_use == base
+
+
+# ----------------------------------------------------------- eviction
+
+
+def test_reclaim_evicts_lru_leaves_first():
+    pool = _pool()
+    cache = _cache(pool)
+    old = list(range(9))
+    new = [50 + i for i in range(9)]
+    p_old = _prefilled(pool, 2)
+    p_new = _prefilled(pool, 2)
+    cache.publish(old, p_old, 9)
+    cache.publish(new, p_new, 9)
+    pool.free(p_old)  # requests retire; cache holds remain
+    pool.free(p_new)
+    cache.lookup(new) and pool.free(p_new[:2])  # touch new (LRU = old)
+    assert cache.reclaim(2) == 2
+    assert cache.lookup(old) == []  # old evicted...
+    hit = cache.lookup(new)
+    assert hit == p_new[:2]  # ...new survived
+    pool.free(hit)
+
+
+def test_interior_pages_never_outlive_descendants():
+    pool = _pool()
+    cache = _cache(pool)
+    prompt = list(range(13))  # pages: p0 -> p1 -> p2 chain
+    pages = _prefilled(pool, 3)
+    cache.publish(prompt, pages, len(prompt))
+    pool.free(pages)  # only the cache holds now
+    assert cache.reclaim(1) == 1  # evicts the leaf p2
+    assert cache.lookup(prompt) == pages[:2]
+    pool.free(pages[:2])
+    # evicting everything walks leaf-by-leaf without breaking the chain
+    assert cache.reclaim(10) == 2
+    assert cache.cached_pages == 0
+    assert pool.pages_in_use == 0
+
+
+def test_held_pages_are_not_evictable():
+    pool = _pool()
+    cache = _cache(pool)
+    prompt = list(range(9))
+    pages = _prefilled(pool, 2)
+    cache.publish(prompt, pages, 9)
+    # the publishing request still holds its pages: refcount 2 > 1
+    assert cache.reclaim(10) == 0
+    pool.free(pages)
+    assert cache.reclaim(10) == 2
+    assert cache.cached_pages == 0
+
+
+def test_clear_drops_only_idle_entries():
+    pool = _pool()
+    cache = _cache(pool)
+    a, b = list(range(9)), [30 + i for i in range(9)]
+    pa, pb = _prefilled(pool, 2), _prefilled(pool, 2)
+    cache.publish(a, pa, 9)
+    cache.publish(b, pb, 9)
+    pool.free(pb)  # b idle, a still held
+    assert cache.clear() == 2
+    assert cache.cached_pages == 2
+    hit = cache.lookup(a)
+    assert hit == pa[:2]
+    pool.free(hit)
+
+
+# ------------------------------------------------------------- races
+
+
+def test_lookup_racing_reclaim_never_yields_a_freed_page():
+    """The lock contract: a concurrent lookup either acquires the page
+    (reference taken before the lock drops, so eviction skips it) or
+    misses cleanly — it can never hand out a page that reclaim freed."""
+    pool = _pool(n_pages=64)
+    cache = _cache(pool)
+    prompt = list(range(21))
+    pages = _prefilled(pool, 5)
+    cache.publish(prompt, pages, len(prompt))
+    pool.free(pages)  # idle: everything is fair game for reclaim
+    stop = threading.Event()
+    errors: list[Exception] = []
+
+    def hammer_lookup():
+        try:
+            while not stop.is_set():
+                hit = cache.lookup(prompt)
+                # every page handed out is held (>= our ref) right now
+                assert all(pool.refcount(p) >= 1 for p in hit)
+                if hit:
+                    pool.free(hit)
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=hammer_lookup) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for _ in range(200):
+        cache.reclaim(1)
+        if cache.cached_pages == 0:
+            break
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+    cache.clear()
+    # all references eventually returned: the pool is fully reclaimed
+    assert cache.cached_pages == 0
+    assert pool.pages_in_use == 0
+
+
+def test_eviction_between_compute_and_commit_leaves_streams_bitwise():
+    """Satellite gate: pages evicted + reallocated while a decode tick
+    is in flight must not tear KV out from under it. The tick computes
+    against an immutable snapshot of the pool arrays, so we kill a step
+    at the ``decode.step`` chaos site (after compute, before commit),
+    evict the cached prefix, let a new prompt's prefill REUSE those
+    physical pages, and then resume: the survivor's stream must be
+    bitwise what an unchaosed engine produces."""
+    model = DecoderConfig(
+        vocab_size=97, hidden_size=16, num_layers=2, num_heads=2,
+        intermediate_size=32, max_position=64,
+    )
+    params = init_decoder_params(model, seed=0)
+    cfg = DecodeConfig(
+        pages=16, page_size=4, lanes=2, max_new_tokens=6,
+        degrade_max_new_tokens=2, max_seq=32, impl="xla",
+        prefix_cache=True,
+    )
+
+    def fresh():
+        return DecodeEngine(model, cfg, params=params)
+
+    warm = [3, 1, 4, 1, 5, 9, 2, 6, 5]  # publishes 2 full pages
+    victim_prompt = [2, 7, 1, 8, 2, 8]
+    intruder_prompt = [41, 42, 43, 44, 45, 46, 47, 48, 49]
+
+    ref_engine = fresh()
+    ref_engine.generate([warm])
+    ref = ref_engine.generate([victim_prompt])[0]
+
+    eng = fresh()
+    eng.generate([warm])  # cache now holds warm's full pages
+    cached_before = eng.cache.cached_pages
+    assert cached_before > 0
+    victim = eng.submit(victim_prompt)
+    chaos.activate([{"site": "decode.step", "time": eng.steps + 2, "action": "raise"}])
+    with pytest.raises(chaos.ChaosInjected):
+        eng.drain()
+    chaos.deactivate()
+    # mid-flight: evict the idle cached prefix and hand its physical
+    # pages to a new prompt whose prefill overwrites their bytes
+    assert eng.cache.reclaim(cached_before) == cached_before
+    intruder = eng.submit(intruder_prompt)
+    eng.drain()
+    assert victim.result() == ref
+    # the intruder decoded on the recycled pages without corruption
+    assert intruder.result() == fresh().generate([intruder_prompt])[0]

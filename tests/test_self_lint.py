@@ -427,6 +427,44 @@ def test_pwl022_json_carries_elastic_intent():
     assert diag["detail"]["persistence"] is False
 
 
+def test_decode_no_prefix_cache_warns_pwl023():
+    """A RAG pipeline (device-backed index) whose run configures the
+    decode plane with prefix caching off: PWL023 warns (exit 0),
+    nonzero only under --fail-on=warn."""
+    fixture = os.path.join(FIXTURES, "decode_no_prefix_cache.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL023" in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--fail-on=warn")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl023_json_carries_traffic_and_cache_intent():
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "decode_no_prefix_cache.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL023"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["prefix_cache"] is False
+    assert diag["detail"]["rag_indexes"][0]["device_backed"] is True
+    assert diag["detail"]["decode"]["pages"] == 128
+
+
+def test_pwl023_prefix_cache_on_silences_cli(monkeypatch):
+    """The fix the diagnostic suggests (decode cache=1) makes the same
+    RAG+decode shape lint clean — combined_over_hbm.py is that program
+    with prefix caching on (and a budget big enough for both planes)."""
+    monkeypatch.setenv("PATHWAY_HBM_BYTES", str(256 * 1024 * 1024))
+    fixture = os.path.join(FIXTURES, "combined_over_hbm.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL023" not in proc.stdout
+
+
 def test_combined_over_hbm_warns_pwl015(monkeypatch):
     """An index plane and a decode KV pool that each fit the HBM budget
     alone but jointly oversubscribe it: PWL015 warns (exit 0), nonzero

@@ -204,6 +204,19 @@ DESCRIPTIONS: dict[str, str] = {
         "writes are not fenced across restart and the pending reshard "
         "cannot be recovered or rolled back"
     ),
+    "PWL023": (
+        "decode serving economics, two arms. (1) the decode plane serves "
+        "multi-tenant (`tenancy=`) or RAG traffic (a device-backed index in "
+        "the same run) with **prefix caching off**: every request re-prefills "
+        "the shared system/template prefix that `decode=\"cache=1\"` would "
+        "serve from refcounted COW pages at ~zero cost — "
+        "`decode_prefix_hit_ratio` makes the win measurable. (2) a "
+        "speculative **draft checkpoint** (`draft_weights=`) whose weights "
+        "booking is the straw that pushes KV pool + target weights past "
+        "`PATHWAY_HBM_BYTES` — the plane deploys, then OOMs when the draft "
+        "loads. Use the layer-skip self-draft (`draft_layers=`, zero extra "
+        "weights), shrink `pages=`, or raise the budget"
+    ),
 }
 
 
