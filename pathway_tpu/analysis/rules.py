@@ -82,6 +82,16 @@ PWL023 (warning) decode serving economics: the decode plane serves
                  weights booking is the straw that pushes the KV pool +
                  target weights past PATHWAY_HBM_BYTES — the plane fits
                  until the draft loads, then OOMs at admission.
+PWL024 (warning) freshness SLO configured but unmeasurable: a streaming
+                 run arms the watchdog's freshness_warn/freshness_critical
+                 keys while the freshness plane (pw.run(freshness=) /
+                 PATHWAY_FRESHNESS) is off — the rule can never fire
+                 because no watermark is ever measured. Second arm: the
+                 plane is on but the slo_ms budget is tighter than the
+                 floor the pipeline itself imposes (the connectors'
+                 autocommit_duration_ms plus the serving batcher's
+                 batch_window_ms linger), so every answer breaches the
+                 SLO by construction.
 
 Deep rules (``pathway analyze --deep`` / ``pw.run(analysis="deep")``,
 implemented in :mod:`.deep`):
@@ -166,6 +176,7 @@ RULES: dict[str, tuple[Severity, str]] = {
     "PWL021": (Severity.WARNING, "SLO/watchdog run with chip-time accounting off"),
     "PWL022": (Severity.WARNING, "elastic reshard configured without durable persistence"),
     "PWL023": (Severity.WARNING, "decode plane leaves prefix caching off / draft overflows HBM"),
+    "PWL024": (Severity.WARNING, "freshness SLO configured but unmeasurable"),
 }
 
 #: rule ids that only the deep pass (``pathway analyze --deep`` /
@@ -1565,6 +1576,102 @@ def check_decode_serving_economics(view: GraphView) -> list[Diagnostic]:
     return out
 
 
+# --------------------------------------------------------------------------
+# PWL024 — freshness SLO configured but unmeasurable
+
+
+def check_freshness_unmeasurable(view: GraphView) -> list[Diagnostic]:
+    """The run declares a freshness contract it cannot honor. First
+    arm: the watchdog spec carries ``freshness_warn``/
+    ``freshness_critical`` thresholds but the freshness plane
+    (``pw.run(freshness=...)`` / PATHWAY_FRESHNESS) is off — the
+    ``freshness_slo`` watch rule reads the plane's visibility-lag EWMA,
+    and with no watermarks ever measured the rule is dead weight: a
+    staleness regression sails past the very thresholds configured to
+    catch it. Second arm: the plane is on with an ``slo=`` budget
+    tighter than the latency floor the pipeline itself imposes — a
+    streaming connector only *commits* input every
+    ``autocommit_duration_ms`` (so no document can become visible
+    faster than that), and a served answer additionally waits out the
+    adaptive batcher's ``batch_window_ms`` linger. An SLO below that
+    floor breaches on every single answer by construction; the alert
+    is noise, not signal. Intent is recorded on the parse graph by
+    ``pw.run`` (``run_context``: ``freshness``, ``watchdog_freshness``),
+    the connector ops (``autocommit_duration_ms``) and
+    ``rest_connector`` (``serving_endpoints`` carrying
+    ``batch_window_ms``)."""
+    ctx = getattr(view.graph, "run_context", None) or {}
+    if not ctx:
+        return []  # no pw.run configuration recorded (unit-built graph)
+    streaming_ops: list[LogicalOp] = []
+    seen: set[int] = set()
+    for t in view.tables:
+        op = t._op
+        if op.kind == "connector" and id(op) not in seen:
+            seen.add(id(op))
+            streaming_ops.append(op)
+    if not streaming_ops:
+        return []  # bounded static run: freshness is a no-op by design
+    fresh = ctx.get("freshness")
+    out: list[Diagnostic] = []
+    if ctx.get("watchdog_freshness") and fresh is None:
+        out.append(
+            _diag(
+                "PWL024",
+                "the watchdog configures freshness_warn/freshness_critical "
+                "thresholds but the freshness plane is off: the "
+                "freshness_slo rule reads the plane's visibility-lag "
+                "EWMA, so with no watermarks measured it can never "
+                "fire and a staleness regression goes unalerted. Turn "
+                "on pw.run(freshness='slo=...') (or PATHWAY_FRESHNESS) "
+                "so every answer carries a staleness bound the "
+                "watchdog can grade",
+                detail={"watchdog_freshness": True, "freshness": None},
+            )
+        )
+        return out
+    slo_ms = (fresh or {}).get("slo_ms") if isinstance(fresh, dict) else None
+    if slo_ms is None:
+        return out
+    autocommit = max(
+        (
+            float(op.params.get("autocommit_duration_ms") or 0)
+            for op in streaming_ops
+        ),
+        default=0.0,
+    )
+    endpoints = getattr(view.graph, "serving_endpoints", None) or []
+    batch_window = max(
+        (float(e.get("batch_window_ms") or 0) for e in endpoints),
+        default=0.0,
+    )
+    floor_ms = autocommit + batch_window
+    if floor_ms > 0 and float(slo_ms) < floor_ms:
+        parts = [f"autocommit_duration_ms={autocommit:g}"]
+        if batch_window:
+            parts.append(f"batcher batch_window_ms={batch_window:g}")
+        out.append(
+            _diag(
+                "PWL024",
+                f"freshness SLO {float(slo_ms):g}ms is tighter than the "
+                f"{floor_ms:g}ms floor the pipeline imposes "
+                f"({' + '.join(parts)}): no document can become "
+                "visible faster than the connector commits it, so "
+                "every answer breaches the budget by construction. "
+                "Raise the SLO past the floor, or shrink "
+                "autocommit_duration_ms / the batcher window to meet "
+                "it",
+                detail={
+                    "slo_ms": float(slo_ms),
+                    "floor_ms": floor_ms,
+                    "autocommit_duration_ms": autocommit,
+                    "batch_window_ms": batch_window,
+                },
+            )
+        )
+    return out
+
+
 LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_dtype_consistency,
     check_unbounded_state,
@@ -1585,4 +1692,5 @@ LOGICAL_RULES: list[Callable[[GraphView], list[Diagnostic]]] = [
     check_tenancy_without_quotas,
     check_elastic_without_persistence,
     check_decode_serving_economics,
+    check_freshness_unmeasurable,
 ]

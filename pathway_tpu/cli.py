@@ -656,6 +656,38 @@ def top(url, directory, once, interval):
         pass
 
 
+@cli.command()
+@click.option("--url", default=None, help="monitoring server base URL (reads /status)")
+@click.option("--journal", "directory", default=None, help=_JOURNAL_DIR_HELP)
+@click.option("--json", "as_json", is_flag=True, help="emit the raw freshness block")
+def freshness(url, directory, as_json):
+    """Where the visibility lag accrues: per-plane split (ingest queue /
+    staging / epoch / publish / promotion / migration), end-to-end
+    p50/p99, per-index visible watermarks with current staleness, and
+    the verdict against the configured freshness SLO.
+
+    Reads --url's /status when given, else the newest journal sample
+    (--journal / PATHWAY_JOURNAL_DIR). Exits 0 when a freshness sample
+    was rendered, 1 when there is none yet.
+    """
+    import json as _json
+
+    from .freshness.report import render_freshness
+    from .perf.top import load_from_journal, load_status_from_url
+
+    try:
+        data = load_status_from_url(url) if url else load_from_journal(directory)
+    except Exception as exc:
+        raise click.ClickException(str(exc))
+    if as_json:
+        fresh = data.get("freshness")
+        click.echo(_json.dumps(fresh or {}, indent=2, sort_keys=True))
+        sys.exit(0 if fresh else 1)
+    text, state = render_freshness(data)
+    click.echo(text)
+    sys.exit(0 if state != "empty" else 1)
+
+
 def main() -> None:
     cli()
 

@@ -226,6 +226,13 @@ class ElasticIndexHandle:
             self._migrating = False
             self._delta = []
             self.generation = int(generation)
+            # generation-aware watermark carry: the new shard set
+            # inherits the old index-level minimum, so the visible
+            # watermark is monotone across the cutover (no time-travel)
+            # and the dual-answer dedup window serves under it
+            from ..freshness.plane import FRESHNESS
+
+            FRESHNESS.carry_over(old, target, int(generation))
             return old
 
     def end_cutover(self) -> None:
@@ -483,6 +490,11 @@ def reshard(
             CLUSTER_METRICS.set_generation(generation)
         mttr_s = _time.monotonic() - t0
         ELASTIC_METRICS.record_cutover(generation, mttr_s, reason)
+        from ..freshness.plane import FRESHNESS
+
+        # rows finished migrating this much after they were first
+        # visible on the old generation — additive freshness accrual
+        FRESHNESS.accrue("migration", mttr_s)
         for h, _old, _target, _n in migrated:
             h.end_cutover()
         flight_recorder.record(

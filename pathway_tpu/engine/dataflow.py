@@ -42,6 +42,7 @@ from .value import (
 # Eager import so the one-time g++ build of the native runtime happens at
 # engine load, never mid-epoch inside the hot loop.
 from .. import native as _native
+from ..freshness.plane import FRESHNESS
 from ..internals import flight_recorder
 
 # Update = (key: int, row: tuple, diff: int)
@@ -312,10 +313,12 @@ class InputSession:
             self._pending.append((key, row, 1))
             if offsets:
                 self._offsets.update(offsets)
+        FRESHNESS.note_arrival(id(self))
 
     def remove(self, key: int, row: tuple) -> None:
         with self._lock:
             self._pending.append((key, row, -1))
+        FRESHNESS.note_arrival(id(self))
 
     def upsert(self, key: int, row: tuple | None, offsets: dict | None = None) -> None:
         """Replace the current row at key (None row = delete). ``offsets``
@@ -326,6 +329,7 @@ class InputSession:
             self._pending.append((key, row, 2))  # marker; resolved at feed
             if offsets:
                 self._offsets.update(offsets)
+        FRESHNESS.note_arrival(id(self))
 
     def commit(self) -> None:
         with self._lock:
@@ -333,6 +337,7 @@ class InputSession:
                 self._committed.append(self._pending)
                 self._pending = []
             self._committed_offsets = dict(self._offsets)
+        FRESHNESS.note_commit(id(self))
         self.node.graph.wake()
 
     def pending(self) -> bool:
@@ -348,6 +353,7 @@ class InputSession:
                 self._pending = []
             self._committed_offsets = dict(self._offsets)
             self._closed = True
+        FRESHNESS.note_commit(id(self))
         self.node.graph.wake()
 
     def drain(self) -> list[Update] | None:
@@ -357,6 +363,7 @@ class InputSession:
             batches = self._committed
             self._committed = []
             self.node.last_offsets = self._committed_offsets
+        FRESHNESS.note_drain(id(self))
         return [u for b in batches for u in b]
 
     @property
@@ -2293,8 +2300,18 @@ class EngineGraph:
                 # signature mismatch (program changed) → ignore snapshot,
                 # fall back to full input replay
                 if sig_ok:
+                    # restored index contents are visible as-of the
+                    # snapshot epoch: route the restore's index adds
+                    # through the freshness epoch lifecycle so the
+                    # per-shard watermark re-advances to the exact
+                    # pre-crash epoch (wall restarts at recovery time —
+                    # pre-crash arrival timestamps did not survive)
+                    FRESHNESS.begin_epoch(int(t0))
+                    FRESHNESS.epoch_staged(int(t0))
+                    FRESHNESS.epoch_exec(int(t0))
                     for nid, st in data["states"].items():
                         self.nodes[nid].restore_state(st)
+                    FRESHNESS.epoch_committed(int(t0))
                     for s in self.session_sources:
                         s.replay_batches = [
                             (tt, ups) for tt, ups in s.replay_batches if tt > t0
@@ -2425,6 +2442,7 @@ class EngineGraph:
                 t = max(scripted_t, last_time + 1)
             t = max(t, last_time + 1) if t <= last_time else t
             self.current_time = t
+            FRESHNESS.begin_epoch(int(t))
             _epoch_kw = {"t": int(t), "worker": self.worker_id}
             if self.cluster_generation():
                 _epoch_kw["generation"] = self.cluster_generation()
@@ -2452,8 +2470,11 @@ class EngineGraph:
                         s.persistent_id, t, resolved, s.last_offsets or {}
                     )
                     _chaos.inject("engine.after_stage_commit", time=int(t))
+            FRESHNESS.epoch_staged(int(t))
             _sweep0 = _wall.perf_counter()
+            FRESHNESS.epoch_exec(int(t))
             self._topo_pass(t)
+            FRESHNESS.epoch_committed(int(t))
             if self.epoch_observers:
                 self._notify_epoch_observers(int(t), _wall.perf_counter() - _sweep0)
             if self.persistence is not None:

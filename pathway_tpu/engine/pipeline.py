@@ -49,6 +49,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from . import device_ring
+from ..freshness.plane import FRESHNESS
 from ..internals import flight_recorder
 
 __all__ = ["PipelineStats", "StagedEpoch", "run_pipelined"]
@@ -267,6 +268,7 @@ class _Stager(threading.Thread):
             if session_batches and scripted_t is not None:
                 t = max(scripted_t, last_time + 1)
             t = max(t, last_time + 1) if t <= last_time else t
+            FRESHNESS.begin_epoch(int(t))
 
             ep = StagedEpoch(time=t, scripted=scripted_t is not None)
             with self.commit_lock:
@@ -308,6 +310,7 @@ class _Stager(threading.Thread):
                         ep.fed = True
             self.stats.staged_epochs += 1
             self.stats.end("prep")
+            FRESHNESS.epoch_staged(int(t))
             if ist is not None:
                 # host_prep/device_wait attribution feeds the stage's
                 # autoscaler: host-bound epochs grow the worker pool
@@ -346,11 +349,13 @@ def _execute_epoch(engine, ep: StagedEpoch, stats: PipelineStats) -> None:
         s.emit(resolved, t)
 
     stats.begin("exec")
+    FRESHNESS.epoch_exec(int(t))
     cpu0 = _wall.thread_time()
     w0 = _wall.perf_counter()
     engine._topo_pass(t)
     wall = _wall.perf_counter() - w0
     cpu = _wall.thread_time() - cpu0
+    FRESHNESS.epoch_committed(int(t))
     stats.add_device_wait(wall - cpu)
     stats.end("exec")
     if engine.epoch_observers:

@@ -223,6 +223,7 @@ class MonitoringHttpServer:
         lines.extend(self._tenancy_lines(wl))
         lines.extend(self._chip_lines(wl))
         lines.extend(self._elastic_lines(wl))
+        lines.extend(self._freshness_lines(wl))
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -994,6 +995,95 @@ class MonitoringHttpServer:
             )
         return lines
 
+    @staticmethod
+    def _freshness_lines(wl: str = "") -> list[str]:
+        """Freshness plane (``pathway_freshness_*``): per-plane lag
+        accrual (ingest queue / staging / epoch / publish / promotion /
+        migration), the ingest→visible lag histogram, per-index visible
+        watermarks with current staleness, the configured SLO, and
+        per-tenant answer bounds. Rendered only once the plane recorded
+        something, so freshness-off runs scrape byte-identical."""
+        from ..freshness.plane import FRESHNESS
+
+        if not FRESHNESS.active():
+            return []
+
+        def series(name: str, value, labels: str = "") -> str:
+            parts = ",".join(p for p in (labels, wl) if p)
+            return f"{name}{{{parts}}} {value}" if parts else f"{name} {value}"
+
+        snap = FRESHNESS.snapshot()
+        lines = ["# TYPE pathway_freshness_seconds counter"]
+        for plane in sorted(snap["planes"]):
+            row = snap["planes"][plane]
+            lines.append(
+                series(
+                    "pathway_freshness_seconds",
+                    f"{row['seconds']:.6f}",
+                    f'plane="{_escape_label(plane)}"',
+                )
+            )
+        lag = snap["lag"]
+        lines.append("# TYPE pathway_freshness_visibility_lag_seconds histogram")
+        cum = 0
+        for le, count in zip(lag["buckets_s"], lag["hist"]):
+            cum += count
+            lines.append(
+                series(
+                    "pathway_freshness_visibility_lag_seconds_bucket",
+                    cum,
+                    f'le="{le:g}"',
+                )
+            )
+        lines.extend(
+            [
+                series(
+                    "pathway_freshness_visibility_lag_seconds_bucket",
+                    lag["count"],
+                    'le="+Inf"',
+                ),
+                series(
+                    "pathway_freshness_visibility_lag_seconds_sum",
+                    f"{lag['total_s']:.6f}",
+                ),
+                series(
+                    "pathway_freshness_visibility_lag_seconds_count", lag["count"]
+                ),
+            ]
+        )
+        lines.append("# TYPE pathway_freshness_staleness_seconds gauge")
+        for key in sorted(snap["watermarks"]):
+            row = snap["watermarks"][key]
+            lines.append(
+                series(
+                    "pathway_freshness_staleness_seconds",
+                    f"{row['staleness_ms'] / 1000.0:.6f}",
+                    f'index="{_escape_label(key)}",shard="min"',
+                )
+            )
+        if snap["slo_ms"] is not None:
+            lines.extend(
+                [
+                    "# TYPE pathway_freshness_slo_seconds gauge",
+                    series(
+                        "pathway_freshness_slo_seconds",
+                        f"{snap['slo_ms'] / 1000.0:.6f}",
+                    ),
+                ]
+            )
+        tenants = {t: row for t, row in snap["answers"].items() if t}
+        if tenants:
+            lines.append("# TYPE pathway_freshness_answer_staleness_seconds gauge")
+            for t in sorted(tenants):
+                lines.append(
+                    series(
+                        "pathway_freshness_answer_staleness_seconds",
+                        f"{tenants[t]['last_ms'] / 1000.0:.6f}",
+                        f'tenant="{_escape_label(t)}"',
+                    )
+                )
+        return lines
+
     def _status(self) -> str:
         from ..resilience import RETRY_METRICS, SUPERVISOR_METRICS
 
@@ -1067,6 +1157,10 @@ class MonitoringHttpServer:
 
         if ELASTIC_METRICS.active():
             status["elastic"] = ELASTIC_METRICS.snapshot()
+        from ..freshness.plane import FRESHNESS
+
+        if FRESHNESS.active():
+            status["freshness"] = FRESHNESS.snapshot()
         return json.dumps(status)
 
     # -- lifecycle --

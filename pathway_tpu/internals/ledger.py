@@ -405,6 +405,11 @@ DEFAULT_RULES: tuple[WatchRule, ...] = (
         "stranded_chip_time", "chip", "stranded_fraction",
         warn=0.5, critical=0.8,
     ),
+    # freshness_burn = visibility-lag EWMA / freshness SLO, same shape
+    # as p99_burn: 1.0 means answers are exactly as stale as promised
+    WatchRule(
+        "freshness_slo", "freshness", "freshness_burn", warn=0.8, critical=1.0,
+    ),
 )
 
 _LEVEL_RANK = {"ok": 0, "warn": 1, "critical": 2}
@@ -446,6 +451,9 @@ class HealthWatchdog:
         self._ewma_rate = 0.0  # bytes/s EWMA of ledger growth
         self._last_bytes: int | None = None
         self._last_t: float | None = None
+        self._fresh_rate = 0.0  # s/s EWMA of visibility-lag growth
+        self._fresh_last: float | None = None
+        self._fresh_t: float | None = None
         self._samples = 0
         self._breaches = 0
         self._dump_attempted = False
@@ -514,6 +522,17 @@ class HealthWatchdog:
                 sample["chip_accounted_fraction"] = chip["accounted_fraction"]
         except Exception:
             pass
+        try:
+            from ..freshness.plane import FRESHNESS
+
+            if FRESHNESS.active():
+                ewma_ms = FRESHNESS.lag_ewma_ms()
+                if ewma_ms is not None:
+                    sample["freshness_lag_s"] = ewma_ms / 1000.0
+                if FRESHNESS.slo_ms:
+                    sample["freshness_slo_s"] = FRESHNESS.slo_ms / 1000.0
+        except Exception:
+            pass
         return sample
 
     def _derive(self, sample: dict) -> dict:
@@ -549,6 +568,31 @@ class HealthWatchdog:
             deadline = sample.get("deadline_s")
             if p99 is not None and deadline:
                 out["p99_burn"] = float(p99) / float(deadline)
+        if "freshness_burn" not in out:
+            lag = sample.get("freshness_lag_s")
+            slo = sample.get("freshness_slo_s")
+            if lag is not None and slo:
+                lag = float(lag)
+                slo = float(slo)
+                out["freshness_burn"] = lag / slo
+                # lag-trend forecast, same EWMA shape as time-to-OOM:
+                # how long until the smoothed lag growth eats the SLO
+                if self._fresh_last is not None and self._fresh_t is not None:
+                    dt = max(1e-6, float(now) - self._fresh_t)
+                    rate = (lag - self._fresh_last) / dt
+                    alpha = 0.25
+                    self._fresh_rate = (
+                        alpha * rate + (1 - alpha) * self._fresh_rate
+                    )
+                self._fresh_last = lag
+                self._fresh_t = float(now)
+                headroom = slo - lag
+                if headroom <= 0:
+                    out["freshness_time_to_breach_s"] = 0.0
+                elif self._fresh_rate > 1e-9:
+                    out["freshness_time_to_breach_s"] = headroom / self._fresh_rate
+                else:
+                    out["freshness_time_to_breach_s"] = None  # flat or improving
         return out
 
     # -- evaluation --
@@ -677,6 +721,7 @@ class HealthWatchdog:
                 "hbm": LEDGER.snapshot() if LEDGER.active() else None,
                 "tenants": self._tenants_snapshot(),
                 "chip": self._chip_snapshot(),
+                "freshness": self._freshness_snapshot(),
             }
 
     @staticmethod
@@ -691,6 +736,18 @@ class HealthWatchdog:
         if not CHIP_LEDGER.active():
             return None
         return CHIP_LEDGER.snapshot()
+
+    @staticmethod
+    def _freshness_snapshot() -> dict | None:
+        """Freshness-plane block for the verdict (``pathway doctor``'s
+        staleness evidence rows); None unless the plane saw activity."""
+        try:
+            from ..freshness.plane import FRESHNESS
+        except Exception:
+            return None
+        if not FRESHNESS.active():
+            return None
+        return FRESHNESS.snapshot()
 
     @staticmethod
     def _tenants_snapshot() -> dict | None:
@@ -749,6 +806,8 @@ _THRESHOLD_KEYS = {
     "hit_critical": ("hot_hit_ratio", "critical"),
     "stranded_warn": ("stranded_chip_time", "warn"),
     "stranded_critical": ("stranded_chip_time", "critical"),
+    "freshness_warn": ("freshness_slo", "warn"),
+    "freshness_critical": ("freshness_slo", "critical"),
 }
 
 
@@ -897,6 +956,31 @@ def render_verdict(verdict: dict) -> str:
                 f"    encode MFU {mfu.get('mfu', 0.0) * 100:.2f}% "
                 f"({mfu.get('achieved_tflops', 0.0):.1f} / "
                 f"{mfu.get('peak_tflops', 0.0):.1f} TFLOPs)"
+            )
+    fresh = verdict.get("freshness")
+    if fresh:
+        lag = fresh.get("lag") or {}
+        slo_ms = fresh.get("slo_ms")
+        slo_txt = f", slo {slo_ms:g}ms" if slo_ms else ""
+        lines.append(
+            f"  freshness: lag p50 {lag.get('p50_ms', 0.0):.1f}ms / "
+            f"p99 {lag.get('p99_ms', 0.0):.1f}ms "
+            f"(ewma {lag.get('ewma_ms') or 0.0:.1f}ms over "
+            f"{fresh.get('epochs', 0)} epochs{slo_txt})"
+        )
+        planes_acc = fresh.get("planes") or {}
+        acc_txt = ", ".join(
+            f"{p}={row.get('seconds', 0.0) * 1000:.1f}ms"
+            for p, row in planes_acc.items()
+            if row.get("events")
+        )
+        if acc_txt:
+            lines.append(f"    lag accrual: {acc_txt}")
+        for key, row in (fresh.get("watermarks") or {}).items():
+            lines.append(
+                f"    {key:<14} staleness {row.get('staleness_ms', 0.0):8.1f}ms "
+                f"(wm epoch {row.get('wm_epoch', -1)}, "
+                f"{row.get('shards', 0)} shards, gen {row.get('generation', 0)})"
             )
     tenants = verdict.get("tenants")
     if tenants:

@@ -92,6 +92,7 @@ def run(
     decode: Any = None,
     tenancy: Any = None,
     elastic: Any = None,
+    freshness: Any = None,
     cluster_accept_timeout: float | None = None,
     cluster_hello_timeout: float | None = None,
     cluster_lease_ms: float | None = None,
@@ -135,6 +136,19 @@ def run(
     ``chip_ledger=False`` overrides an env-enabled plane. Set
     PATHWAY_JOURNAL_DIR to also sample the ledger (plus the HBM ledger
     and serving/index gauges) into the on-disk metrics journal.
+
+    ``freshness``: turns on the end-to-end freshness plane for this
+    run — per-source event-time watermarks carried from connector
+    arrival through staging, epoch execution and index publish, so
+    every index shard exposes a visible watermark and every served
+    answer carries a staleness bound (REST replies get an
+    ``X-Pathway-Freshness-Ms`` header). ``True``/``"on"`` for
+    defaults; ``"slo=250ms"`` (or ``{"slo_ms": 250}``) additionally
+    sets the freshness SLO budget the watchdog's breach forecast and
+    ``pathway top``'s coloring judge against. Defaults to the
+    PATHWAY_FRESHNESS env var; ``freshness=False`` overrides an
+    env-enabled plane. Surfaced on ``/metrics``/``/status``, the
+    metrics journal, and the ``pathway freshness`` CLI.
 
     ``tenancy``: enables the multi-tenant serving plane for this run —
     ``True``/``"on"`` for defaults, a spec string
@@ -299,11 +313,22 @@ def run(
     # spec raises here, before any sink is built
     from .ledger import parse_watchdog_spec
 
-    _watchdog_cfg = parse_watchdog_spec(
+    _wd_raw = (
         watchdog
         if watchdog is not None
         else (os.environ.get("PATHWAY_WATCHDOG") or None)
     )
+    _watchdog_cfg = parse_watchdog_spec(_wd_raw)
+    # freshness spec parsed jax-free too (freshness/plane.py is
+    # stdlib-only); a malformed spec raises here like watchdog's
+    from ..freshness.plane import parse_freshness_spec
+
+    _freshness_spec = (
+        freshness
+        if freshness is not None
+        else (os.environ.get("PATHWAY_FRESHNESS") or None)
+    )
+    _freshness_cfg = parse_freshness_spec(_freshness_spec)
     # explicit chip_ledger= wins over PATHWAY_CHIP_LEDGER, same shape
     # as tracing; resolved jax-free (chip_ledger.py is stdlib-only)
     from .chip_ledger import CHIP_LEDGER, chip_ledger_enabled
@@ -350,6 +375,11 @@ def run(
         # chip-time accounting intent, resolved jax-free; PWL021
         # (SLO/watchdog run with no chip-time attribution) reads this
         "chip_ledger": _chip_on,
+        # FreshnessConfig knob dict or None; PWL024 (unmeasurable
+        # freshness SLO) reads this plus whether the watchdog spec
+        # tuned freshness thresholds with the plane itself off
+        "freshness": _freshness_cfg.as_dict() if _freshness_cfg is not None else None,
+        "watchdog_freshness": "freshness_" in str(_wd_raw or ""),
     }
     if os.environ.get("PATHWAY_ANALYZE_ONLY"):
         # `pathway analyze <program>`: the graph is fully described at
@@ -414,6 +444,15 @@ def run(
     # nested test runs do not leak the setting)
     _prev_chip = CHIP_LEDGER._override
     CHIP_LEDGER.set_enabled(bool(chip_ledger) if chip_ledger is not None else None)
+    # freshness plane override for this run, same shape (restored on
+    # exit); the SLO budget rides on the plane for watchdog/top/status
+    from ..freshness.plane import FRESHNESS
+
+    _prev_fresh = FRESHNESS._override
+    FRESHNESS.set_enabled(
+        (_freshness_cfg is not None) if freshness is not None else None
+    )
+    FRESHNESS.configure(_freshness_cfg)
     # metrics journal sampler: periodic chip/HBM/serving/index samples
     # under PATHWAY_JOURNAL_DIR for the duration of the run
     _journal_sampler = None
@@ -784,6 +823,7 @@ def run(
                 # writes one final sample (the run's parting state)
                 _journal_sampler.stop()
             CHIP_LEDGER.set_enabled(_prev_chip)
+            FRESHNESS.set_enabled(_prev_fresh)
     try:
         from ..io.http._server import bound_serving_ports
 

@@ -295,7 +295,13 @@ def input_table_from_reader(
         )
         for n, t in dtypes.items()
     }
-    op = LogicalOp("connector", [], {"build": build})
+    # the commit cadence rides on the op so jax-free analysis (PWL024:
+    # freshness SLO tighter than the autocommit floor) can read it
+    op = LogicalOp(
+        "connector",
+        [],
+        {"build": build, "autocommit_duration_ms": autocommit_duration_ms},
+    )
     out = Table(cols, Universe(), op, name=name)
     out._universe_append_only = schema_ao
     return out

@@ -40,6 +40,11 @@ except ImportError:  # pragma: no cover
 
 logger = logging.getLogger(__name__)
 
+#: Answer-level staleness bound (ms) stamped on every REST reply while
+#: the freshness plane is live: any row this reply could have seen was
+#: visible at most this many milliseconds ago.
+FRESHNESS_HEADER = "X-Pathway-Freshness-Ms"
+
 #: Every started webserver registers here so ``pw.run`` can surface
 #: the actually-bound serving ports on RunResult (parity with the
 #: monitoring server's ``monitoring_http_port``).
@@ -206,6 +211,7 @@ def rest_connector(
         SERVING_METRICS,
         AdaptiveBatcher,
     )
+    from ...freshness.plane import FRESHNESS
     from ...tenancy.config import TENANT_HEADER, active_tenancy
     from ...tracing import (
         TRACE_RESPONSE_HEADER,
@@ -225,6 +231,8 @@ def rest_connector(
             "kind": "rest_connector",
             "protected": serving is not None,
             "deadline_ms": serving.default_deadline_ms if serving is not None else None,
+            # PWL024 folds the batcher linger into the freshness floor
+            "batch_window_ms": serving.batch_window_ms if serving is not None else None,
         }
     )
 
@@ -288,6 +296,26 @@ def rest_connector(
                 if trace_id:
                     headers = dict(headers or {})
                     headers[TRACE_RESPONSE_HEADER] = trace_id
+                if FRESHNESS.active():
+                    # answer-level staleness bound: now − min(visible
+                    # watermark) over every registered index — the
+                    # conservative bound any data this reply saw obeys
+                    bound = FRESHNESS.answer_bound()
+                    if bound is not None:
+                        headers = dict(headers or {})
+                        headers[FRESHNESS_HEADER] = (
+                            f"{bound['staleness_ms']:.1f}"
+                        )
+                        # the reply is a served answer: record its
+                        # staleness under the requesting tenant
+                        FRESHNESS.observe_answer(tenant=tenant)
+                        if root_sp is not None:
+                            root_sp.attrs["freshness_ms"] = round(
+                                bound["staleness_ms"], 3
+                            )
+                            root_sp.attrs["freshness_wm_epoch"] = bound[
+                                "wm_epoch"
+                            ]
                 log_ctx.log_response(status)
                 return web.json_response(data, status=status, headers=headers)
 

@@ -471,6 +471,15 @@ class FusedRagPipeline:
         fslots = np.asarray(fslots)
         fvals = np.asarray(fvals)
         gen = np.asarray(gen)
+        # generated answers inherit the retrieval staleness bound: the
+        # tokens are conditioned on hits no staler than the index's
+        # visible watermark at dispatch (key present only when the
+        # freshness plane is live, so plane-off outputs are unchanged)
+        from ..freshness.plane import FRESHNESS
+
+        bound = (
+            FRESHNESS.observe_answer(self.index) if FRESHNESS.active() else None
+        )
         out: list[dict[str, Any]] = []
         for qi in range(len(texts)):
             hits: list[tuple[Any, float]] = []
@@ -481,9 +490,13 @@ class FusedRagPipeline:
                 if key is None:
                     continue
                 hits.append((key, float(val)))
-            out.append(
-                {"hits": hits[:k], "tokens": [int(t) for t in gen[qi]]}
-            )
+            row: dict[str, Any] = {
+                "hits": hits[:k],
+                "tokens": [int(t) for t in gen[qi]],
+            }
+            if bound is not None:
+                row["freshness_ms"] = round(bound["staleness_ms"], 3)
+            out.append(row)
         return out
 
     def answer(self, text: str, **kw) -> dict[str, Any]:

@@ -35,6 +35,8 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..freshness.plane import FRESHNESS
+
 _NEG = -3.0e38
 
 _NAME_SEQ = itertools.count()
@@ -770,6 +772,7 @@ class DeviceKnnIndex:
         if not self._full:
             for i, slot in enumerate(slots):
                 self._pending[slot] = vecs[i]
+        FRESHNESS.note_index_add(self, {s // self.shard_capacity for s in slots})
         self._publish_metrics()
 
     def add_batch_device(self, keys, dev_vectors, metadatas=None) -> None:
@@ -858,6 +861,9 @@ class DeviceKnnIndex:
             self._slot_of[key] = int(slot)
             if metadatas is not None and metadatas[i] is not None:
                 self._meta[key] = metadatas[i]
+        FRESHNESS.note_index_add(
+            self, {int(s) // self.shard_capacity for s in real}
+        )
         self._publish_metrics()
 
     def remove(self, key) -> None:
@@ -873,6 +879,7 @@ class DeviceKnnIndex:
         self._docs_shard[shard] -= 1
         if not self._full:
             self._pending[slot] = None
+        FRESHNESS.note_index_add(self, (shard,))
         self._publish_metrics()
 
     # --- elastic reshard protocol (elastic/controller.py drives) ---
@@ -1194,6 +1201,9 @@ class DeviceKnnIndex:
         from .index_metrics import INDEX_METRICS
 
         merge_s = getattr(self, "_last_merge_s", None)
+        # every answer served off this index carries the staleness bound
+        # now − min(visible watermark over the shards touched)
+        FRESHNESS.observe_answer(self)
         INDEX_METRICS.record_search(self.name, n_queries)
         flight_recorder.record(
             "index.search",

@@ -944,6 +944,9 @@ class TieredKnnIndex:
         chunks leaves keys hot-resident AND still listed cold; search
         dedups (hot wins) and the cold entry is cleared on retry, so
         nothing is lost or duplicated."""
+        import time as _wall
+
+        from ..freshness.plane import FRESHNESS
         from ..resilience import chaos
 
         free = [len(f) for f in self.hot._free_shard]
@@ -956,6 +959,8 @@ class TieredKnnIndex:
         if not keys:
             return 0
         moved = 0
+        _t0 = _wall.perf_counter()
+        touched: set[int] = set()
         half = max(1, len(keys) // 2)
         for chunk in (keys[:half], keys[half:]):
             if not chunk:
@@ -968,11 +973,17 @@ class TieredKnnIndex:
                 chunk, vecs, [self._meta.get(key) for key in chunk]
             )
             for key in chunk:
+                sh = _shard_of_key(key, self.n_shards)
+                touched.add(sh)
                 self._cold_keys[c].discard(key)
-                self._cold_docs_shard[_shard_of_key(key, self.n_shards)] -= 1
+                self._cold_docs_shard[sh] -= 1
                 self._cold_total -= 1
             moved += len(chunk)
         self._promotions += 1
+        # promotion-completion watermark: the promoted cluster is fully
+        # hot-resident now; the wall spent is off-hot-path lag accrual
+        FRESHNESS.accrue("promotion", _wall.perf_counter() - _t0)
+        FRESHNESS.note_index_add(self, touched)
         self._tier_event("index.tier.promote", c, moved)
         return moved
 

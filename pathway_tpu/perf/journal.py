@@ -186,6 +186,13 @@ class MetricsJournal:
                 payload["tenancy"] = TENANCY_METRICS.snapshot()
         except Exception:
             pass
+        try:
+            from ..freshness.plane import FRESHNESS
+
+            if FRESHNESS.active():
+                payload["freshness"] = FRESHNESS.snapshot()
+        except Exception:
+            pass
         return self.append("sample", payload)
 
     def close(self) -> None:

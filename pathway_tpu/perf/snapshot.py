@@ -123,6 +123,13 @@ def _direction(metric: str, unit: str) -> str:
         return "higher"
     if u in _LOWER_UNITS or m.endswith(("_ms", "_s", "_seconds", "_bytes")):
         return "lower"
+    # freshness metrics are lags/staleness: lower is better, even the
+    # unitless ones — except coverage/fraction gauges, which carry
+    # their own absolute gate and grade higher-is-better
+    if m.startswith(("freshness_", "staleness_")) and not m.endswith(
+        ("coverage", "fraction")
+    ):
+        return "lower"
     return "two_sided"
 
 

@@ -69,54 +69,79 @@ def render_top(data: dict[str, Any]) -> tuple[str, str]:
             "  (no chip-time samples — enable with pw.run(chip_ledger=True) "
             "or PATHWAY_CHIP_LEDGER=1)"
         )
-        return "\n".join(lines), state
-
-    wall = float(chip.get("wall_seconds", 0.0))
-    busy = float(chip.get("busy_seconds", 0.0))
-    lines.append(
-        f"  wall {_fmt_s(wall).strip()}  busy {_fmt_s(busy).strip()}  "
-        f"accounted {100 * float(chip.get('accounted_fraction', 0.0)):.1f}%  "
-        f"[{state}]"
-    )
-
-    accounts = chip.get("accounts") or {}
-    if accounts:
-        lines.append(f"  {'plane':<14} {'chip-time':>10} {'share':>7} {'dispatches':>11}")
-        for name, row in accounts.items():
-            lines.append(
-                f"  {name:<14} {_fmt_s(float(row.get('seconds', 0.0))):>10} "
-                f"{100 * float(row.get('share', 0.0)):>6.1f}% "
-                f"{int(row.get('dispatches', 0)):>11}"
-            )
-
-    mfu = chip.get("encode_mfu")
-    if mfu:
+        # a freshness-only session still gets its row below
+        fresh = data.get("freshness")
+        if not (isinstance(fresh, dict) and fresh):
+            return "\n".join(lines), state
+        state = "green"
+    else:
+        wall = float(chip.get("wall_seconds", 0.0))
+        busy = float(chip.get("busy_seconds", 0.0))
         lines.append(
-            f"  encode MFU {100 * float(mfu.get('mfu', 0.0)):.2f}%  "
-            f"({float(mfu.get('achieved_tflops', 0.0)):.1f} / "
-            f"{float(mfu.get('peak_tflops', 0.0)):.1f} TFLOPs, "
-            f"pad {100 * float(mfu.get('pad_fraction', 0.0)):.1f}%)"
+            f"  wall {_fmt_s(wall).strip()}  busy {_fmt_s(busy).strip()}  "
+            f"accounted {100 * float(chip.get('accounted_fraction', 0.0)):.1f}%  "
+            f"[{state}]"
         )
 
-    stranded = float(chip.get("stranded_fraction", 0.0))
-    causes = chip.get("stranded_causes") or {}
-    cause_txt = ", ".join(
-        f"{c}={_fmt_s(float(s)).strip()}" for c, s in causes.items()
-    )
-    lines.append(
-        f"  stranded {100 * stranded:.1f}%"
-        + (f"  ({cause_txt})" if cause_txt else "")
-    )
-
-    tenants = chip.get("tenants") or {}
-    if tenants:
-        lines.append(f"  {'tenant':<14} {'chip share':>10} {'drr weight':>11}")
-        for t, row in tenants.items():
-            ws = row.get("weight_share")
-            ws_txt = f"{100 * float(ws):>10.1f}%" if ws is not None else f"{'—':>11}"
+        accounts = chip.get("accounts") or {}
+        if accounts:
             lines.append(
-                f"  {t:<14} {100 * float(row.get('share', 0.0)):>9.1f}% {ws_txt}"
+                f"  {'plane':<14} {'chip-time':>10} {'share':>7} {'dispatches':>11}"
             )
+            for name, row in accounts.items():
+                lines.append(
+                    f"  {name:<14} {_fmt_s(float(row.get('seconds', 0.0))):>10} "
+                    f"{100 * float(row.get('share', 0.0)):>6.1f}% "
+                    f"{int(row.get('dispatches', 0)):>11}"
+                )
+
+        mfu = chip.get("encode_mfu")
+        if mfu:
+            lines.append(
+                f"  encode MFU {100 * float(mfu.get('mfu', 0.0)):.2f}%  "
+                f"({float(mfu.get('achieved_tflops', 0.0)):.1f} / "
+                f"{float(mfu.get('peak_tflops', 0.0)):.1f} TFLOPs, "
+                f"pad {100 * float(mfu.get('pad_fraction', 0.0)):.1f}%)"
+            )
+
+        stranded = float(chip.get("stranded_fraction", 0.0))
+        causes = chip.get("stranded_causes") or {}
+        cause_txt = ", ".join(
+            f"{c}={_fmt_s(float(s)).strip()}" for c, s in causes.items()
+        )
+        lines.append(
+            f"  stranded {100 * stranded:.1f}%"
+            + (f"  ({cause_txt})" if cause_txt else "")
+        )
+
+        tenants = chip.get("tenants") or {}
+        if tenants:
+            lines.append(f"  {'tenant':<14} {'chip share':>10} {'drr weight':>11}")
+            for t, row in tenants.items():
+                ws = row.get("weight_share")
+                ws_txt = (
+                    f"{100 * float(ws):>10.1f}%" if ws is not None else f"{'—':>11}"
+                )
+                lines.append(
+                    f"  {t:<14} {100 * float(row.get('share', 0.0)):>9.1f}% {ws_txt}"
+                )
+
+    fresh = data.get("freshness")
+    if isinstance(fresh, dict) and fresh:
+        from ..freshness.report import freshness_state
+
+        fstate = freshness_state(fresh)
+        lag = fresh.get("lag") or {}
+        slo_ms = fresh.get("slo_ms")
+        slo_txt = f"  slo {float(slo_ms):.0f}ms" if slo_ms else ""
+        lines.append(
+            f"  freshness p50 {float(lag.get('p50_ms', 0.0)):.1f}ms  "
+            f"p99 {float(lag.get('p99_ms', 0.0)):.1f}ms  "
+            f"ewma {float(lag.get('ewma_ms') or 0.0):.1f}ms{slo_txt}  [{fstate}]"
+        )
+        # freshness SLO breach outranks a green stranded verdict
+        if fstate == "red" or (fstate == "yellow" and state == "green"):
+            state = fstate
 
     hbm = data.get("hbm")
     if isinstance(hbm, dict) and hbm:

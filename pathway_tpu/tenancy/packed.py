@@ -350,6 +350,11 @@ class TenantPackedIndex(DeviceKnnIndex):
         if q.ndim == 1:
             q = q[None, :]
         TENANCY_METRICS.record_search(tenant, len(q))
+        from ..freshness.plane import FRESHNESS
+
+        # per-tenant staleness attribution (the base _record_search
+        # already records the untagged answer bound)
+        FRESHNESS.observe_answer(self, tenant=tenant)
         self._note_hit(tenant)
         self._maybe_sweep(exclude=tenant)
         if tenant in self._cold:

@@ -1,0 +1,22 @@
+"""Analysis fixture: a streaming run arms the watchdog's
+freshness_warn/freshness_critical thresholds while the freshness plane
+(``pw.run(freshness=)`` / PATHWAY_FRESHNESS) is off — the freshness_slo
+watch rule reads the plane's visibility-lag EWMA, so with no watermarks
+ever measured it can never fire. The verifier must flag PWL024
+(warning). ``chip_ledger=True`` keeps PWL021 quiet (this fixture is
+about the freshness plane, not chip-time accounting); the stream feeds
+no stateful operator (PWL002 quiet) and no device index (PWL011
+quiet)."""
+
+import pathway_tpu as pw
+
+docs = pw.demo.range_stream(nb_rows=5, input_rate=1000.0)
+
+out = docs.select(doubled=pw.this.value * 2)
+
+pw.io.null.write(out)
+
+pw.run(
+    watchdog="interval=1,freshness_warn=0.8,freshness_critical=1.0",
+    chip_ledger=True,
+)

@@ -1364,3 +1364,105 @@ def test_pwl023_negative_base_already_over_budget(monkeypatch):
 def test_pwl023_negative_without_run_context():
     _knn_sink(reserved=20_000)
     assert "PWL023" not in _rules(pw.analysis.analyze())
+
+
+# ---------------------------------------------------------------- PWL024
+
+
+def _stream_sink(autocommit_ms: int = 1000):
+    docs = pw.demo.range_stream(
+        nb_rows=5, input_rate=1000.0, autocommit_duration_ms=autocommit_ms
+    )
+    pw.io.null.write(docs.select(doubled=pw.this.value * 2))
+
+
+def test_pwl024_watchdog_freshness_keys_with_plane_off(monkeypatch):
+    monkeypatch.delenv("PATHWAY_FRESHNESS", raising=False)
+    _stream_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        watchdog="interval=1,freshness_warn=0.8,freshness_critical=1.0",
+        chip_ledger=True,
+    )
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL024"]
+    assert len(hits) == 1 and hits[0].severity is Severity.WARNING
+    assert "never" in hits[0].message
+    assert hits[0].detail["watchdog_freshness"] is True
+    assert hits[0].detail["freshness"] is None
+
+
+def test_pwl024_slo_tighter_than_autocommit_floor(monkeypatch):
+    _stream_sink(autocommit_ms=500)
+    _describe_run(monkeypatch, monitoring_level="in_out", freshness="slo=100ms")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL024"]
+    assert len(hits) == 1 and hits[0].severity is Severity.WARNING
+    assert "floor" in hits[0].message
+    assert hits[0].detail["slo_ms"] == 100.0
+    assert hits[0].detail["floor_ms"] == 500.0
+    assert hits[0].detail["autocommit_duration_ms"] == 500.0
+
+
+def test_pwl024_batcher_linger_folds_into_floor(monkeypatch):
+    # the rest connector commits every 50ms; alone that clears a 60ms
+    # SLO, but the serving batcher's 30ms linger pushes the floor to 80
+    _rest_endpoint(serving=pw.ServingConfig(batch_window_ms=30.0))
+    _describe_run(monkeypatch, monitoring_level="in_out", freshness="slo=60ms")
+    hits = [d for d in pw.analysis.analyze() if d.rule == "PWL024"]
+    assert len(hits) == 1
+    assert hits[0].detail["autocommit_duration_ms"] == 50.0
+    assert hits[0].detail["batch_window_ms"] == 30.0
+    assert hits[0].detail["floor_ms"] == 80.0
+
+
+def test_pwl024_freshness_env_silences(monkeypatch):
+    # the fix the diagnostic suggests: PATHWAY_FRESHNESS turns the
+    # plane on, so the watchdog's freshness rule has a signal
+    monkeypatch.setenv("PATHWAY_FRESHNESS", "1")
+    _stream_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        watchdog="interval=1,freshness_critical=1.0",
+        chip_ledger=True,
+    )
+    assert "PWL024" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl024_negative_slo_clears_floor(monkeypatch):
+    _stream_sink(autocommit_ms=500)
+    _describe_run(monkeypatch, monitoring_level="in_out", freshness="slo=2000ms")
+    assert "PWL024" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl024_negative_plane_on_without_slo(monkeypatch):
+    # plane on, no slo budget: nothing to grade against the floor, and
+    # arm 1 is satisfied — the watchdog's freshness rule has a signal
+    _stream_sink(autocommit_ms=500)
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        watchdog="interval=1,freshness_critical=1.0",
+        chip_ledger=True,
+        freshness=True,
+    )
+    assert "PWL024" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl024_negative_bounded_run(monkeypatch):
+    # no streaming connector: freshness is a no-op by design, the
+    # watchdog keys are harmless dead config on a bounded run
+    monkeypatch.delenv("PATHWAY_FRESHNESS", raising=False)
+    _null_sink()
+    _describe_run(
+        monkeypatch,
+        monitoring_level="in_out",
+        watchdog="interval=1,freshness_critical=1.0",
+        chip_ledger=True,
+    )
+    assert "PWL024" not in _rules(pw.analysis.analyze())
+
+
+def test_pwl024_negative_without_run_context():
+    _stream_sink()
+    assert "PWL024" not in _rules(pw.analysis.analyze())

@@ -1,0 +1,843 @@
+"""End-to-end freshness plane: per-shard visible watermarks, answer
+staleness bounds, and freshness SLOs.
+
+Covers the plane registry itself (spec parsing, activity gating,
+arrival→epoch→publish lifecycle, monotone watermarks, elastic
+carry-over), the engine integration (a streaming run with the plane on
+accrues a per-plane lag split that covers the measured end-to-end lag),
+the answer-bound surfaces, the watchdog's freshness_slo rule with its
+breach forecast, the report renderer, the /metrics and /status and
+journal blocks, and the two cross-feature guarantees: watermark
+monotonicity across a live elastic 2→4→2 reshard, and exact watermark
+re-advance (with byte-identical answers) across persistence-replay
+recovery.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.freshness import (
+    FRESHNESS,
+    FreshnessConfig,
+    LAG_BUCKETS_S,
+    PLANES,
+    freshness_enabled,
+    parse_freshness_spec,
+    render_freshness,
+)
+from pathway_tpu.freshness.report import freshness_state
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane(monkeypatch):
+    monkeypatch.delenv("PATHWAY_FRESHNESS", raising=False)
+    FRESHNESS.reset()
+    FRESHNESS.set_enabled(None)
+    yield
+    FRESHNESS.reset()
+    FRESHNESS.set_enabled(None)
+    pw.clear_graph()
+
+
+class _Idx:
+    """Duck index: a name is all the plane keys on."""
+
+    def __init__(self, name, n_shards=2):
+        self.name = name
+        self.n_shards = n_shards
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+
+
+def test_parse_freshness_spec_forms():
+    assert parse_freshness_spec(None) is None
+    assert parse_freshness_spec(False) is None
+    assert parse_freshness_spec("off") is None
+    assert parse_freshness_spec("0") is None
+    assert parse_freshness_spec(True) == FreshnessConfig()
+    assert parse_freshness_spec("on") == FreshnessConfig()
+    assert parse_freshness_spec("1") == FreshnessConfig()
+    assert parse_freshness_spec("slo=250ms") == FreshnessConfig(slo_ms=250.0)
+    assert parse_freshness_spec("slo=0.25s") == FreshnessConfig(slo_ms=250.0)
+    assert parse_freshness_spec("slo_ms=250") == FreshnessConfig(slo_ms=250.0)
+    assert parse_freshness_spec({"slo_ms": 250}) == FreshnessConfig(slo_ms=250.0)
+    assert parse_freshness_spec(FreshnessConfig(slo_ms=9.0)).slo_ms == 9.0
+    assert FreshnessConfig(slo_ms=250.0).as_dict() == {"slo_ms": 250.0}
+
+
+def test_parse_freshness_spec_rejects_malformed():
+    for bad in ("wat", "nope=1", {"nope": 1}, 3.5, [1], "slo=abc"):
+        with pytest.raises(ValueError):
+            parse_freshness_spec(bad)
+
+
+def test_freshness_enabled_env(monkeypatch):
+    assert not freshness_enabled()
+    monkeypatch.setenv("PATHWAY_FRESHNESS", "1")
+    assert freshness_enabled()
+    monkeypatch.setenv("PATHWAY_FRESHNESS", "slo=2s")
+    assert freshness_enabled()
+    monkeypatch.setenv("PATHWAY_FRESHNESS", "off")
+    assert not freshness_enabled()
+    # malformed env counts as off, never raises at import/hook time
+    monkeypatch.setenv("PATHWAY_FRESHNESS", "wat")
+    assert not freshness_enabled()
+
+
+# ---------------------------------------------------------------------------
+# gating
+
+
+def test_plane_off_is_inert():
+    assert not FRESHNESS.enabled()
+    FRESHNESS.note_arrival(1)
+    FRESHNESS.begin_epoch(0)
+    FRESHNESS.note_index_add(_Idx("a"), (0,))
+    FRESHNESS.epoch_committed(0)
+    FRESHNESS.accrue("promotion", 1.0)
+    assert not FRESHNESS.active()
+    assert FRESHNESS.answer_bound() is None
+    assert FRESHNESS.visible_wm(_Idx("a")) is None
+
+
+def test_enable_override_and_activity_gate():
+    FRESHNESS.set_enabled(True)
+    assert FRESHNESS.enabled()
+    assert not FRESHNESS.active()  # enabled but untouched: still silent
+    FRESHNESS.note_arrival(1)
+    assert FRESHNESS.active()
+    FRESHNESS.set_enabled(None)
+    assert not FRESHNESS.enabled()
+
+
+def test_env_enables_without_override(monkeypatch):
+    monkeypatch.setenv("PATHWAY_FRESHNESS", "1")
+    assert FRESHNESS.enabled()
+    FRESHNESS.set_enabled(False)  # explicit run arg wins over env
+    assert not FRESHNESS.enabled()
+
+
+# ---------------------------------------------------------------------------
+# arrival -> epoch -> publish lifecycle
+
+
+def test_full_lifecycle_accrual_covers_lag():
+    FRESHNESS.set_enabled(True)
+    idx = _Idx("demo")
+    src = 41
+    FRESHNESS.note_arrival(src, n=3)
+    FRESHNESS.note_commit(src)
+    FRESHNESS.note_drain(src)
+    FRESHNESS.begin_epoch(5)
+    FRESHNESS.epoch_staged(5)
+    FRESHNESS.epoch_exec(5)
+    FRESHNESS.note_index_add(idx, (0, 1))
+    FRESHNESS.epoch_committed(5)
+    snap = FRESHNESS.snapshot()
+    assert snap["epochs"] == 1
+    assert snap["lag"]["count"] == 1
+    # the 4-plane split sums to the measured e2e lag by construction
+    assert snap["coverage"] == pytest.approx(1.0)
+    wm = snap["watermarks"]["demo"]
+    assert wm["shards"] == 2 and wm["wm_epoch"] == 5
+    assert FRESHNESS.visible_wm(idx)[0] == 5
+
+
+def test_epoch_without_arrivals_accrues_no_lag():
+    # replayed / timer-only epochs have no arrival window: the wm still
+    # advances (epoch number is exact) but no lag sample is recorded
+    FRESHNESS.set_enabled(True)
+    idx = _Idx("demo")
+    FRESHNESS.begin_epoch(7)
+    FRESHNESS.epoch_exec(7)
+    FRESHNESS.note_index_add(idx, (0,))
+    FRESHNESS.epoch_committed(7)
+    snap = FRESHNESS.snapshot()
+    assert snap["lag"]["count"] == 0
+    assert snap["watermarks"]["demo"]["wm_epoch"] == 7
+
+
+def test_standalone_add_publishes_immediately():
+    FRESHNESS.set_enabled(True)
+    idx = _Idx("solo")
+    FRESHNESS.note_index_add(idx, (0,))
+    epoch, wall = FRESHNESS.visible_wm(idx)
+    assert epoch == -1 and wall <= time.time()
+
+
+def test_empty_drain_is_ignored():
+    FRESHNESS.set_enabled(True)
+    FRESHNESS.note_drain(99)  # source never arrived anything
+    FRESHNESS.begin_epoch(0)
+    FRESHNESS.epoch_committed(0)
+    assert FRESHNESS.snapshot()["lag"]["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# watermark semantics
+
+
+def test_watermark_monotone_never_regresses():
+    FRESHNESS.set_enabled(True)
+    idx = _Idx("m")
+    FRESHNESS.publish(idx, 0, wall=100.0, epoch=5)
+    FRESHNESS.publish(idx, 0, wall=50.0, epoch=3)  # stale publish: no-op
+    assert FRESHNESS.visible_wm(idx) == (5, 100.0)
+    FRESHNESS.publish(idx, 0, wall=200.0, epoch=9)
+    assert FRESHNESS.visible_wm(idx) == (9, 200.0)
+
+
+def test_visible_wm_is_min_over_shards():
+    FRESHNESS.set_enabled(True)
+    idx = _Idx("m")
+    FRESHNESS.publish(idx, 0, wall=100.0, epoch=4)
+    FRESHNESS.publish(idx, 1, wall=300.0, epoch=8)
+    assert FRESHNESS.visible_wm(idx) == (4, 100.0)
+    # shard subset: the bound only covers what the query touched
+    assert FRESHNESS.visible_wm(idx, shards=(1,)) == (8, 300.0)
+    assert FRESHNESS.visible_wm(idx, shards=(7,)) is None
+
+
+def test_carry_over_grow_and_shrink():
+    FRESHNESS.set_enabled(True)
+    old = _Idx("gen", n_shards=2)
+    FRESHNESS.publish(old, 0, wall=100.0, epoch=4)
+    FRESHNESS.publish(old, 1, wall=120.0, epoch=6)
+    new = _Idx("gen", n_shards=4)  # spawn_like keeps the name
+    FRESHNESS.carry_over(old, new, generation=1)
+    snap = FRESHNESS.snapshot()["watermarks"]["gen"]
+    assert snap["shards"] == 4 and snap["generation"] == 1
+    # every new shard inherits the old index-level minimum
+    assert FRESHNESS.visible_wm(new) == (4, 100.0)
+    small = _Idx("gen", n_shards=1)
+    FRESHNESS.carry_over(new, small, generation=2)
+    snap = FRESHNESS.snapshot()["watermarks"]["gen"]
+    assert snap["shards"] == 1 and snap["generation"] == 2
+    assert FRESHNESS.visible_wm(small) == (4, 100.0)  # still never ahead
+
+
+def test_index_key_unnamed_indexes_get_stable_keys():
+    FRESHNESS.set_enabled(True)
+
+    class Bare:
+        name = None
+
+    a, b = Bare(), Bare()
+    ka, kb = FRESHNESS.index_key(a), FRESHNESS.index_key(b)
+    assert ka != kb
+    assert FRESHNESS.index_key(a) == ka  # stable across calls
+
+
+# ---------------------------------------------------------------------------
+# answer bounds
+
+
+def test_answer_bound_pinned_now():
+    FRESHNESS.set_enabled(True)
+    idx = _Idx("a")
+    FRESHNESS.publish(idx, 0, wall=100.0, epoch=5)
+    bound = FRESHNESS.answer_bound(idx, now=100.5)
+    assert bound == {
+        "staleness_ms": pytest.approx(500.0),
+        "visible_wm": 100.0,
+        "wm_epoch": 5,
+    }
+
+
+def test_answer_bound_defaults_to_conservative_min():
+    # index=None (the REST layer): min over every registered index —
+    # the reply never claims fresher than the stalest plane it may
+    # have touched
+    FRESHNESS.set_enabled(True)
+    FRESHNESS.publish(_Idx("a"), 0, wall=100.0, epoch=3)
+    FRESHNESS.publish(_Idx("b"), 0, wall=200.0, epoch=9)
+    bound = FRESHNESS.answer_bound(now=200.0)
+    assert bound["visible_wm"] == 100.0 and bound["wm_epoch"] == 3
+
+
+def test_observe_answer_per_tenant():
+    FRESHNESS.set_enabled(True)
+    idx = _Idx("a")
+    FRESHNESS.publish(idx, 0, wall=100.0, epoch=1)
+    FRESHNESS.observe_answer(idx, tenant="acme", now=100.1)
+    FRESHNESS.observe_answer(idx, tenant="acme", now=100.3)
+    FRESHNESS.observe_answer(idx, now=100.2)
+    answers = FRESHNESS.snapshot()["answers"]
+    assert answers["acme"]["count"] == 2
+    assert answers["acme"]["max_ms"] == pytest.approx(300.0, abs=1e-6)
+    assert answers["acme"]["mean_ms"] == pytest.approx(200.0, abs=1e-6)
+    assert answers[""]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# report renderer
+
+
+def test_render_freshness_empty():
+    text, state = render_freshness({})
+    assert state == "empty"
+    assert "PATHWAY_FRESHNESS" in text
+
+
+def test_render_freshness_report():
+    FRESHNESS.set_enabled(True)
+    FRESHNESS.configure(FreshnessConfig(slo_ms=1000.0))
+    idx = _Idx("demo")
+    src = 7
+    FRESHNESS.note_arrival(src)
+    FRESHNESS.note_commit(src)
+    FRESHNESS.note_drain(src)
+    FRESHNESS.begin_epoch(1)
+    FRESHNESS.epoch_staged(1)
+    FRESHNESS.epoch_exec(1)
+    FRESHNESS.note_index_add(idx, (0, 1))
+    FRESHNESS.epoch_committed(1)
+    FRESHNESS.observe_answer(idx, tenant="acme")
+    text, state = render_freshness({"freshness": FRESHNESS.snapshot()})
+    assert state == "green"
+    for plane in PLANES:
+        assert plane in text
+    assert "accrual covers" in text
+    assert "demo" in text and "acme" in text
+    assert "slo" in text
+
+
+def test_freshness_state_thresholds():
+    assert freshness_state(None) == "empty"
+    assert freshness_state({"lag": {"ewma_ms": 50.0}}) == "green"  # no slo
+    sample = lambda ewma: {"slo_ms": 100.0, "lag": {"ewma_ms": ewma}}
+    assert freshness_state(sample(50.0)) == "green"
+    assert freshness_state(sample(85.0)) == "yellow"
+    assert freshness_state(sample(120.0)) == "red"
+
+
+# ---------------------------------------------------------------------------
+# watchdog freshness rule
+
+
+def test_watchdog_freshness_burn_levels():
+    from pathway_tpu.internals.ledger import HealthWatchdog
+
+    wd = HealthWatchdog(interval_s=0.01)
+    for _ in range(2):
+        verdict = wd.evaluate_once(
+            {"t": 0.0, "freshness_lag_s": 0.09, "freshness_slo_s": 0.1}
+        )
+    assert verdict["planes"]["freshness"]["status"] == "yellow"
+    (rule,) = [r for r in verdict["rules"] if r["name"] == "freshness_slo"]
+    assert rule["value"] == pytest.approx(0.9)
+    for _ in range(2):
+        verdict = wd.evaluate_once(
+            {"t": 1.0, "freshness_lag_s": 0.2, "freshness_slo_s": 0.1}
+        )
+    assert verdict["planes"]["freshness"]["status"] == "red"
+
+
+def test_watchdog_freshness_breach_forecast():
+    from pathway_tpu.internals.ledger import HealthWatchdog
+
+    wd = HealthWatchdog(interval_s=0.01)
+    # lag ramping 10ms/s against a 100ms SLO from 50ms: ~5s to breach
+    d0 = wd._derive({"t": 0.0, "freshness_lag_s": 0.05, "freshness_slo_s": 0.1})
+    assert d0.get("freshness_time_to_breach_s") is None  # no rate yet
+    d1 = wd._derive({"t": 1.0, "freshness_lag_s": 0.06, "freshness_slo_s": 0.1})
+    ttb = d1["freshness_time_to_breach_s"]
+    assert ttb is not None and 0.0 < ttb < 60.0
+    # already past the SLO: forecast pins to zero
+    d2 = wd._derive({"t": 2.0, "freshness_lag_s": 0.2, "freshness_slo_s": 0.1})
+    assert d2["freshness_time_to_breach_s"] == 0.0
+
+
+def test_watchdog_live_sample_and_doctor_render():
+    from pathway_tpu.internals.ledger import HealthWatchdog, render_verdict
+
+    FRESHNESS.set_enabled(True)
+    FRESHNESS.configure(FreshnessConfig(slo_ms=1000.0))
+    idx = _Idx("doc")
+    src = 3
+    FRESHNESS.note_arrival(src)
+    FRESHNESS.note_commit(src)
+    FRESHNESS.note_drain(src)
+    FRESHNESS.begin_epoch(0)
+    FRESHNESS.epoch_staged(0)
+    FRESHNESS.epoch_exec(0)
+    FRESHNESS.note_index_add(idx, (0,))
+    FRESHNESS.epoch_committed(0)
+    wd = HealthWatchdog(interval_s=0.01)
+    sample = wd._live_sample()
+    assert "freshness_lag_s" in sample
+    assert sample["freshness_slo_s"] == pytest.approx(1.0)
+    verdict = wd.evaluate_once(dict(sample, t=0.0))
+    assert verdict["freshness"] is not None
+    text = render_verdict(verdict)
+    assert "freshness:" in text
+    assert "lag accrual:" in text
+    assert "doc" in text and "staleness" in text
+
+
+# ---------------------------------------------------------------------------
+# pw.run integration
+
+
+def test_run_records_freshness_context(monkeypatch):
+    monkeypatch.setenv("PATHWAY_ANALYZE_ONLY", "1")
+    t = pw.debug.table_from_markdown(
+        """
+        | x
+      1 | 1
+    """
+    )
+    pw.io.null.write(t.select(pw.this.x))
+    assert pw.run(freshness="slo=250ms") is None
+    from pathway_tpu.internals.parse_graph import G
+
+    assert G.run_context["freshness"] == {"slo_ms": 250.0}
+    assert G.run_context["watchdog_freshness"] is False
+
+
+def test_run_records_watchdog_freshness_intent(monkeypatch):
+    monkeypatch.setenv("PATHWAY_ANALYZE_ONLY", "1")
+    t = pw.debug.table_from_markdown(
+        """
+        | x
+      1 | 1
+    """
+    )
+    pw.io.null.write(t.select(pw.this.x))
+    assert pw.run(watchdog="interval=1,freshness_critical=1.0") is None
+    from pathway_tpu.internals.parse_graph import G
+
+    assert G.run_context["freshness"] is None
+    assert G.run_context["watchdog_freshness"] is True
+
+
+def test_run_rejects_malformed_freshness(monkeypatch):
+    monkeypatch.setenv("PATHWAY_ANALYZE_ONLY", "1")
+    t = pw.debug.table_from_markdown(
+        """
+        | x
+      1 | 1
+    """
+    )
+    pw.io.null.write(t.select(pw.this.x))
+    with pytest.raises(ValueError):
+        pw.run(freshness="wat")
+
+
+def test_run_installs_and_restores_plane():
+    t = pw.debug.table_from_markdown(
+        """
+        | x
+      1 | 1
+    """
+    )
+    pw.io.null.write(t.select(pw.this.x))
+    assert FRESHNESS._override is None
+    pw.run(freshness="slo=2s")
+    # restored after the run; the SLO stayed configured for reporting
+    assert FRESHNESS._override is None
+    assert not FRESHNESS.enabled()
+
+
+# ---------------------------------------------------------------------------
+# engine integration: streaming run with the plane on
+
+
+class _VecSchema(pw.Schema):
+    x: float
+    y: float
+
+
+class _VecSubject(pw.io.python.ConnectorSubject):
+    """Emits deterministic 2-d docs; resumes from the persisted offset."""
+
+    supports_offsets = True
+
+    def __init__(self, stop):
+        super().__init__()
+        self.stop = stop
+
+    def run(self):
+        start = int(self.offsets.get("next", 0))
+        for i in range(start, self.stop):
+            self.next_with_offset("next", i + 1, x=float(i + 1), y=float(i % 3))
+        self.commit()
+
+
+def _knn_run(stop, persistence_cfg=None):
+    """One streaming KNN run; returns (answers, freshness snapshot)."""
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    docs = pw.io.python.read(
+        _VecSubject(stop),
+        schema=_VecSchema,
+        autocommit_duration_ms=None,
+        persistent_id="docs",
+    )
+    docs = docs.select(
+        emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, docs.x, docs.y)
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+        | qx  | qy
+      1 | 1.0 | 1.0
+    """
+    )
+    queries = queries.select(
+        emb=pw.apply_with_type(lambda x, y: (x, y), pw.ANY, queries.qx, queries.qy)
+    )
+    index = KNNIndex(
+        docs.emb, docs, n_dimensions=2, reserved_space=32, distance_type="cosine"
+    )
+    res = index.get_nearest_items(queries.emb, k=2, with_distances=True)
+    runner = GraphRunner()
+    if persistence_cfg is not None:
+        runner.engine.persistence_config = persistence_cfg
+    cap, _names = runner.capture(res)
+    runner.run()
+    pw.clear_graph()
+    return sorted(cap.state.values()), FRESHNESS.snapshot()
+
+
+def test_streaming_run_publishes_watermark_and_lag():
+    FRESHNESS.set_enabled(True)
+    answers, snap = _knn_run(6)
+    assert answers  # the query answered
+    assert snap["lag"]["count"] >= 1
+    assert snap["epochs"] >= 1
+    # the 4-plane accrual split covers the measured end-to-end lag
+    assert snap["coverage"] is not None and snap["coverage"] >= 0.95
+    (wm,) = snap["watermarks"].values()
+    assert wm["wm_epoch"] >= 0
+    assert wm["staleness_ms"] >= 0.0
+    # any answer served off this run carries a bound derived from the wm
+    bound = FRESHNESS.answer_bound(now=wm["visible_wm"] + 0.25)
+    assert bound["wm_epoch"] == wm["wm_epoch"]
+    assert bound["staleness_ms"] == pytest.approx(250.0)
+
+
+def test_streaming_run_plane_off_records_nothing():
+    answers, snap = _knn_run(6)
+    assert answers
+    assert not FRESHNESS.active()
+    assert snap["lag"]["count"] == 0 and not snap["watermarks"]
+
+
+# ---------------------------------------------------------------------------
+# cross-feature: elastic 2 -> 4 -> 2 reshard keeps the watermark monotone
+
+
+def test_elastic_reshard_watermark_monotone_no_time_travel():
+    from pathway_tpu import elastic
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+    from pathway_tpu.parallel.mesh import resolve_mesh
+
+    elastic.reset_registry()
+    FRESHNESS.set_enabled(True)
+    rng = np.random.default_rng(5)
+    idx = DeviceKnnIndex(16, mesh=resolve_mesh(2), reserved_space=128)
+    idx.add_batch_arrays(
+        [f"k{i}" for i in range(200)],
+        rng.normal(size=(200, 16)).astype(np.float32),
+    )
+    q = rng.normal(size=(4, 16)).astype(np.float32)
+    h = elastic.register_handle(idx)
+    ref = h.search_batch(q, 5)
+    wm0 = FRESHNESS.visible_wm(idx)
+    assert wm0 is not None
+
+    def assert_monotone(prev, cur):
+        assert cur is not None
+        assert cur[0] >= prev[0], "watermark epoch went back in time"
+        assert cur[1] >= prev[1], "watermark wall went back in time"
+        return cur
+
+    try:
+        elastic.reshard(4, chunk_rows=64)
+        assert h.index.n_shards == 4
+        wm1 = assert_monotone(wm0, FRESHNESS.visible_wm(h.index))
+        snap = FRESHNESS.snapshot()
+        (entry,) = snap["watermarks"].values()
+        assert entry["generation"] == 1 and entry["shards"] == 4
+        assert h.search_batch(q, 5) == ref
+
+        elastic.reshard(2, chunk_rows=64)
+        assert h.index.n_shards == 2
+        wm2 = assert_monotone(wm1, FRESHNESS.visible_wm(h.index))
+        snap = FRESHNESS.snapshot()
+        (entry,) = snap["watermarks"].values()
+        assert entry["generation"] == 2 and entry["shards"] == 2
+        assert h.search_batch(q, 5) == ref
+        # the migration wall accrued to the freshness split
+        assert snap["planes"]["migration"]["events"] == 2
+        assert wm2[1] >= wm0[1]
+    finally:
+        elastic.reset_registry()
+
+
+def test_elastic_dual_answer_window_bound_is_conservative():
+    """During the dual-serve dedup window the answer bound is taken
+    over old AND new generation entries under one plane key — the
+    merged answers never claim fresher than the stalest generation
+    that contributed to them."""
+    from pathway_tpu import elastic
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+    from pathway_tpu.parallel.mesh import resolve_mesh
+
+    elastic.reset_registry()
+    FRESHNESS.set_enabled(True)
+    rng = np.random.default_rng(6)
+    idx = DeviceKnnIndex(8, mesh=resolve_mesh(2), reserved_space=64)
+    idx.add_batch_arrays(
+        [f"k{i}" for i in range(60)], rng.normal(size=(60, 8)).astype(np.float32)
+    )
+    h = elastic.register_handle(idx)
+    old = h.index
+    wm_before = FRESHNESS.visible_wm(old)
+    assert wm_before is not None
+    try:
+        elastic.reshard(4, chunk_rows=32)
+        reshard_done = time.time()
+        # simulate the cutover dual-serve window: merged old+new answers
+        h._dual = old
+        q = rng.normal(size=(2, 8)).astype(np.float32)
+        rows = h.search_batch(q, 4)
+        keys_seen = [k for row in rows for k, _ in row]
+        assert len(keys_seen) == len(set(keys_seen)), "double answer leaked"
+        wm_during = FRESHNESS.visible_wm(h.index)
+        bound_during = FRESHNESS.answer_bound(h.index, now=reshard_done + 1.0)
+        h._dual = None
+        # same plane key spans both generations: during the window the
+        # bound never claims fresher than what migration carried over
+        # from the old generation, and never regresses either
+        assert wm_before[1] <= wm_during[1] <= reshard_done
+        assert wm_during[0] >= wm_before[0]
+        assert bound_during["staleness_ms"] >= (reshard_done + 1.0 - wm_during[1]) * 1000.0 - 1e-6
+        assert bound_during["wm_epoch"] >= wm_before[0]
+    finally:
+        h._dual = None
+        elastic.reset_registry()
+
+
+# ---------------------------------------------------------------------------
+# cross-feature: chaos recovery replays to the exact pre-kill watermark
+
+
+def test_recovery_readvances_watermark_exactly():
+    events_store: dict = {}
+    backend = pw.persistence.Backend.mock(events_store)
+    cfg = pw.persistence.Config.simple_config(backend)
+
+    FRESHNESS.set_enabled(True)
+    answers1, snap1 = _knn_run(6, persistence_cfg=cfg)
+    (wm1,) = snap1["watermarks"].values()
+
+    # "crash": the process state is gone, only the persisted store
+    # survives. A fresh plane + the same program on the same store.
+    FRESHNESS.reset()
+    FRESHNESS.set_enabled(True)
+    answers2, snap2 = _knn_run(6, persistence_cfg=cfg)
+    (wm2,) = snap2["watermarks"].values()
+
+    # replayed epochs re-advance the watermark to the EXACT pre-kill
+    # epoch (the wall restarts at recovery time — arrival stamps do not
+    # survive a crash, so no phantom lag is accrued either)
+    assert wm2["wm_epoch"] == wm1["wm_epoch"]
+    assert wm2["shards"] == wm1["shards"]
+    assert snap2["lag"]["count"] == 0  # replay is not fresh ingest
+
+    # byte-identical answers...
+    assert answers2 == answers1
+
+    # ...carrying identical staleness bounds as a function of their
+    # watermark: pinning `now` at the same offset past each run's wm
+    # wall yields the same bound (wall itself differs — recovery time)
+    bound2 = FRESHNESS.answer_bound(now=wm2["visible_wm"] + 0.5)
+    assert bound2["wm_epoch"] == wm1["wm_epoch"]
+    assert bound2["staleness_ms"] == pytest.approx(500.0)
+
+
+# ---------------------------------------------------------------------------
+# /metrics, /status, journal surfaces
+
+
+def test_metrics_and_status_blocks_appear_after_activity():
+    from pathway_tpu.internals.http_monitoring import MonitoringHttpServer
+    from pathway_tpu.internals.monitoring import StatsMonitor
+
+    server = MonitoringHttpServer(StatsMonitor(), port=0)
+    assert "pathway_freshness" not in server._prometheus()
+    assert "freshness" not in server._status()
+
+    FRESHNESS.set_enabled(True)
+    FRESHNESS.configure(FreshnessConfig(slo_ms=500.0))
+    idx = _Idx("metricsidx")
+    src = 11
+    FRESHNESS.note_arrival(src)
+    FRESHNESS.note_commit(src)
+    FRESHNESS.note_drain(src)
+    FRESHNESS.begin_epoch(0)
+    FRESHNESS.epoch_staged(0)
+    FRESHNESS.epoch_exec(0)
+    FRESHNESS.note_index_add(idx, (0,))
+    FRESHNESS.epoch_committed(0)
+    FRESHNESS.observe_answer(idx, tenant="acme")
+
+    body = server._prometheus()
+    assert 'pathway_freshness_seconds{plane="ingest_queue"}' in body
+    assert "pathway_freshness_visibility_lag_seconds_bucket" in body
+    assert 'le="+Inf"' in body
+    assert 'pathway_freshness_staleness_seconds{index="metricsidx"' in body
+    assert "pathway_freshness_slo_seconds 0.5" in body
+    assert 'pathway_freshness_answer_staleness_seconds{tenant="acme"}' in body
+
+    status = server._status()
+    assert '"freshness"' in status
+    import json
+
+    fresh = json.loads(status)["freshness"]
+    assert fresh["slo_ms"] == 500.0
+    assert "metricsidx" in fresh["watermarks"]
+
+
+def test_journal_sample_carries_freshness_block(tmp_path):
+    from pathway_tpu.perf.journal import MetricsJournal
+
+    j = MetricsJournal(str(tmp_path))
+    j.sample()
+    assert "freshness" not in (j.tail(1) or [{}])[-1]
+
+    FRESHNESS.set_enabled(True)
+    FRESHNESS.note_index_add(_Idx("j"), (0,))
+    j.sample()
+    rec = j.tail(1)[-1]
+    assert rec["freshness"]["watermarks"]["j"]["shards"] == 1
+
+
+def test_top_renders_freshness_row():
+    from pathway_tpu.perf.top import render_top
+
+    FRESHNESS.set_enabled(True)
+    FRESHNESS.configure(FreshnessConfig(slo_ms=1000.0))
+    src = 13
+    FRESHNESS.note_arrival(src)
+    FRESHNESS.note_commit(src)
+    FRESHNESS.note_drain(src)
+    FRESHNESS.begin_epoch(0)
+    FRESHNESS.epoch_staged(0)
+    FRESHNESS.epoch_exec(0)
+    FRESHNESS.note_index_add(_Idx("t"), (0,))
+    FRESHNESS.epoch_committed(0)
+    data = {"freshness": FRESHNESS.snapshot()}
+    text, state = render_top(data)
+    assert "freshness" in text
+    assert state in ("green", "yellow", "red")
+
+
+def test_perf_diff_grades_freshness_lower_is_better():
+    from pathway_tpu.perf.snapshot import _direction
+
+    assert _direction("freshness_visibility_lag_p99_ms", "ms") == "lower"
+    assert _direction("freshness_visibility_lag_p50", "") == "lower"
+    assert _direction("staleness_bound", "") == "lower"
+    assert _direction("freshness_accrual_coverage", "") == "two_sided"
+
+
+# ---------------------------------------------------------------------------
+# REST serving: every served answer carries the staleness bound
+
+
+def _rest_roundtrip(payload):
+    """rest_connector roundtrip returning (json_body, response_headers)."""
+    import json
+    import socket
+    import threading
+    import urllib.request
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    class _QuerySchema(pw.Schema):
+        value: int
+
+    queries, response_writer = pw.io.http.rest_connector(
+        host="127.0.0.1",
+        port=port,
+        schema=_QuerySchema,
+        delete_completed_queries=False,
+    )
+    response_writer(queries.select(result=pw.this.value * 2))
+
+    out: dict = {}
+    errors: list = []
+
+    def client():
+        try:
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/",
+                        data=json.dumps(payload).encode(),
+                        headers={"Content-Type": "application/json"},
+                        method="POST",
+                    )
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        out["body"] = json.loads(resp.read().decode())
+                        out["headers"] = dict(resp.headers)
+                    break
+                except Exception:
+                    time.sleep(0.3)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+        finally:
+            runner.engine.stop()
+
+    runner = GraphRunner()
+    for table, sink in list(pw.parse_graph.outputs):
+        build = sink.get("build")
+        if build is not None:
+            build(runner, table)
+    for spec in list(pw.parse_graph.subscriptions):
+        runner.subscribe(
+            spec["table"],
+            on_change=spec.get("on_change"),
+            on_time_end=spec.get("on_time_end"),
+            on_end=spec.get("on_end"),
+        )
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    runner.run()
+    t.join(timeout=30)
+    pw.clear_graph()
+    assert not errors, errors
+    return out["body"], out["headers"]
+
+
+def test_rest_reply_carries_staleness_header():
+    FRESHNESS.set_enabled(True)
+    # a published watermark before the query arrives: the reply's bound
+    # is now − that watermark, conservative over every registered index
+    FRESHNESS.publish(_Idx("served"), 0, wall=time.time() - 0.2, epoch=3)
+    body, headers = _rest_roundtrip({"value": 21})
+    assert body == 42
+    staleness = float(headers["X-Pathway-Freshness-Ms"])
+    assert staleness >= 200.0 - 1e-6
+    answers = FRESHNESS.snapshot()["answers"]
+    assert sum(a["count"] for a in answers.values()) >= 1
+
+
+def test_rest_reply_plane_off_no_header():
+    body, headers = _rest_roundtrip({"value": 5})
+    assert body == 10
+    assert "X-Pathway-Freshness-Ms" not in headers

@@ -511,6 +511,45 @@ def test_pwl015_silent_when_budget_fits_both(monkeypatch):
     assert "PWL015" not in proc.stdout
 
 
+def test_freshness_unmeasurable_warns_pwl024(monkeypatch):
+    """A streaming run arming the watchdog's freshness thresholds with
+    the freshness plane off: PWL024 warns (exit 0), nonzero only under
+    --fail-on=warn — and PWL021 stays quiet (the fixture keeps the
+    chip ledger on)."""
+    monkeypatch.delenv("PATHWAY_FRESHNESS", raising=False)
+    fixture = os.path.join(FIXTURES, "freshness_unmeasurable.py")
+    proc = _analyze_cli(fixture)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL024" in proc.stdout
+    assert "PWL021" not in proc.stdout
+    assert "warning" in proc.stdout
+
+    proc = _analyze_cli(fixture, "--fail-on=warn")
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+
+
+def test_pwl024_json_carries_intent(monkeypatch):
+    monkeypatch.delenv("PATHWAY_FRESHNESS", raising=False)
+    proc = _analyze_cli(
+        os.path.join(FIXTURES, "freshness_unmeasurable.py"), "--json"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    (diag,) = [d for d in payload["diagnostics"] if d["rule"] == "PWL024"]
+    assert diag["severity"] == "warning"
+    assert diag["detail"]["watchdog_freshness"] is True
+    assert diag["detail"]["freshness"] is None
+
+
+def test_pwl024_freshness_env_silences_cli(monkeypatch):
+    """The fix the diagnostic suggests (PATHWAY_FRESHNESS=1) makes the
+    same program lint clean."""
+    monkeypatch.setenv("PATHWAY_FRESHNESS", "1")
+    proc = _analyze_cli(os.path.join(FIXTURES, "freshness_unmeasurable.py"))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PWL024" not in proc.stdout
+
+
 # ---------------------------------------------------------------------------
 # pathway doctor (internals/ledger.py HealthWatchdog + cli.py doctor)
 # ---------------------------------------------------------------------------

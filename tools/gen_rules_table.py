@@ -217,6 +217,19 @@ DESCRIPTIONS: dict[str, str] = {
         "loads. Use the layer-skip self-draft (`draft_layers=`, zero extra "
         "weights), shrink `pages=`, or raise the budget"
     ),
+    "PWL024": (
+        "freshness SLO configured but unmeasurable, two arms. (1) a "
+        "streaming run arms the watchdog's `freshness_warn`/"
+        "`freshness_critical` thresholds with the freshness plane "
+        "(`pw.run(freshness=)` / `PATHWAY_FRESHNESS`) off: the "
+        "`freshness_slo` watch rule reads the plane's visibility-lag EWMA, "
+        "so with no watermarks measured it can never fire. (2) the plane "
+        "is on but `slo=` is tighter than the floor the pipeline itself "
+        "imposes (the connectors' `autocommit_duration_ms` plus the "
+        "serving batcher's `batch_window_ms` linger) — every answer "
+        "breaches by construction. Raise the SLO past the floor or shrink "
+        "the commit/linger windows"
+    ),
 }
 
 
